@@ -1,0 +1,40 @@
+"""alphafold2_tpu — a TPU-native (JAX/XLA/Pallas/pjit) protein structure framework.
+
+Re-designed from scratch with the capabilities of alphafold2-pytorch v0.0.28
+(the lucidrains / Eric Alcaide speculative AlphaFold2 reimplementation):
+MSA + sequence dual-track axial-attention trunk -> distogram head ->
+classical-geometry 3D realization (MDS + mirror fix) -> equivariant refinement.
+
+The compute path is pure JAX (jit / pjit / shard_map / Pallas); parallelism is
+expressed over a `jax.sharding.Mesh` with XLA collectives rather than NCCL.
+"""
+
+from alphafold2_tpu.constants import (
+    MAX_NUM_MSA,
+    NUM_AMINO_ACIDS,
+    NUM_EMBEDDS_TR,
+    DISTOGRAM_BUCKETS,
+)
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # lazy import so geometry-only use doesn't pull in flax/the model stack
+    if name == "Alphafold2":
+        try:
+            from alphafold2_tpu.models.alphafold2 import Alphafold2
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"module {__name__!r} attribute {name!r} unavailable: {e}"
+            ) from e
+        return Alphafold2
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+__all__ = [
+    "Alphafold2",
+    "MAX_NUM_MSA",
+    "NUM_AMINO_ACIDS",
+    "NUM_EMBEDDS_TR",
+    "DISTOGRAM_BUCKETS",
+]

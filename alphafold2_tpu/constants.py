@@ -1,0 +1,89 @@
+"""Library-wide constants.
+
+Parity: reference `alphafold2_pytorch/constants.py:5-8`. The reference also
+pins a global torch DEVICE (`constants.py:12-13`); JAX needs no such global —
+device placement is handled by jit/pjit and shardings.
+"""
+
+import numpy as np
+
+# maximum number of rows of a multiple sequence alignment the row-position
+# embedding table supports
+MAX_NUM_MSA = 20
+
+# 20 standard amino acids + 1 pad/unknown token
+NUM_AMINO_ACIDS = 21
+
+# width of precomputed language-model residue embeddings (ESM-1b final layer)
+NUM_EMBEDDS_TR = 1280
+
+# number of distance buckets of the distogram head (AlphaFold1-style)
+DISTOGRAM_BUCKETS = 37
+
+# distogram bucket boundaries in Angstroms (reference utils.py:29)
+DISTANCE_THRESHOLDS = np.linspace(2.0, 20.0, DISTOGRAM_BUCKETS)
+
+# number of atom slots per residue in the dense atom representation
+# (sidechainnet layout: N, CA, C, O, then up to 10 side-chain heavy atoms)
+NUM_COORDS_PER_RES = 14
+
+# padding value used in dense atom clouds
+GLOBAL_PAD_CHAR = 0
+
+# carbonyl-group build constants used when placing the backbone oxygen
+# (reference utils.py:20-21 fallback values)
+BOND_LEN_C_O = 1.229
+BOND_ANG_CA_C_O = 2.0944
+
+# --- amino-acid vocabulary -------------------------------------------------
+#
+# Our own, explicitly defined vocabulary (the reference defers to
+# sidechainnet's ProteinVocabulary, reference utils.py:11-16). Index 20 is the
+# pad/unknown token. Heavy-atom counts include the 4 backbone atoms
+# (N, CA, C, O).
+
+AA_ORDER = "ACDEFGHIKLMNPQRSTVWY"  # alphabetical one-letter codes, ids 0..19
+PAD_TOKEN_ID = 20
+
+# total heavy atoms per residue (backbone 4 + side chain)
+AA_NUM_HEAVY_ATOMS = {
+    "A": 5,   # Ala
+    "C": 6,   # Cys
+    "D": 8,   # Asp
+    "E": 9,   # Glu
+    "F": 11,  # Phe
+    "G": 4,   # Gly
+    "H": 10,  # His
+    "I": 8,   # Ile
+    "K": 9,   # Lys
+    "L": 8,   # Leu
+    "M": 8,   # Met
+    "N": 8,   # Asn
+    "P": 7,   # Pro
+    "Q": 9,   # Gln
+    "R": 11,  # Arg
+    "S": 6,   # Ser
+    "T": 7,   # Thr
+    "V": 7,   # Val
+    "W": 14,  # Trp
+    "Y": 12,  # Tyr
+}
+
+# atom-count lookup table indexed by token id; pad rows get 0 atoms
+ATOMS_PER_TOKEN = np.array(
+    [AA_NUM_HEAVY_ATOMS[aa] for aa in AA_ORDER] + [0], dtype=np.int32
+)
+
+
+def aa_to_tokens(seq: str) -> np.ndarray:
+    """Encode a one-letter amino-acid string into integer tokens."""
+    lookup = {aa: i for i, aa in enumerate(AA_ORDER)}
+    return np.array([lookup.get(c.upper(), PAD_TOKEN_ID) for c in seq], dtype=np.int32)
+
+
+def tokens_to_aa(tokens) -> str:
+    """Decode integer tokens into a one-letter amino-acid string."""
+    out = []
+    for t in np.asarray(tokens).reshape(-1):
+        out.append(AA_ORDER[int(t)] if 0 <= int(t) < len(AA_ORDER) else "X")
+    return "".join(out)

@@ -1,0 +1,40 @@
+"""Geometry / structure post-processing layer.
+
+Pure-jnp, jit-friendly re-design of the reference geometry stack
+(`alphafold2_pytorch/utils.py`): distogram centering, stress-majorization MDS,
+dihedrals + chirality fix, Kabsch alignment, structure metrics, atom masks,
+NeRF side-chain building, and host-side PDB I/O.
+
+Unlike the reference there is no torch/numpy dual-backend dispatch layer
+(`utils.py:33-76`): every function is a single jnp implementation that jits,
+vmaps, and differentiates; numpy arrays are accepted and converted on entry.
+"""
+
+from alphafold2_tpu.geometry.distogram import center_distogram
+from alphafold2_tpu.geometry.mds import mds, mdscaling, MDScaling
+from alphafold2_tpu.geometry.dihedral import get_dihedral, calc_phis
+from alphafold2_tpu.geometry.kabsch import kabsch, Kabsch
+from alphafold2_tpu.geometry.metrics import rmsd, gdt, tmscore, RMSD, GDT, TMscore
+from alphafold2_tpu.geometry.masks import scn_backbone_mask, scn_cloud_mask
+from alphafold2_tpu.geometry.sidechain import nerf, sidechain_container
+
+__all__ = [
+    "center_distogram",
+    "mds",
+    "mdscaling",
+    "MDScaling",
+    "get_dihedral",
+    "calc_phis",
+    "kabsch",
+    "Kabsch",
+    "rmsd",
+    "gdt",
+    "tmscore",
+    "RMSD",
+    "GDT",
+    "TMscore",
+    "scn_backbone_mask",
+    "scn_cloud_mask",
+    "nerf",
+    "sidechain_container",
+]

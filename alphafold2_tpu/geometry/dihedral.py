@@ -1,0 +1,70 @@
+"""Dihedral angles and backbone chirality (phi) statistics.
+
+Parity: reference `alphafold2_pytorch/utils.py:401-508`
+(`get_dihedral_torch`, `calc_phis_torch`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def get_dihedral(c1, c2, c3, c4):
+    """Dihedral angle (radians) between planes (c1,c2,c3) and (c2,c3,c4).
+
+    atan2 formulation (polymer-physics convention), matching reference
+    `utils.py:401-417`. Inputs are (..., 3); broadcasting is supported.
+    """
+    c1, c2, c3, c4 = map(jnp.asarray, (c1, c2, c3, c4))
+    u1 = c2 - c1
+    u2 = c3 - c2
+    u3 = c4 - c3
+
+    y = jnp.sum(
+        (jnp.linalg.norm(u2, axis=-1, keepdims=True) * u1) * jnp.cross(u2, u3), axis=-1
+    )
+    x = jnp.sum(jnp.cross(u1, u2) * jnp.cross(u2, u3), axis=-1)
+    return jnp.arctan2(y, x)
+
+
+def calc_phis(pred_coords, N_mask, CA_mask, C_mask=None, prop: bool = True):
+    """Backbone phi angles (or the fraction that are negative).
+
+    Used for chirality detection: a correctly-handed backbone has mostly
+    negative phi. Parity: reference `utils.py:437-471` — including the
+    gradient stop (reference detaches before the angle computation,
+    `utils.py:454`); here `stop_gradient` keeps everything on-device instead
+    of forcing a GPU->CPU sync.
+
+    Args:
+      pred_coords: (batch, 3, P) coordinates over P backbone points.
+      N_mask, CA_mask, C_mask: (P,) boolean masks selecting N / C-alpha /
+        C-term atoms. Must be *static* (numpy) so shapes stay static under
+        jit. If C_mask is None it is ~(N | CA).
+      prop: return the per-structure fraction of negative phis.
+
+    Returns: (batch,) proportions if prop else (batch, L-1) phi angles.
+    """
+    coords = jnp.transpose(jax.lax.stop_gradient(jnp.asarray(pred_coords)), (0, 2, 1))
+
+    N_mask = np.asarray(N_mask).reshape(-1).astype(bool)
+    CA_mask = np.asarray(CA_mask).reshape(-1).astype(bool)
+    if C_mask is None:
+        C_mask = ~(N_mask | CA_mask)
+    else:
+        C_mask = np.asarray(C_mask).reshape(-1).astype(bool)
+
+    n_terms = coords[:, N_mask]
+    c_alphas = coords[:, CA_mask]
+    c_terms = coords[:, C_mask]
+
+    # phi_i between planes (C_{i-1}, N_i, CA_i) and (N_i, CA_i, C_i)
+    phis = get_dihedral(
+        c_terms[:, :-1], n_terms[:, 1:], c_alphas[:, 1:], c_terms[:, 1:]
+    )
+
+    if prop:
+        return jnp.mean((phis < 0.0).astype(jnp.float32), axis=-1)
+    return phis

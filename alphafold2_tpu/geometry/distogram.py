@@ -1,0 +1,118 @@
+"""Distogram -> distance-matrix centering.
+
+Parity: reference `alphafold2_pytorch/utils.py:260-302`
+(`center_distogram_torch`). Converts a per-pair distance *distribution* over
+buckets into a central distance estimate plus confidence weights used by MDS.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from alphafold2_tpu.constants import DISTANCE_THRESHOLDS
+
+
+def _bin_centers(bins: jnp.ndarray) -> jnp.ndarray:
+    """Centers of distance buckets given their upper thresholds.
+
+    Matches reference `utils.py:273-275`: shift thresholds down by half a bin
+    width, clamp the first center to 1.5 A, and push the last (catch-all
+    "far") bucket to 1.33x the final threshold.
+    """
+    centers = bins - 0.5 * (bins[2] - bins[1])
+    centers = centers.at[0].set(1.5)
+    centers = centers.at[-1].set(1.33 * bins[-1])
+    return centers
+
+
+def center_distogram(
+    distogram,
+    bins=None,
+    center: str = "mean",
+    wide: str = "std",
+):
+    """Central distance estimate + confidence weights from a distogram.
+
+    Args:
+      distogram: (batch, N, N, B) probabilities over B distance buckets
+        (softmax the logits first).
+      bins: (B,) bucket thresholds; defaults to linspace(2, 20, 37).
+      center: "mean" (expectation over bin centers) or "median"
+        (bucket whose CDF crosses 0.5).
+      wide: dispersion measure for the weights — "std", "var", or "none".
+
+    Returns:
+      central: (batch, N, N) distances, zero diagonal.
+      weights: (batch, N, N) confidence in [0, 1]; 0 where the central
+        estimate falls in the catch-all "far" bucket.
+    """
+    distogram = jnp.asarray(distogram)
+    if distogram.ndim == 3:
+        distogram = distogram[None]
+    bins = jnp.asarray(DISTANCE_THRESHOLDS if bins is None else bins, dtype=distogram.dtype)
+
+    centers = _bin_centers(bins)
+    n = distogram.shape[-2]
+
+    if center == "median":
+        cum = jnp.cumsum(distogram, axis=-1)
+        # index of the first bucket whose CDF reaches 0.5 (reference
+        # utils.py:279-282 via searchsorted)
+        idx = jnp.sum((cum < 0.5).astype(jnp.int32), axis=-1)
+        idx = jnp.minimum(idx, centers.shape[0] - 1)
+        central = centers[idx]
+    elif center == "mean":
+        central = jnp.einsum("...b,b->...", distogram, centers)
+    else:
+        raise ValueError(f"unknown center mode {center!r}")
+
+    # pairs predicted beyond the last real threshold carry no signal
+    # (reference utils.py:286)
+    mask = (central <= bins[-2]).astype(distogram.dtype)
+
+    # the self-distance is exactly zero (reference utils.py:288-290)
+    eye = jnp.eye(n, dtype=bool)
+    central = jnp.where(eye[None], 0.0, central)
+
+    if wide == "var":
+        dispersion = jnp.einsum(
+            "...b,...b->...", distogram, (centers - central[..., None]) ** 2
+        )
+    elif wide == "std":
+        dispersion = jnp.sqrt(
+            jnp.einsum(
+                "...b,...b->...", distogram, (centers - central[..., None]) ** 2
+            )
+        )
+    else:
+        dispersion = jnp.zeros_like(central)
+
+    weights = mask / (1.0 + dispersion)
+    weights = jnp.nan_to_num(weights, nan=0.0)
+    return central, weights
+
+
+def bucketize_distances(coords, mask=None, bins=None, ignore_index: int = -100):
+    """Ground-truth bucketized distance labels for distogram training.
+
+    Parity: reference `train_pre.py:35-40` (`get_bucketed_distance_matrix`).
+
+    Args:
+      coords: (batch, N, 3) C-alpha coordinates.
+      mask: (batch, N) bool validity mask.
+      bins: (B,) bucket thresholds.
+      ignore_index: label for masked-out pairs.
+
+    Returns: (batch, N, N) int32 bucket labels in [0, B-1] or ignore_index.
+    """
+    coords = jnp.asarray(coords)
+    bins = jnp.asarray(DISTANCE_THRESHOLDS if bins is None else bins, dtype=coords.dtype)
+    d2 = jnp.sum((coords[:, :, None, :] - coords[:, None, :, :]) ** 2, axis=-1)
+    dist = jnp.sqrt(jnp.maximum(d2, 1e-12))
+    labels = jnp.searchsorted(bins[:-1], dist).astype(jnp.int32)
+    if mask is not None:
+        mask = jnp.asarray(mask, dtype=bool)
+        pair_mask = mask[:, :, None] & mask[:, None, :]
+        labels = jnp.where(pair_mask, labels, np.int32(ignore_index))
+    return labels

@@ -1,0 +1,50 @@
+"""Atom-presence masks for dense (residue, atom-slot) coordinate clouds.
+
+Parity: reference `alphafold2_pytorch/utils.py:154-189` (`scn_cloud_mask`,
+`scn_backbone_mask`). The reference fills the cloud mask with a Python loop
+over residues (`utils.py:164-168`); here it is a vectorized table lookup that
+jits.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from alphafold2_tpu.constants import ATOMS_PER_TOKEN, NUM_COORDS_PER_RES
+
+
+def scn_cloud_mask(seq_tokens, boolean: bool = True, n_atoms: int = NUM_COORDS_PER_RES):
+    """Per-residue atom-slot presence mask.
+
+    Args:
+      seq_tokens: (batch, L) integer amino-acid tokens (our vocabulary,
+        `constants.AA_ORDER`).
+      boolean: return bool mask (True) or indices (False).
+
+    Returns: (batch, L, n_atoms) bool — slot s is present iff
+      s < heavy_atom_count(residue).
+    """
+    seq_tokens = jnp.asarray(seq_tokens)
+    counts = jnp.asarray(ATOMS_PER_TOKEN)[seq_tokens]  # (batch, L)
+    mask = jnp.arange(n_atoms)[None, None, :] < counts[..., None]
+    if boolean:
+        return mask
+    return jnp.argwhere(mask)
+
+
+def scn_backbone_mask(seq_tokens, boolean: bool = True, l_aa: int = NUM_COORDS_PER_RES):
+    """(N_mask, CA_mask) over a flattened (L * l_aa) atom axis.
+
+    N is atom 0 of each residue, C-alpha is atom 1 (reference
+    `utils.py:180-189`). Returned as numpy so they can serve as *static*
+    masks for `calc_phis` under jit.
+    """
+    seq_tokens = np.asarray(seq_tokens)
+    length = seq_tokens.shape[-1] * l_aa
+    pos = np.arange(length)
+    N_mask = pos % l_aa == 0
+    CA_mask = pos % l_aa == 1
+    if boolean:
+        return N_mask, CA_mask
+    return np.nonzero(N_mask)[0], np.nonzero(CA_mask)[0]

@@ -1,0 +1,178 @@
+"""Host-side PDB I/O (pure Python — no mdtraj dependency).
+
+Parity: reference `alphafold2_pytorch/utils.py:83-149` (`download_pdb`,
+`clean_pdb`, `custom2pdb`), which shells out to curl and uses mdtraj. This is
+deliberately a thin host-side plugin boundary: nothing here touches the TPU
+compute path.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from alphafold2_tpu.constants import AA_ORDER
+
+# standard 3-letter residue names for our vocabulary
+AA_THREE = {
+    "A": "ALA", "C": "CYS", "D": "ASP", "E": "GLU", "F": "PHE",
+    "G": "GLY", "H": "HIS", "I": "ILE", "K": "LYS", "L": "LEU",
+    "M": "MET", "N": "ASN", "P": "PRO", "Q": "GLN", "R": "ARG",
+    "S": "SER", "T": "THR", "V": "VAL", "W": "TRP", "Y": "TYR",
+}
+THREE_TO_ONE = {v: k for k, v in AA_THREE.items()}
+
+BACKBONE_ATOM_NAMES = ("N", "CA", "C", "O")
+
+
+@dataclass
+class PdbAtom:
+    serial: int
+    name: str
+    res_name: str
+    chain_id: str
+    res_seq: int
+    xyz: np.ndarray
+    element: str = ""
+
+
+@dataclass
+class PdbStructure:
+    atoms: List[PdbAtom] = field(default_factory=list)
+
+    def coords(self) -> np.ndarray:
+        return np.stack([a.xyz for a in self.atoms]) if self.atoms else np.zeros((0, 3))
+
+    def select_chain(self, chain_id: str) -> "PdbStructure":
+        return PdbStructure([a for a in self.atoms if a.chain_id == chain_id])
+
+    def select_atoms(self, names) -> "PdbStructure":
+        names = set(names)
+        return PdbStructure([a for a in self.atoms if a.name in names])
+
+    def chains(self) -> List[str]:
+        seen = []
+        for a in self.atoms:
+            if a.chain_id not in seen:
+                seen.append(a.chain_id)
+        return seen
+
+    def sequence(self) -> str:
+        seq, last = [], None
+        for a in self.atoms:
+            key = (a.chain_id, a.res_seq)
+            if key != last:
+                seq.append(THREE_TO_ONE.get(a.res_name, "X"))
+                last = key
+        return "".join(seq)
+
+
+def parse_pdb(path: str) -> PdbStructure:
+    """Parse ATOM records from a PDB file (first model only)."""
+    atoms: List[PdbAtom] = []
+    with open(path) as fh:
+        for line in fh:
+            if line.startswith("ENDMDL"):
+                break
+            if not line.startswith("ATOM"):
+                continue
+            atoms.append(
+                PdbAtom(
+                    serial=int(line[6:11]),
+                    name=line[12:16].strip(),
+                    res_name=line[17:20].strip(),
+                    chain_id=line[21].strip() or "A",
+                    res_seq=int(line[22:26]),
+                    xyz=np.array(
+                        [float(line[30:38]), float(line[38:46]), float(line[46:54])]
+                    ),
+                    element=line[76:78].strip(),
+                )
+            )
+    return PdbStructure(atoms)
+
+
+def write_pdb(path: str, structure: PdbStructure) -> str:
+    """Write ATOM records to a PDB file."""
+    with open(path, "w") as fh:
+        for a in structure.atoms:
+            name = a.name if len(a.name) == 4 else f" {a.name:<3s}"
+            fh.write(
+                f"ATOM  {a.serial:5d} {name}{'':1s}{a.res_name:>3s} "
+                f"{a.chain_id:1s}{a.res_seq:4d}    "
+                f"{a.xyz[0]:8.3f}{a.xyz[1]:8.3f}{a.xyz[2]:8.3f}"
+                f"{1.00:6.2f}{0.00:6.2f}          {a.element:>2s}\n"
+            )
+        fh.write("END\n")
+    return path
+
+
+def coords_to_structure(
+    coords,
+    sequence: Optional[str] = None,
+    atom_names=BACKBONE_ATOM_NAMES[:3],
+    chain_id: str = "A",
+) -> PdbStructure:
+    """Build a PdbStructure from (L, A, 3) or (L*A, 3) coordinates.
+
+    Each residue gets `len(atom_names)` atoms; `sequence` is a one-letter
+    string (defaults to poly-alanine).
+    """
+    coords = np.asarray(coords, dtype=np.float64).reshape(-1, 3)
+    n_per_res = len(atom_names)
+    length = coords.shape[0] // n_per_res
+    if sequence is None:
+        sequence = "A" * length
+    atoms = []
+    serial = 1
+    for i in range(length):
+        res3 = AA_THREE.get(sequence[i].upper(), "ALA")
+        for j, an in enumerate(atom_names):
+            atoms.append(
+                PdbAtom(
+                    serial=serial,
+                    name=an,
+                    res_name=res3,
+                    chain_id=chain_id,
+                    res_seq=i + 1,
+                    xyz=coords[i * n_per_res + j],
+                    element=an[0],
+                )
+            )
+            serial += 1
+    return PdbStructure(atoms)
+
+
+def coords_to_pdb(path: str, coords, sequence: Optional[str] = None, **kwargs) -> str:
+    """Convenience: coordinates -> .pdb file (reference `custom2pdb` analog,
+    without the RCSB scaffold download)."""
+    return write_pdb(path, coords_to_structure(coords, sequence, **kwargs))
+
+
+def download_pdb(name: str, route: str) -> str:
+    """Download a PDB entry from RCSB (reference `utils.py:83-91`).
+
+    Network access may be unavailable; raises RuntimeError on failure instead
+    of silently writing an empty file.
+    """
+    url = f"https://files.rcsb.org/download/{name}.pdb"
+    result = subprocess.run(
+        ["curl", "-sf", "-o", route, url], capture_output=True, timeout=120
+    )
+    if result.returncode != 0 or not os.path.exists(route):
+        raise RuntimeError(f"failed to download {url}: {result.stderr.decode()!r}")
+    return route
+
+
+def clean_pdb(name: str, route: Optional[str] = None, chain_id: Optional[str] = None) -> str:
+    """Keep only ATOM records (optionally a single chain) — reference
+    `utils.py:93-120` without the mdtraj dependency."""
+    destin = route if route is not None else name
+    structure = parse_pdb(name)
+    if chain_id is not None:
+        structure = structure.select_chain(chain_id)
+    return write_pdb(destin, structure)

@@ -1,0 +1,13 @@
+"""Test configuration: force an 8-device virtual CPU platform so pjit/mesh
+sharding paths are exercised without TPU hardware."""
+
+import os
+
+# force CPU: the ambient environment may pin JAX_PLATFORMS to a TPU tunnel
+# (e.g. "axon"); unit tests must run on the virtual 8-device CPU mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

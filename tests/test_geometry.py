@@ -1,0 +1,270 @@
+"""Geometry-layer tests.
+
+Modeled on the reference suite (`tests/test_utils.py`) plus value-exact
+oracles the reference lacks: Kabsch round-trip on rotated clouds, MDS
+reconstruction of a known structure, metric values on hand-built cases.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu.constants import DISTANCE_THRESHOLDS, aa_to_tokens
+from alphafold2_tpu.geometry import (
+    GDT,
+    Kabsch,
+    MDScaling,
+    RMSD,
+    TMscore,
+    calc_phis,
+    center_distogram,
+    get_dihedral,
+    mds,
+    nerf,
+    scn_backbone_mask,
+    scn_cloud_mask,
+    sidechain_container,
+)
+from alphafold2_tpu.geometry.distogram import bucketize_distances
+
+
+def _rand_prob_distogram(key, b, n, buckets=37):
+    logits = jax.random.normal(key, (b, n, n, buckets))
+    logits = (logits + jnp.transpose(logits, (0, 2, 1, 3))) / 2
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def test_center_distogram_mean_and_median():
+    key = jax.random.PRNGKey(0)
+    dg = _rand_prob_distogram(key, 1, 32)
+    for mode in ("mean", "median"):
+        central, weights = center_distogram(dg, center=mode)
+        assert central.shape == (1, 32, 32)
+        assert weights.shape == (1, 32, 32)
+        # diagonal zeroed
+        assert np.allclose(np.asarray(central)[:, np.arange(32), np.arange(32)], 0.0)
+        assert np.all(np.isfinite(np.asarray(weights)))
+        assert np.all(np.asarray(weights) >= 0)
+
+
+def test_center_distogram_peaked_recovers_distance():
+    # a distogram fully confident in bucket k should produce that bucket's center
+    n, buckets = 8, 37
+    k = 10
+    dg = np.zeros((1, n, n, buckets), dtype=np.float32)
+    dg[..., k] = 1.0
+    central, weights = center_distogram(dg, center="mean")
+    bins = DISTANCE_THRESHOLDS
+    expected = bins[k] - 0.5 * (bins[2] - bins[1])
+    off_diag = ~np.eye(n, dtype=bool)
+    assert np.allclose(np.asarray(central)[0][off_diag], expected, atol=1e-4)
+    # fully peaked => zero dispersion => weight 1
+    assert np.allclose(np.asarray(weights)[0][off_diag], 1.0, atol=1e-4)
+
+
+def test_bucketize_distances_matches_thresholds():
+    coords = np.zeros((1, 3, 3), dtype=np.float32)
+    coords[0, 1, 0] = 2.5   # first bucket boundary at 2.0
+    coords[0, 2, 0] = 100.0  # beyond last threshold
+    labels = bucketize_distances(coords, mask=np.ones((1, 3), bool))
+    labels = np.asarray(labels)
+    assert labels[0, 0, 0] == 0
+    assert labels[0, 0, 1] == 1  # 2.5 is within (2.0, 2.5] bucket
+    assert labels[0, 0, 2] == 36  # clamped to last bucket
+    masked = bucketize_distances(coords, mask=np.array([[True, True, False]]))
+    assert np.asarray(masked)[0, 0, 2] == -100
+
+
+def test_mds_reconstructs_known_structure():
+    # build a random 3D cloud, take its exact distance matrix, and check MDS
+    # recovers it up to rigid motion (RMSD after Kabsch ~ 0)
+    key = jax.random.PRNGKey(1)
+    n = 24
+    truth = jax.random.normal(key, (1, n, 3)) * 4.0
+    dist = jnp.sqrt(
+        jnp.sum((truth[:, :, None] - truth[:, None]) ** 2, axis=-1) + 1e-12
+    )
+    coords, history = mds(dist, iters=500, tol=1e-9, key=jax.random.PRNGKey(2))
+    assert coords.shape == (1, 3, n)
+    assert history.shape[0] == 500
+    X, Y = Kabsch(coords[0], jnp.transpose(truth[0]))
+    err = RMSD(X, Y)
+    assert float(err[0]) < 0.5
+    # try mirror too: MDS has reflection ambiguity
+    Xm, Ym = Kabsch(coords[0] * jnp.array([[1.0], [1.0], [-1.0]]), jnp.transpose(truth[0]))
+    err_m = RMSD(Xm, Ym)
+    assert min(float(err[0]), float(err_m[0])) < 0.1
+
+
+def test_mds_and_mirror_shapes():
+    # reference tests/test_utils.py:18-35
+    key = jax.random.PRNGKey(0)
+    dg = _rand_prob_distogram(key, 1, 32 * 3)
+    distances, weights = center_distogram(dg)
+    masker = np.arange(dg.shape[1]) % 3
+    N_mask = masker == 0
+    CA_mask = masker == 1
+    coords_3d, _ = MDScaling(
+        distances,
+        weights=weights,
+        iters=50,
+        fix_mirror=True,
+        N_mask=N_mask,
+        CA_mask=CA_mask,
+        C_mask=None,
+    )
+    assert list(coords_3d.shape) == [1, 3, 32 * 3]
+
+
+def test_mds_differentiable():
+    key = jax.random.PRNGKey(3)
+    n = 12
+    truth = jax.random.normal(key, (1, n, 3))
+    dist = jnp.sqrt(jnp.sum((truth[:, :, None] - truth[:, None]) ** 2, axis=-1) + 1e-9)
+
+    def loss(d):
+        coords, _ = mds(d, iters=10, tol=0.0, key=jax.random.PRNGKey(0))
+        return jnp.sum(coords**2)
+
+    g = jax.grad(loss)(dist)
+    assert np.all(np.isfinite(np.asarray(g)))
+    assert float(jnp.abs(g).sum()) > 0
+
+
+def test_nerf_and_dihedral():
+    # reference tests/test_utils.py:37-63 — hand-computed ground truth
+    a = jnp.array([1.0, 2.0, 3.0])
+    b = jnp.array([1.0, 4.0, 5.0])
+    c = jnp.array([1.0, 4.0, 7.0])
+    d = jnp.array([1.0, 8.0, 8.0])
+    v2 = np.array([0.0, 0.0, 2.0])
+    v3 = np.array([0.0, 4.0, 1.0])
+    theta = np.arccos(np.dot(v2, v3) / (np.linalg.norm(v2) * np.linalg.norm(v3)))
+    v1 = np.array([0.0, 2.0, 2.0])
+    normal_p = np.cross(v1, v2)
+    normal_p_ = np.cross(v2, v3)
+    chi = np.arccos(
+        np.dot(normal_p, normal_p_) / (np.linalg.norm(normal_p) * np.linalg.norm(normal_p_))
+    )
+    l = np.linalg.norm(v3)
+    rebuilt = nerf(a, b, c, jnp.asarray(l), jnp.asarray(theta), jnp.asarray(chi - np.pi))
+    assert float(jnp.abs(rebuilt - jnp.array([1.0, 0.0, 6.0])).sum()) < 0.1
+    assert abs(float(get_dihedral(a, b, c, d)) - chi) < 1e-5
+
+
+def test_dihedral_batched():
+    key = jax.random.PRNGKey(4)
+    pts = jax.random.normal(key, (4, 10, 3))
+    out = get_dihedral(pts[0], pts[1], pts[2], pts[3])
+    assert out.shape == (10,)
+    # compare against per-element computation
+    for i in range(10):
+        single = get_dihedral(pts[0, i], pts[1, i], pts[2, i], pts[3, i])
+        assert np.allclose(np.asarray(single), np.asarray(out[i]), atol=1e-5)
+
+
+def test_calc_phis_prop():
+    key = jax.random.PRNGKey(5)
+    L = 16
+    coords = jax.random.normal(key, (2, 3, L * 3))
+    masker = np.arange(L * 3) % 3
+    props = calc_phis(coords, masker == 0, masker == 1)
+    assert props.shape == (2,)
+    assert np.all((np.asarray(props) >= 0) & (np.asarray(props) <= 1))
+
+
+def test_kabsch_roundtrip_exact():
+    # rotate a cloud by a known rotation; Kabsch must realign to ~0 RMSD
+    key = jax.random.PRNGKey(6)
+    X = jax.random.normal(key, (3, 32))
+    angle = 0.7
+    R = jnp.array(
+        [
+            [np.cos(angle), -np.sin(angle), 0.0],
+            [np.sin(angle), np.cos(angle), 0.0],
+            [0.0, 0.0, 1.0],
+        ]
+    )
+    Y = R @ X + jnp.array([[1.0], [2.0], [3.0]])
+    Xa, Yc = Kabsch(X, Y)
+    assert Xa.shape == X.shape
+    assert float(RMSD(Xa, Yc)[0]) < 1e-2  # float32 SVD precision
+
+
+def test_kabsch_batched():
+    key = jax.random.PRNGKey(7)
+    X = jax.random.normal(key, (4, 3, 16))
+    Y = jax.random.normal(jax.random.PRNGKey(8), (4, 3, 16))
+    Xa, Yc = Kabsch(X, Y)
+    assert Xa.shape == (4, 3, 16)
+    # aligned RMSD must be <= unaligned centered RMSD
+    Xc = X - X.mean(-1, keepdims=True)
+    assert np.all(np.asarray(RMSD(Xa, Yc)) <= np.asarray(RMSD(Xc, Yc)) + 1e-5)
+
+
+def test_metrics_identity_and_shapes():
+    key = jax.random.PRNGKey(9)
+    a = jax.random.normal(key, (2, 3, 25))
+    assert np.allclose(np.asarray(RMSD(a, a)), 0.0, atol=1e-6)
+    assert np.allclose(np.asarray(TMscore(a, a)), 1.0, atol=1e-6)
+    assert np.allclose(np.asarray(GDT(a, a)), 1.0, atol=1e-6)
+    b = a + 100.0  # move everything far away
+    assert np.allclose(np.asarray(GDT(a, b)), 0.0, atol=1e-6)
+    # GDT with a uniform 3A offset: TS cutoffs {1,2,4,8} -> half pass
+    c = a + jnp.array([3.0, 0.0, 0.0]).reshape(1, 3, 1)
+    assert np.allclose(np.asarray(GDT(a, c)), 0.5, atol=1e-6)
+    assert np.allclose(np.asarray(GDT(a, c, mode="HA")), 0.25, atol=1e-6)
+
+
+def test_backbone_and_cloud_masks():
+    seqs = np.random.randint(0, 20, size=(2, 50))
+    N_mask, CA_mask = scn_backbone_mask(seqs, boolean=True, l_aa=3)
+    assert N_mask.shape == (150,)
+    assert N_mask.sum() == 50 and CA_mask.sum() == 50
+    assert not np.any(N_mask & CA_mask)
+
+    tokens = aa_to_tokens("GAWG")
+    cloud = scn_cloud_mask(tokens[None])
+    cloud = np.asarray(cloud)
+    assert cloud.shape == (1, 4, 14)
+    assert cloud[0, 0].sum() == 4   # Gly: backbone only
+    assert cloud[0, 1].sum() == 5   # Ala
+    assert cloud[0, 2].sum() == 14  # Trp: all slots
+    assert cloud[0, 3].sum() == 4
+
+
+def test_sidechain_container_shapes_and_backbone_passthrough():
+    key = jax.random.PRNGKey(10)
+    bb = jax.random.normal(key, (2, 137 * 3, 3))
+    proto = sidechain_container(bb, place_oxygen=True)
+    assert list(proto.shape) == [2, 137, 14, 3]
+    # backbone slots must be the input coordinates
+    assert np.allclose(
+        np.asarray(proto[:, :, :3]).reshape(2, -1, 3), np.asarray(bb), atol=1e-6
+    )
+    # oxygen placed at the C-O bond length from C
+    o_dist = np.linalg.norm(
+        np.asarray(proto[:, :, 3] - proto[:, :, 2]), axis=-1
+    )
+    assert np.allclose(o_dist, 1.229, atol=1e-3)
+    # non-oxygen variant parks remaining slots at backbone slot 2
+    # (reference utils.py:236 behavior)
+    proto2 = sidechain_container(bb, place_oxygen=False)
+    assert np.allclose(
+        np.asarray(proto2[:, :, 3:]),
+        np.asarray(proto2[:, :, 2:3]).repeat(11, axis=2),
+        atol=1e-6,
+    )
+
+
+def test_pdb_roundtrip(tmp_path):
+    from alphafold2_tpu.geometry.pdb import coords_to_pdb, parse_pdb
+
+    coords = np.random.randn(10 * 3, 3).astype(np.float64)
+    path = str(tmp_path / "test.pdb")
+    coords_to_pdb(path, coords, sequence="ACDEFGHIKL")
+    structure = parse_pdb(path)
+    assert len(structure.atoms) == 30
+    assert structure.sequence() == "ACDEFGHIKL"
+    assert np.allclose(structure.coords(), coords, atol=1e-3)
