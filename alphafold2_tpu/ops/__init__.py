@@ -1,0 +1,45 @@
+"""Ops layer: functional NN primitives, attention (dense / axial / tied-row /
+KV-compressed / block-sparse), and feed-forward blocks.
+
+Everything here is a pure function over explicit parameter pytrees — the
+TPU-native answer to the reference's `torch.nn.Module` ops layer
+(reference alphafold2_pytorch/alphafold2.py:30-286).
+"""
+
+from alphafold2_tpu.ops.core import (
+    linear_init,
+    linear,
+    layer_norm_init,
+    layer_norm,
+    embedding_init,
+    embedding,
+    dropout,
+)
+from alphafold2_tpu.ops.attention import (
+    AttentionConfig,
+    attention_init,
+    attention_apply,
+    axial_attention_init,
+    axial_attention_apply,
+)
+from alphafold2_tpu.ops.feedforward import (
+    feed_forward_init,
+    feed_forward_apply,
+)
+
+__all__ = [
+    "linear_init",
+    "linear",
+    "layer_norm_init",
+    "layer_norm",
+    "embedding_init",
+    "embedding",
+    "dropout",
+    "AttentionConfig",
+    "attention_init",
+    "attention_apply",
+    "axial_attention_init",
+    "axial_attention_apply",
+    "feed_forward_init",
+    "feed_forward_apply",
+]

@@ -1,0 +1,100 @@
+"""Functional NN primitives.
+
+Parameters are plain pytrees (nested dicts of jnp arrays); every op is a pure
+function `f(params, x, ...) -> y`. This replaces the reference's torch.nn
+primitives (Linear / LayerNorm / Embedding / Dropout) with a functional core
+that composes cleanly with jit / pjit / scan / custom_vjp.
+
+Initialization follows torch defaults so training dynamics are comparable to
+the reference:
+  - Linear: U(-1/sqrt(fan_in), 1/sqrt(fan_in)) for weight and bias
+  - Embedding: N(0, 1)
+  - LayerNorm: scale=1, bias=0
+
+Parameters are stored in float32; `dtype` arguments select the compute dtype
+(bfloat16 on TPU for the MXU path). LayerNorm statistics and softmax are
+always accumulated in float32.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _uniform(key, shape, bound, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+# --- linear -----------------------------------------------------------------
+
+
+def linear_init(key, d_in: int, d_out: int, bias: bool = True):
+    """Params for a dense layer; weight layout (d_in, d_out)."""
+    kw, kb = jax.random.split(key)
+    bound = 1.0 / math.sqrt(d_in)
+    params = {"w": _uniform(kw, (d_in, d_out), bound)}
+    if bias:
+        params["b"] = _uniform(kb, (d_out,), bound)
+    return params
+
+
+def linear(params, x, dtype=None):
+    """y = x @ w (+ b). Computes in `dtype` if given (params are cast)."""
+    w = params["w"]
+    if dtype is not None:
+        w = w.astype(dtype)
+        x = x.astype(dtype)
+    y = x @ w
+    if "b" in params:
+        y = y + params["b"].astype(y.dtype)
+    return y
+
+
+# --- layer norm -------------------------------------------------------------
+
+
+def layer_norm_init(dim: int):
+    return {"scale": jnp.ones((dim,), jnp.float32), "bias": jnp.zeros((dim,), jnp.float32)}
+
+
+def layer_norm(params, x, eps: float = 1e-5):
+    """LayerNorm over the last axis; statistics in float32, output in x.dtype."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    y = y * params["scale"] + params["bias"]
+    return y.astype(x.dtype)
+
+
+# --- embedding --------------------------------------------------------------
+
+
+def embedding_init(key, num_embeddings: int, dim: int):
+    return {"table": jax.random.normal(key, (num_embeddings, dim), jnp.float32)}
+
+
+def embedding(params, ids, dtype=None):
+    table = params["table"]
+    if dtype is not None:
+        table = table.astype(dtype)
+    return jnp.take(table, ids, axis=0)
+
+
+# --- dropout ----------------------------------------------------------------
+
+
+def dropout(rng, x, rate: float, deterministic: bool = False):
+    """Inverted dropout. `rng is None` or `deterministic` means identity.
+
+    JAX's explicit keys give the determinism the reference needs RNG
+    state capture/replay for (reference reversible.py:26-56) for free: the
+    reversible backward simply folds in the same key again.
+    """
+    if deterministic or rate == 0.0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))

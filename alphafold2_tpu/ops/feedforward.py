@@ -1,0 +1,29 @@
+"""GEGLU feed-forward block (reference alphafold2_pytorch/alphafold2.py:52-73).
+
+Linear(d -> 2*mult*d) -> GEGLU (value * gelu(gate)) -> dropout ->
+Linear(mult*d -> d). Uses exact (erf) GELU to match torch.nn.functional.gelu.
+The two matmuls dominate; XLA fuses the gating elementwise into them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu.ops.core import dropout, linear, linear_init
+
+
+def feed_forward_init(key, dim: int, mult: int = 4):
+    k_in, k_out = jax.random.split(key)
+    return {
+        "proj_in": linear_init(k_in, dim, dim * mult * 2),
+        "proj_out": linear_init(k_out, dim * mult, dim),
+    }
+
+
+def feed_forward_apply(params, x, *, dropout_rate: float = 0.0, rng=None, dtype=None):
+    y = linear(params["proj_in"], x, dtype=dtype)
+    value, gate = jnp.split(y, 2, axis=-1)
+    y = value * jax.nn.gelu(gate, approximate=False)
+    y = dropout(rng, y, dropout_rate)
+    return linear(params["proj_out"], y, dtype=dtype)

@@ -1,5 +1,7 @@
 """Test configuration: force an 8-device virtual CPU platform so pjit/mesh
-sharding paths are exercised without TPU hardware."""
+sharding paths are exercised without TPU hardware, and so numerical parity
+tests run at full float32 precision (TPU matmul defaults would fail 1e-5
+tolerances)."""
 
 import os
 
@@ -11,3 +13,9 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# the axon tunnel pins the platform in a way that wins over the env var, so
+# pin the config flag as well (must happen before any backend initialization)
+import jax
+
+jax.config.update("jax_platforms", "cpu")

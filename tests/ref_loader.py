@@ -1,0 +1,78 @@
+"""Test-only helper: load the reference PyTorch implementation as an oracle.
+
+The reference at /root/reference is the behavioral spec. For parity tests we
+import it (with its unavailable external deps stubbed out), copy its randomly
+initialized weights into our parameter pytrees, and compare outputs. No
+reference code is used at runtime by alphafold2_tpu itself.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+
+
+def load_reference():
+    """Import alphafold2_pytorch from /root/reference with stubbed externals."""
+    if "se3_transformer_pytorch" not in sys.modules:
+        stub = types.ModuleType("se3_transformer_pytorch")
+        stub.SE3Transformer = object
+        sys.modules["se3_transformer_pytorch"] = stub
+    if "/root/reference" not in sys.path:
+        sys.path.insert(0, "/root/reference")
+    import alphafold2_pytorch.alphafold2 as ref_af2
+
+    return ref_af2
+
+
+def t2n(t):
+    return t.detach().cpu().numpy().astype(np.float32)
+
+
+def convert_linear(torch_linear):
+    """torch.nn.Linear (out, in) -> {'w': (in, out), 'b': (out,)}."""
+    p = {"w": t2n(torch_linear.weight).T}
+    if torch_linear.bias is not None:
+        p["b"] = t2n(torch_linear.bias)
+    return p
+
+
+def convert_layernorm(torch_ln):
+    return {"scale": t2n(torch_ln.weight), "bias": t2n(torch_ln.bias)}
+
+
+def convert_attention(torch_attn):
+    """Reference Attention module -> our attention params pytree."""
+    p = {
+        "to_q": convert_linear(torch_attn.to_q),
+        "to_kv": convert_linear(torch_attn.to_kv),
+        "to_out": convert_linear(torch_attn.to_out),
+    }
+    if torch_attn.compress_fn is not None:
+        # torch Conv1d weight (out, in/groups, k) -> ours (k, in/groups, out)
+        w = t2n(torch_attn.compress_fn.weight)
+        p["compress"] = {
+            "w": np.transpose(w, (2, 1, 0)),
+            "b": t2n(torch_attn.compress_fn.bias),
+        }
+    return p
+
+
+def convert_axial_attention(torch_axial):
+    return {
+        "attn_width": convert_attention(torch_axial.attn_width),
+        "attn_height": convert_attention(torch_axial.attn_height),
+    }
+
+
+def convert_feed_forward(torch_ff):
+    return {
+        "proj_in": convert_linear(torch_ff.net[0]),
+        "proj_out": convert_linear(torch_ff.net[3]),
+    }
+
+
+def convert_embedding(torch_emb):
+    return {"table": t2n(torch_emb.weight)}
