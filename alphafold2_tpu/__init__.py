@@ -19,20 +19,22 @@ from alphafold2_tpu.constants import (
 __version__ = "0.1.0"
 
 
+_MODEL_EXPORTS = ("Alphafold2Config", "alphafold2_init", "alphafold2_apply")
+
+
 def __getattr__(name):
-    # lazy import so geometry-only use doesn't pull in flax/the model stack
-    if name == "Alphafold2":
-        try:
-            from alphafold2_tpu.models.alphafold2 import Alphafold2
-        except ModuleNotFoundError as e:
-            raise AttributeError(
-                f"module {__name__!r} attribute {name!r} unavailable: {e}"
-            ) from e
-        return Alphafold2
+    # lazy import so geometry-only use doesn't pull in the model stack
+    if name in _MODEL_EXPORTS:
+        from alphafold2_tpu import models
+
+        return getattr(models, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
+
 __all__ = [
-    "Alphafold2",
+    "Alphafold2Config",
+    "alphafold2_init",
+    "alphafold2_apply",
     "MAX_NUM_MSA",
     "NUM_AMINO_ACIDS",
     "NUM_EMBEDDS_TR",

@@ -1,0 +1,22 @@
+"""Model layer: the dual-track (pair representation + MSA) attention trunk
+and the Alphafold2 model (reference alphafold2_pytorch/alphafold2.py:290-545),
+re-designed as pure init/apply functions over param pytrees.
+"""
+
+from alphafold2_tpu.models.alphafold2 import (
+    Alphafold2Config,
+    alphafold2_init,
+    alphafold2_apply,
+)
+from alphafold2_tpu.models.trunk import (
+    trunk_layer_init,
+    sequential_trunk_apply,
+)
+
+__all__ = [
+    "Alphafold2Config",
+    "alphafold2_init",
+    "alphafold2_apply",
+    "trunk_layer_init",
+    "sequential_trunk_apply",
+]

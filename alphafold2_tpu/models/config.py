@@ -1,0 +1,71 @@
+"""Model configuration.
+
+Replaces the reference's `Alphafold2.__init__` kwarg soup
+(reference alphafold2_pytorch/alphafold2.py:329-346) with a frozen dataclass
+that is hashable (safe as a jit static argument) and explicit about every
+capability flag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple, Union
+
+import jax.numpy as jnp
+
+from alphafold2_tpu.constants import (
+    DISTOGRAM_BUCKETS,
+    MAX_NUM_MSA,
+    NUM_AMINO_ACIDS,
+    NUM_EMBEDDS_TR,
+)
+from alphafold2_tpu.ops.attention import AttentionConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class Alphafold2Config:
+    dim: int
+    depth: int = 6
+    heads: int = 8
+    dim_head: int = 64
+    max_seq_len: int = 2048
+    num_tokens: int = NUM_AMINO_ACIDS
+    num_embedds: int = NUM_EMBEDDS_TR
+    max_num_msa: int = MAX_NUM_MSA
+    num_buckets: int = DISTOGRAM_BUCKETS
+    attn_dropout: float = 0.0
+    ff_dropout: float = 0.0
+    reversible: bool = False
+    # bool, or a per-layer tuple of bools (reference cast_tuple semantics,
+    # alphafold2.py:25-26,349 — the reference ignores the per-layer value at
+    # alphafold2.py:392, a bug; we apply it per layer)
+    sparse_self_attn: Union[bool, Tuple[bool, ...]] = False
+    sparse_block_size: int = 16
+    cross_attn_compress_ratio: int = 1
+    msa_tie_row_attn: bool = False
+    template_attn_depth: int = 2
+    dtype: Any = jnp.float32
+
+    @property
+    def layer_sparse(self) -> Tuple[bool, ...]:
+        v = self.sparse_self_attn
+        return v if isinstance(v, tuple) else (bool(v),) * self.depth
+
+    def self_attn_config(self) -> AttentionConfig:
+        return AttentionConfig(
+            dim=self.dim,
+            heads=self.heads,
+            dim_head=self.dim_head,
+            dropout=self.attn_dropout,
+            dtype=self.dtype,
+        )
+
+    def cross_attn_config(self) -> AttentionConfig:
+        return AttentionConfig(
+            dim=self.dim,
+            heads=self.heads,
+            dim_head=self.dim_head,
+            dropout=self.attn_dropout,
+            compress_ratio=self.cross_attn_compress_ratio,
+            dtype=self.dtype,
+        )

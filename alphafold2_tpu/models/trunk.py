@@ -1,0 +1,188 @@
+"""The dual-track trunk: pair-representation and MSA streams.
+
+Re-design of the reference `SequentialSequence`
+(reference alphafold2_pytorch/alphafold2.py:290-326). The reference keeps the
+pair representation flattened to (b, n*n, d) and reshapes per axial pass; here
+both streams stay in their natural grid layouts — pair (b, i, j, d), MSA
+(b, rows, cols, d) — and only the cross-attention flattens, which keeps the
+sharding story simple (the grid axes are the mesh axes, see parallel/).
+
+Per layer, every op residual (reference alphafold2.py:309-324):
+  pair axial self-attn -> msa axial self-attn (optionally tied rows) ->
+  pair<-msa cross-attn (optionally KV-compressed) -> msa<-pair cross-attn ->
+  pair FF -> msa FF.
+The MSA branch is skipped entirely when no MSA stream exists
+(reference alphafold2.py:311).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu.models.config import Alphafold2Config
+from alphafold2_tpu.ops.attention import (
+    attention_apply,
+    attention_init,
+    axial_attention_apply,
+    axial_attention_init,
+)
+from alphafold2_tpu.ops.core import layer_norm, layer_norm_init
+from alphafold2_tpu.ops.feedforward import feed_forward_apply, feed_forward_init
+
+
+# --- pre-norm wrapped blocks ------------------------------------------------
+
+
+def prenorm_axial_init(key, cfg: Alphafold2Config, attn_cfg):
+    return {"norm": layer_norm_init(cfg.dim), "attn": axial_attention_init(key, attn_cfg)}
+
+
+def prenorm_cross_init(key, cfg: Alphafold2Config, attn_cfg):
+    return {
+        "norm": layer_norm_init(cfg.dim),
+        "norm_context": layer_norm_init(cfg.dim),
+        "attn": attention_init(key, attn_cfg),
+    }
+
+
+def prenorm_ff_init(key, cfg: Alphafold2Config):
+    return {"norm": layer_norm_init(cfg.dim), "ff": feed_forward_init(key, cfg.dim)}
+
+
+def prenorm_axial_apply(params, attn_cfg, x, **kwargs):
+    return axial_attention_apply(params["attn"], attn_cfg, layer_norm(params["norm"], x), **kwargs)
+
+
+def prenorm_cross_apply(params, attn_cfg, x, context, **kwargs):
+    return attention_apply(
+        params["attn"],
+        attn_cfg,
+        layer_norm(params["norm"], x),
+        context=layer_norm(params["norm_context"], context),
+        **kwargs,
+    )
+
+
+def prenorm_ff_apply(params, cfg: Alphafold2Config, x, rng=None):
+    return feed_forward_apply(
+        params["ff"],
+        layer_norm(params["norm"], x),
+        dropout_rate=cfg.ff_dropout,
+        rng=rng,
+        dtype=cfg.dtype,
+    )
+
+
+# --- trunk layer ------------------------------------------------------------
+
+
+def trunk_layer_init(key, cfg: Alphafold2Config, *, reversible: bool = False):
+    """One trunk layer's params.
+
+    Sequential layers carry 6 blocks; reversible layers carry 8 — the
+    reference drops the 4th feed-forward of each half-layer when sequential
+    (reference alphafold2.py:407-408).
+    """
+    keys = jax.random.split(key, 8)
+    self_cfg = cfg.self_attn_config()
+    cross_cfg = cfg.cross_attn_config()
+    params = {
+        "seq_attn": prenorm_axial_init(keys[0], cfg, self_cfg),
+        "msa_attn": prenorm_axial_init(keys[1], cfg, self_cfg),
+        "seq_cross": prenorm_cross_init(keys[2], cfg, cross_cfg),
+        "msa_cross": prenorm_cross_init(keys[3], cfg, cross_cfg),
+        "seq_ff": prenorm_ff_init(keys[4], cfg),
+        "msa_ff": prenorm_ff_init(keys[5], cfg),
+    }
+    if reversible:
+        params["seq_ff2"] = prenorm_ff_init(keys[6], cfg)
+        params["msa_ff2"] = prenorm_ff_init(keys[7], cfg)
+    return params
+
+
+def sequential_trunk_apply(
+    layers,
+    cfg: Alphafold2Config,
+    x,
+    m,
+    *,
+    x_mask=None,
+    msa_mask=None,
+    rng=None,
+):
+    """Run the sequential trunk.
+
+    Args:
+      layers: list of trunk_layer_init params.
+      x: pair representation (b, n, n, d).
+      m: MSA stream (b, rows, cols, d) or None.
+      x_mask: (b, n, n) bool.
+      msa_mask: (b, rows, cols) bool.
+      rng: dropout key (None = deterministic).
+
+    Returns: (x, m) in the same layouts.
+    """
+    self_cfg = cfg.self_attn_config()
+    cross_cfg = cfg.cross_attn_config()
+    b = x.shape[0]
+    n = x.shape[1]
+    d = cfg.dim
+
+    x_mask_flat = x_mask.reshape(b, -1) if x_mask is not None else None
+    msa_mask_flat = msa_mask.reshape(b, -1) if msa_mask is not None else None
+
+    for li, layer in enumerate(layers):
+        lrng = jax.random.fold_in(rng, li) if rng is not None else None
+        rngs = (
+            jax.random.split(lrng, 6) if lrng is not None else [None] * 6
+        )
+
+        # pair axial self-attention (reference alphafold2.py:309)
+        x = prenorm_axial_apply(
+            layer["seq_attn"], self_cfg, x, mask=x_mask, rng=rngs[0]
+        ) + x
+
+        if m is not None:
+            # msa axial self-attention, optionally tied rows
+            # (reference alphafold2.py:312)
+            m = prenorm_axial_apply(
+                layer["msa_attn"],
+                self_cfg,
+                m,
+                mask=msa_mask,
+                tie_row=cfg.msa_tie_row_attn,
+                rng=rngs[1],
+            ) + m
+
+            # cross-attention both ways over flattened streams
+            # (reference alphafold2.py:316-317)
+            xf = x.reshape(b, n * n, d)
+            mf = m.reshape(b, -1, d)
+            xf = prenorm_cross_apply(
+                layer["seq_cross"],
+                cross_cfg,
+                xf,
+                mf,
+                mask=x_mask_flat,
+                context_mask=msa_mask_flat,
+                rng=rngs[2],
+            ) + xf
+            x = xf.reshape(x.shape)
+            mf = prenorm_cross_apply(
+                layer["msa_cross"],
+                cross_cfg,
+                mf,
+                xf,
+                mask=msa_mask_flat,
+                context_mask=x_mask_flat,
+                rng=rngs[3],
+            ) + mf
+            m = mf.reshape(m.shape)
+
+        # feed-forwards (reference alphafold2.py:321-324)
+        x = prenorm_ff_apply(layer["seq_ff"], cfg, x, rng=rngs[4]) + x
+        if m is not None:
+            m = prenorm_ff_apply(layer["msa_ff"], cfg, m, rng=rngs[5]) + m
+
+    return x, m

@@ -15,16 +15,35 @@ import numpy as np
 
 
 def load_reference():
-    """Import alphafold2_pytorch from /root/reference with stubbed externals."""
+    """Import alphafold2_pytorch from /root/reference with stubbed externals.
+
+    One in-memory patch is applied: `msa_shape = None` is pre-bound in
+    Alphafold2.forward, because the unpatched reference crashes with
+    UnboundLocalError on ANY msa-less forward (alphafold2.py:531 — even its
+    own train_pre.py path is broken at v0.0.28). The patch only un-breaks
+    that path; everything else is byte-identical reference behavior.
+    """
     if "se3_transformer_pytorch" not in sys.modules:
         stub = types.ModuleType("se3_transformer_pytorch")
         stub.SE3Transformer = object
         sys.modules["se3_transformer_pytorch"] = stub
     if "/root/reference" not in sys.path:
         sys.path.insert(0, "/root/reference")
-    import alphafold2_pytorch.alphafold2 as ref_af2
+    if "_ref_af2_patched" in sys.modules:
+        return sys.modules["_ref_af2_patched"]
 
-    return ref_af2
+    src_path = "/root/reference/alphafold2_pytorch/alphafold2.py"
+    with open(src_path) as f:
+        src = f.read()
+    patched = src.replace(
+        "        m = None\n", "        m = None\n        msa_shape = None\n", 1
+    )
+    assert patched != src, "reference source changed; revisit the patch"
+    module = types.ModuleType("_ref_af2_patched")
+    module.__file__ = src_path
+    exec(compile(patched, src_path, "exec"), module.__dict__)
+    sys.modules["_ref_af2_patched"] = module
+    return module
 
 
 def t2n(t):
@@ -76,3 +95,70 @@ def convert_feed_forward(torch_ff):
 
 def convert_embedding(torch_emb):
     return {"table": t2n(torch_emb.weight)}
+
+
+def _convert_prenorm_axial(m):
+    return {"norm": convert_layernorm(m.norm), "attn": convert_axial_attention(m.fn)}
+
+
+def _convert_prenorm_attn(m):
+    return {"norm": convert_layernorm(m.norm), "attn": convert_attention(m.fn)}
+
+
+def _convert_prenorm_cross(m):
+    return {
+        "norm": convert_layernorm(m.norm),
+        "norm_context": convert_layernorm(m.norm_context),
+        "attn": convert_attention(m.fn),
+    }
+
+
+def _convert_prenorm_ff(m):
+    return {"norm": convert_layernorm(m.norm), "ff": convert_feed_forward(m.fn)}
+
+
+def convert_alphafold2(model):
+    """Reference Alphafold2 module -> our full params pytree (sequential)."""
+    p = {
+        "token_emb": convert_embedding(model.token_emb),
+        "pos_emb": convert_embedding(model.pos_emb),
+        "pos_emb_ax": convert_embedding(model.pos_emb_ax),
+        "msa_pos_emb": convert_embedding(model.msa_pos_emb),
+        "msa_num_pos_emb": convert_embedding(model.msa_num_pos_emb),
+        "template_emb": convert_embedding(model.template_emb),
+        "template_pos_emb": convert_embedding(model.template_pos_emb),
+        "template_pos_emb_ax": convert_embedding(model.template_pos_emb_ax),
+        "embedd_project": convert_linear(model.embedd_project),
+        "head_norm": convert_layernorm(model.to_distogram_logits[0]),
+        "head_out": convert_linear(model.to_distogram_logits[1]),
+    }
+
+    tower = []
+    for seq_attn, tmpl_attn, joint_attn, ff in model.template_attn_net:
+        tower.append(
+            {
+                "seq_attn": _convert_prenorm_axial(seq_attn),
+                "template_attn": _convert_prenorm_axial(tmpl_attn),
+                "joint_attn": _convert_prenorm_attn(joint_attn),
+                "template_ff": _convert_prenorm_ff(ff),
+            }
+        )
+    p["template_tower"] = tower
+
+    trunk = []
+    blocks = list(model.net.blocks)
+    for g1, g2 in zip(*[iter(blocks)] * 2):
+        attn, ff, msa_attn = g1[0], g1[1], g1[2]
+        cross, msa_ff, msa_cross = g2[0], g2[1], g2[2]
+        trunk.append(
+            {
+                "seq_attn": _convert_prenorm_axial(attn),
+                "seq_ff": _convert_prenorm_ff(ff),
+                "msa_attn": _convert_prenorm_axial(msa_attn),
+                "seq_cross": _convert_prenorm_cross(cross),
+                "msa_ff": _convert_prenorm_ff(msa_ff),
+                "msa_cross": _convert_prenorm_cross(msa_cross),
+            }
+        )
+    p["trunk"] = trunk
+    return p

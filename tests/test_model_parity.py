@@ -1,0 +1,178 @@
+"""Full-model numerical parity against the reference Alphafold2.
+
+Covers the reference's own smoke-test matrix (reference tests/
+test_attention.py) but with exact output comparison on converted weights:
+plain forward, MSA forward, tied rows, KV-compressed cross-attention,
+templates. The embedds path is ours alone (the reference's crashes,
+see models/alphafold2.py docstring) so it gets a shape/finiteness check.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+
+from ref_loader import load_reference, convert_alphafold2
+from alphafold2_tpu.models import (
+    Alphafold2Config,
+    alphafold2_init,
+    alphafold2_apply,
+)
+
+ref = load_reference()
+
+DIM, HEADS, DIM_HEAD, DEPTH, N = 32, 4, 8, 2, 12
+
+
+def make_pair(seed=0, **kw):
+    torch.manual_seed(seed)
+    m = ref.Alphafold2(
+        dim=DIM,
+        depth=DEPTH,
+        heads=HEADS,
+        dim_head=DIM_HEAD,
+        max_seq_len=64,
+        **kw,
+    ).eval()
+    cfg = Alphafold2Config(
+        dim=DIM,
+        depth=DEPTH,
+        heads=HEADS,
+        dim_head=DIM_HEAD,
+        max_seq_len=64,
+        cross_attn_compress_ratio=kw.get("cross_attn_compress_ratio", 1),
+        msa_tie_row_attn=kw.get("msa_tie_row_attn", False),
+        template_attn_depth=kw.get("template_attn_depth", 2),
+    )
+    return m, cfg, convert_alphafold2(m)
+
+
+def _seq(b=1, n=N, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 21, size=(b, n)).astype(np.int64)
+
+
+def test_seq_only_forward():
+    m, cfg, params = make_pair(seed=0)
+    seq = _seq()
+    mask = np.ones((1, N), dtype=bool)
+    mask[0, 9:] = False
+    want = m(torch.from_numpy(seq), mask=torch.from_numpy(mask)).detach().numpy()
+    got = alphafold2_apply(
+        params, cfg, jnp.asarray(seq), mask=jnp.asarray(mask)
+    )
+    assert got.shape == (1, N, N, 37)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4)
+
+
+def test_msa_forward():
+    m, cfg, params = make_pair(seed=1)
+    seq = _seq(seed=1)
+    msa = np.random.RandomState(2).randint(0, 21, size=(1, 3, 8)).astype(np.int64)
+    mask = np.ones((1, N), dtype=bool)
+    msa_mask = np.ones((1, 3, 8), dtype=bool)
+    msa_mask[0, 2, 5:] = False
+    want = m(
+        torch.from_numpy(seq),
+        msa=torch.from_numpy(msa),
+        mask=torch.from_numpy(mask),
+        msa_mask=torch.from_numpy(msa_mask),
+    ).detach().numpy()
+    got = alphafold2_apply(
+        params,
+        cfg,
+        jnp.asarray(seq),
+        jnp.asarray(msa),
+        mask=jnp.asarray(mask),
+        msa_mask=jnp.asarray(msa_mask),
+    )
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4)
+
+
+def test_msa_tied_rows():
+    m, cfg, params = make_pair(seed=2, msa_tie_row_attn=True)
+    seq = _seq(seed=3)
+    msa = np.random.RandomState(4).randint(0, 21, size=(1, 4, 10)).astype(np.int64)
+    want = m(torch.from_numpy(seq), msa=torch.from_numpy(msa)).detach().numpy()
+    got = alphafold2_apply(params, cfg, jnp.asarray(seq), jnp.asarray(msa))
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4)
+
+
+def test_cross_attn_compressed():
+    m, cfg, params = make_pair(seed=3, cross_attn_compress_ratio=3)
+    # lengths chosen so nothing is an exact multiple of the ratio: the
+    # reference skips compression on exact multiples (a bug we fix), so
+    # parity only holds when both implementations compress. n*n=121 and
+    # 2*11=22 are both non-multiples of 3.
+    seq = _seq(n=11, seed=5)
+    msa = np.random.RandomState(6).randint(0, 21, size=(1, 2, 11)).astype(np.int64)
+    want = m(torch.from_numpy(seq), msa=torch.from_numpy(msa)).detach().numpy()
+    got = alphafold2_apply(params, cfg, jnp.asarray(seq), jnp.asarray(msa))
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4)
+
+
+def test_templates_forward():
+    m, cfg, params = make_pair(seed=4)
+    b, T, n = 1, 2, 8
+    seq = _seq(n=n, seed=7)
+    msa = np.random.RandomState(8).randint(0, 21, size=(1, 3, 8)).astype(np.int64)
+    templates = np.random.RandomState(9).randint(0, 37, size=(b, T, n, n)).astype(np.int64)
+    templates_mask = np.ones((b, T, n, n), dtype=bool)
+    mask = np.ones((b, n), dtype=bool)
+    want = m(
+        torch.from_numpy(seq),
+        msa=torch.from_numpy(msa),
+        mask=torch.from_numpy(mask),
+        templates=torch.from_numpy(templates),
+        templates_mask=torch.from_numpy(templates_mask),
+    ).detach().numpy()
+    got = alphafold2_apply(
+        params,
+        cfg,
+        jnp.asarray(seq),
+        jnp.asarray(msa),
+        mask=jnp.asarray(mask),
+        templates=jnp.asarray(templates),
+        templates_mask=jnp.asarray(templates_mask),
+    )
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4)
+
+
+def test_embedds_path():
+    # ours alone: the reference embedds path crashes (msa_shape unbound)
+    _, cfg, _ = make_pair(seed=5)
+    key = jax.random.PRNGKey(0)
+    params = alphafold2_init(key, cfg)
+    seq = _seq(seed=10)
+    embedds = np.random.RandomState(11).randn(1, N, cfg.num_embedds).astype(np.float32)
+    out = alphafold2_apply(
+        params, cfg, jnp.asarray(seq), embedds=jnp.asarray(embedds)
+    )
+    assert out.shape == (1, N, N, 37)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_own_init_jit_forward():
+    # init + jitted forward with dropout rng on our own params
+    cfg = Alphafold2Config(
+        dim=DIM, depth=DEPTH, heads=HEADS, dim_head=DIM_HEAD, max_seq_len=64,
+        attn_dropout=0.1, ff_dropout=0.1,
+    )
+    params = alphafold2_init(jax.random.PRNGKey(1), cfg)
+    seq = jnp.asarray(_seq(b=2, seed=12))
+    msa = jnp.asarray(
+        np.random.RandomState(13).randint(0, 21, size=(2, 3, N)).astype(np.int64)
+    )
+
+    @jax.jit
+    def fwd(params, seq, msa, rng):
+        return alphafold2_apply(params, cfg, seq, msa, rng=rng)
+
+    out = fwd(params, seq, msa, jax.random.PRNGKey(2))
+    assert out.shape == (2, N, N, 37)
+    assert np.isfinite(np.asarray(out)).all()
+    # dropout actually fires: different rng -> different output
+    out2 = fwd(params, seq, msa, jax.random.PRNGKey(3))
+    assert not np.allclose(np.asarray(out), np.asarray(out2))
