@@ -12,6 +12,11 @@ from alphafold2_tpu.models.trunk import (
     trunk_layer_init,
     sequential_trunk_apply,
 )
+from alphafold2_tpu.models.reversible import (
+    reversible_trunk_init,
+    reversible_trunk_apply,
+    stack_layers,
+)
 
 __all__ = [
     "Alphafold2Config",
@@ -19,4 +24,7 @@ __all__ = [
     "alphafold2_apply",
     "trunk_layer_init",
     "sequential_trunk_apply",
+    "reversible_trunk_init",
+    "reversible_trunk_apply",
+    "stack_layers",
 ]

@@ -29,6 +29,10 @@ import jax
 import jax.numpy as jnp
 
 from alphafold2_tpu.models.config import Alphafold2Config
+from alphafold2_tpu.models.reversible import (
+    reversible_trunk_apply,
+    reversible_trunk_init,
+)
 from alphafold2_tpu.models.trunk import (
     prenorm_axial_apply,
     prenorm_axial_init,
@@ -57,15 +61,11 @@ def _prenorm_attn_init(key, cfg: Alphafold2Config):
 
 def alphafold2_init(key, cfg: Alphafold2Config):
     """Initialize all model params (embeddings, template tower, trunk, head)."""
-    if cfg.reversible:
+    if any(cfg.layer_sparse) and cfg.reversible:
         raise NotImplementedError(
-            "reversible trunk lands with models/reversible.py; use "
-            "reversible=False until then"
-        )
-    if any(cfg.layer_sparse):
-        raise NotImplementedError(
-            "block-sparse self-attention lands with ops/sparse.py; use "
-            "sparse_self_attn=False until then"
+            "block-sparse attention inside the scanned reversible trunk "
+            "needs a uniform layer body; use the sequential trunk with "
+            "sparse_self_attn, or reversible without it"
         )
     keys = jax.random.split(key, 16)
     params = {
@@ -99,13 +99,17 @@ def alphafold2_init(key, cfg: Alphafold2Config):
         )
     params["template_tower"] = tower
 
-    # trunk (reference alphafold2.py:386-405)
-    lkey = keys[11]
-    layers = []
-    for _ in range(cfg.depth):
-        lkey, k = jax.random.split(lkey)
-        layers.append(trunk_layer_init(k, cfg, reversible=cfg.reversible))
-    params["trunk"] = layers
+    # trunk (reference alphafold2.py:386-405); reversible layers are stacked
+    # along a leading depth axis so the trunk runs as one scanned body
+    if cfg.reversible:
+        params["trunk"] = reversible_trunk_init(keys[11], cfg)
+    else:
+        lkey = keys[11]
+        layers = []
+        for _ in range(cfg.depth):
+            lkey, k = jax.random.split(lkey)
+            layers.append(trunk_layer_init(k, cfg, reversible=False))
+        params["trunk"] = layers
 
     return params
 
@@ -236,6 +240,8 @@ def alphafold2_apply(
     elif embedds is not None:
         p = linear(params["embedd_project"], embedds, dtype=cfg.dtype)
         m = p[:, :, None, :] + p[:, None, :, :]  # (b, n, n, d) grid stream
+        if m_mask is None:
+            m_mask = x_mask  # the grid stream's validity is the pair mask
 
     rng_tower, rng_trunk = (
         jax.random.split(rng) if rng is not None else (None, None)
@@ -248,15 +254,26 @@ def alphafold2_apply(
         )
 
     # trunk (reference :528-535)
-    x, m = sequential_trunk_apply(
-        params["trunk"],
-        cfg,
-        x,
-        m,
-        x_mask=x_mask,
-        msa_mask=m_mask,
-        rng=rng_trunk,
-    )
+    if cfg.reversible:
+        x, m = reversible_trunk_apply(
+            params["trunk"],
+            cfg,
+            x,
+            m,
+            x_mask=x_mask,
+            msa_mask=m_mask,
+            rng=rng_trunk,
+        )
+    else:
+        x, m = sequential_trunk_apply(
+            params["trunk"],
+            cfg,
+            x,
+            m,
+            x_mask=x_mask,
+            msa_mask=m_mask,
+            rng=rng_trunk,
+        )
 
     # head: symmetrize + project (reference :543-545)
     x = (x + jnp.swapaxes(x, 1, 2)) * 0.5

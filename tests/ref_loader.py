@@ -117,6 +117,32 @@ def _convert_prenorm_ff(m):
     return {"norm": convert_layernorm(m.norm), "ff": convert_feed_forward(m.fn)}
 
 
+def convert_reversible_trunk(rev_sequence):
+    """Reference ReversibleSequence -> our per-layer params list (unstacked).
+
+    Reference block layout (reversible.py:304-313): blocks alternate
+    ReversibleSelfAttnBlock(f=seq axial attn, g=seq ff, j=msa axial attn,
+    k=msa ff) and ReversibleCrossAttnBlock(f=seq cross, g=seq ff2,
+    j=msa cross, k=msa ff2); each sub-fn is wrapped in Deterministic (.net).
+    """
+    blocks = list(rev_sequence.blocks)
+    layers = []
+    for self_blk, cross_blk in zip(*[iter(blocks)] * 2):
+        layers.append(
+            {
+                "seq_attn": _convert_prenorm_axial(self_blk.f.net),
+                "seq_ff": _convert_prenorm_ff(self_blk.g.net),
+                "msa_attn": _convert_prenorm_axial(self_blk.j.net),
+                "msa_ff": _convert_prenorm_ff(self_blk.k.net),
+                "seq_cross": _convert_prenorm_cross(cross_blk.f.net),
+                "seq_ff2": _convert_prenorm_ff(cross_blk.g.net),
+                "msa_cross": _convert_prenorm_cross(cross_blk.j.net),
+                "msa_ff2": _convert_prenorm_ff(cross_blk.k.net),
+            }
+        )
+    return layers
+
+
 def convert_alphafold2(model):
     """Reference Alphafold2 module -> our full params pytree (sequential)."""
     p = {
@@ -144,6 +170,10 @@ def convert_alphafold2(model):
             }
         )
     p["template_tower"] = tower
+
+    if type(model.net).__name__ == "ReversibleSequence":
+        p["trunk"] = convert_reversible_trunk(model.net)
+        return p
 
     trunk = []
     blocks = list(model.net.blocks)
