@@ -1,0 +1,30 @@
+"""Parallelism layer: device meshes, sharding rules, distributed training.
+
+TPU-native replacement for the reference's (empty) DeepSpeed/Lightning
+distribution story (reference training_scripts/, install_deepspeed.sh):
+`jax.sharding.Mesh` + GSPMD annotations, with XLA emitting the ICI/DCN
+collectives. See SURVEY.md §2.2 for the strategy-by-strategy mapping.
+"""
+
+from alphafold2_tpu.parallel.mesh import data_parallel_mesh, make_mesh
+from alphafold2_tpu.parallel.sharding import (
+    batch_shardings,
+    param_spec,
+    replicated,
+    state_shardings,
+)
+from alphafold2_tpu.parallel.train import (
+    make_sharded_train_step,
+    sharded_train_state_init,
+)
+
+__all__ = [
+    "make_mesh",
+    "data_parallel_mesh",
+    "param_spec",
+    "state_shardings",
+    "batch_shardings",
+    "replicated",
+    "make_sharded_train_step",
+    "sharded_train_state_init",
+]

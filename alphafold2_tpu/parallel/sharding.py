@@ -1,0 +1,105 @@
+"""Sharding rules: PartitionSpecs for params, optimizer state, and batches.
+
+Tensor-parallel layout (the Megatron split, expressed as GSPMD annotations
+rather than collective calls):
+  * attention to_q / to_kv weights shard their OUTPUT (head) dim;
+  * attention to_out weight shards its INPUT dim (XLA inserts the psum);
+  * feed-forward proj_in shards output, proj_out shards input;
+  * the KV-compression conv shards its output channels (per-head groups);
+  * embeddings, norms, biases of row-sharded layers: replicated.
+
+Rules match on parameter-tree path suffixes, so they apply unchanged to the
+optimizer state (whose mu/nu subtrees mirror the param tree) and to the
+reversible trunk's depth-stacked params (leading depth axis is detected by
+leaf rank).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _path_names(path) -> tuple:
+    names = []
+    for e in path:
+        if isinstance(e, jax.tree_util.DictKey):
+            names.append(str(e.key))
+        elif isinstance(e, jax.tree_util.SequenceKey):
+            names.append(str(e.idx))
+        elif isinstance(e, jax.tree_util.GetAttrKey):
+            names.append(str(e.name))
+    return tuple(names)
+
+
+def _tp_spec(names: tuple, leaf) -> P:
+    """Tensor-parallel PartitionSpec for one param leaf (base rank, no
+    depth-stacking)."""
+    if not names:
+        return P()
+    leaf_name = names[-1]
+    parent = names[-2] if len(names) >= 2 else ""
+    if leaf_name == "w":
+        if parent in ("to_q", "to_kv", "proj_in"):
+            return P(None, "model")  # column parallel: shard output dim
+        if parent in ("to_out", "proj_out"):
+            return P("model", None)  # row parallel: shard input dim
+    if leaf_name == "b" and parent in ("to_q", "to_kv", "proj_in"):
+        return P("model")
+    if parent == "compress":
+        # conv kernel (k, in_per_group, out) / bias (out,): shard out
+        if leaf_name == "w":
+            return P(None, None, "model")
+        if leaf_name == "b":
+            return P("model")
+    return P()
+
+
+def param_spec(path, leaf, *, tp: bool) -> P:
+    """PartitionSpec for a param (or optimizer-state) leaf."""
+    if not hasattr(leaf, "ndim"):
+        return P()
+    names = _path_names(path)
+    if not tp:
+        return P()
+    spec = _tp_spec(names, leaf)
+    base_rank = {"w": 2, "b": 1, "table": 2, "scale": 1, "bias": 1}.get(
+        names[-1] if names else "", None
+    )
+    if names and names[-2:-1] == ("compress",) and names[-1] == "w":
+        base_rank = 3
+    if base_rank is not None and leaf.ndim == base_rank + 1:
+        # depth-stacked (reversible trunk): leading depth axis is replicated
+        spec = P(None, *spec)
+    return spec
+
+
+def state_shardings(mesh: Mesh, state: Any, *, tp: bool = True):
+    """NamedShardings for a full train state (params + opt state + step)."""
+    has_model = tp and "model" in mesh.axis_names
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(
+            mesh, param_spec(path, leaf, tp=has_model)
+        ),
+        state,
+    )
+
+
+def batch_shardings(mesh: Mesh, batch: Any, *, microbatched: bool = True):
+    """Shard the per-device batch axis over "data". With `microbatched`,
+    leaves are (accum, b, ...) and axis 1 is the batch axis."""
+    axis = 1 if microbatched else 0
+
+    def spec(leaf):
+        parts = [None] * leaf.ndim
+        if "data" in mesh.axis_names and leaf.ndim > axis:
+            parts[axis] = "data"
+        return NamedSharding(mesh, P(*parts))
+
+    return jax.tree_util.tree_map(spec, batch)
+
+
+def replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())

@@ -1,0 +1,41 @@
+"""Training harness layer.
+
+Replaces the reference's inlined script loops and empty launcher stubs
+(reference train_pre.py, train_end2end.py, training_scripts/) with a
+first-class subsystem: losses, an optax-based jitted train step with
+scanned gradient accumulation, and a static-shape data pipeline.
+"""
+
+from alphafold2_tpu.training.losses import (
+    IGNORE_INDEX,
+    bucketed_distance_matrix,
+    distogram_cross_entropy,
+)
+from alphafold2_tpu.training.harness import (
+    TrainConfig,
+    distogram_loss_fn,
+    make_optimizer,
+    make_train_step,
+    train_state_init,
+)
+from alphafold2_tpu.training.data import (
+    DataConfig,
+    stack_microbatches,
+    synthetic_batches,
+    sidechainnet_batches,
+)
+
+__all__ = [
+    "IGNORE_INDEX",
+    "bucketed_distance_matrix",
+    "distogram_cross_entropy",
+    "TrainConfig",
+    "distogram_loss_fn",
+    "make_optimizer",
+    "make_train_step",
+    "train_state_init",
+    "DataConfig",
+    "stack_microbatches",
+    "synthetic_batches",
+    "sidechainnet_batches",
+]

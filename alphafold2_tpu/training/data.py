@@ -1,0 +1,113 @@
+"""Data pipeline: synthetic protein batches + sidechainnet adapter.
+
+The reference feeds sidechainnet batches straight into the model with
+dynamic lengths, filtering on `len < 250` at iteration time
+(reference train_pre.py:44-55). XLA wants static shapes, so this adapter
+does the shape discipline on the host: proteins are cropped/padded to a
+fixed `max_len` and batches always have identical shapes, with validity
+carried in the mask. Length filtering becomes crop-or-pad instead of skip.
+
+Synthetic data generates protein-like C-alpha traces (fixed-step random
+walk, ~3.8 A bond length) so the training loop and benchmarks run without
+any dataset download.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional
+
+import numpy as np
+
+from alphafold2_tpu.constants import NUM_AMINO_ACIDS
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    batch_size: int = 1
+    max_len: int = 128
+    msa_rows: int = 0  # 0 = sequence-only (the train_pre path)
+    seed: int = 0
+
+
+def synthetic_batches(cfg: DataConfig) -> Iterator[dict]:
+    """Endless protein-like batches with static shapes.
+
+    Yields {"seq": (b, L) int32, "mask": (b, L) bool, "coords": (b, L, 3)
+    float32} (+ msa/msa_mask when cfg.msa_rows > 0).
+    """
+    rng = np.random.RandomState(cfg.seed)
+    b, L = cfg.batch_size, cfg.max_len
+    while True:
+        seq = rng.randint(0, NUM_AMINO_ACIDS, size=(b, L)).astype(np.int32)
+        lengths = rng.randint(max(8, L // 2), L + 1, size=(b,))
+        mask = np.arange(L)[None, :] < lengths[:, None]
+        # C-alpha trace: unit-step random walk scaled to ~3.8 A
+        steps = rng.randn(b, L, 3).astype(np.float32)
+        steps /= np.linalg.norm(steps, axis=-1, keepdims=True) + 1e-8
+        coords = np.cumsum(3.8 * steps, axis=1).astype(np.float32)
+        batch = {"seq": seq, "mask": mask, "coords": coords}
+        if cfg.msa_rows > 0:
+            batch["msa"] = rng.randint(
+                0, NUM_AMINO_ACIDS, size=(b, cfg.msa_rows, L)
+            ).astype(np.int32)
+            batch["msa_mask"] = np.broadcast_to(mask[:, None, :], batch["msa"].shape)
+        yield batch
+
+
+def stack_microbatches(it: Iterator[dict], grad_accum: int) -> Iterator[dict]:
+    """Group `grad_accum` batches under a leading microbatch axis for the
+    scanned accumulation in the train step."""
+    while True:
+        mbs = [next(it) for _ in range(grad_accum)]
+        yield {k: np.stack([m[k] for m in mbs]) for k in mbs[0]}
+
+
+def sidechainnet_batches(
+    cfg: DataConfig,
+    casp_version: int = 12,
+    thinning: int = 30,
+    split: str = "train",
+) -> Optional[Iterator[dict]]:
+    """Adapter over sidechainnet (reference train_pre.py:44-55), reshaped to
+    static (b, max_len) batches. Returns None when sidechainnet is absent
+    (it is an optional dependency, as in the reference)."""
+    try:
+        import sidechainnet as scn  # type: ignore
+    except Exception:
+        return None
+
+    data = scn.load(casp_version=casp_version, thinning=thinning)
+
+    def gen():
+        rng = np.random.RandomState(cfg.seed)
+        b, L = cfg.batch_size, cfg.max_len
+        seqs, coords_all = data[split]["seq"], data[split]["crd"]
+        order = np.arange(len(seqs))
+        while True:
+            rng.shuffle(order)
+            for start in range(0, len(order) - b + 1, b):
+                idx = order[start : start + b]
+                seq = np.zeros((b, L), np.int32)
+                mask = np.zeros((b, L), bool)
+                coords = np.zeros((b, L, 3), np.float32)
+                for row, i in enumerate(idx):
+                    s = _encode_seq(seqs[i])[:L]
+                    c = np.asarray(coords_all[i], np.float32).reshape(-1, 14, 3)[
+                        : len(s), 1
+                    ]  # C-alpha is atom 1 in sidechainnet's 14-atom layout
+                    n = min(len(s), len(c))
+                    seq[row, :n] = s[:n]
+                    coords[row, :n] = c[:n]
+                    mask[row, :n] = np.abs(coords[row, :n]).sum(-1) > 0
+                yield {"seq": seq, "mask": mask, "coords": coords}
+
+    return gen()
+
+
+_AA = "ACDEFGHIKLMNPQRSTVWY"
+_AA_IDX = {a: i for i, a in enumerate(_AA)}
+
+
+def _encode_seq(s: str) -> np.ndarray:
+    return np.asarray([_AA_IDX.get(c, NUM_AMINO_ACIDS - 1) for c in s], np.int32)

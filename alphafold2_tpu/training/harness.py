@@ -1,0 +1,131 @@
+"""Training harness: jitted optax train step with scanned grad accumulation.
+
+The reference has no Trainer abstraction at all — its loops are inlined in
+entry scripts with a Python-level gradient-accumulation loop
+(reference train_pre.py:72-102) and empty DeepSpeed/Lightning launcher files
+(reference training_scripts/). Here the harness is a first-class subsystem:
+
+  * one `TrainState` pytree (params, opt state, step);
+  * a single jitted `train_step(state, batch, rng)` in which gradient
+    accumulation is a `lax.scan` over a leading microbatch axis — the XLA
+    analog of the reference's GRADIENT_ACCUMULATE_EVERY=16 Python loop,
+    compiled once and free of host round-trips;
+  * gradients are averaged over microbatches (the reference sums via
+    repeated .backward(); under Adam the two differ only through eps —
+    documented divergence, mean is the standard JAX convention).
+
+The distributed variant of this step (mesh-sharded batch, psum-ed grads)
+lives in alphafold2_tpu/parallel/.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from alphafold2_tpu.models import Alphafold2Config, alphafold2_apply, alphafold2_init
+from alphafold2_tpu.training.losses import bucketed_distance_matrix, distogram_cross_entropy
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Replaces the reference's module-level UPPER_CASE globals
+    (reference train_pre.py:12-19)."""
+
+    learning_rate: float = 3e-4
+    grad_accum: int = 16
+    max_grad_norm: Optional[float] = None  # reference has no clipping
+    weight_decay: float = 0.0
+
+
+def make_optimizer(tcfg: TrainConfig) -> optax.GradientTransformation:
+    tx = []
+    if tcfg.max_grad_norm is not None:
+        tx.append(optax.clip_by_global_norm(tcfg.max_grad_norm))
+    if tcfg.weight_decay > 0.0:
+        tx.append(optax.adamw(tcfg.learning_rate, weight_decay=tcfg.weight_decay))
+    else:
+        tx.append(optax.adam(tcfg.learning_rate))
+    return optax.chain(*tx)
+
+
+def train_state_init(key, cfg: Alphafold2Config, tcfg: TrainConfig):
+    params = alphafold2_init(key, cfg)
+    opt = make_optimizer(tcfg)
+    return {
+        "params": params,
+        "opt_state": opt.init(params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def distogram_loss_fn(params, cfg: Alphafold2Config, batch, rng):
+    """Distogram pretraining loss on one microbatch
+    (reference train_pre.py:82-95).
+
+    batch: {"seq": (b, L) int, "mask": (b, L) bool, "coords": (b, L, 3)
+    C-alpha coords} and optionally {"msa": (b, r, c), "msa_mask"}.
+    """
+    labels = bucketed_distance_matrix(batch["coords"], batch["mask"])
+    logits = alphafold2_apply(
+        params,
+        cfg,
+        batch["seq"],
+        batch.get("msa"),
+        mask=batch["mask"],
+        msa_mask=batch.get("msa_mask"),
+        rng=rng,
+    )
+    return distogram_cross_entropy(logits, labels)
+
+
+def make_train_step(
+    cfg: Alphafold2Config,
+    tcfg: TrainConfig,
+    loss_fn: Callable[..., Any] = distogram_loss_fn,
+):
+    """Build the jitted train step.
+
+    The returned step consumes a batch whose leaves carry a leading
+    microbatch axis (grad_accum, per_device_batch, ...) and scans over it.
+    """
+    opt = make_optimizer(tcfg)
+
+    def microbatch_grads(params, batch, rng):
+        return jax.value_and_grad(loss_fn)(params, cfg, batch, rng)
+
+    def train_step(state, batch, rng=None):
+        params = state["params"]
+
+        def accum(carry, inp):
+            loss_sum, grad_sum = carry
+            mb, i = inp
+            mb_rng = jax.random.fold_in(rng, i) if rng is not None else None
+            loss, grads = microbatch_grads(params, mb, mb_rng)
+            return (
+                loss_sum + loss,
+                jax.tree_util.tree_map(jnp.add, grad_sum, grads),
+            ), None
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        n = tcfg.grad_accum
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            accum, (jnp.zeros((), jnp.float32), zeros), (batch, jnp.arange(n))
+        )
+        loss = loss_sum / n
+        grads = jax.tree_util.tree_map(lambda g: g / n, grad_sum)
+
+        updates, opt_state = opt.update(grads, state["opt_state"], params)
+        params = optax.apply_updates(params, updates)
+        new_state = {
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }
+        return new_state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
+
+    return train_step

@@ -1,0 +1,82 @@
+"""Benchmark: distogram-pretraining train-step throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md), so the baseline is the
+driver-defined operational target of 1.0 optimizer step/sec/chip; the
+benchmarked workload is the train_pre path (reference train_pre.py) at
+crop=256, depth=12, bf16 on TPU (reduced shapes on CPU fallback).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from alphafold2_tpu.models import Alphafold2Config
+    from alphafold2_tpu.training import (
+        DataConfig,
+        TrainConfig,
+        make_train_step,
+        stack_microbatches,
+        synthetic_batches,
+        train_state_init,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        dim, depth, crop, steps = 256, 12, 256, 20
+    else:  # CPU smoke fallback so the bench always completes
+        dim, depth, crop, steps = 64, 2, 64, 3
+
+    cfg = Alphafold2Config(
+        dim=dim,
+        depth=depth,
+        heads=8,
+        dim_head=64,
+        max_seq_len=max(2048, crop),
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    tcfg = TrainConfig(learning_rate=3e-4, grad_accum=1)
+    dcfg = DataConfig(batch_size=1, max_len=crop, seed=0)
+
+    batches = stack_microbatches(synthetic_batches(dcfg), tcfg.grad_accum)
+    state = train_state_init(jax.random.PRNGKey(0), cfg, tcfg)
+    step = jax.jit(make_train_step(cfg, tcfg), donate_argnums=(0,))
+
+    batch = next(batches)
+    rng = jax.random.PRNGKey(1)
+
+    # warmup / compile
+    state, metrics = step(state, batch, rng)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = step(state, next(batches), jax.random.fold_in(rng, i))
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = steps / dt
+    baseline = 1.0  # driver target: >=1 optimizer step/sec/chip (BASELINE.md)
+    print(
+        json.dumps(
+            {
+                "metric": f"train_pre_steps_per_sec_crop{crop}_depth{depth}_"
+                          f"{jax.devices()[0].platform}",
+                "value": round(steps_per_sec, 4),
+                "unit": "steps/sec",
+                "vs_baseline": round(steps_per_sec / baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""Distributed tests on the virtual 8-device CPU mesh.
+
+The reference has no distributed tests at all (SURVEY.md §4: its launchers
+are empty files). The strategy here is the one the survey prescribes:
+sharded-vs-single-device parity — the same step on a (data x model) mesh
+must produce the same losses and parameters as the unsharded step.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu.models import Alphafold2Config, alphafold2_apply
+from alphafold2_tpu.parallel import (
+    make_mesh,
+    make_sharded_train_step,
+    sharded_train_state_init,
+    state_shardings,
+)
+from alphafold2_tpu.training import (
+    DataConfig,
+    TrainConfig,
+    make_train_step,
+    stack_microbatches,
+    synthetic_batches,
+    train_state_init,
+)
+
+CFG = Alphafold2Config(dim=32, depth=1, heads=2, dim_head=8, max_seq_len=64)
+TCFG = TrainConfig(learning_rate=1e-3, grad_accum=2)
+
+
+def _batch(batch_size=4, max_len=12, msa_rows=0, seed=0):
+    dcfg = DataConfig(batch_size=batch_size, max_len=max_len, msa_rows=msa_rows, seed=seed)
+    return next(stack_microbatches(synthetic_batches(dcfg), TCFG.grad_accum))
+
+
+def test_eight_devices_present():
+    assert len(jax.devices()) == 8
+
+
+def test_dp_tp_matches_single_device():
+    mesh = make_mesh({"data": 2, "model": 2})
+    batch = _batch()
+
+    # single-device oracle
+    state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+    step = jax.jit(make_train_step(CFG, TCFG))
+
+    # sharded
+    sh_state, _ = sharded_train_state_init(jax.random.PRNGKey(0), CFG, TCFG, mesh)
+    sh_step, _ = make_sharded_train_step(
+        CFG, TCFG, mesh, batch, donate_state=False
+    )
+
+    rng = jax.random.PRNGKey(1)
+    for i in range(3):
+        b = _batch(seed=i)
+        state, m1 = step(state, b, rng)
+        sh_state, m2 = sh_step(sh_state, b, rng)
+        np.testing.assert_allclose(
+            float(m1["loss"]), float(m2["loss"]), rtol=2e-5
+        )
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state["params"]),
+        jax.tree_util.tree_leaves(sh_state["params"]),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_dp_only_mesh():
+    mesh = make_mesh({"data": 8})
+    batch = _batch(batch_size=8)
+    sh_state, _ = sharded_train_state_init(
+        jax.random.PRNGKey(0), CFG, TCFG, mesh, tp=False
+    )
+    sh_step, _ = make_sharded_train_step(
+        CFG, TCFG, mesh, batch, tp=False, donate_state=False
+    )
+    _, metrics = sh_step(sh_state, batch, jax.random.PRNGKey(1))
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_tp_forward_parity_msa_model():
+    """Tensor-parallel sharded forward == replicated forward, incl. MSA and
+    KV-compressed cross-attention params."""
+    import dataclasses
+    cfg = dataclasses.replace(CFG, cross_attn_compress_ratio=2, msa_tie_row_attn=True)
+    mesh = make_mesh({"data": 2, "model": 4})
+
+    from alphafold2_tpu.models import alphafold2_init
+    params = alphafold2_init(jax.random.PRNGKey(3), cfg)
+    sharded_params = jax.device_put(params, state_shardings(mesh, params))
+
+    rs = np.random.RandomState(0)
+    seq = jnp.asarray(rs.randint(0, 21, size=(2, 11)))
+    msa = jnp.asarray(rs.randint(0, 21, size=(2, 3, 11)))
+
+    fwd = jax.jit(lambda p: alphafold2_apply(p, cfg, seq, msa))
+    np.testing.assert_allclose(
+        np.asarray(fwd(params)), np.asarray(fwd(sharded_params)), atol=2e-5
+    )
+
+
+def test_reversible_sharded_step():
+    """Reversible trunk (scanned custom_vjp) under a DP+TP mesh."""
+    import dataclasses
+    cfg = dataclasses.replace(CFG, depth=2, reversible=True)
+    mesh = make_mesh({"data": 2, "model": 2})
+    dcfg = DataConfig(batch_size=2, max_len=10, msa_rows=3, seed=7)
+    batch = next(stack_microbatches(synthetic_batches(dcfg), TCFG.grad_accum))
+
+    sh_state, _ = sharded_train_state_init(jax.random.PRNGKey(0), cfg, TCFG, mesh)
+    sh_step, _ = make_sharded_train_step(cfg, TCFG, mesh, batch, donate_state=False)
+    _, metrics = sh_step(sh_state, batch, jax.random.PRNGKey(1))
+    assert np.isfinite(float(metrics["loss"]))

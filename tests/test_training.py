@@ -1,0 +1,92 @@
+"""Training harness tests: bucketize parity vs torch, loss semantics, and a
+short loss-goes-down run — the check the reference never had (its loop is
+fire-and-forget, reference train_pre.py:72-102)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu.models import Alphafold2Config
+from alphafold2_tpu.training import (
+    DataConfig,
+    TrainConfig,
+    bucketed_distance_matrix,
+    distogram_cross_entropy,
+    make_train_step,
+    stack_microbatches,
+    synthetic_batches,
+    train_state_init,
+)
+
+
+def test_bucketize_matches_torch():
+    torch = pytest.importorskip("torch")
+    rs = np.random.RandomState(0)
+    coords = rs.randn(2, 16, 3).astype(np.float32) * 8
+    mask = rs.rand(2, 16) > 0.2
+
+    got = bucketed_distance_matrix(jnp.asarray(coords), jnp.asarray(mask))
+
+    # reference train_pre.py:35-40
+    tc = torch.from_numpy(coords)
+    distances = torch.cdist(tc, tc, p=2)
+    boundaries = torch.linspace(2, 20, steps=37)
+    disc = torch.bucketize(distances, boundaries[:-1])
+    tm = torch.from_numpy(mask)
+    disc.masked_fill_(~(tm[:, :, None] & tm[:, None, :]), -100)
+
+    np.testing.assert_array_equal(np.asarray(got), disc.numpy())
+
+
+def test_cross_entropy_matches_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rs = np.random.RandomState(1)
+    logits = rs.randn(2, 8, 8, 37).astype(np.float32)
+    labels = rs.randint(0, 37, size=(2, 8, 8))
+    labels[0, :2] = -100
+
+    got = distogram_cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+    want = F.cross_entropy(
+        torch.from_numpy(logits).permute(0, 3, 1, 2),
+        torch.from_numpy(labels),
+        ignore_index=-100,
+    )
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+
+def test_train_loss_decreases():
+    cfg = Alphafold2Config(dim=32, depth=1, heads=2, dim_head=8, max_seq_len=64)
+    tcfg = TrainConfig(learning_rate=1e-3, grad_accum=2)
+    dcfg = DataConfig(batch_size=2, max_len=16, seed=3)
+
+    state = train_state_init(jax.random.PRNGKey(0), cfg, tcfg)
+    step = jax.jit(make_train_step(cfg, tcfg))
+    batches = stack_microbatches(synthetic_batches(dcfg), tcfg.grad_accum)
+
+    # overfit a single repeated batch: loss must drop clearly
+    batch = next(batches)
+    losses = []
+    for i in range(30):
+        state, metrics = step(state, batch, jax.random.PRNGKey(i))
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.3, losses
+    assert int(state["step"]) == 30
+
+
+def test_train_step_msa_and_reversible():
+    cfg = Alphafold2Config(
+        dim=32, depth=2, heads=2, dim_head=8, max_seq_len=64, reversible=True
+    )
+    tcfg = TrainConfig(learning_rate=1e-3, grad_accum=2)
+    dcfg = DataConfig(batch_size=1, max_len=12, msa_rows=3, seed=4)
+
+    state = train_state_init(jax.random.PRNGKey(1), cfg, tcfg)
+    step = jax.jit(make_train_step(cfg, tcfg))
+    batch = next(stack_microbatches(synthetic_batches(dcfg), tcfg.grad_accum))
+    state, metrics = step(state, batch, jax.random.PRNGKey(2))
+    assert np.isfinite(float(metrics["loss"]))
+    assert np.isfinite(float(metrics["grad_norm"]))
