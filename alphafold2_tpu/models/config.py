@@ -9,7 +9,7 @@ capability flag.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Tuple, Union
+from typing import Any, Optional, Tuple, Union
 
 import jax.numpy as jnp
 
@@ -41,6 +41,11 @@ class Alphafold2Config:
     # alphafold2.py:392, a bug; we apply it per layer)
     sparse_self_attn: Union[bool, Tuple[bool, ...]] = False
     sparse_block_size: int = 16
+    sparse_num_random_blocks: Optional[int] = None  # None: max_seq_len//block//4
+    sparse_num_local_blocks: int = 4
+    sparse_num_global_blocks: int = 1
+    sparse_layout_seed: int = 0
+    sparse_use_kernel: bool = False  # Pallas TPU kernel fast path
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False
     template_attn_depth: int = 2
@@ -50,6 +55,18 @@ class Alphafold2Config:
     def layer_sparse(self) -> Tuple[bool, ...]:
         v = self.sparse_self_attn
         return v if isinstance(v, tuple) else (bool(v),) * self.depth
+
+    def sparse_config(self):
+        from alphafold2_tpu.ops.sparse import SparseConfig
+
+        return SparseConfig(
+            block_size=self.sparse_block_size,
+            num_random_blocks=self.sparse_num_random_blocks,
+            num_local_blocks=self.sparse_num_local_blocks,
+            num_global_blocks=self.sparse_num_global_blocks,
+            layout_seed=self.sparse_layout_seed,
+            max_seq_len=self.max_seq_len,
+        )
 
     def self_attn_config(self) -> AttentionConfig:
         return AttentionConfig(

@@ -29,6 +29,36 @@ from alphafold2_tpu.ops.attention import (
 )
 from alphafold2_tpu.ops.core import layer_norm, layer_norm_init
 from alphafold2_tpu.ops.feedforward import feed_forward_apply, feed_forward_init
+from alphafold2_tpu.ops.sparse import sparse_attention_apply
+
+
+def make_sparse_axial_fn(cfg: Alphafold2Config):
+    """Inner-attention override running each axial pass block-sparsely.
+
+    Replaces the dense inner attention with the variable-sparsity pattern
+    for layers flagged in cfg.layer_sparse — the reference applies sparse
+    attention to the pair-rep (seq) axial passes only
+    (reference alphafold2.py:393), never to tied-row MSA attention
+    (reference alphafold2.py:192).
+    """
+    attn_cfg = cfg.self_attn_config()
+    scfg = cfg.sparse_config()
+
+    def fn(params, x, *, axis, mask, tie_dim, rng, **ctx):
+        del axis
+        if ctx:
+            raise ValueError("sparse attention is self-attention only")
+        if tie_dim is not None:
+            raise ValueError(
+                "sparse attention is incompatible with tied-row attention "
+                "(reference alphafold2.py:192)"
+            )
+        return sparse_attention_apply(
+            params, attn_cfg, scfg, x, mask=mask, rng=rng,
+            use_kernel=cfg.sparse_use_kernel,
+        )
+
+    return fn
 
 
 # --- pre-norm wrapped blocks ------------------------------------------------
@@ -132,15 +162,26 @@ def sequential_trunk_apply(
     x_mask_flat = x_mask.reshape(b, -1) if x_mask is not None else None
     msa_mask_flat = msa_mask.reshape(b, -1) if msa_mask is not None else None
 
+    layer_sparse = cfg.layer_sparse
+    sparse_fn = make_sparse_axial_fn(cfg) if any(layer_sparse) else None
+
     for li, layer in enumerate(layers):
         lrng = jax.random.fold_in(rng, li) if rng is not None else None
         rngs = (
             jax.random.split(lrng, 6) if lrng is not None else [None] * 6
         )
 
-        # pair axial self-attention (reference alphafold2.py:309)
+        # pair axial self-attention (reference alphafold2.py:309), with the
+        # block-sparse inner attention on layers flagged sparse — applied
+        # PER LAYER, fixing the reference bug that ignores the per-layer
+        # tuple (reference alphafold2.py:392)
         x = prenorm_axial_apply(
-            layer["seq_attn"], self_cfg, x, mask=x_mask, rng=rngs[0]
+            layer["seq_attn"],
+            self_cfg,
+            x,
+            mask=x_mask,
+            rng=rngs[0],
+            attention_fn=sparse_fn if layer_sparse[li] else None,
         ) + x
 
         if m is not None:

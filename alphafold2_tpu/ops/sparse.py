@@ -1,0 +1,219 @@
+"""Block-sparse self-attention (variable sparsity layout).
+
+TPU-native replacement for the reference's DeepSpeed `SparseSelfAttention`
+with `VariableSparsityConfig` (reference alphafold2_pytorch/alphafold2.py:
+183-238): block size 16, bidirectional, random blocks defaulting to
+`max_seq_len // block // 4`, additive key-padding mask. The CUDA/Triton
+kernels DeepSpeed builds (reference install_deepspeed.sh) are replaced by:
+
+  * a static block LAYOUT (local group + global + random blocks, mirroring
+    the structure of DeepSpeed's VariableSparsityConfig defaults:
+    num_local_blocks=4, num_global_blocks=1) computed host-side;
+  * a block-GATHER attention in pure XLA: per query block, only its active
+    key blocks are gathered and attended — compute/memory O(n · A · block)
+    instead of O(n²), static shapes, fully differentiable (no custom
+    kernel needed for the bwd: XLA differentiates the gather);
+  * a Pallas TPU kernel fast path for the same computation
+    (ops/sparse_kernel.py).
+
+Deliberate divergences from the reference (documented):
+  * the reference DISCARDS the user's mask whenever padding is needed
+    (it rebuilds an all-ones mask, reference alphafold2.py:218-221) — we
+    honor the caller's mask and extend it with padding;
+  * the reference also computes full dense attention logits that are never
+    used (dead compute, reference alphafold2.py:227) — not reproduced;
+  * DeepSpeed samples random blocks per head with torch's global RNG; our
+    random blocks are deterministic per (layout_seed, row).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu.ops.core import dropout, linear
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseConfig:
+    """Static sparsity hyper-parameters (hashable, jit-static)."""
+
+    block_size: int = 16  # reference alphafold2.py:187
+    num_random_blocks: Optional[int] = None  # None: max_seq_len//block//4
+    num_local_blocks: int = 4  # DeepSpeed VariableSparsityConfig default
+    num_global_blocks: int = 1  # DeepSpeed VariableSparsityConfig default
+    layout_seed: int = 0
+    max_seq_len: int = 2048  # reference alphafold2.py:333
+
+
+@functools.lru_cache(maxsize=64)
+def sparsity_layout(num_blocks: int, scfg: SparseConfig) -> np.ndarray:
+    """(num_blocks, num_blocks) bool block-connectivity, bidirectional.
+
+    Local: blocks attend within their group of `num_local_blocks`.
+    Global: the first `num_global_blocks` blocks attend everywhere and are
+    attended by everyone. Random: `num_random_blocks` extra key blocks per
+    query row (symmetrized for bidirectionality).
+    """
+    B = num_blocks
+    nl = scfg.num_local_blocks
+    ng = min(scfg.num_global_blocks, B)
+    nr = scfg.num_random_blocks
+    if nr is None:
+        nr = scfg.max_seq_len // scfg.block_size // 4  # reference :197
+    nr = min(nr, B)
+
+    layout = np.zeros((B, B), dtype=bool)
+    for g in range(0, B, nl):
+        layout[g : g + nl, g : g + nl] = True
+    layout[:, :ng] = True
+    layout[:ng, :] = True
+    rng = np.random.RandomState(scfg.layout_seed)
+    for i in range(B):
+        cols = rng.choice(B, size=nr, replace=False)
+        layout[i, cols] = True
+    # bidirectional symmetry
+    layout |= layout.T
+    return layout
+
+
+@functools.lru_cache(maxsize=64)
+def layout_block_indices(num_blocks: int, scfg: SparseConfig):
+    """Per-row active key-block indices, padded to the max row population.
+
+    Returns (idx, valid): int32 (B, A) and bool (B, A). Cached per
+    (num_blocks, config) — static at trace time.
+    """
+    layout = sparsity_layout(num_blocks, scfg)
+    counts = layout.sum(axis=1)
+    A = int(counts.max())
+    idx = np.zeros((num_blocks, A), np.int32)
+    valid = np.zeros((num_blocks, A), bool)
+    for i in range(num_blocks):
+        cols = np.nonzero(layout[i])[0]
+        idx[i, : len(cols)] = cols
+        valid[i, : len(cols)] = True
+    return idx, valid
+
+
+def block_sparse_attention(
+    q,
+    k,
+    v,
+    scfg: SparseConfig,
+    *,
+    mask=None,
+    scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    rng=None,
+):
+    """Block-sparse attention over pre-projected q/k/v.
+
+    Args:
+      q, k, v: (b, n, h, dh) with n a multiple of scfg.block_size.
+      mask: (b, n) bool key validity (additive -inf semantics, matching
+        DeepSpeed attn_mask_mode='add', reference alphafold2.py:208).
+
+    Returns: (b, n, h, dh).
+    """
+    b, n, h, dh = q.shape
+    bs = scfg.block_size
+    assert n % bs == 0, f"sequence {n} not a multiple of block {bs}"
+    B = n // bs
+    scale = dh ** -0.5 if scale is None else scale
+
+    idx_np, valid_np = layout_block_indices(B, scfg)
+    idx = jnp.asarray(idx_np)
+    valid = jnp.asarray(valid_np)
+    A = idx.shape[1]
+
+    # blocked views: (b, B, bs, h, dh)
+    qb = q.reshape(b, B, bs, h, dh)
+    kb = k.reshape(b, B, bs, h, dh)
+    vb = v.reshape(b, B, bs, h, dh)
+
+    # gather active key/value blocks per query row: (b, B, A, bs, h, dh)
+    kg = jnp.take(kb, idx, axis=1)
+    vg = jnp.take(vb, idx, axis=1)
+
+    logits = jnp.einsum("bqihd,bqajhd->bhqiaj", qb, kg) * scale
+
+    # key-validity: padded active slots + caller's key padding mask
+    key_ok = valid[None, None, :, None, :, None]  # (1,1,B,1,A,1)
+    if mask is not None:
+        mb = mask.reshape(b, B, bs)
+        mg = jnp.take(mb, idx, axis=1)  # (b, B, A, bs)
+        key_ok = key_ok & mg[:, None, :, None, :, :]
+    neg = jnp.finfo(jnp.float32).min
+    logits = jnp.where(key_ok, logits, neg)
+
+    attn = jax.nn.softmax(logits.astype(jnp.float32), axis=(-2, -1)).astype(q.dtype)
+    attn = dropout(rng, attn, dropout_rate)
+    out = jnp.einsum("bhqiaj,bqajhd->bqihd", attn, vg)
+    return out.reshape(b, n, h, dh)
+
+
+def sparse_attention_apply(
+    params,
+    cfg,
+    scfg: SparseConfig,
+    x,
+    *,
+    mask=None,
+    rng=None,
+    use_kernel: bool = False,
+):
+    """Drop-in sparse counterpart of `attention_apply` for SELF-attention.
+
+    Shares the dense attention's parameters (to_q / to_kv / to_out) — the
+    sparsity only changes the attention pattern, exactly as the reference's
+    SparseAttention subclasses Attention (reference alphafold2.py:183).
+    Pads to a block multiple and unpads on exit (reference :216-222, but
+    honoring the caller's mask — see module docstring).
+    """
+    b, n, _ = x.shape
+    dtype = cfg.dtype
+    bs = scfg.block_size
+
+    q = linear(params["to_q"], x, dtype=dtype)
+    kv = linear(params["to_kv"], x, dtype=dtype)
+    k, v = jnp.split(kv, 2, axis=-1)
+
+    h, dh = cfg.heads, cfg.dim_head
+
+    pad = (-n) % bs
+    if pad:
+        q, k, v = (jnp.pad(t, ((0, 0), (0, pad), (0, 0))) for t in (q, k, v))
+        if mask is None:
+            mask = jnp.ones((b, n), bool)
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+
+    def split_heads(t):
+        return t.reshape(b, t.shape[1], h, dh)
+
+    # the streaming kernel does not implement attention-weight dropout;
+    # fall back to the XLA path when dropout is live so the two paths
+    # always compute the same function
+    if use_kernel and (rng is None or cfg.dropout == 0.0):
+        from alphafold2_tpu.ops.sparse_kernel import block_sparse_attention_tpu
+
+        out = block_sparse_attention_tpu(
+            split_heads(q), split_heads(k), split_heads(v), scfg, mask
+        )
+    else:
+        out = block_sparse_attention(
+            split_heads(q),
+            split_heads(k),
+            split_heads(v),
+            scfg,
+            mask=mask,
+            dropout_rate=cfg.dropout,
+            rng=rng,
+        )
+    out = out.reshape(b, out.shape[1], h * dh)[:, :n]
+    return linear(params["to_out"], out, dtype=dtype)

@@ -1,0 +1,166 @@
+"""Block-sparse attention tests.
+
+The reference has NO sparse-vs-dense parity test (SURVEY.md §4 flags this
+gap); here the all-blocks-active sparse layout is required to reproduce
+dense attention exactly, plus layout structure and model-integration
+checks for the interleaved (True, False)*N depth config
+(reference README.md:72-79).
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu.models import Alphafold2Config, alphafold2_apply, alphafold2_init
+from alphafold2_tpu.ops.attention import AttentionConfig, attention_apply, attention_init
+from alphafold2_tpu.ops.sparse import (
+    SparseConfig,
+    layout_block_indices,
+    sparse_attention_apply,
+    sparsity_layout,
+)
+
+
+def test_layout_structure():
+    scfg = SparseConfig(block_size=16, num_random_blocks=2, max_seq_len=256)
+    L = sparsity_layout(16, scfg)
+    # bidirectional
+    assert (L == L.T).all()
+    # global first block row+col
+    assert L[0].all() and L[:, 0].all()
+    # local groups of 4 on the diagonal
+    for g in range(0, 16, 4):
+        assert L[g : g + 4, g : g + 4].all()
+    # random blocks: rows have more than local+global
+    idx, valid = layout_block_indices(16, scfg)
+    assert valid.sum(axis=1).min() >= 4  # at least the local group
+
+
+def test_sparse_full_layout_matches_dense():
+    """With every block active, sparse == dense self-attention."""
+    cfg = AttentionConfig(dim=32, heads=2, dim_head=8)
+    # num_local_blocks >= num_blocks makes the layout all-ones
+    scfg = SparseConfig(block_size=4, num_local_blocks=64, num_random_blocks=0,
+                        max_seq_len=64)
+    params = attention_init(jax.random.PRNGKey(0), cfg)
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 16, 32).astype(np.float32))
+    mask = jnp.asarray(rs.rand(2, 16) > 0.2)
+
+    dense = attention_apply(params, cfg, x, mask=mask)
+    sparse = sparse_attention_apply(params, cfg, scfg, x, mask=mask)
+    # compare valid query rows only: dense masks queries AND keys (outer
+    # product), sparse — like the reference's DeepSpeed key_padding_mask —
+    # masks keys only; masked-row outputs are garbage in both
+    m = np.asarray(mask)
+    np.testing.assert_allclose(
+        np.asarray(sparse)[m], np.asarray(dense)[m], atol=1e-5
+    )
+
+
+def test_sparse_with_padding_matches_dense():
+    """Sequence not a multiple of the block size: pad/unpad round-trip."""
+    cfg = AttentionConfig(dim=32, heads=2, dim_head=8)
+    scfg = SparseConfig(block_size=8, num_local_blocks=64, num_random_blocks=0,
+                        max_seq_len=64)
+    params = attention_init(jax.random.PRNGKey(1), cfg)
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(1, 13, 32).astype(np.float32))
+
+    dense = attention_apply(params, cfg, x)
+    sparse = sparse_attention_apply(params, cfg, scfg, x)
+    np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense), atol=1e-5)
+
+
+def test_sparse_restricts_attention():
+    """A genuinely sparse layout differs from dense (sanity that the mask
+    actually restricts the pattern)."""
+    cfg = AttentionConfig(dim=32, heads=2, dim_head=8)
+    scfg = SparseConfig(block_size=4, num_local_blocks=1, num_global_blocks=0,
+                        num_random_blocks=0, max_seq_len=64)
+    params = attention_init(jax.random.PRNGKey(2), cfg)
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(1, 16, 32).astype(np.float32))
+    dense = attention_apply(params, cfg, x)
+    sparse = sparse_attention_apply(params, cfg, scfg, x)
+    assert not np.allclose(np.asarray(sparse), np.asarray(dense), atol=1e-3)
+
+
+def test_model_interleaved_sparse():
+    """Interleaved dense/sparse depth config (reference README.md:72-79)."""
+    cfg = Alphafold2Config(
+        dim=32,
+        depth=2,
+        heads=2,
+        dim_head=8,
+        max_seq_len=64,
+        sparse_self_attn=(True, False),
+        sparse_block_size=4,
+    )
+    params = alphafold2_init(jax.random.PRNGKey(3), cfg)
+    rs = np.random.RandomState(3)
+    seq = jnp.asarray(rs.randint(0, 21, size=(1, 10)))
+    msa = jnp.asarray(rs.randint(0, 21, size=(1, 3, 10)))
+
+    @jax.jit
+    def loss(params):
+        out = alphafold2_apply(params, cfg, seq, msa)
+        return jnp.sum(out ** 2), out
+
+    (val, out), grads = jax.value_and_grad(loss, has_aux=True)(params)
+    assert out.shape == (1, 10, 10, 37)
+    assert np.isfinite(np.asarray(out)).all()
+    for leaf in jax.tree_util.tree_leaves(grads):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_pallas_kernel_matches_xla_path():
+    """Pallas flash-style kernel (interpret mode on CPU) == XLA block-gather
+    path, forward and gradients."""
+    from alphafold2_tpu.ops.sparse import block_sparse_attention
+    from alphafold2_tpu.ops.sparse_kernel import block_sparse_attention_tpu
+
+    scfg = SparseConfig(block_size=4, num_local_blocks=2, num_global_blocks=1,
+                        num_random_blocks=2, max_seq_len=64)
+    rs = np.random.RandomState(5)
+    b, n, h, dh = 2, 16, 2, 8
+    q = jnp.asarray(rs.randn(b, n, h, dh).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, n, h, dh).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, n, h, dh).astype(np.float32))
+    mask = jnp.asarray(rs.rand(b, n) > 0.2)
+
+    ref_out = block_sparse_attention(q, k, v, scfg, mask=mask)
+    ker_out = block_sparse_attention_tpu(q, k, v, scfg, mask)
+    np.testing.assert_allclose(
+        np.asarray(ker_out), np.asarray(ref_out), atol=1e-5
+    )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(block_sparse_attention(q, k, v, scfg, mask=mask) ** 2)
+
+    def loss_ker(q, k, v):
+        return jnp.sum(block_sparse_attention_tpu(q, k, v, scfg, mask) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ker = jax.grad(loss_ker, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ref, g_ker):
+        np.testing.assert_allclose(np.asarray(b_), np.asarray(a), atol=1e-4)
+
+
+def test_sparse_rejects_tied_rows():
+    cfg = Alphafold2Config(
+        dim=32, depth=1, heads=2, dim_head=8, max_seq_len=64,
+        sparse_self_attn=True, sparse_block_size=4, msa_tie_row_attn=True,
+    )
+    # tied rows apply to the MSA stream only, sparse to the seq stream only,
+    # so the two coexist at the model level (reference forbids combining
+    # them within ONE attention, alphafold2.py:192 — our trunk never does)
+    params = alphafold2_init(jax.random.PRNGKey(4), cfg)
+    rs = np.random.RandomState(4)
+    seq = jnp.asarray(rs.randint(0, 21, size=(1, 8)))
+    msa = jnp.asarray(rs.randint(0, 21, size=(1, 3, 8)))
+    out = alphafold2_apply(params, cfg, seq, msa)
+    assert np.isfinite(np.asarray(out)).all()
