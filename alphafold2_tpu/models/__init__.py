@@ -17,8 +17,16 @@ from alphafold2_tpu.models.reversible import (
     reversible_trunk_apply,
     stack_layers,
 )
+from alphafold2_tpu.models.refiner import (
+    RefinerConfig,
+    refiner_init,
+    refiner_apply,
+)
 
 __all__ = [
+    "RefinerConfig",
+    "refiner_init",
+    "refiner_apply",
     "Alphafold2Config",
     "alphafold2_init",
     "alphafold2_apply",

@@ -1,0 +1,151 @@
+"""SE(3)-equivariant structure refiner.
+
+Functional replacement for the reference's *external* SE3Transformer
+dependency (imported at reference alphafold2_pytorch/alphafold2.py:13,
+instantiated in train_end2end.py:86-94, invoked at train_end2end.py:168-169
+as `refiner(atom_tokens, proto_sidechain, mask) -> refined coords`;
+declared as deps at setup.py:19,23).
+
+Rather than porting the irreducible-representation (spherical-harmonic)
+machinery of SE3-Transformer — which maps poorly onto the MXU (small tensor
+products, gather-heavy) — this module uses an E(3)-equivariant message
+passing network in the style of EGNN (Satorras et al., 2021): node features
+are invariant (built from atom tokens and pairwise distances only), and
+coordinate updates are linear combinations of difference vectors. That
+gives exact rotation/translation equivariance with nothing but large dense
+einsums, which is the TPU-native answer to the same functional contract:
+
+  h_ij   = MLP(h_i, h_j, |x_i - x_j|^2)          # invariant messages
+  a_ij   = sigmoid(w . h_ij)                     # attention gate
+  x_i   <- x_i + mean_j a_ij * (x_i - x_j)/(|.|+1) * phi_x(h_ij)
+  h_i   <- h_i + MLP(h_i, sum_j a_ij h_ij)
+
+All pair tensors are dense (atoms, atoms) with a boolean mask — static
+shapes, fully fusable by XLA. Cost is O(A^2 * msg_dim) per layer; at the
+north-star crop (384 residues x 14 atoms = 5376 atoms) this fits
+comfortably in HBM in bfloat16.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu.ops.core import embedding, embedding_init, layer_norm, layer_norm_init, linear, linear_init
+
+
+@dataclasses.dataclass(frozen=True)
+class RefinerConfig:
+    """Shape/capability config (mirrors the kwargs the reference passes to
+    SE3Transformer at train_end2end.py:86-94: num_tokens=10 atom types,
+    dim=64, depth=2)."""
+
+    num_tokens: int = 10
+    dim: int = 64
+    depth: int = 2
+    msg_dim: int = 64
+    dtype: Any = jnp.float32
+    # scale on the per-layer coordinate delta; final coord head is
+    # zero-initialized so an untrained refiner is the identity on coords.
+    coord_scale: float = 1.0
+
+
+def _mlp_init(key, d_in, d_hidden, d_out):
+    k1, k2 = jax.random.split(key)
+    return {"l1": linear_init(k1, d_in, d_hidden), "l2": linear_init(k2, d_hidden, d_out)}
+
+
+def _mlp(params, x, dtype):
+    h = jax.nn.silu(linear(params["l1"], x, dtype=dtype))
+    return linear(params["l2"], h, dtype=dtype)
+
+
+def refiner_init(key, cfg: RefinerConfig):
+    keys = jax.random.split(key, 1 + cfg.depth)
+    params = {
+        "token_emb": embedding_init(keys[0], cfg.num_tokens, cfg.dim),
+        "out_norm": layer_norm_init(cfg.dim),
+        "layers": [],
+    }
+    for li in range(cfg.depth):
+        k = jax.random.split(keys[1 + li], 5)
+        layer = {
+            "edge_mlp": _mlp_init(k[0], 2 * cfg.dim + 1, cfg.msg_dim, cfg.msg_dim),
+            "att": linear_init(k[1], cfg.msg_dim, 1),
+            "coord_mlp": _mlp_init(k[2], cfg.msg_dim, cfg.msg_dim, 1),
+            "node_mlp": _mlp_init(k[3], cfg.dim + cfg.msg_dim, cfg.dim, cfg.dim),
+            "norm": layer_norm_init(cfg.dim),
+        }
+        # zero the final coord projection: identity coords at init
+        layer["coord_mlp"]["l2"]["w"] = jnp.zeros_like(layer["coord_mlp"]["l2"]["w"])
+        layer["coord_mlp"]["l2"]["b"] = jnp.zeros_like(layer["coord_mlp"]["l2"]["b"])
+        params["layers"].append(layer)
+    return params
+
+
+def refiner_apply(params, cfg: RefinerConfig, tokens, coords, mask=None):
+    """Refine an atom point cloud.
+
+    Args:
+      tokens: (b, A) int atom-type ids (the reference's `atom_tokens`,
+        train_end2end.py:143-146).
+      coords: (b, A, 3) float coordinates (the proto sidechain cloud,
+        train_end2end.py:163-169).
+      mask:   (b, A) bool atom presence; masked atoms neither send messages
+        nor move.
+
+    Returns: (refined_coords (b, A, 3), node_features (b, A, dim)).
+    """
+    b, num_atoms = tokens.shape
+    dtype = cfg.dtype
+    coords = coords.astype(jnp.float32)
+    if mask is None:
+        mask = jnp.ones((b, num_atoms), bool)
+
+    # pair mask excludes self-edges and masked endpoints
+    eye = jnp.eye(num_atoms, dtype=bool)[None]
+    pair_mask = (mask[:, :, None] & mask[:, None, :]) & ~eye  # (b, A, A)
+    denom = jnp.maximum(jnp.sum(pair_mask, axis=-1, keepdims=True), 1).astype(jnp.float32)
+
+    h = embedding(params["token_emb"], tokens, dtype=dtype)  # (b, A, d)
+
+    for layer in params["layers"]:
+        diff = coords[:, :, None, :] - coords[:, None, :, :]  # (b, A, A, 3)
+        sqdist = jnp.sum(jnp.square(diff), axis=-1, keepdims=True)  # (b, A, A, 1)
+
+        # The edge MLP's first layer is linear over concat(h_i, h_j, |.|^2),
+        # which is separable: project h once per *node* and broadcast-add,
+        # so the largest pair tensor is (b, A, A, msg) rather than
+        # (b, A, A, 2*dim+1) — at 5376 atoms that halves peak pair memory.
+        d = h.shape[-1]
+        w1 = layer["edge_mlp"]["l1"]["w"].astype(dtype)
+        b1 = layer["edge_mlp"]["l1"]["b"].astype(dtype)
+        hd = h.astype(dtype)
+        pre = (
+            (hd @ w1[:d])[:, :, None, :]
+            + (hd @ w1[d : 2 * d])[:, None, :, :]
+            + sqdist.astype(dtype) * w1[2 * d]
+            + b1
+        )
+        m = linear(layer["edge_mlp"]["l2"], jax.nn.silu(pre), dtype=dtype)  # (b, A, A, msg)
+        gate = jax.nn.sigmoid(linear(layer["att"], m, dtype=dtype))  # (b, A, A, 1)
+        gate = jnp.where(pair_mask[..., None], gate, 0.0)
+
+        # equivariant coordinate update along normalized difference vectors
+        coef = _mlp(layer["coord_mlp"], m, dtype).astype(jnp.float32)  # (b, A, A, 1)
+        # sqrt under a where: sqrt(0) on the (masked-out) diagonal would give
+        # NaN gradients that 0-gates cannot stop (0 * nan = nan in the vjp)
+        safe_sq = jnp.where(pair_mask[..., None], sqdist, 1.0)
+        direction = jnp.where(pair_mask[..., None], diff, 0.0) / (jnp.sqrt(safe_sq) + 1.0)
+        delta = jnp.sum(gate.astype(jnp.float32) * coef * direction, axis=2) / denom
+        coords = coords + cfg.coord_scale * jnp.where(mask[..., None], delta, 0.0)
+
+        # invariant feature update
+        agg = jnp.sum(gate * m, axis=2) / denom.astype(m.dtype)  # (b, A, msg)
+        upd = _mlp(layer["node_mlp"], jnp.concatenate([h, agg], axis=-1), dtype)
+        h = layer_norm(layer["norm"], h + upd)
+
+    return coords, h

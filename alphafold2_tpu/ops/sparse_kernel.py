@@ -23,6 +23,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -81,7 +82,7 @@ def _forward(q, k, v, scfg: SparseConfig, mask):
     scale = dh ** -0.5
 
     idx_np, valid_np = layout_block_indices(B, scfg)
-    idx = jnp.asarray(jnp.where(jnp.asarray(valid_np), jnp.asarray(idx_np), -1))
+    idx = jnp.asarray(np.where(valid_np, idx_np, -1))
     A = idx.shape[1]
 
     # (b*h, n, dh) layout; bias (b, n) additive

@@ -12,6 +12,7 @@ import dataclasses
 import numpy as np
 import jax
 import jax.numpy as jnp
+import pytest
 
 from alphafold2_tpu.models import Alphafold2Config, alphafold2_apply, alphafold2_init
 from alphafold2_tpu.ops.attention import AttentionConfig, attention_apply, attention_init
@@ -150,7 +151,7 @@ def test_pallas_kernel_matches_xla_path():
         np.testing.assert_allclose(np.asarray(b_), np.asarray(a), atol=1e-4)
 
 
-def test_sparse_rejects_tied_rows():
+def test_sparse_coexists_with_tied_rows():
     cfg = Alphafold2Config(
         dim=32, depth=1, heads=2, dim_head=8, max_seq_len=64,
         sparse_self_attn=True, sparse_block_size=4, msa_tie_row_attn=True,
@@ -164,3 +165,19 @@ def test_sparse_rejects_tied_rows():
     msa = jnp.asarray(rs.randint(0, 21, size=(1, 3, 8)))
     out = alphafold2_apply(params, cfg, seq, msa)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_sparse_axial_fn_rejects_tied_rows():
+    """Within ONE attention, sparse + tied rows is forbidden
+    (reference alphafold2.py:192)."""
+    from alphafold2_tpu.models.trunk import make_sparse_axial_fn
+
+    cfg = Alphafold2Config(
+        dim=32, depth=1, heads=2, dim_head=8, max_seq_len=64,
+        sparse_self_attn=True, sparse_block_size=4,
+    )
+    fn = make_sparse_axial_fn(cfg)
+    params = attention_init(jax.random.PRNGKey(0), cfg.self_attn_config())
+    x = jnp.zeros((1, 8, 32))
+    with pytest.raises(ValueError):
+        fn(params, x, axis=-2, mask=None, tie_dim=3, rng=None)
