@@ -17,19 +17,36 @@ import jax
 import jax.numpy as jnp
 
 
-def kabsch(X, Y):
-    """Align X onto Y. X, Y: (..., 3, N). Returns (X_aligned, Y_centered)."""
+def kabsch(X, Y, weights=None):
+    """Align X onto Y. X, Y: (..., 3, N). Returns (X_aligned, Y_centered).
+
+    `weights` (..., N), optional: per-point weights (e.g. a boolean atom
+    mask) applied to the centroid and covariance. The reference selects
+    valid atoms by boolean indexing before calling Kabsch
+    (train_end2end.py:172) — dynamic shapes that cannot jit; a weighted
+    Kabsch is the static-shape equivalent (zero-weight points do not
+    influence the alignment but are still carried through the rotation).
+    """
     X = jnp.asarray(X)
     Y = jnp.asarray(Y)
     squeeze = X.ndim == 2
     if squeeze:
         X, Y = X[None], Y[None]
+        if weights is not None:
+            weights = jnp.asarray(weights)[None]
 
-    Xc = X - X.mean(axis=-1, keepdims=True)
-    Yc = Y - Y.mean(axis=-1, keepdims=True)
-
-    # covariance per structure: (..., 3, 3)
-    C = jnp.einsum("...dn,...en->...de", Xc, Yc)
+    if weights is None:
+        Xc = X - X.mean(axis=-1, keepdims=True)
+        Yc = Y - Y.mean(axis=-1, keepdims=True)
+        C = jnp.einsum("...dn,...en->...de", Xc, Yc)
+    else:
+        w = jnp.asarray(weights, X.dtype)[..., None, :]  # (..., 1, N)
+        denom = jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-8)
+        Xc = X - jnp.sum(X * w, axis=-1, keepdims=True) / denom
+        Yc = Y - jnp.sum(Y * w, axis=-1, keepdims=True) / denom
+        # weight one side of the covariance only; Xc/Yc stay unweighted for
+        # the returned aligned coords
+        C = jnp.einsum("...dn,...en->...de", Xc * w, Yc)
     U, S, Vt = jnp.linalg.svd(jax.lax.stop_gradient(C))
 
     # reflection fix: flip the last singular direction where det < 0

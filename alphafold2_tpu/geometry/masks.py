@@ -38,9 +38,9 @@ def scn_backbone_mask(seq_tokens, boolean: bool = True, l_aa: int = NUM_COORDS_P
 
     N is atom 0 of each residue, C-alpha is atom 1 (reference
     `utils.py:180-189`). Returned as numpy so they can serve as *static*
-    masks for `calc_phis` under jit.
+    masks for `calc_phis` under jit. Only the token SHAPE is read, so
+    traced arrays are fine (the masks stay host-side constants).
     """
-    seq_tokens = np.asarray(seq_tokens)
     length = seq_tokens.shape[-1] * l_aa
     pos = np.arange(length)
     N_mask = pos % l_aa == 0

@@ -136,10 +136,12 @@ def refiner_apply(params, cfg: RefinerConfig, tokens, coords, mask=None):
 
         # equivariant coordinate update along normalized difference vectors
         coef = _mlp(layer["coord_mlp"], m, dtype).astype(jnp.float32)  # (b, A, A, 1)
-        # sqrt under a where: sqrt(0) on the (masked-out) diagonal would give
-        # NaN gradients that 0-gates cannot stop (0 * nan = nan in the vjp)
-        safe_sq = jnp.where(pair_mask[..., None], sqdist, 1.0)
-        direction = jnp.where(pair_mask[..., None], diff, 0.0) / (jnp.sqrt(safe_sq) + 1.0)
+        # clamp before sqrt: coincident atoms (the sidechain proto cloud
+        # parks every non-backbone slot at the SAME point) and the diagonal
+        # have sqdist == 0, where sqrt's vjp is inf and 0-gates cannot stop
+        # it (0 * inf = nan); max() routes the gradient to the eps branch
+        norm = jnp.sqrt(jnp.maximum(sqdist, 1e-12))
+        direction = jnp.where(pair_mask[..., None], diff, 0.0) / (norm + 1.0)
         delta = jnp.sum(gate.astype(jnp.float32) * coef * direction, axis=2) / denom
         coords = coords + cfg.coord_scale * jnp.where(mask[..., None], delta, 0.0)
 

@@ -16,7 +16,6 @@ from typing import Callable, Optional
 import jax
 from jax.sharding import Mesh
 
-from alphafold2_tpu.models import Alphafold2Config
 from alphafold2_tpu.training.harness import (
     TrainConfig,
     distogram_loss_fn,
@@ -30,22 +29,33 @@ from alphafold2_tpu.parallel.sharding import (
 )
 
 
-def sharded_train_state_init(key, cfg: Alphafold2Config, tcfg: TrainConfig, mesh: Mesh, *, tp: bool = True):
+def sharded_train_state_init(
+    key,
+    cfg,
+    tcfg: TrainConfig,
+    mesh: Mesh,
+    *,
+    tp: bool = True,
+    state_init: Callable = train_state_init,
+):
     """Init the train state directly into its sharded layout.
 
     Runs init under jit with out_shardings so large params materialize
-    already distributed (no host-memory full copy).
+    already distributed (no host-memory full copy). `state_init` defaults
+    to the distogram-pretrain state; pass e.g.
+    `training.e2e_train_state_init` (with cfg=E2EConfig) for the full
+    structure workload.
     """
-    shape = jax.eval_shape(lambda k: train_state_init(k, cfg, tcfg), key)
+    shape = jax.eval_shape(lambda k: state_init(k, cfg, tcfg), key)
     shardings = state_shardings(mesh, shape, tp=tp)
     init = jax.jit(
-        lambda k: train_state_init(k, cfg, tcfg), out_shardings=shardings
+        lambda k: state_init(k, cfg, tcfg), out_shardings=shardings
     )
     return init(key), shardings
 
 
 def make_sharded_train_step(
-    cfg: Alphafold2Config,
+    cfg,
     tcfg: TrainConfig,
     mesh: Mesh,
     example_batch,
@@ -53,6 +63,7 @@ def make_sharded_train_step(
     loss_fn: Callable = distogram_loss_fn,
     tp: bool = True,
     donate_state: bool = True,
+    state_init: Callable = train_state_init,
 ):
     """Compile the train step with sharding annotations for `mesh`.
 
@@ -66,7 +77,7 @@ def make_sharded_train_step(
     """
     step = make_train_step(cfg, tcfg, loss_fn)
     state_shape = jax.eval_shape(
-        lambda k: train_state_init(k, cfg, tcfg), jax.random.PRNGKey(0)
+        lambda k: state_init(k, cfg, tcfg), jax.random.PRNGKey(0)
     )
     st_shardings = state_shardings(mesh, state_shape, tp=tp)
     b_shardings = batch_shardings(mesh, example_batch, microbatched=True)

@@ -22,10 +22,22 @@ from alphafold2_tpu.training.data import (
     DataConfig,
     stack_microbatches,
     synthetic_batches,
+    synthetic_structure_batches,
     sidechainnet_batches,
+)
+from alphafold2_tpu.training.e2e import (
+    E2EConfig,
+    e2e_loss_fn,
+    e2e_train_state_init,
+    predict_structure,
 )
 
 __all__ = [
+    "E2EConfig",
+    "e2e_loss_fn",
+    "e2e_train_state_init",
+    "predict_structure",
+    "synthetic_structure_batches",
     "IGNORE_INDEX",
     "bucketed_distance_matrix",
     "distogram_cross_entropy",

@@ -55,6 +55,39 @@ def synthetic_batches(cfg: DataConfig) -> Iterator[dict]:
         yield batch
 
 
+def synthetic_structure_batches(cfg: DataConfig) -> Iterator[dict]:
+    """Endless full-atom batches for the end-to-end structure workload
+    (reference train_end2end.py's sidechainnet crd tensor, reshaped
+    (b, L, 14, 3)).
+
+    The backbone is a noisy helix with protein-like chirality
+    (mostly-negative phi dihedrals), so the MDS mirror fix in the pipeline
+    resolves to the correct enantiomer; sidechain slots are parked at the
+    carbonyl C exactly like `sidechain_container` does, giving a
+    reachable target for the refiner.
+    """
+    from alphafold2_tpu.geometry import sidechain_container
+
+    rng = np.random.RandomState(cfg.seed)
+    b, L = cfg.batch_size, cfg.max_len
+    while True:
+        seq = rng.randint(0, NUM_AMINO_ACIDS, size=(b, L)).astype(np.int32)
+        mask = np.ones((b, L), bool)
+        t = 0.6 * np.arange(3 * L)[None, :, None]
+        helix = np.concatenate(
+            [2 * np.cos(t), 2 * np.sin(t), -0.16 * t], axis=-1
+        ).astype(np.float32)
+        backbone = helix + 0.05 * rng.randn(b, 3 * L, 3).astype(np.float32)
+        cloud = np.asarray(sidechain_container(backbone, place_oxygen=True))
+        batch = {"seq": seq, "mask": mask, "coords": cloud}
+        if cfg.msa_rows > 0:
+            batch["msa"] = rng.randint(
+                0, NUM_AMINO_ACIDS, size=(b, cfg.msa_rows, L)
+            ).astype(np.int32)
+            batch["msa_mask"] = np.broadcast_to(mask[:, None, :], batch["msa"].shape)
+        yield batch
+
+
 def stack_microbatches(it: Iterator[dict], grad_accum: int) -> Iterator[dict]:
     """Group `grad_accum` batches under a leading microbatch axis for the
     scanned accumulation in the train step."""

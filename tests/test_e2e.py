@@ -1,0 +1,85 @@
+"""End-to-end structure workload tests (BASELINE config 5 shape).
+
+The reference's train_end2end.py is a non-runnable specification (SURVEY.md
+§3.2 defect list); these tests validate our *working* implementation of its
+intended pipeline: trunk -> distogram -> MDS -> sidechain lift -> refiner ->
+Kabsch RMSD loss, differentiable end to end.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu.models import Alphafold2Config, RefinerConfig
+from alphafold2_tpu.training import (
+    DataConfig,
+    E2EConfig,
+    TrainConfig,
+    e2e_loss_fn,
+    e2e_train_state_init,
+    make_train_step,
+    predict_structure,
+    stack_microbatches,
+    synthetic_structure_batches,
+)
+
+
+@pytest.fixture(scope="module")
+def ecfg():
+    return E2EConfig(
+        model=Alphafold2Config(dim=32, depth=1, heads=2, dim_head=8, max_seq_len=64),
+        refiner=RefinerConfig(num_tokens=14, dim=16, depth=1, msg_dim=16),
+        mds_iters=4,
+    )
+
+
+@pytest.fixture(scope="module")
+def batch():
+    dcfg = DataConfig(batch_size=2, max_len=8, seed=0)
+    return {k: jnp.asarray(v) for k, v in next(synthetic_structure_batches(dcfg)).items()}
+
+
+def test_predict_structure_shapes(ecfg, batch):
+    params = e2e_train_state_init(jax.random.PRNGKey(0), ecfg, TrainConfig())["params"]
+    out = predict_structure(
+        params, ecfg, batch["seq"], mask=batch["mask"], rng=jax.random.PRNGKey(1)
+    )
+    b, L = batch["seq"].shape
+    assert out["refined"].shape == (b, L, 14, 3)
+    assert out["proto"].shape == (b, L, 14, 3)
+    assert out["distogram_logits"].shape == (b, 3 * L, 3 * L, 37)
+    assert np.isfinite(np.asarray(out["refined"])).all()
+
+
+def test_e2e_loss_and_grads(ecfg, batch):
+    state = e2e_train_state_init(jax.random.PRNGKey(0), ecfg, TrainConfig())
+
+    @jax.jit
+    def loss(params):
+        return e2e_loss_fn(params, ecfg, batch, jax.random.PRNGKey(2))
+
+    val, grads = jax.value_and_grad(loss)(state["params"])
+    assert np.isfinite(float(val))
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in leaves)
+    # the loss must actually reach the trunk: some model grads nonzero
+    model_norm = sum(float(jnp.sum(jnp.abs(g))) for g in jax.tree_util.tree_leaves(grads["model"]))
+    refiner_norm = sum(float(jnp.sum(jnp.abs(g))) for g in jax.tree_util.tree_leaves(grads["refiner"]))
+    assert model_norm > 0 and refiner_norm > 0
+
+
+def test_e2e_train_step_improves(ecfg):
+    """A few steps on a fixed batch decrease the loss."""
+    tcfg = TrainConfig(learning_rate=1e-3, grad_accum=2)
+    state = e2e_train_state_init(jax.random.PRNGKey(0), ecfg, tcfg)
+    dcfg = DataConfig(batch_size=1, max_len=8, seed=1)
+    mb = next(stack_microbatches(synthetic_structure_batches(dcfg), tcfg.grad_accum))
+    mb = {k: jnp.asarray(v) for k, v in mb.items()}
+
+    step = jax.jit(make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn))
+    state, first = step(state, mb, jax.random.PRNGKey(3))
+    for i in range(4):
+        state, metrics = step(state, mb, jax.random.PRNGKey(3))
+    assert float(metrics["loss"]) < float(first["loss"])
+    assert int(state["step"]) == 5

@@ -1,0 +1,85 @@
+"""End-to-end structure training entry point.
+
+The reference's `train_end2end.py` is a non-runnable specification (SURVEY.md
+§3.2 lists its defects: unbound names, wrong kwargs, missing imports). This
+is the working TPU-native realization of its *intended* pipeline
+(reference train_end2end.py:104-183): trunk -> distogram -> MDS + mirror
+fix -> sidechain lift -> SE(3)-equivariant refiner -> Kabsch RMSD +
+dispersion loss, all inside ONE jitted train step with scanned gradient
+accumulation.
+
+Usage: python train_end2end.py [--steps N] [--dim 64] [--depth 2] [--len 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+
+from alphafold2_tpu.models import Alphafold2Config, RefinerConfig
+from alphafold2_tpu.training import (
+    DataConfig,
+    E2EConfig,
+    TrainConfig,
+    e2e_loss_fn,
+    e2e_train_state_init,
+    make_train_step,
+    stack_microbatches,
+    synthetic_structure_batches,
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--depth", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--dim-head", type=int, default=16)
+    ap.add_argument("--len", dest="max_len", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--accum", type=int, default=2)
+    ap.add_argument("--mds-iters", type=int, default=20)
+    ap.add_argument("--refiner-depth", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+
+    ecfg = E2EConfig(
+        model=Alphafold2Config(
+            dim=args.dim,
+            depth=args.depth,
+            heads=args.heads,
+            dim_head=args.dim_head,
+            # the trunk sees the x3-elongated backbone sequence
+            max_seq_len=max(64, 3 * args.max_len),
+            dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        ),
+        refiner=RefinerConfig(num_tokens=14, dim=64, depth=args.refiner_depth),
+        mds_iters=args.mds_iters,
+    )
+    tcfg = TrainConfig(learning_rate=args.lr, grad_accum=args.accum)
+    dcfg = DataConfig(batch_size=args.batch, max_len=args.max_len)
+
+    batches = stack_microbatches(synthetic_structure_batches(dcfg), tcfg.grad_accum)
+    state = e2e_train_state_init(jax.random.PRNGKey(0), ecfg, tcfg)
+    train_step = jax.jit(make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn))
+
+    rng = jax.random.PRNGKey(1)
+    t0 = time.time()
+    for step in range(args.steps):
+        rng, step_rng = jax.random.split(rng)
+        state, metrics = train_step(state, next(batches), step_rng)
+        loss = float(metrics["loss"])
+        if step % 10 == 0 or step == args.steps - 1:
+            dt = time.time() - t0
+            print(f"step {step}  loss {loss:.4f}  ({dt:.1f}s elapsed)")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()
