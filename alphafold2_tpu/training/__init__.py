@@ -31,8 +31,20 @@ from alphafold2_tpu.training.e2e import (
     e2e_train_state_init,
     predict_structure,
 )
+from alphafold2_tpu.training.checkpoint import (
+    CheckpointManager,
+    abstract_like,
+    finish,
+    open_or_init,
+    restore_or_init,
+)
 
 __all__ = [
+    "CheckpointManager",
+    "abstract_like",
+    "finish",
+    "open_or_init",
+    "restore_or_init",
     "E2EConfig",
     "e2e_loss_fn",
     "e2e_train_state_init",

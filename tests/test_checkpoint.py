@@ -1,0 +1,93 @@
+"""Checkpoint/resume subsystem tests.
+
+The reference has no checkpointing (no torch.save anywhere — SURVEY.md §5);
+this is new framework surface. Covered: round-trip exactness, rotation,
+resume-or-init, and sharded restore onto an 8-device CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from alphafold2_tpu.models import Alphafold2Config
+from alphafold2_tpu.parallel import make_mesh
+from alphafold2_tpu.parallel.sharding import state_shardings
+from alphafold2_tpu.training import (
+    CheckpointManager,
+    TrainConfig,
+    abstract_like,
+    restore_or_init,
+    train_state_init,
+)
+
+CFG = Alphafold2Config(dim=32, depth=1, heads=2, dim_head=8, max_seq_len=64)
+TCFG = TrainConfig()
+
+
+def _assert_tree_equal(a, b):
+    flat_a = jax.tree_util.tree_leaves(a)
+    flat_b = jax.tree_util.tree_leaves(b)
+    assert len(flat_a) == len(flat_b)
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_roundtrip(tmp_path):
+    state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+    with CheckpointManager(str(tmp_path / "ckpt")) as mgr:
+        assert mgr.save(state, step=0)
+        mgr.wait()
+        restored = mgr.restore(abstract_like(state))
+    _assert_tree_equal(state, restored)
+
+
+def test_rotation_and_latest(tmp_path):
+    state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+    with CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2) as mgr:
+        for s in (1, 2, 3):
+            state = dict(state, step=jnp.asarray(s, jnp.int32))
+            mgr.save(state, force=True)
+        mgr.wait()
+        assert mgr.latest_step() == 3
+        restored = mgr.restore(abstract_like(state))
+        assert int(restored["step"]) == 3
+
+
+def test_restore_or_init(tmp_path):
+    path = str(tmp_path / "ckpt")
+
+    def init():
+        return train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+
+    with CheckpointManager(path) as mgr:
+        state, resumed = restore_or_init(mgr, init)
+        assert not resumed
+        state = dict(state, step=jnp.asarray(7, jnp.int32))
+        mgr.save(state)
+        mgr.wait()
+
+    with CheckpointManager(path) as mgr:
+        state2, resumed = restore_or_init(mgr, init)
+        assert resumed
+        assert int(state2["step"]) == 7
+        _assert_tree_equal(state, state2)
+
+
+def test_sharded_restore(tmp_path):
+    """A checkpoint restores directly into a mesh-sharded layout."""
+    mesh = make_mesh({"data": 4, "model": 2})
+    state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+    shardings = state_shardings(mesh, state, tp=True)
+
+    with CheckpointManager(str(tmp_path / "ckpt")) as mgr:
+        mgr.save(state, step=0)
+        mgr.wait()
+        restored = mgr.restore(abstract_like(state, shardings))
+
+    _assert_tree_equal(state, restored)
+    # spot-check: restored leaves actually carry the requested sharding
+    flat_r = jax.tree_util.tree_leaves(restored)
+    flat_s = jax.tree_util.tree_leaves(shardings, is_leaf=lambda x: hasattr(x, "spec"))
+    assert any(
+        r.sharding.is_equivalent_to(s, r.ndim) for r, s in zip(flat_r, flat_s)
+    )

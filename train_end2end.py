@@ -25,7 +25,9 @@ from alphafold2_tpu.training import (
     TrainConfig,
     e2e_loss_fn,
     e2e_train_state_init,
+    finish,
     make_train_step,
+    open_or_init,
     stack_microbatches,
     synthetic_structure_batches,
 )
@@ -45,6 +47,8 @@ def main():
     ap.add_argument("--refiner-depth", type=int, default=2)
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
+    ap.add_argument("--ckpt-dir", default=None, help="checkpoint/resume directory")
+    ap.add_argument("--ckpt-every", type=int, default=25)
     args = ap.parse_args()
 
     import jax.numpy as jnp
@@ -66,18 +70,33 @@ def main():
     dcfg = DataConfig(batch_size=args.batch, max_len=args.max_len)
 
     batches = stack_microbatches(synthetic_structure_batches(dcfg), tcfg.grad_accum)
-    state = e2e_train_state_init(jax.random.PRNGKey(0), ecfg, tcfg)
+    mgr, state, resumed = open_or_init(
+        args.ckpt_dir, e2e_train_state_init, jax.random.PRNGKey(0), ecfg, tcfg,
+        save_every=args.ckpt_every,
+    )
     train_step = jax.jit(make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn))
 
-    rng = jax.random.PRNGKey(1)
+    base_rng = jax.random.PRNGKey(1)
     t0 = time.time()
-    for step in range(args.steps):
-        rng, step_rng = jax.random.split(rng)
+    start = int(state["step"])
+    if resumed:
+        print(f"resumed from step {start} in {args.ckpt_dir}")
+        # replay the data stream to where the checkpoint left off so the
+        # resumed run continues the stream instead of re-reading from the top
+        for _ in range(start):
+            next(batches)
+    for step in range(start, start + args.steps):
+        # per-step key derived from the step index: identical schedule
+        # whether the run is fresh or resumed
+        step_rng = jax.random.fold_in(base_rng, step)
         state, metrics = train_step(state, next(batches), step_rng)
         loss = float(metrics["loss"])
-        if step % 10 == 0 or step == args.steps - 1:
+        if step % 10 == 0 or step == start + args.steps - 1:
             dt = time.time() - t0
             print(f"step {step}  loss {loss:.4f}  ({dt:.1f}s elapsed)")
+        if mgr is not None:
+            mgr.save(state)  # orbax save_interval_steps gates the cadence
+    finish(mgr, state)
     print("done")
 
 

@@ -19,7 +19,9 @@ from alphafold2_tpu.models import Alphafold2Config
 from alphafold2_tpu.training import (
     DataConfig,
     TrainConfig,
+    finish,
     make_train_step,
+    open_or_init,
     sidechainnet_batches,
     stack_microbatches,
     synthetic_batches,
@@ -42,6 +44,8 @@ def main():
     ap.add_argument(
         "--data", choices=["synthetic", "sidechainnet"], default="synthetic"
     )
+    ap.add_argument("--ckpt-dir", default=None, help="checkpoint/resume directory")
+    ap.add_argument("--ckpt-every", type=int, default=50)
     args = ap.parse_args()
 
     import jax.numpy as jnp
@@ -66,18 +70,33 @@ def main():
         it = synthetic_batches(dcfg)
     batches = stack_microbatches(it, tcfg.grad_accum)
 
-    state = train_state_init(jax.random.PRNGKey(0), cfg, tcfg)
+    mgr, state, resumed = open_or_init(
+        args.ckpt_dir, train_state_init, jax.random.PRNGKey(0), cfg, tcfg,
+        save_every=args.ckpt_every,
+    )
     train_step = jax.jit(make_train_step(cfg, tcfg))
 
-    rng = jax.random.PRNGKey(1)
+    base_rng = jax.random.PRNGKey(1)
     t0 = time.time()
-    for step in range(args.steps):
-        rng, step_rng = jax.random.split(rng)
+    start = int(state["step"])
+    if resumed:
+        print(f"resumed from step {start} in {args.ckpt_dir}")
+        # replay the data stream to where the checkpoint left off so the
+        # resumed run continues the stream instead of re-reading from the top
+        for _ in range(start):
+            next(batches)
+    for step in range(start, start + args.steps):
+        # per-step key derived from the step index: identical schedule
+        # whether the run is fresh or resumed
+        step_rng = jax.random.fold_in(base_rng, step)
         state, metrics = train_step(state, next(batches), step_rng)
         loss = float(metrics["loss"])
-        if step % 10 == 0 or step == args.steps - 1:
+        if step % 10 == 0 or step == start + args.steps - 1:
             dt = time.time() - t0
             print(f"step {step}  loss {loss:.4f}  ({dt:.1f}s elapsed)")
+        if mgr is not None:
+            mgr.save(state)  # orbax save_interval_steps gates the cadence
+    finish(mgr, state)
     print("done")
 
 
