@@ -17,8 +17,16 @@ from alphafold2_tpu.parallel.train import (
     make_sharded_train_step,
     sharded_train_state_init,
 )
+from alphafold2_tpu.parallel.sequence import (
+    axial_alltoall_transpose,
+    ring_attention,
+    ulysses_attention,
+)
 
 __all__ = [
+    "ring_attention",
+    "ulysses_attention",
+    "axial_alltoall_transpose",
     "make_mesh",
     "data_parallel_mesh",
     "param_spec",

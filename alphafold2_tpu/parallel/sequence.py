@@ -1,0 +1,180 @@
+"""Sequence / context parallelism: ring attention, Ulysses all_to_all
+attention, and sequence-parallel axial transposes.
+
+The reference has no comm-based sequence parallelism (SURVEY.md §2.2: its
+long-context story is architectural — axial factorization, block-sparse
+attention, KV compression). A TPU-native framework at multi-chip scale needs
+the communication-based complement, and these are its three primitives, all
+designed to run inside `shard_map` over a mesh axis so XLA lowers the
+communication onto ICI:
+
+  * `ring_attention` — exact blockwise attention for sequences longer than
+    one chip's HBM: K/V shards rotate around the ring via `ppermute` while
+    each chip streams flash-style log-sum-exp softmax accumulation over its
+    resident Q shard. Communication overlaps compute block by block;
+    memory per chip is O(n/P) in sequence.
+  * `ulysses_attention` — all_to_all (DeepSpeed-Ulysses-style) sequence
+    parallelism: resharding flips (sequence-sharded, all heads) into
+    (head-sharded, full sequence) so each chip runs a plain dense attention
+    over its head group, then flips back. Two all_to_alls per attention;
+    best when heads >= chips and the sequence fits per-chip after the flip.
+  * `axial_alltoall_transpose` — for the axial (row/column) attention
+    pattern: swaps which grid axis is sharded between the row pass and the
+    column pass. Each axial pass is embarrassingly parallel over its
+    folded-into-batch axis (reference alphafold2.py:276-283 semantics); the
+    transpose is the only communication.
+
+All softmax statistics accumulate in float32 with -inf masking handled the
+same way as the Pallas block-sparse kernel (ops/sparse_kernel.py): masked
+logits never contribute, fully-masked queries return zeros.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float("-inf")
+
+
+def _stream_block(q, k_blk, v_blk, bias_blk, m, l, acc, scale):
+    """One flash-attention accumulation step against a K/V block.
+
+    q: (b, nq, h, d); k_blk/v_blk: (b, nk, h, d); bias_blk: (b, nk) additive
+    (-inf for masked keys). Running stats m, l: (b, h, nq); acc: (b, h, nq, d).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+    s = s + bias_blk[:, None, None, :]
+
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # alpha/p guards: -inf - -inf = nan. The exp ARGUMENT must be sanitized
+    # too, not just the result: exp(nan) in the unselected where-branch has a
+    # nan primal, and exp's vjp multiplies even a zero cotangent by it
+    # (0 * nan = nan), poisoning dq/dk for fully-masked rows.
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    alpha = jnp.where(
+        jnp.isneginf(m), 0.0, jnp.exp(jnp.where(jnp.isneginf(m), 0.0, m) - m_safe)
+    )
+    p = jnp.where(
+        jnp.isneginf(s),
+        0.0,
+        jnp.exp(jnp.where(jnp.isneginf(s), 0.0, s) - m_safe[..., None]),
+    )
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    acc_new = acc * alpha[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p.astype(v_blk.dtype), v_blk
+    ).astype(jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, axis_name: str, mask=None):
+    """Exact ring attention over a sharded sequence axis.
+
+    Call inside `shard_map` with the sequence axis sharded over `axis_name`.
+
+    Args:
+      q, k, v: (b, n_local, h, d) — this chip's sequence shard.
+      mask: (b, n_local) bool key-validity for the local shard (key-side
+        masking, matching the reference's key_padding semantics,
+        alphafold2.py:156-161 / DeepSpeed attn_mask_mode='add').
+
+    Returns: (b, n_local, h, d) attention output for the local Q shard.
+    """
+    b, n_local, h, d = q.shape
+    scale = d ** -0.5
+    num_shards = jax.lax.psum(1, axis_name)
+
+    # mark constant-built carries as device-varying over the ring axis so
+    # the fori_loop carry types match after the first ppermute
+    def varying(x):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+
+    bias = (
+        varying(jnp.zeros((b, n_local), jnp.float32))
+        if mask is None
+        else jnp.where(mask, 0.0, _NEG_INF).astype(jnp.float32)
+    )
+
+    m0 = varying(jnp.full((b, h, n_local), _NEG_INF, jnp.float32))
+    l0 = varying(jnp.zeros((b, h, n_local), jnp.float32))
+    acc0 = varying(jnp.zeros((b, h, n_local, d), jnp.float32))
+    perm = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+
+    # resident block first, then rotate-before-compute for the remaining
+    # num_shards-1 blocks: exactly P-1 neighbor copies, no discarded final
+    # rotation (XLA cannot DCE a collective inside the loop body)
+    m, l, acc = _stream_block(q, k, v, bias, m0, l0, acc0, scale)
+
+    def body(_, carry):
+        m, l, acc, k_blk, v_blk, bias_blk = carry
+        # one hop around the ring (ICI neighbor copy); XLA overlaps this
+        # with the block compute
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        bias_blk = jax.lax.ppermute(bias_blk, axis_name, perm)
+        m, l, acc = _stream_block(q, k_blk, v_blk, bias_blk, m, l, acc, scale)
+        return m, l, acc, k_blk, v_blk, bias_blk
+
+    m, l, acc, _, _, _ = jax.lax.fori_loop(
+        1, num_shards, body, (m, l, acc, k, v, bias)
+    )
+    out = acc / jnp.where(l > 0, l, 1.0)[..., None]  # zeros for fully-masked q
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, mask=None):
+    """All_to_all (Ulysses-style) sequence-parallel attention.
+
+    Call inside `shard_map`; sequence axis sharded over `axis_name`, heads
+    divisible by the axis size. Reshards to (full sequence, heads/P) per
+    chip, runs dense flash-style attention locally, reshards back.
+
+    Args/returns as `ring_attention`.
+    """
+    b, n_local, h, d = q.shape
+    num_shards = jax.lax.psum(1, axis_name)
+    if h % num_shards != 0:
+        raise ValueError(f"heads ({h}) must divide by the sp axis ({num_shards})")
+
+    # (b, n_local, h, d) -> (b, n, h_local, d): split heads, concat sequence
+    def flip(t):
+        return jax.lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    qg, kg, vg = flip(q), flip(k), flip(v)
+    if mask is None:
+        bias = jnp.zeros((b, n_local * num_shards), jnp.float32)
+    else:
+        gathered = jax.lax.all_gather(mask, axis_name, tiled=True)  # (b*P, n_local)?
+        # all_gather(tiled) concatenates over axis 0; reshape back to (b, n)
+        bias = jnp.where(
+            gathered.reshape(num_shards, b, n_local).transpose(1, 0, 2).reshape(b, -1),
+            0.0,
+            _NEG_INF,
+        ).astype(jnp.float32)
+
+    # one _stream_block call over the full gathered sequence: the -inf
+    # softmax edge cases live in exactly one place
+    n_full, h_local = qg.shape[1], qg.shape[2]
+    scale = d ** -0.5
+    m0 = jnp.full((b, h_local, n_full), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h_local, n_full), jnp.float32)
+    acc0 = jnp.zeros((b, h_local, n_full, d), jnp.float32)
+    m, l, acc = _stream_block(qg, kg, vg, bias, m0, l0, acc0, scale)
+    out = acc / jnp.where(l > 0, l, 1.0)[..., None]
+    out = jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+    # (b, n, h_local, d) -> (b, n_local, h, d)
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def axial_alltoall_transpose(x, axis_name: str, row_sharded: bool = True):
+    """Swap the sharded grid axis of a pair-representation shard.
+
+    x: (b, rows_local, cols, d) when `row_sharded` (-> (b, rows, cols_local, d)),
+    or the mirror when not. One all_to_all on ICI; this is the only
+    communication between the row pass and the column pass of sequence-
+    parallel axial attention (SURVEY.md §2.2 'Ulysses-style transpose').
+    """
+    if row_sharded:
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
