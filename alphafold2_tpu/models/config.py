@@ -36,6 +36,10 @@ class Alphafold2Config:
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
     reversible: bool = False
+    # jax.checkpoint each trunk layer: O(1) activation memory in depth at
+    # ~33% extra FLOPs — the remat sibling of the reversible trunk; works
+    # with or without an MSA stream (reversible requires one)
+    remat: bool = False
     # bool, or a per-layer tuple of bools (reference cast_tuple semantics,
     # alphafold2.py:25-26,349 — the reference ignores the per-layer value at
     # alphafold2.py:392, a bug; we apply it per layer)
@@ -50,6 +54,13 @@ class Alphafold2Config:
     msa_tie_row_attn: bool = False
     template_attn_depth: int = 2
     dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.reversible and self.remat:
+            raise ValueError(
+                "reversible=True and remat=True are mutually exclusive "
+                "activation-memory strategies; pick one"
+            )
 
     @property
     def layer_sparse(self) -> Tuple[bool, ...]:

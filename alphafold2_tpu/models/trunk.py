@@ -165,65 +165,77 @@ def sequential_trunk_apply(
     layer_sparse = cfg.layer_sparse
     sparse_fn = make_sparse_axial_fn(cfg) if any(layer_sparse) else None
 
+    def one_layer(sparse_this_layer):
+        def body(layer, x, m, rngs):
+            # pair axial self-attention (reference alphafold2.py:309), with
+            # the block-sparse inner attention on layers flagged sparse —
+            # applied PER LAYER, fixing the reference bug that ignores the
+            # per-layer tuple (reference alphafold2.py:392)
+            x = prenorm_axial_apply(
+                layer["seq_attn"],
+                self_cfg,
+                x,
+                mask=x_mask,
+                rng=rngs[0],
+                attention_fn=sparse_fn if sparse_this_layer else None,
+            ) + x
+
+            if m is not None:
+                # msa axial self-attention, optionally tied rows
+                # (reference alphafold2.py:312)
+                m = prenorm_axial_apply(
+                    layer["msa_attn"],
+                    self_cfg,
+                    m,
+                    mask=msa_mask,
+                    tie_row=cfg.msa_tie_row_attn,
+                    rng=rngs[1],
+                ) + m
+
+                # cross-attention both ways over flattened streams
+                # (reference alphafold2.py:316-317)
+                xf = x.reshape(b, n * n, d)
+                mf = m.reshape(b, -1, d)
+                xf = prenorm_cross_apply(
+                    layer["seq_cross"],
+                    cross_cfg,
+                    xf,
+                    mf,
+                    mask=x_mask_flat,
+                    context_mask=msa_mask_flat,
+                    rng=rngs[2],
+                ) + xf
+                x = xf.reshape(x.shape)
+                mf = prenorm_cross_apply(
+                    layer["msa_cross"],
+                    cross_cfg,
+                    mf,
+                    xf,
+                    mask=msa_mask_flat,
+                    context_mask=x_mask_flat,
+                    rng=rngs[3],
+                ) + mf
+                m = mf.reshape(m.shape)
+
+            # feed-forwards (reference alphafold2.py:321-324)
+            x = prenorm_ff_apply(layer["seq_ff"], cfg, x, rng=rngs[4]) + x
+            if m is not None:
+                m = prenorm_ff_apply(layer["msa_ff"], cfg, m, rng=rngs[5]) + m
+            return x, m
+
+        if cfg.remat:
+            # recompute this layer's activations in the backward pass
+            # instead of storing them: O(1) trunk activation memory in
+            # depth, the jax.checkpoint sibling of the reversible trunk
+            # (reference reversible.py's motivation, SURVEY.md §2.2)
+            return jax.checkpoint(body)
+        return body
+
     for li, layer in enumerate(layers):
         lrng = jax.random.fold_in(rng, li) if rng is not None else None
         rngs = (
             jax.random.split(lrng, 6) if lrng is not None else [None] * 6
         )
-
-        # pair axial self-attention (reference alphafold2.py:309), with the
-        # block-sparse inner attention on layers flagged sparse — applied
-        # PER LAYER, fixing the reference bug that ignores the per-layer
-        # tuple (reference alphafold2.py:392)
-        x = prenorm_axial_apply(
-            layer["seq_attn"],
-            self_cfg,
-            x,
-            mask=x_mask,
-            rng=rngs[0],
-            attention_fn=sparse_fn if layer_sparse[li] else None,
-        ) + x
-
-        if m is not None:
-            # msa axial self-attention, optionally tied rows
-            # (reference alphafold2.py:312)
-            m = prenorm_axial_apply(
-                layer["msa_attn"],
-                self_cfg,
-                m,
-                mask=msa_mask,
-                tie_row=cfg.msa_tie_row_attn,
-                rng=rngs[1],
-            ) + m
-
-            # cross-attention both ways over flattened streams
-            # (reference alphafold2.py:316-317)
-            xf = x.reshape(b, n * n, d)
-            mf = m.reshape(b, -1, d)
-            xf = prenorm_cross_apply(
-                layer["seq_cross"],
-                cross_cfg,
-                xf,
-                mf,
-                mask=x_mask_flat,
-                context_mask=msa_mask_flat,
-                rng=rngs[2],
-            ) + xf
-            x = xf.reshape(x.shape)
-            mf = prenorm_cross_apply(
-                layer["msa_cross"],
-                cross_cfg,
-                mf,
-                xf,
-                mask=msa_mask_flat,
-                context_mask=x_mask_flat,
-                rng=rngs[3],
-            ) + mf
-            m = mf.reshape(m.shape)
-
-        # feed-forwards (reference alphafold2.py:321-324)
-        x = prenorm_ff_apply(layer["seq_ff"], cfg, x, rng=rngs[4]) + x
-        if m is not None:
-            m = prenorm_ff_apply(layer["msa_ff"], cfg, m, rng=rngs[5]) + m
+        x, m = one_layer(layer_sparse[li])(layer, x, m, rngs)
 
     return x, m

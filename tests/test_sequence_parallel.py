@@ -15,11 +15,14 @@ import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from alphafold2_tpu.parallel import make_mesh
 from alphafold2_tpu.parallel.sequence import (
     axial_alltoall_transpose,
     ring_attention,
     ulysses_attention,
 )
+
+PRIMS = {"ring": ring_attention, "ulysses": ulysses_attention}
 
 
 def dense_oracle(q, k, v, mask=None):
@@ -33,7 +36,7 @@ def dense_oracle(q, k, v, mask=None):
 
 
 def _mesh(n=8):
-    return Mesh(np.array(jax.devices()[:n]), ("sp",))
+    return make_mesh({"sp": n})
 
 
 def _data(seed=0, b=2, n=32, h=4, d=8, masked=True):
@@ -43,38 +46,25 @@ def _data(seed=0, b=2, n=32, h=4, d=8, masked=True):
     return q, k, v, mask
 
 
-@pytest.mark.parametrize("masked", [False, True])
-def test_ring_attention_parity(masked):
-    mesh = _mesh()
-    q, k, v, mask = _data(masked=masked)
-    want = dense_oracle(q, k, v, mask)
-
+def _shard_mapped(prim, mesh, masked):
+    """shard_map'd primitive accepting (q, k, v[, mask]); mask=None folds in."""
     spec = P(None, "sp", None, None)
     args = (spec, spec, spec) + ((P(None, "sp"),) if masked else ())
     body = (
-        (lambda q, k, v, m: ring_attention(q, k, v, "sp", mask=m))
+        (lambda q, k, v, m: prim(q, k, v, "sp", mask=m))
         if masked
-        else (lambda q, k, v: ring_attention(q, k, v, "sp"))
+        else (lambda q, k, v: prim(q, k, v, "sp"))
     )
-    fn = shard_map(body, mesh=mesh, in_specs=args, out_specs=spec)
-    got = fn(q, k, v, mask) if masked else fn(q, k, v)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+    return shard_map(body, mesh=mesh, in_specs=args, out_specs=spec)
 
 
+@pytest.mark.parametrize("name", list(PRIMS))
 @pytest.mark.parametrize("masked", [False, True])
-def test_ulysses_attention_parity(masked):
+def test_attention_parity(name, masked):
     mesh = _mesh()
     q, k, v, mask = _data(seed=1, h=8, masked=masked)
     want = dense_oracle(q, k, v, mask)
-
-    spec = P(None, "sp", None, None)
-    args = (spec, spec, spec) + ((P(None, "sp"),) if masked else ())
-    body = (
-        (lambda q, k, v, m: ulysses_attention(q, k, v, "sp", mask=m))
-        if masked
-        else (lambda q, k, v: ulysses_attention(q, k, v, "sp"))
-    )
-    fn = shard_map(body, mesh=mesh, in_specs=args, out_specs=spec)
+    fn = _shard_mapped(PRIMS[name], mesh, masked)
     got = fn(q, k, v, mask) if masked else fn(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
@@ -142,18 +132,14 @@ def test_ring_attention_grads():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
-def test_ring_grads_finite_with_fully_masked_row():
+@pytest.mark.parametrize("name", list(PRIMS))
+def test_grads_finite_with_fully_masked_row(name):
     """Fully-padded batch element: gradients stay finite (the exp-vjp
     0 * nan poisoning case)."""
     mesh = _mesh()
     q, k, v, _ = _data(seed=5, h=8)
     mask = jnp.ones(q.shape[:2], bool).at[0].set(False)
-    spec = P(None, "sp", None, None)
-    for prim in (ring_attention, ulysses_attention):
-        fn = shard_map(
-            lambda q, k, v, m, _p=prim: _p(q, k, v, "sp", mask=m),
-            mesh=mesh, in_specs=(spec, spec, spec, P(None, "sp")), out_specs=spec,
-        )
-        g = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v, mask) ** 2), argnums=(0, 1, 2))(q, k, v)
-        for t in g:
-            assert np.isfinite(np.asarray(t)).all(), prim.__name__
+    fn = _shard_mapped(PRIMS[name], mesh, masked=True)
+    g = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v, mask) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for t in g:
+        assert np.isfinite(np.asarray(t)).all()
