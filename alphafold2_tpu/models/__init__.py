@@ -22,8 +22,22 @@ from alphafold2_tpu.models.refiner import (
     refiner_init,
     refiner_apply,
 )
+from alphafold2_tpu.models.embedder import (
+    EmbedderConfig,
+    convert_esm_state_dict,
+    embed_sequences,
+    embedder_apply,
+    embedder_init,
+    esm_tokenize,
+)
 
 __all__ = [
+    "EmbedderConfig",
+    "convert_esm_state_dict",
+    "embed_sequences",
+    "embedder_apply",
+    "embedder_init",
+    "esm_tokenize",
     "RefinerConfig",
     "refiner_init",
     "refiner_apply",

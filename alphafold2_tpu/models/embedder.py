@@ -1,0 +1,234 @@
+"""Protein language-model embedder (ESM-1b-compatible architecture).
+
+The reference obtains per-residue embeddings by running Facebook's ESM-1b
+(esm1b_t33_650M_UR50S) through torch.hub on a GPU and slicing representation
+layer 33 (reference train_end2end.py:37-43,54-59); the embeddings then enter
+the model through the `embedds` input (reference alphafold2.py:469-472, our
+models/alphafold2.py embedds path). This module is the TPU-native embedder
+for that contract:
+
+  * the same architecture family as ESM-1b — pre-LN transformer encoder,
+    learned positional embeddings, GELU MLP, final LayerNorm — expressed as
+    pure init/apply over a param pytree, jit/pjit-ready;
+  * `convert_esm_state_dict` maps a torch ESM-1b `state_dict()` (host-side
+    numpy) onto the pytree, so the real 650M-param weights drop in when
+    available — the architecture hyperparameters default to ESM-1b's
+    (33 layers, 1280 dim, 20 heads);
+  * `esm_tokenize` converts our amino-acid vocabulary (constants.AA_ORDER)
+    to the ESM alphabet with BOS/EOS framing, and `embed_sequences` strips
+    the framing back off so the output aligns 1:1 with residues.
+
+Embeddings feed `Alphafold2Config.num_embedds` = 1280 (constants.py,
+reference constants.py:7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from alphafold2_tpu.constants import AA_ORDER
+from alphafold2_tpu.ops.core import (
+    embedding,
+    embedding_init,
+    layer_norm,
+    layer_norm_init,
+    linear,
+    linear_init,
+)
+
+# the ESM alphabet (fair-esm constants): specials + amino acids in ESM order
+ESM_TOKENS = (
+    "<cls>", "<pad>", "<eos>", "<unk>",
+    "L", "A", "G", "V", "S", "E", "R", "T", "I", "D", "P", "K",
+    "Q", "N", "F", "Y", "M", "H", "W", "C", "X", "B", "U", "Z", "O",
+    ".", "-", "<null_1>", "<mask>",
+)
+ESM_IDX = {t: i for i, t in enumerate(ESM_TOKENS)}
+_CLS, _PAD, _EOS = ESM_IDX["<cls>"], ESM_IDX["<pad>"], ESM_IDX["<eos>"]
+
+# our token id (0..19 = AA_ORDER, 20 = pad) -> ESM alphabet id
+_OURS_TO_ESM = np.array(
+    [ESM_IDX[aa] for aa in AA_ORDER] + [_PAD], dtype=np.int32
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbedderConfig:
+    """ESM-1b shape defaults (esm1b_t33_650M_UR50S)."""
+
+    num_layers: int = 33
+    dim: int = 1280
+    heads: int = 20
+    vocab: int = len(ESM_TOKENS)
+    max_len: int = 1024  # ESM-1b positional table (incl. specials)
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+
+def embedder_init(key, cfg: EmbedderConfig):
+    keys = jax.random.split(key, 3 + cfg.num_layers)
+    params = {
+        "token_emb": embedding_init(keys[0], cfg.vocab, cfg.dim),
+        "pos_emb": embedding_init(keys[1], cfg.max_len, cfg.dim),
+        "pre_norm": layer_norm_init(cfg.dim),  # ESM-1b emb_layer_norm_before
+        "final_norm": layer_norm_init(cfg.dim),
+        "layers": [],
+    }
+    for li in range(cfg.num_layers):
+        k = jax.random.split(keys[3 + li], 6)
+        params["layers"].append(
+            {
+                "attn_norm": layer_norm_init(cfg.dim),
+                "qkv": linear_init(k[0], cfg.dim, 3 * cfg.dim),
+                "attn_out": linear_init(k[1], cfg.dim, cfg.dim),
+                "ff_norm": layer_norm_init(cfg.dim),
+                "ff_in": linear_init(k[2], cfg.dim, 4 * cfg.dim),
+                "ff_out": linear_init(k[3], 4 * cfg.dim, cfg.dim),
+            }
+        )
+    return params
+
+
+def embedder_apply(params, cfg: EmbedderConfig, tokens, mask=None):
+    """Forward over ESM-alphabet tokens. tokens: (b, n) int; mask: (b, n).
+
+    Returns (b, n, dim) final-layer representations (post final LayerNorm,
+    the reference's `repr_layers=[33]` slice, train_end2end.py:55-58).
+    """
+    b, n = tokens.shape
+    if n > cfg.max_len:
+        raise ValueError(
+            f"sequence length {n} exceeds the positional table "
+            f"(max_len={cfg.max_len}); jnp.take would clamp silently"
+        )
+    dtype = cfg.dtype
+    if mask is None:
+        mask = tokens != _PAD
+
+    h = embedding(params["token_emb"], tokens, dtype=dtype)
+    # fairseq LearnedPositionalEmbedding semantics (what ESM-1b trained
+    # with): position = cumulative count of non-pad tokens + padding_idx,
+    # pads pinned at padding_idx — NOT a plain arange
+    positions = jnp.cumsum(mask.astype(jnp.int32), axis=1) * mask + _PAD
+    h = h + embedding(params["pos_emb"], positions, dtype=dtype)
+    h = layer_norm(params["pre_norm"], h)  # ESM-1b emb_layer_norm_before
+
+    bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)[:, None, None, :]
+
+    scale = cfg.head_dim ** -0.5
+    for layer in params["layers"]:
+        x = layer_norm(layer["attn_norm"], h)
+        qkv = linear(layer["qkv"], x, dtype=dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(b, n, cfg.heads, cfg.head_dim)
+
+        s = jnp.einsum("bqhd,bkhd->bhqk", heads(q), heads(k)).astype(jnp.float32)
+        s = s * scale + bias
+        p = jax.nn.softmax(s, axis=-1).astype(dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, heads(v)).reshape(b, n, cfg.dim)
+        h = h + linear(layer["attn_out"], o, dtype=dtype)
+
+        x = layer_norm(layer["ff_norm"], h)
+        x = jax.nn.gelu(linear(layer["ff_in"], x, dtype=dtype), approximate=False)
+        h = h + linear(layer["ff_out"], x, dtype=dtype)
+
+    return layer_norm(params["final_norm"], h)
+
+
+def esm_tokenize(our_tokens, our_mask=None):
+    """Map our AA tokens (b, L) to ESM-alphabet tokens (b, L+2) with
+    <cls>...<eos> framing, plus the framed mask.
+
+    Like ESM's BatchConverter, <eos> sits immediately AFTER the last valid
+    residue of each sequence (padding follows it), not at a fixed final
+    slot — with contiguous-prefix masks the two agree only for full-length
+    rows."""
+    our_tokens = jnp.asarray(our_tokens)
+    b, L = our_tokens.shape
+    core = jnp.asarray(_OURS_TO_ESM)[our_tokens]
+    if our_mask is None:
+        our_mask = jnp.ones((b, L), bool)
+    core = jnp.where(our_mask, core, _PAD)
+    tokens = jnp.concatenate(
+        [jnp.full((b, 1), _CLS, jnp.int32), core.astype(jnp.int32),
+         jnp.full((b, 1), _PAD, jnp.int32)],
+        axis=1,
+    )
+    mask = jnp.concatenate(
+        [jnp.ones((b, 1), bool), our_mask, jnp.zeros((b, 1), bool)], axis=1
+    )
+    # <eos> right after the last valid residue of each row
+    lengths = jnp.sum(our_mask.astype(jnp.int32), axis=1)  # (b,)
+    eos_pos = (1 + lengths)[:, None]
+    idx = jnp.arange(L + 2)[None, :]
+    tokens = jnp.where(idx == eos_pos, _EOS, tokens)
+    mask = mask | (idx == eos_pos)
+    return tokens, mask
+
+
+def embed_sequences(params, cfg: EmbedderConfig, our_tokens, our_mask=None):
+    """Our-vocabulary sequences -> (b, L, dim) residue embeddings, aligned
+    1:1 with input residues (BOS/EOS stripped — the reference's
+    `[..., 1:-1]` slice at train_end2end.py:58)."""
+    tokens, mask = esm_tokenize(our_tokens, our_mask)
+    reps = embedder_apply(params, cfg, tokens, mask)
+    return reps[:, 1:-1]
+
+
+# --- torch weight conversion ------------------------------------------------
+
+def convert_esm_state_dict(state_dict, cfg: EmbedderConfig):
+    """Map a torch ESM-1b `state_dict()` (dict of numpy arrays / tensors)
+    onto the embedder pytree.
+
+    Key layout per fair-esm's ProteinBertModel: `embed_tokens.weight`,
+    `embed_positions.weight`, `emb_layer_norm_after.{weight,bias}`, and per
+    layer `layers.{i}.self_attn.{q,k,v,out}_proj.{weight,bias}`,
+    `layers.{i}.self_attn_layer_norm.*`, `layers.{i}.fc1/fc2.*`,
+    `layers.{i}.final_layer_norm.*`. Torch Linear stores (out, in); ours is
+    (in, out) — transposed here.
+    """
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+
+    def lin(prefix):
+        return {"w": sd[f"{prefix}.weight"].T.copy(), "b": sd[f"{prefix}.bias"].copy()}
+
+    def norm(prefix):
+        return {"scale": sd[f"{prefix}.weight"].copy(), "bias": sd[f"{prefix}.bias"].copy()}
+
+    params = {
+        "token_emb": {"table": sd["embed_tokens.weight"].copy()},
+        "pos_emb": {"table": sd["embed_positions.weight"].copy()},
+        "pre_norm": norm("emb_layer_norm_before"),
+        "final_norm": norm("emb_layer_norm_after"),
+        "layers": [],
+    }
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        q = lin(f"{p}.self_attn.q_proj")
+        k = lin(f"{p}.self_attn.k_proj")
+        v = lin(f"{p}.self_attn.v_proj")
+        params["layers"].append(
+            {
+                "attn_norm": norm(f"{p}.self_attn_layer_norm"),
+                "qkv": {
+                    "w": np.concatenate([q["w"], k["w"], v["w"]], axis=1),
+                    "b": np.concatenate([q["b"], k["b"], v["b"]]),
+                },
+                "attn_out": lin(f"{p}.self_attn.out_proj"),
+                "ff_norm": norm(f"{p}.final_layer_norm"),
+                "ff_in": lin(f"{p}.fc1"),
+                "ff_out": lin(f"{p}.fc2"),
+            }
+        )
+    return jax.tree_util.tree_map(jnp.asarray, params)
