@@ -23,40 +23,63 @@ def _batchify(*arrays):
     return arrays
 
 
-def rmsd(X, Y):
-    """Root-mean-square deviation. X, Y: (batch, 3, N) -> (batch,)."""
+def _point_weights(mask, X):
+    """(batch, N) float point weights and per-structure counts from an
+    optional boolean mask; None means all points valid."""
+    if mask is None:
+        w = jnp.ones(X.shape[:1] + X.shape[-1:], X.dtype)
+    else:
+        w = jnp.asarray(mask, X.dtype)
+        if w.ndim == 1:
+            w = w[None]
+    return w, jnp.maximum(jnp.sum(w, axis=-1), 1.0)
+
+
+def rmsd(X, Y, mask=None):
+    """Root-mean-square deviation. X, Y: (batch, 3, N) -> (batch,).
+    `mask` (batch, N): points excluded from the average when False."""
     X, Y = _batchify(X, Y)
-    return jnp.sqrt(jnp.mean((X - Y) ** 2, axis=(-1, -2)))
+    w, n = _point_weights(mask, X)
+    sq = jnp.sum((X - Y) ** 2, axis=-2)  # (batch, N)
+    return jnp.sqrt(jnp.sum(sq * w, axis=-1) / (3.0 * n))
 
 
-def gdt(X, Y, cutoffs=GDT_TS_CUTOFFS, weights=None):
-    """Global distance test. X, Y: (batch, 3, N) -> (batch,)."""
+def gdt(X, Y, cutoffs=GDT_TS_CUTOFFS, weights=None, mask=None):
+    """Global distance test. X, Y: (batch, 3, N) -> (batch,).
+    `weights`: per-cutoff weights; `mask` (batch, N): per-point validity."""
     X, Y = _batchify(X, Y)
     cutoffs = jnp.asarray(cutoffs, dtype=X.dtype)
     if weights is None:
         weights = jnp.ones_like(cutoffs)
     else:
         weights = jnp.broadcast_to(jnp.asarray(weights, dtype=X.dtype), cutoffs.shape)
+    pw, n = _point_weights(mask, X)
     dist = jnp.sqrt(jnp.sum((X - Y) ** 2, axis=-2))  # (batch, N)
-    # fraction of residues within each cutoff, weighted mean over cutoffs
-    frac = jnp.mean(
-        (dist[..., None, :] <= cutoffs[:, None]).astype(X.dtype), axis=-1
-    )  # (batch, K)
+    # fraction of valid residues within each cutoff, weighted mean over cutoffs
+    within = (dist[..., None, :] <= cutoffs[:, None]).astype(X.dtype)
+    frac = jnp.sum(within * pw[..., None, :], axis=-1) / n[..., None]  # (batch, K)
     return jnp.mean(frac * weights, axis=-1)
 
 
-def tmscore(X, Y):
+def tmscore(X, Y, mask=None):
     """Template-modeling score. X, Y: (batch, 3, N) -> (batch,).
 
     Deviation from the reference (`utils.py:608-615`): d0 is clamped to
     >= 0.5 as in standard TM-score implementations — the unclamped formula
     goes negative near L=18 and collapses the score for short chains.
+    With `mask`, L is the per-structure count of valid points.
     """
     X, Y = _batchify(X, Y)
-    L = X.shape[-1]
-    d0 = max(1.24 * np.cbrt(L - 15) - 1.8, 0.5) if L > 15 else 0.5
+    w, n = _point_weights(mask, X)
+    if mask is None:
+        L = X.shape[-1]
+        d0 = max(1.24 * np.cbrt(L - 15) - 1.8, 0.5) if L > 15 else 0.5
+        d0 = jnp.asarray(d0, X.dtype)
+    else:
+        d0 = jnp.maximum(1.24 * jnp.cbrt(jnp.maximum(n - 15.0, 1e-3)) - 1.8, 0.5)
     dist = jnp.sqrt(jnp.sum((X - Y) ** 2, axis=-2))
-    return jnp.mean(1.0 / (1.0 + (dist / d0) ** 2), axis=-1)
+    terms = 1.0 / (1.0 + (dist / d0[..., None]) ** 2)
+    return jnp.sum(terms * w, axis=-1) / n
 
 
 # public wrappers (reference utils.py:713-761)

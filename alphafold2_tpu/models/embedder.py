@@ -65,8 +65,15 @@ class EmbedderConfig:
     dim: int = 1280
     heads: int = 20
     vocab: int = len(ESM_TOKENS)
-    max_len: int = 1024  # ESM-1b positional table (incl. specials)
+    # max framed length (residues + cls/eos). fairseq position ids run up to
+    # max_len + padding_idx, so the table holds max_len + padding_idx + 1
+    # rows — (1026, 1280) for real ESM-1b, matching its state dict
+    max_len: int = 1024
     dtype: Any = jnp.float32
+
+    @property
+    def pos_table_rows(self) -> int:
+        return self.max_len + _PAD + 1
 
     @property
     def head_dim(self) -> int:
@@ -77,7 +84,7 @@ def embedder_init(key, cfg: EmbedderConfig):
     keys = jax.random.split(key, 3 + cfg.num_layers)
     params = {
         "token_emb": embedding_init(keys[0], cfg.vocab, cfg.dim),
-        "pos_emb": embedding_init(keys[1], cfg.max_len, cfg.dim),
+        "pos_emb": embedding_init(keys[1], cfg.pos_table_rows, cfg.dim),
         "pre_norm": layer_norm_init(cfg.dim),  # ESM-1b emb_layer_norm_before
         "final_norm": layer_norm_init(cfg.dim),
         "layers": [],
@@ -104,9 +111,10 @@ def embedder_apply(params, cfg: EmbedderConfig, tokens, mask=None):
     the reference's `repr_layers=[33]` slice, train_end2end.py:55-58).
     """
     b, n = tokens.shape
-    if n > cfg.max_len:
+    # fairseq position ids reach n + padding_idx; the table must cover that
+    if n + _PAD >= cfg.pos_table_rows:
         raise ValueError(
-            f"sequence length {n} exceeds the positional table "
+            f"framed length {n} exceeds the positional table "
             f"(max_len={cfg.max_len}); jnp.take would clamp silently"
         )
     dtype = cfg.dtype

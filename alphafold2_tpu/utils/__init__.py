@@ -1,0 +1,13 @@
+"""Utility layer: observability (metrics logging, profiling, eval).
+
+All new framework surface — the reference has no tracing, metrics, or eval
+wiring at all (SURVEY.md §5).
+"""
+
+from alphafold2_tpu.utils.observability import (
+    MetricsLogger,
+    profile_trace,
+    structure_eval,
+)
+
+__all__ = ["MetricsLogger", "profile_trace", "structure_eval"]

@@ -70,7 +70,7 @@ def test_convert_torch_state_dict():
     cfg = TINY
     sd = {
         "embed_tokens.weight": rs.randn(cfg.vocab, cfg.dim).astype(np.float32),
-        "embed_positions.weight": rs.randn(cfg.max_len, cfg.dim).astype(np.float32),
+        "embed_positions.weight": rs.randn(cfg.pos_table_rows, cfg.dim).astype(np.float32),
         "emb_layer_norm_before.weight": rs.randn(cfg.dim).astype(np.float32),
         "emb_layer_norm_before.bias": rs.randn(cfg.dim).astype(np.float32),
         "emb_layer_norm_after.weight": rs.randn(cfg.dim).astype(np.float32),
@@ -144,3 +144,14 @@ def test_overlong_sequence_raises():
     seq = jnp.zeros((1, TINY.max_len + 1), jnp.int32)
     with pytest.raises(ValueError):
         embed_sequences(params, TINY, seq)
+
+
+def test_near_max_length_positions_in_table():
+    """A framed length of exactly max_len must index only existing
+    positional rows (fairseq ids reach n + padding_idx)."""
+    cfg = EmbedderConfig(num_layers=1, dim=16, heads=2, max_len=12)
+    params = embedder_init(jax.random.PRNGKey(0), cfg)
+    assert params["pos_emb"]["table"].shape[0] == cfg.pos_table_rows
+    seq = jnp.zeros((1, cfg.max_len - 2), jnp.int32)  # framed n == max_len
+    out = embed_sequences(params, cfg, seq)
+    assert np.isfinite(np.asarray(out)).all()

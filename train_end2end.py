@@ -14,7 +14,6 @@ Usage: python train_end2end.py [--steps N] [--dim 64] [--depth 2] [--len 16]
 from __future__ import annotations
 
 import argparse
-import time
 
 import jax
 
@@ -49,6 +48,9 @@ def main():
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     ap.add_argument("--ckpt-dir", default=None, help="checkpoint/resume directory")
     ap.add_argument("--ckpt-every", type=int, default=25)
+    ap.add_argument("--eval-every", type=int, default=0, help="0 = no eval")
+    ap.add_argument("--metrics-jsonl", default=None, help="JSONL metrics stream")
+    ap.add_argument("--profile-dir", default=None, help="jax.profiler trace dir")
     args = ap.parse_args()
 
     import jax.numpy as jnp
@@ -76,8 +78,14 @@ def main():
     )
     train_step = jax.jit(make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn))
 
+    from alphafold2_tpu.training import predict_structure
+    from alphafold2_tpu.utils import MetricsLogger, profile_trace, structure_eval
+
+    eval_fwd = jax.jit(
+        lambda p, seq, mask, rng: predict_structure(p, ecfg, seq, mask=mask, rng=rng)
+    )
+
     base_rng = jax.random.PRNGKey(1)
-    t0 = time.time()
     start = int(state["step"])
     if resumed:
         print(f"resumed from step {start} in {args.ckpt_dir}")
@@ -85,17 +93,31 @@ def main():
         # resumed run continues the stream instead of re-reading from the top
         for _ in range(start):
             next(batches)
-    for step in range(start, start + args.steps):
-        # per-step key derived from the step index: identical schedule
-        # whether the run is fresh or resumed
-        step_rng = jax.random.fold_in(base_rng, step)
-        state, metrics = train_step(state, next(batches), step_rng)
-        loss = float(metrics["loss"])
-        if step % 10 == 0 or step == start + args.steps - 1:
-            dt = time.time() - t0
-            print(f"step {step}  loss {loss:.4f}  ({dt:.1f}s elapsed)")
-        if mgr is not None:
-            mgr.save(state)  # orbax save_interval_steps gates the cadence
+
+    logger = MetricsLogger(jsonl_path=args.metrics_jsonl, print_every=10)
+    with profile_trace(args.profile_dir, enabled=args.profile_dir is not None):
+        for step in range(start, start + args.steps):
+            # per-step key derived from the step index: identical schedule
+            # whether the run is fresh or resumed
+            step_rng = jax.random.fold_in(base_rng, step)
+            batch = next(batches)
+            state, metrics = train_step(state, batch, step_rng)
+            logger.log(step, metrics)
+            if args.eval_every and (step + 1) % args.eval_every == 0:
+                # structure quality on the last microbatch (the reference's
+                # metrics library, finally wired into a loop)
+                mb = {k: v[-1] for k, v in batch.items()}
+                out = eval_fwd(state["params"], mb["seq"], mb["mask"], step_rng)
+                b = mb["seq"].shape[0]
+                scores = structure_eval(
+                    out["refined"].reshape(b, -1, 3),
+                    mb["coords"].reshape(b, -1, 3),
+                    mask=out["cloud_mask"].reshape(b, -1),
+                )
+                print("eval  " + "  ".join(f"{k} {v:.4f}" for k, v in scores.items()))
+            if mgr is not None:
+                mgr.save(state)  # orbax save_interval_steps gates the cadence
+    logger.close()
     finish(mgr, state)
     print("done")
 
