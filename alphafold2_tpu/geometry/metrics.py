@@ -27,7 +27,7 @@ def _point_weights(mask, X):
     """(batch, N) float point weights and per-structure counts from an
     optional boolean mask; None means all points valid."""
     if mask is None:
-        w = jnp.ones(X.shape[:1] + X.shape[-1:], X.dtype)
+        w = jnp.ones(X.shape[:-2] + X.shape[-1:], X.dtype)
     else:
         w = jnp.asarray(mask, X.dtype)
         if w.ndim == 1:
@@ -84,14 +84,14 @@ def tmscore(X, Y, mask=None):
 
 # public wrappers (reference utils.py:713-761)
 
-def RMSD(A, B):
-    return rmsd(A, B)
+def RMSD(A, B, *, mask=None):
+    return rmsd(A, B, mask=mask)
 
 
-def GDT(A, B, *, mode: str = "TS", weights=None):
+def GDT(A, B, *, mode: str = "TS", weights=None, mask=None):
     cutoffs = GDT_HA_CUTOFFS if str(mode).upper() == "HA" else GDT_TS_CUTOFFS
-    return gdt(A, B, cutoffs=cutoffs, weights=weights)
+    return gdt(A, B, cutoffs=cutoffs, weights=weights, mask=mask)
 
 
-def TMscore(A, B):
-    return tmscore(A, B)
+def TMscore(A, B, *, mask=None):
+    return tmscore(A, B, mask=mask)

@@ -1,0 +1,20 @@
+"""Native host runtime layer (C++ via ctypes).
+
+See csrc/af2_runtime.cc: threaded prefetch batch loader + PDB codec. All
+entry points degrade to pure-Python fallbacks when the native library
+cannot be built (no g++), so the framework never hard-requires it.
+"""
+
+from alphafold2_tpu.runtime.native import (
+    NativePrefetchLoader,
+    native_available,
+    parse_pdb_fast,
+    write_pdb_fast,
+)
+
+__all__ = [
+    "NativePrefetchLoader",
+    "native_available",
+    "parse_pdb_fast",
+    "write_pdb_fast",
+]

@@ -1,0 +1,266 @@
+"""ctypes bindings for the C++ host runtime (csrc/af2_runtime.cc).
+
+Build-on-first-use: `g++ -O3 -shared` into a cached .so next to the source.
+Everything degrades to pure-Python fallbacks (geometry/pdb.py, the numpy
+data pipeline) when the toolchain or the library is unavailable, mirroring
+the reference's optional-dependency discipline (reference utils.py:10-21).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+_SRC = os.path.join(_REPO_ROOT, "csrc", "af2_runtime.cc")
+_LIB = os.path.join(_REPO_ROOT, "csrc", "libaf2runtime.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return _LIB
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC, "-o", _LIB]
+    try:
+        res = subprocess.run(cmd, capture_output=True, timeout=300)
+    except Exception:
+        return None
+    if res.returncode != 0:
+        return None
+    return _LIB
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        path = _build()
+        if path is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(path)
+        lib.af2_loader_create.restype = ctypes.c_void_p
+        lib.af2_loader_create.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.af2_loader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.af2_loader_destroy.argtypes = [ctypes.c_void_p]
+        lib.af2_parse_pdb.restype = ctypes.c_int
+        lib.af2_parse_pdb.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_char_p,
+        ]
+        lib.af2_write_pdb.restype = ctypes.c_int64
+        lib.af2_write_pdb.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# Prefetch loader
+# ---------------------------------------------------------------------------
+
+
+class NativePrefetchLoader:
+    """Threaded C++ batch loader over an in-memory structure dataset.
+
+    Dataset: list of (seq_tokens (L,), coords (L, atoms, 3)) pairs of
+    arbitrary lengths. Workers shuffle, random-crop to `max_len`, pad, and
+    assemble static-shape batches off the GIL into a bounded queue.
+
+    Iterating yields {"seq": (b, max_len) int32, "mask": (b, max_len) bool,
+    "coords": (b, max_len, atoms, 3) float32} — the train_pre/e2e batch
+    contract (coords sliced to (b, L, 3) by the caller when only C-alpha is
+    needed).
+
+    Falls back to a single-threaded numpy implementation when the native
+    library is unavailable (`self.native` False).
+    """
+
+    def __init__(self, dataset, batch_size: int, max_len: int,
+                 atoms_per_res: int = 14, pad_token: int = 20, seed: int = 0,
+                 n_threads: int = 2, queue_capacity: int = 4):
+        if not dataset:
+            raise ValueError("NativePrefetchLoader needs a non-empty dataset")
+        self.batch = batch_size
+        self.max_len = max_len
+        self.atoms = atoms_per_res
+        self.pad_token = pad_token
+        self._handle = None
+        self._closed = False
+
+        seqs = [np.asarray(s, np.int32).reshape(-1) for s, _ in dataset]
+        coords = [
+            np.asarray(c, np.float32).reshape(len(s), atoms_per_res, 3)
+            for s, (_, c) in zip(seqs, dataset)
+        ]
+        self._offsets = np.zeros(len(seqs) + 1, np.int64)
+        np.cumsum([len(s) for s in seqs], out=self._offsets[1:])
+        self._seqs = np.concatenate(seqs) if seqs else np.zeros(0, np.int32)
+        self._coords = (
+            np.concatenate(coords).reshape(-1) if coords else np.zeros(0, np.float32)
+        )
+
+        lib = _load()
+        if lib is not None:
+            self._lib = lib
+            self._handle = lib.af2_loader_create(
+                self._seqs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                self._offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(seqs),
+                self._coords.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                atoms_per_res, batch_size, max_len, pad_token, seed,
+                n_threads, queue_capacity,
+            )
+        if self._handle is None:
+            # pure-python fallback
+            self._rng = np.random.RandomState(seed)
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            yield self.next()
+
+    def next(self) -> dict:
+        if getattr(self, "_closed", False):
+            raise RuntimeError("loader is closed")
+        b, L, A = self.batch, self.max_len, self.atoms
+        if self._handle is not None:
+            seq = np.empty((b, L), np.int32)
+            mask = np.empty((b, L), np.uint8)
+            coords = np.empty((b, L, A, 3), np.float32)
+            self._lib.af2_loader_next(
+                self._handle,
+                seq.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                coords.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            )
+            return {"seq": seq, "mask": mask.astype(bool), "coords": coords}
+
+        seq = np.full((b, L), self.pad_token, np.int32)
+        mask = np.zeros((b, L), bool)
+        coords = np.zeros((b, L, A, 3), np.float32)
+        n_seqs = len(self._offsets) - 1
+        for i in range(b):
+            idx = self._rng.randint(n_seqs)
+            beg, end = self._offsets[idx], self._offsets[idx + 1]
+            length = int(end - beg)
+            start = self._rng.randint(0, length - L + 1) if length > L else 0
+            length = min(length, L)
+            sl = slice(int(beg) + start, int(beg) + start + length)
+            seq[i, :length] = self._seqs[sl]
+            mask[i, :length] = True
+            coords[i, :length] = self._coords.reshape(-1, A, 3)[sl]
+        return {"seq": seq, "mask": mask, "coords": coords}
+
+    def close(self):
+        self._closed = True
+        if self._handle is not None:
+            self._lib.af2_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# PDB codec
+# ---------------------------------------------------------------------------
+
+
+def parse_pdb_fast(path: str):
+    """Parse ATOM records via the C++ codec; returns a
+    geometry.pdb.PdbStructure (falls back to the Python parser)."""
+    from alphafold2_tpu.geometry.pdb import PdbAtom, PdbStructure, parse_pdb
+
+    lib = _load()
+    if lib is None:
+        return parse_pdb(path)
+
+    with open(path, "rb") as fh:
+        text = fh.read()
+    max_atoms = max(1, text.count(b"\nATOM") + (1 if text.startswith(b"ATOM") else 0))
+    xyz = np.empty((max_atoms, 3), np.float32)
+    res_seq = np.empty(max_atoms, np.int32)
+    names = ctypes.create_string_buffer(8 * max_atoms)
+    n = lib.af2_parse_pdb(
+        text, len(text), max_atoms,
+        xyz.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        res_seq.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        names,
+    )
+    atoms = []
+    raw = names.raw
+    for i in range(n):
+        rec = raw[i * 8 : i * 8 + 8]
+        atoms.append(
+            PdbAtom(
+                serial=i + 1,
+                name=rec[0:4].decode().strip(),
+                res_name=rec[4:7].decode().strip(),
+                chain_id=(rec[7:8].decode().strip() or "A"),
+                res_seq=int(res_seq[i]),
+                xyz=xyz[i].astype(np.float64),
+            )
+        )
+    return PdbStructure(atoms)
+
+
+def write_pdb_fast(path: str, structure) -> str:
+    """Write a PdbStructure via the C++ codec (Python fallback)."""
+    from alphafold2_tpu.geometry.pdb import write_pdb
+
+    lib = _load()
+    if lib is None:
+        return write_pdb(path, structure)
+
+    n = len(structure.atoms)
+    xyz = np.asarray([a.xyz for a in structure.atoms], np.float32).reshape(n, 3)
+    res_seq = np.asarray([a.res_seq for a in structure.atoms], np.int32)
+    names = bytearray(8 * n)
+    for i, a in enumerate(structure.atoms):
+        nm = a.name if len(a.name) == 4 else f" {a.name:<3s}"
+        names[i * 8 : i * 8 + 4] = nm.encode()[:4].ljust(4)
+        names[i * 8 + 4 : i * 8 + 7] = a.res_name.encode()[:3].rjust(3)
+        names[i * 8 + 7] = (a.chain_id or "A").encode()[0]
+    cap = 82 * (n + 1)
+    out = ctypes.create_string_buffer(cap)
+    written = lib.af2_write_pdb(
+        xyz.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        res_seq.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        bytes(names), n, out, cap,
+    )
+    if written < 0:
+        return write_pdb(path, structure)
+    with open(path, "wb") as fh:
+        fh.write(out.raw[:written])
+    return path

@@ -48,9 +48,13 @@ class MetricsLogger:
         vals = {
             k: float(np.asarray(jax.device_get(v))) for k, v in metrics.items()
         }
-        if self._step_last is not None and now > self._t_last:
+        # throughput only when the step actually advanced (a second log call
+        # at the same step — e.g. eval scores — must not zero it out)
+        if self._step_last is not None and step > self._step_last and now > self._t_last:
             vals["steps_per_sec"] = (step - self._step_last) / (now - self._t_last)
-        self._t_last, self._step_last = now, step
+            self._t_last, self._step_last = now, step
+        elif self._step_last is None or step > self._step_last:
+            self._t_last, self._step_last = now, step
 
         record = {"step": step, **{k: round(v, 6) for k, v in vals.items()}}
         if self._file is not None:

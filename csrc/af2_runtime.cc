@@ -1,0 +1,220 @@
+// TPU-native host runtime: threaded prefetch batch loader + PDB codec.
+//
+// The reference's data path is Python-side sidechainnet iteration with
+// dynamic shapes (reference train_pre.py:44-55) and its PDB I/O shells out
+// to curl + mdtraj (reference utils.py:83-149). Here the host-side hot
+// paths are native:
+//
+//   * a prefetching batch loader: worker threads shuffle, crop/pad to
+//     static shapes, and assemble (seq, mask, coords) batches into a
+//     bounded queue entirely outside the Python GIL, so the accelerator
+//     never waits on host batch assembly;
+//   * a fixed-column PDB ATOM-record codec (parse + write), the text
+//     format's cost center when loading thousands of structures.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in the image).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Prefetch loader
+// ---------------------------------------------------------------------------
+
+struct Af2Batch {
+  std::vector<int32_t> seq;    // (batch, max_len)
+  std::vector<uint8_t> mask;   // (batch, max_len)
+  std::vector<float> coords;   // (batch, max_len, atoms_per_res, 3)
+};
+
+struct Af2Loader {
+  // dataset (borrowed copies — the loader owns its memory after create)
+  std::vector<int32_t> seqs;      // concatenated residue tokens
+  std::vector<int64_t> offsets;   // n_seqs+1 prefix offsets into seqs
+  std::vector<float> coords;      // aligned with seqs: atoms_per_res*3 per residue
+  int n_seqs = 0;
+  int batch = 1;
+  int max_len = 128;
+  int atoms_per_res = 14;
+  int pad_token = 20;
+
+  // queue
+  size_t capacity = 4;
+  std::deque<Af2Batch> queue;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> workers;
+  uint64_t seed = 0;
+
+  void worker(int wid) {
+    std::mt19937_64 rng(seed ^ (0x9e3779b97f4a7c15ULL * (wid + 1)));
+    std::uniform_int_distribution<int> pick(0, n_seqs - 1);
+    while (!stop.load()) {
+      Af2Batch b;
+      b.seq.assign((size_t)batch * max_len, pad_token);
+      b.mask.assign((size_t)batch * max_len, 0);
+      b.coords.assign((size_t)batch * max_len * atoms_per_res * 3, 0.0f);
+      for (int i = 0; i < batch; ++i) {
+        int idx = pick(rng);
+        int64_t beg = offsets[idx], end = offsets[idx + 1];
+        int len = (int)(end - beg);
+        int start = 0;
+        if (len > max_len) {  // random crop
+          std::uniform_int_distribution<int> off(0, len - max_len);
+          start = off(rng);
+          len = max_len;
+        }
+        std::memcpy(&b.seq[(size_t)i * max_len], &seqs[beg + start],
+                    sizeof(int32_t) * len);
+        std::memset(&b.mask[(size_t)i * max_len], 1, len);
+        std::memcpy(&b.coords[(size_t)i * max_len * atoms_per_res * 3],
+                    &coords[(beg + start) * atoms_per_res * 3],
+                    sizeof(float) * (size_t)len * atoms_per_res * 3);
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv_push.wait(lk, [&] { return stop.load() || queue.size() < capacity; });
+      if (stop.load()) return;
+      queue.push_back(std::move(b));
+      cv_pop.notify_one();
+    }
+  }
+};
+
+void* af2_loader_create(const int32_t* seqs, const int64_t* offsets,
+                        int n_seqs, const float* coords, int atoms_per_res,
+                        int batch, int max_len, int pad_token, uint64_t seed,
+                        int n_threads, int queue_capacity) {
+  if (n_seqs <= 0 || batch <= 0 || max_len <= 0) return nullptr;
+  auto* L = new Af2Loader();
+  int64_t total = offsets[n_seqs];
+  L->seqs.assign(seqs, seqs + total);
+  L->offsets.assign(offsets, offsets + n_seqs + 1);
+  L->coords.assign(coords, coords + total * atoms_per_res * 3);
+  L->n_seqs = n_seqs;
+  L->batch = batch;
+  L->max_len = max_len;
+  L->atoms_per_res = atoms_per_res;
+  L->pad_token = pad_token;
+  L->seed = seed;
+  L->capacity = queue_capacity > 0 ? queue_capacity : 4;
+  int nt = n_threads > 0 ? n_threads : 1;
+  for (int i = 0; i < nt; ++i)
+    L->workers.emplace_back([L, i] { L->worker(i); });
+  return L;
+}
+
+void af2_loader_next(void* handle, int32_t* seq_out, uint8_t* mask_out,
+                     float* coords_out) {
+  auto* L = static_cast<Af2Loader*>(handle);
+  Af2Batch b;
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_pop.wait(lk, [&] { return !L->queue.empty(); });
+    b = std::move(L->queue.front());
+    L->queue.pop_front();
+    L->cv_push.notify_one();
+  }
+  std::memcpy(seq_out, b.seq.data(), b.seq.size() * sizeof(int32_t));
+  std::memcpy(mask_out, b.mask.data(), b.mask.size());
+  std::memcpy(coords_out, b.coords.data(), b.coords.size() * sizeof(float));
+}
+
+void af2_loader_destroy(void* handle) {
+  auto* L = static_cast<Af2Loader*>(handle);
+  {
+    // hold the mutex across the store+notify: a worker between its
+    // predicate check and blocking would otherwise miss the wakeup and
+    // join() would hang
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->stop.store(true);
+    L->cv_push.notify_all();
+  }
+  for (auto& t : L->workers) t.join();
+  delete L;
+}
+
+// ---------------------------------------------------------------------------
+// PDB codec (fixed-column ATOM records)
+// ---------------------------------------------------------------------------
+
+static inline float field_f(const char* line, int beg, int len) {
+  char buf[16];
+  std::memcpy(buf, line + beg, len);
+  buf[len] = 0;
+  return (float)atof(buf);
+}
+
+static inline int field_i(const char* line, int beg, int len) {
+  char buf[16];
+  std::memcpy(buf, line + beg, len);
+  buf[len] = 0;
+  return atoi(buf);
+}
+
+// Parse ATOM records (first model). Per atom writes: xyz (3 floats),
+// res_seq (int32), and 4-char atom name + 3-char residue name + 1-char
+// chain into the names buffer (8 bytes/atom: name[4], res3[3], chain[1]).
+// Returns number of atoms parsed (capped at max_atoms).
+int af2_parse_pdb(const char* text, int64_t text_len, int max_atoms,
+                  float* xyz_out, int32_t* res_seq_out, char* names_out) {
+  int n = 0;
+  const char* p = text;
+  const char* end = text + text_len;
+  while (p < end && n < max_atoms) {
+    const char* nl = (const char*)memchr(p, '\n', end - p);
+    size_t linelen = nl ? (size_t)(nl - p) : (size_t)(end - p);
+    if (linelen >= 6 && std::strncmp(p, "ENDMDL", 6) == 0) break;
+    if (linelen >= 54 && std::strncmp(p, "ATOM", 4) == 0 &&
+        (p[4] == ' ' || p[4] == '\t')) {
+      xyz_out[n * 3 + 0] = field_f(p, 30, 8);
+      xyz_out[n * 3 + 1] = field_f(p, 38, 8);
+      xyz_out[n * 3 + 2] = field_f(p, 46, 8);
+      res_seq_out[n] = field_i(p, 22, 4);
+      std::memcpy(names_out + n * 8 + 0, p + 12, 4);  // atom name
+      std::memcpy(names_out + n * 8 + 4, p + 17, 3);  // res name
+      names_out[n * 8 + 7] = p[21];                   // chain id
+      ++n;
+    }
+    if (!nl) break;
+    p = nl + 1;
+  }
+  return n;
+}
+
+// Write ATOM records into `out` (caller sizes it at >= 82*(n_atoms+1)).
+// names layout as af2_parse_pdb. Returns bytes written.
+int64_t af2_write_pdb(const float* xyz, const int32_t* res_seq,
+                      const char* names, int n_atoms, char* out,
+                      int64_t out_cap) {
+  int64_t w = 0;
+  for (int i = 0; i < n_atoms; ++i) {
+    if (w + 82 > out_cap) return -1;
+    char name[5] = {0}, res3[4] = {0};
+    std::memcpy(name, names + i * 8, 4);
+    std::memcpy(res3, names + i * 8 + 4, 3);
+    char chain = names[i * 8 + 7];
+    // columns (1-based): serial 7-11, name 13-16, altLoc 17 (blank),
+    // resName 18-20, chain 22, resSeq 23-26, x/y/z from 31 — matching the
+    // fixed-column reads in af2_parse_pdb and geometry/pdb.py
+    w += std::snprintf(
+        out + w, out_cap - w,
+        "ATOM  %5d %-4s %3s %c%4d    %8.3f%8.3f%8.3f%6.2f%6.2f\n",
+        i + 1, name, res3, chain ? chain : 'A', res_seq[i],
+        xyz[i * 3 + 0], xyz[i * 3 + 1], xyz[i * 3 + 2], 1.0, 0.0);
+  }
+  if (w + 4 <= out_cap) w += std::snprintf(out + w, out_cap - w, "END\n");
+  return w;
+}
+
+}  // extern "C"

@@ -51,6 +51,10 @@ def main():
     ap.add_argument("--eval-every", type=int, default=0, help="0 = no eval")
     ap.add_argument("--metrics-jsonl", default=None, help="JSONL metrics stream")
     ap.add_argument("--profile-dir", default=None, help="jax.profiler trace dir")
+    ap.add_argument(
+        "--profile-steps", type=int, default=10,
+        help="trace this many steps (starting after compile at step start+1)",
+    )
     args = ap.parse_args()
 
     import jax.numpy as jnp
@@ -79,7 +83,7 @@ def main():
     train_step = jax.jit(make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn))
 
     from alphafold2_tpu.training import predict_structure
-    from alphafold2_tpu.utils import MetricsLogger, profile_trace, structure_eval
+    from alphafold2_tpu.utils import MetricsLogger, structure_eval
 
     eval_fwd = jax.jit(
         lambda p, seq, mask, rng: predict_structure(p, ecfg, seq, mask=mask, rng=rng)
@@ -94,9 +98,18 @@ def main():
         for _ in range(start):
             next(batches)
 
+    # bounded profiler window AFTER the compile step, so the trace stays
+    # loadable and is not dominated by step-0 compilation
+    prof_beg = start + 1
+    prof_end = prof_beg + max(1, args.profile_steps)
+    profiling = False
+
     logger = MetricsLogger(jsonl_path=args.metrics_jsonl, print_every=10)
-    with profile_trace(args.profile_dir, enabled=args.profile_dir is not None):
+    try:
         for step in range(start, start + args.steps):
+            if args.profile_dir and step == prof_beg and not profiling:
+                jax.profiler.start_trace(args.profile_dir)
+                profiling = True
             # per-step key derived from the step index: identical schedule
             # whether the run is fresh or resumed
             step_rng = jax.random.fold_in(base_rng, step)
@@ -114,9 +127,16 @@ def main():
                     mb["coords"].reshape(b, -1, 3),
                     mask=out["cloud_mask"].reshape(b, -1),
                 )
+                logger.log(step, scores)  # into the JSONL stream too
                 print("eval  " + "  ".join(f"{k} {v:.4f}" for k, v in scores.items()))
             if mgr is not None:
                 mgr.save(state)  # orbax save_interval_steps gates the cadence
+            if profiling and step + 1 >= prof_end:
+                jax.profiler.stop_trace()
+                profiling = False
+    finally:
+        if profiling:
+            jax.profiler.stop_trace()
     logger.close()
     finish(mgr, state)
     print("done")
