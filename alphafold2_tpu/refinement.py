@@ -1,0 +1,159 @@
+"""Physical refinement plugin boundary (FastRelax) + TPU-side fallback.
+
+Parity: reference `scripts/refinement.py` — PyRosetta pose<->pdb converters
+(:22-54) and a `run_fast_relax` hook that raises NotImplementedError (:56-74).
+Here the boundary is completed:
+
+  * the pose<->array contract is explicit: structures cross the boundary as
+    `(coords (L*atoms, 3) numpy, sequence str)` pairs, PDB text as the wire
+    format (the reference's choice, via its pdbfile round-trip);
+  * PyRosetta, when importable, drives a real FastRelax through that
+    contract (optional dependency gate, reference refinement.py:8-14);
+  * without PyRosetta, `jax_relax` runs a WORKING geometric relaxation on
+    the accelerator — gradient descent on ideal backbone bond lengths —
+    instead of raising. It is deliberately simple (no physics force field)
+    but differentiable, jittable, and honest about what it is.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # optional, exactly like the reference (refinement.py:8-14)
+    import pyrosetta  # type: ignore
+
+    _HAS_PYROSETTA = True
+except Exception:
+    pyrosetta = None
+    _HAS_PYROSETTA = False
+
+# ideal backbone geometry (standard values; the reference carries similar
+# build constants at utils.py:20-28)
+IDEAL_N_CA = 1.458
+IDEAL_CA_C = 1.525
+IDEAL_C_N = 1.329
+
+
+def pyrosetta_available() -> bool:
+    return _HAS_PYROSETTA
+
+
+# ---------------------------------------------------------------------------
+# pose <-> array contract
+# ---------------------------------------------------------------------------
+
+
+def coords_to_pose(coords, sequence: str):
+    """(L*3, 3) backbone coords + sequence -> PyRosetta pose (via PDB text,
+    the reference's pdbfile route, refinement.py:22-38). Requires PyRosetta."""
+    if not _HAS_PYROSETTA:
+        raise ImportError("PyRosetta is not installed")
+    import os
+    import tempfile
+
+    from alphafold2_tpu.geometry.pdb import coords_to_pdb
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "pose.pdb")
+        coords_to_pdb(path, coords, sequence=sequence)
+        return pyrosetta.pose_from_pdb(path)
+
+
+def pose_to_coords(pose) -> np.ndarray:
+    """PyRosetta pose -> (L*3, 3) N/CA/C backbone coords
+    (reference refinement.py:41-54's inverse direction)."""
+    if not _HAS_PYROSETTA:
+        raise ImportError("PyRosetta is not installed")
+    out = []
+    for i in range(1, pose.total_residue() + 1):
+        res = pose.residue(i)
+        for name in ("N", "CA", "C"):
+            v = res.xyz(name)
+            out.append([v.x, v.y, v.z])
+    return np.asarray(out, np.float64)
+
+
+# ---------------------------------------------------------------------------
+# relaxation
+# ---------------------------------------------------------------------------
+
+
+def backbone_bond_energy(coords, mask=None):
+    """Sum of squared deviations from ideal backbone bond lengths.
+
+    coords: (b, L*3, 3) in N/CA/C order. Differentiable; the quantity
+    jax_relax descends on.
+    """
+    coords = jnp.asarray(coords, jnp.float32)
+    bb = coords.reshape(coords.shape[0], -1, 3, 3)  # (b, L, 3, 3)
+
+    def bond(a, b_):
+        return jnp.sqrt(jnp.sum((a - b_) ** 2, axis=-1) + 1e-12)
+
+    n_ca = bond(bb[:, :, 0], bb[:, :, 1]) - IDEAL_N_CA  # (b, L)
+    ca_c = bond(bb[:, :, 1], bb[:, :, 2]) - IDEAL_CA_C
+    c_n = bond(bb[:, :-1, 2], bb[:, 1:, 0]) - IDEAL_C_N  # peptide bond
+
+    if mask is not None:
+        # accept bool or float masks (float32 is the convention elsewhere,
+        # e.g. utils/observability.py) — bitwise & on floats would raise
+        mask_b = jnp.asarray(mask).astype(bool)
+        maskf = mask_b.astype(n_ca.dtype)
+        n_ca = n_ca * maskf
+        ca_c = ca_c * maskf
+        c_n = c_n * (mask_b[:, :-1] & mask_b[:, 1:]).astype(c_n.dtype)
+    return jnp.sum(n_ca**2 + ca_c**2, axis=-1) + jnp.sum(c_n**2, axis=-1)
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def jax_relax(coords, mask=None, iters: int = 100, lr: float = 0.05):
+    """Accelerator-side geometric relaxation: gradient descent restoring
+    ideal backbone bond lengths while staying close to the input.
+
+    coords: (b, L*3, 3) or (L*3, 3) N/CA/C backbone.
+    Returns (relaxed coords, energy history (iters, b)).
+    """
+    coords = jnp.asarray(coords, jnp.float32)
+    squeeze = coords.ndim == 2
+    if squeeze:
+        coords = coords[None]
+    if mask is not None and jnp.asarray(mask).ndim == 1:
+        mask = jnp.asarray(mask)[None]
+    anchor = coords
+
+    def energy(c):
+        e = backbone_bond_energy(c, mask)
+        # weak restraint to the predicted structure so relaxation repairs
+        # bonds without drifting the fold (FastRelax's constrained spirit)
+        rest = 0.01 * jnp.sum((c - anchor) ** 2, axis=(-1, -2))
+        return jnp.sum(e + rest), e
+
+    def step(c, _):
+        (_, e), g = jax.value_and_grad(energy, has_aux=True)(c)
+        return c - lr * g, e
+
+    relaxed, history = jax.lax.scan(step, coords, None, length=iters)
+    if squeeze:
+        return relaxed[0], history[:, 0]
+    return relaxed, history
+
+
+def run_fast_relax(coords, sequence: str, iters: int = 100):
+    """The reference's unimplemented hook (refinement.py:56-74), completed.
+
+    PyRosetta present: real FastRelax through the pose contract.
+    Otherwise: jax_relax geometric fallback. Returns (L*3, 3) numpy coords.
+    """
+    if _HAS_PYROSETTA:
+        pose = coords_to_pose(np.asarray(coords), sequence)
+        scorefxn = pyrosetta.get_fa_scorefxn()
+        relax = pyrosetta.rosetta.protocols.relax.FastRelax()
+        relax.set_scorefxn(scorefxn)
+        relax.apply(pose)
+        return pose_to_coords(pose)
+    relaxed, _ = jax_relax(np.asarray(coords, np.float32), iters=iters)
+    return np.asarray(relaxed)

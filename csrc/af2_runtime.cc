@@ -15,6 +15,7 @@
 // Exposed as a plain C ABI for ctypes (no pybind11 in the image).
 
 #include <atomic>
+#include <charconv>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -149,10 +150,14 @@ void af2_loader_destroy(void* handle) {
 // ---------------------------------------------------------------------------
 
 static inline float field_f(const char* line, int beg, int len) {
-  char buf[16];
-  std::memcpy(buf, line + beg, len);
-  buf[len] = 0;
-  return (float)atof(buf);
+  // std::from_chars: locale-INDEPENDENT ('.' decimal always) — atof would
+  // silently truncate fractions under an LC_NUMERIC comma-decimal locale
+  const char* b = line + beg;
+  const char* e = b + len;
+  while (b < e && *b == ' ') ++b;
+  float v = 0.0f;
+  std::from_chars(b, e, v);
+  return v;
 }
 
 static inline int field_i(const char* line, int beg, int len) {

@@ -99,8 +99,9 @@ def main():
             next(batches)
 
     # bounded profiler window AFTER the compile step, so the trace stays
-    # loadable and is not dominated by step-0 compilation
-    prof_beg = start + 1
+    # loadable and is not dominated by step-0 compilation; a 1-step run
+    # traces its only step (compile included) rather than nothing
+    prof_beg = start + 1 if args.steps > 1 else start
     prof_end = prof_beg + max(1, args.profile_steps)
     profiling = False
 
