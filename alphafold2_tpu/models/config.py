@@ -49,7 +49,9 @@ class Alphafold2Config:
     sparse_num_local_blocks: int = 4
     sparse_num_global_blocks: int = 1
     sparse_layout_seed: int = 0
-    sparse_use_kernel: bool = False  # Pallas TPU kernel fast path
+    # Pallas TPU kernel fast path: True / False / "auto" (kernel for long
+    # sequences, XLA block-gather for short — see ops/sparse.py)
+    sparse_use_kernel: Union[bool, str] = "auto"
     cross_attn_compress_ratio: int = 1
     msa_tie_row_attn: bool = False
     template_attn_depth: int = 2

@@ -1,4 +1,4 @@
-"""Pallas TPU kernel for block-sparse attention.
+"""Pallas TPU kernels for block-sparse attention (forward + backward).
 
 The performance path for ops/sparse.py's variable-sparsity attention —
 the TPU replacement for DeepSpeed's CUDA/Triton block-sparse kernels
@@ -7,13 +7,15 @@ streaming softmax over only the ACTIVE key blocks of each query block:
 logits never materialize in HBM, VMEM holds one (block x block) tile at a
 time, and the active-block index table rides in SMEM via scalar prefetch.
 
-Forward is the Pallas kernel; backward currently reuses the XLA
-block-gather path's gradient (ops/sparse.py) through jax.custom_vjp — the
-two compute identical math, so gradients are exact. A native Pallas
-backward (dq / dkv kernels exploiting the layout's symmetry) is the
-planned optimization.
+Backward is also Pallas: the forward additionally emits the per-row
+log-sum-exp, and two kernels recompute tile logits to accumulate dq (over
+a query block's active key blocks) and dk/dv (over a key block's active
+query blocks). The dk/dv kernel reuses the SAME index table by exploiting
+the layout's bidirectional symmetry, which sparsity_layout guarantees by
+construction (ops/sparse.py `layout |= layout.T`; the reference sparsity
+config is likewise bidirectional, alphafold2.py:204).
 
-On non-TPU backends the kernel runs in interpreter mode (tests), keeping
+On non-TPU backends the kernels run in interpreter mode (tests), keeping
 one code path.
 """
 
@@ -29,14 +31,26 @@ from jax.experimental.pallas import tpu as pltpu
 
 from alphafold2_tpu.ops.sparse import (
     SparseConfig,
-    block_sparse_attention,
     layout_block_indices,
 )
 
-_NEG = -1e9  # additive mask value (attn_mask_mode='add', reference :208)
+# masked keys are -inf (exact zero attention after exp); the reference's
+# DeepSpeed config used additive -1e9 (attn_mask_mode='add', reference :208),
+# which leaks O(ulp) attention to masked keys at float32 — we don't copy that
+_NEG = float("-inf")
 
 
-def _kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, out_ref, *, bs, dh, A, scale):
+def _interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
+                *, bs, dh, A, scale):
     qb = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # (bs, dh)
 
@@ -49,15 +63,17 @@ def _kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, out_ref, *, bs, dh, A, scale
             start = kidx * bs
             k = k_ref[0, pl.ds(start, bs), :].astype(jnp.float32)  # (bs, dh)
             v = v_ref[0, pl.ds(start, bs), :].astype(jnp.float32)
-            b = bias_ref[0, pl.ds(start, bs)]  # (bs,)
+            b = bias_ref[0, kidx]  # (bs,)
             s = jax.lax.dot_general(
                 q, k,
                 dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale + b[None, :]
             m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-            alpha = jnp.exp(m - m_new)
-            p = jnp.exp(s - m_new)
+            # -inf - -inf = nan guards (all-masked-so-far rows)
+            m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+            alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+            p = jnp.where(jnp.isneginf(s), 0.0, jnp.exp(s - m_safe))
             l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
             acc_new = acc * alpha + jnp.dot(
                 p, v, preferred_element_type=jnp.float32
@@ -73,6 +89,13 @@ def _kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, out_ref, *, bs, dh, A, scale
 
     out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
     out_ref[0] = out.astype(out_ref.dtype)
+    # +inf for rows with no active mass: exp(s - inf) = 0 zeroes every
+    # recomputed p in the backward, matching the zeroed forward output.
+    # lse rides in a (1, B, bs) block fully covering its last two dims
+    # (Mosaic tiling forbids (1, bs) row blocks); each grid step writes
+    # its own B-slot
+    lse = jnp.where(l > 0, m + jnp.log(jnp.where(l > 0, l, 1.0)), jnp.inf)
+    lse_ref[0, qb] = lse[:, 0]
 
 
 def _forward(q, k, v, scfg: SparseConfig, mask):
@@ -90,10 +113,13 @@ def _forward(q, k, v, scfg: SparseConfig, mask):
     kh = k.transpose(0, 2, 1, 3).reshape(b * h, n, dh)
     vh = v.transpose(0, 2, 1, 3).reshape(b * h, n, dh)
     if mask is None:
-        bias = jnp.zeros((b, n), jnp.float32)
+        bias = jnp.zeros((b, B, bs), jnp.float32)
     else:
-        bias = jnp.where(mask, 0.0, _NEG).astype(jnp.float32)
+        bias = jnp.where(mask, 0.0, _NEG).astype(jnp.float32).reshape(b, B, bs)
 
+    # row vectors (bias, lse) travel as (.., B, bs) 3-D views whose last two
+    # dims are fully covered by their blocks — Mosaic's tiling constraint
+    # rejects (1, bs) / (1, n) row blocks over 2-D arrays
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b * h, B),
@@ -101,38 +127,199 @@ def _forward(q, k, v, scfg: SparseConfig, mask):
             pl.BlockSpec((1, bs, dh), lambda i, j, *_: (i, j, 0)),
             pl.BlockSpec((1, n, dh), lambda i, j, *_: (i, 0, 0)),
             pl.BlockSpec((1, n, dh), lambda i, j, *_: (i, 0, 0)),
-            pl.BlockSpec((1, n), lambda i, j, *_: (i // h, 0)),
+            pl.BlockSpec((1, B, bs), lambda i, j, *_: (i // h, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bs, dh), lambda i, j, *_: (i, j, 0)),
+        out_specs=[
+            pl.BlockSpec((1, bs, dh), lambda i, j, *_: (i, j, 0)),
+            pl.BlockSpec((1, B, bs), lambda i, j, *_: (i, 0, 0)),
+        ],
     )
 
-    interpret = jax.devices()[0].platform != "tpu"
-    out = pl.pallas_call(
-        functools.partial(_kernel, bs=bs, dh=dh, A=A, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((b * h, n, dh), q.dtype),
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, bs=bs, dh=dh, A=A, scale=scale),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, n, dh), q.dtype),
+            jax.ShapeDtypeStruct((b * h, B, bs), jnp.float32),
+        ],
         grid_spec=grid_spec,
-        interpret=interpret,
+        interpret=_interpret(),
     )(idx, qh, kh, vh, bias)
 
-    return out.reshape(b, h, n, dh).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, n, dh).transpose(0, 2, 1, 3), (out, lse)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref,
+               delta_ref, dq_ref, *, bs, dh, A, scale):
+    qb = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)          # (bs, dh)
+    g = g_ref[0].astype(jnp.float32)          # (bs, dh)
+    lse = lse_ref[0, qb][:, None]             # (bs, 1)
+    delta = delta_ref[0, qb][:, None]         # (bs, 1)
+
+    def body(a, dq):
+        kidx = idx_ref[qb, a]
+
+        def active(dq):
+            start = kidx * bs
+            k = k_ref[0, pl.ds(start, bs), :].astype(jnp.float32)
+            v = v_ref[0, pl.ds(start, bs), :].astype(jnp.float32)
+            b = bias_ref[0, kidx]
+            s = jax.lax.dot_general(
+                q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale + b[None, :]
+            p = jnp.exp(s - lse)               # (bs_q, bs_k)
+            dp = jax.lax.dot_general(
+                g, v, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                   # (bs_q, bs_k)
+            ds = p * (dp - delta)
+            return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+        return jax.lax.cond(kidx >= 0, active, lambda d: d, dq)
+
+    dq = jax.lax.fori_loop(0, A, body, jnp.zeros((bs, dh), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref,
+                delta_ref, dk_ref, dv_ref, *, bs, dh, A, scale):
+    # grid position j indexes a KEY block; by layout symmetry idx[j] lists
+    # exactly the query blocks attending to it
+    jb = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)          # (bs, dh)
+    v = v_ref[0].astype(jnp.float32)          # (bs, dh)
+    b = bias_ref[0, jb]                        # (bs,)
+
+    def body(a, carry):
+        dk, dv = carry
+        qidx = idx_ref[jb, a]
+
+        def active(carry):
+            dk, dv = carry
+            start = qidx * bs
+            q = q_ref[0, pl.ds(start, bs), :].astype(jnp.float32)
+            g = g_ref[0, pl.ds(start, bs), :].astype(jnp.float32)
+            lse = lse_ref[0, qidx][:, None]
+            delta = delta_ref[0, qidx][:, None]
+            s = jax.lax.dot_general(
+                q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale + b[None, :]
+            p = jnp.exp(s - lse)               # (bs_q, bs_k)
+            dv_new = dv + jax.lax.dot_general(
+                p, g, dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                   # (bs_k, dh)
+            dp = jax.lax.dot_general(
+                g, v, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta)               # (bs_q, bs_k)
+            dk_new = dk + jax.lax.dot_general(
+                ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                   # (bs_k, dh)
+            return dk_new, dv_new
+
+        return jax.lax.cond(qidx >= 0, active, lambda c: c, carry)
+
+    zero = jnp.zeros((bs, dh), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, A, body, (zero, zero))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _backward_pallas(q, k, v, scfg, mask, out_flat, lse, g):
+    b, n, h, dh = q.shape
+    bs = scfg.block_size
+    B = n // bs
+    scale = dh ** -0.5
+
+    idx_np, valid_np = layout_block_indices(B, scfg)
+    idx = jnp.asarray(np.where(valid_np, idx_np, -1))
+    A = idx.shape[1]
+
+    qh = q.transpose(0, 2, 1, 3).reshape(b * h, n, dh)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * h, n, dh)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * h, n, dh)
+    gh = g.transpose(0, 2, 1, 3).reshape(b * h, n, dh)
+    if mask is None:
+        bias = jnp.zeros((b, B, bs), jnp.float32)
+    else:
+        bias = jnp.where(mask, 0.0, _NEG).astype(jnp.float32).reshape(b, B, bs)
+
+    # delta_i = rowsum(dO_i * O_i), the softmax-jacobian diagonal term
+    delta = jnp.sum(
+        gh.astype(jnp.float32) * out_flat.astype(jnp.float32), axis=-1
+    ).reshape(b * h, B, bs)
+
+    full = pl.BlockSpec((1, n, dh), lambda i, j, *_: (i, 0, 0))
+    blk = pl.BlockSpec((1, bs, dh), lambda i, j, *_: (i, j, 0))
+    row_full = pl.BlockSpec((1, B, bs), lambda i, j, *_: (i, 0, 0))
+    bias_full = pl.BlockSpec((1, B, bs), lambda i, j, *_: (i // h, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, bs=bs, dh=dh, A=A, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((b * h, n, dh), q.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h, B),
+            in_specs=[blk, full, full, bias_full, blk, row_full, row_full],
+            out_specs=blk,
+        ),
+        interpret=_interpret(),
+    )(idx, qh, kh, vh, bias, gh, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, bs=bs, dh=dh, A=A, scale=scale),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, n, dh), k.dtype),
+            jax.ShapeDtypeStruct((b * h, n, dh), v.dtype),
+        ],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h, B),
+            in_specs=[full, blk, blk, bias_full, full, row_full, row_full],
+            out_specs=[blk, blk],
+        ),
+        interpret=_interpret(),
+    )(idx, qh, kh, vh, bias, gh, lse, delta)
+
+    def unflat(t):
+        return t.reshape(b, h, n, dh).transpose(0, 2, 1, 3)
+
+    return unflat(dq), unflat(dk), unflat(dv)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wiring
+# ---------------------------------------------------------------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def block_sparse_attention_tpu(q, k, v, scfg: SparseConfig, mask=None):
-    """Same contract as ops.sparse.block_sparse_attention, Pallas forward."""
-    return _forward(q, k, v, scfg, mask)
+    """Same contract as ops.sparse.block_sparse_attention, Pallas kernels."""
+    out, _ = _forward(q, k, v, scfg, mask)
+    return out
 
 
 def _fwd(q, k, v, scfg, mask):
-    return _forward(q, k, v, scfg, mask), (q, k, v, mask)
+    out, (out_flat, lse) = _forward(q, k, v, scfg, mask)
+    return out, (q, k, v, mask, out_flat, lse)
 
 
 def _bwd(scfg, res, g):
-    q, k, v, mask = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: block_sparse_attention(q, k, v, scfg, mask=mask), q, k, v
-    )
-    dq, dk, dv = vjp(g)
+    # the dkv kernel's index-table reuse relies on the layout being
+    # symmetric, which sparsity_layout guarantees unconditionally
+    # (ops/sparse.py symmetrizes with `layout |= layout.T`)
+    q, k, v, mask, out_flat, lse = res
+    dq, dk, dv = _backward_pallas(q, k, v, scfg, mask, out_flat, lse, g)
     return dq, dk, dv, None
 
 

@@ -82,11 +82,15 @@ def pose_to_coords(pose) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def backbone_bond_energy(coords, mask=None):
+def backbone_bond_energy(coords, mask=None, peptide_mask=None):
     """Sum of squared deviations from ideal backbone bond lengths.
 
     coords: (b, L*3, 3) in N/CA/C order. Differentiable; the quantity
     jax_relax descends on.
+
+    peptide_mask: (b, L-1) — peptide-bond validity between residue i and
+    i+1. Chain breaks and sequence gaps MUST be marked False here or the
+    energy welds unrelated residues together with a 1.329 A bond.
     """
     coords = jnp.asarray(coords, jnp.float32)
     bb = coords.reshape(coords.shape[0], -1, 3, 3)  # (b, L, 3, 3)
@@ -106,15 +110,19 @@ def backbone_bond_energy(coords, mask=None):
         n_ca = n_ca * maskf
         ca_c = ca_c * maskf
         c_n = c_n * (mask_b[:, :-1] & mask_b[:, 1:]).astype(c_n.dtype)
+    if peptide_mask is not None:
+        c_n = c_n * jnp.asarray(peptide_mask).astype(bool).astype(c_n.dtype)
     return jnp.sum(n_ca**2 + ca_c**2, axis=-1) + jnp.sum(c_n**2, axis=-1)
 
 
 @partial(jax.jit, static_argnames=("iters",))
-def jax_relax(coords, mask=None, iters: int = 100, lr: float = 0.05):
+def jax_relax(coords, mask=None, iters: int = 100, lr: float = 0.05, peptide_mask=None):
     """Accelerator-side geometric relaxation: gradient descent restoring
     ideal backbone bond lengths while staying close to the input.
 
     coords: (b, L*3, 3) or (L*3, 3) N/CA/C backbone.
+    peptide_mask: (b, L-1) or (L-1,) — False across chain breaks / gaps
+    (see backbone_bond_energy).
     Returns (relaxed coords, energy history (iters, b)).
     """
     coords = jnp.asarray(coords, jnp.float32)
@@ -123,10 +131,12 @@ def jax_relax(coords, mask=None, iters: int = 100, lr: float = 0.05):
         coords = coords[None]
     if mask is not None and jnp.asarray(mask).ndim == 1:
         mask = jnp.asarray(mask)[None]
+    if peptide_mask is not None and jnp.asarray(peptide_mask).ndim == 1:
+        peptide_mask = jnp.asarray(peptide_mask)[None]
     anchor = coords
 
     def energy(c):
-        e = backbone_bond_energy(c, mask)
+        e = backbone_bond_energy(c, mask, peptide_mask)
         # weak restraint to the predicted structure so relaxation repairs
         # bonds without drifting the fold (FastRelax's constrained spirit)
         rest = 0.01 * jnp.sum((c - anchor) ** 2, axis=(-1, -2))
@@ -142,11 +152,14 @@ def jax_relax(coords, mask=None, iters: int = 100, lr: float = 0.05):
     return relaxed, history
 
 
-def run_fast_relax(coords, sequence: str, iters: int = 100):
+def run_fast_relax(coords, sequence: str, iters: int = 100, peptide_mask=None):
     """The reference's unimplemented hook (refinement.py:56-74), completed.
 
     PyRosetta present: real FastRelax through the pose contract.
     Otherwise: jax_relax geometric fallback. Returns (L*3, 3) numpy coords.
+
+    peptide_mask: (L-1,) bool, False across chain breaks / residue-number
+    gaps so the fallback never welds unrelated residues.
     """
     if _HAS_PYROSETTA:
         pose = coords_to_pose(np.asarray(coords), sequence)
@@ -155,5 +168,7 @@ def run_fast_relax(coords, sequence: str, iters: int = 100):
         relax.set_scorefxn(scorefxn)
         relax.apply(pose)
         return pose_to_coords(pose)
-    relaxed, _ = jax_relax(np.asarray(coords, np.float32), iters=iters)
+    relaxed, _ = jax_relax(
+        np.asarray(coords, np.float32), iters=iters, peptide_mask=peptide_mask
+    )
     return np.asarray(relaxed)

@@ -47,9 +47,25 @@ def main():
     coords = np.asarray(
         [by_res[k][n].xyz for k in complete for n in ("N", "CA", "C")]
     )
+    # peptide bonds exist only between same-chain residues with consecutive
+    # numbering — chain breaks and gaps (incl. residues dropped above) must
+    # not be welded by the relaxation
+    peptide_mask = np.asarray(
+        [
+            complete[i][0] == complete[i + 1][0]
+            and complete[i + 1][1] == complete[i][1] + 1
+            for i in range(len(complete) - 1)
+        ],
+        bool,
+    )
+    n_breaks = int((~peptide_mask).sum())
+    if n_breaks:
+        print(f"note: {n_breaks} chain break(s)/gap(s) excluded from relaxation")
     backend = "pyrosetta FastRelax" if pyrosetta_available() else "jax_relax fallback"
     print(f"relaxing {len(seq)} residues via {backend}")
-    relaxed = run_fast_relax(np.asarray(coords), seq, iters=args.iters)
+    relaxed = run_fast_relax(
+        np.asarray(coords), seq, iters=args.iters, peptide_mask=peptide_mask
+    )
     coords_to_pdb(args.output, relaxed, sequence=seq)
     print(f"wrote {args.output}")
 

@@ -58,3 +58,26 @@ def test_batched_relax():
     relaxed, history = jax_relax(bb, iters=50)
     assert relaxed.shape == bb.shape
     assert history.shape == (50, 2)
+
+
+def test_peptide_mask_prevents_chain_welding():
+    """Two chains 30 A apart must NOT be pulled together by relaxation when
+    the break is masked."""
+    import jax.numpy as jnp
+
+    a = _distorted_backbone(L=6, seed=5, noise=0.05)
+    b = _distorted_backbone(L=6, seed=6, noise=0.05) + np.asarray([30.0, 0, 0])
+    bb = np.concatenate([a, b])  # (36, 3), chain break at residue 5->6
+    pmask = np.ones(11, bool)
+    pmask[5] = False
+
+    relaxed, _ = jax_relax(bb, iters=200, peptide_mask=pmask)
+    # the inter-chain gap survives
+    gap_before = np.linalg.norm(bb[5 * 3 + 2] - bb[6 * 3])
+    gap_after = float(jnp.linalg.norm(relaxed[5 * 3 + 2] - relaxed[6 * 3]))
+    assert gap_after > 0.8 * gap_before, (gap_before, gap_after)
+
+    # without the mask the chains get welded (the failure mode under test)
+    welded, _ = jax_relax(bb, iters=200)
+    gap_welded = float(jnp.linalg.norm(welded[5 * 3 + 2] - welded[6 * 3]))
+    assert gap_welded < 0.5 * gap_before
