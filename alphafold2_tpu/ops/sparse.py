@@ -198,7 +198,9 @@ def sparse_attention_apply(
     if isinstance(use_kernel, str):
         if use_kernel != "auto":
             raise ValueError(f"use_kernel must be True/False/'auto', got {use_kernel!r}")
-        use_kernel = n >= 4096
+        # only on real TPUs: off-TPU the kernel would run in the Pallas
+        # interpreter, orders of magnitude slower than the XLA path
+        use_kernel = n >= 4096 and jax.devices()[0].platform == "tpu"
     dtype = cfg.dtype
     bs = scfg.block_size
 

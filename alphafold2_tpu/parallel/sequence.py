@@ -167,6 +167,60 @@ def ulysses_attention(q, k, v, axis_name: str, mask=None):
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
+def sequence_parallel_axial_attention(params, cfg, x, axis_name: str, mask=None, rng=None):
+    """The trunk's axial attention, sequence-parallel over the grid's row
+    axis (SURVEY.md §2.2: 'shard the folded-into-batch axis').
+
+    Call inside `shard_map` with x (b, rows_local, cols, d) row-sharded over
+    `axis_name` (and mask (b, rows_local, cols)). Semantics match
+    ops.attention.axial_attention_apply for self-attention: the row pass is
+    embarrassingly parallel (rows are the folded batch), the column pass
+    runs after an `all_to_all` grid transpose, and the two results sum in
+    the row-sharded layout. One all_to_all pair per call — the only
+    communication.
+
+    Tied-row attention needs a cross-shard logit psum and is not supported
+    here; keep tied-row layers on the replicated path.
+
+    Dropout: `rng` is folded with the shard index so masks are independent
+    across shards (the exact single-device mask pattern is not reproduced —
+    documented divergence; rng=None is bit-identical).
+    """
+    from alphafold2_tpu.ops.attention import attention_apply
+
+    b, h_local, w, d = x.shape
+
+    if rng is not None:
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
+        rng_col, rng_row = jax.random.split(rng)
+    else:
+        rng_col, rng_row = None, None
+
+    # row pass: fold (sharded) rows into batch, attend along the full width
+    row_x = x.reshape(b * h_local, w, d)
+    row_mask = mask.reshape(b * h_local, w) if mask is not None else None
+    row_out = attention_apply(
+        params["attn_height"], cfg, row_x, mask=row_mask, rng=rng_row
+    ).reshape(b, h_local, w, d)
+
+    # column pass: transpose shard axis rows->cols, fold cols into batch
+    xc = axial_alltoall_transpose(x, axis_name, row_sharded=True)  # (b, H, w/P, d)
+    h_full, w_local = xc.shape[1], xc.shape[2]
+    if mask is not None:
+        mc = axial_alltoall_transpose(mask[..., None], axis_name, row_sharded=True)[..., 0]
+        col_mask = jnp.swapaxes(mc, 1, 2).reshape(b * w_local, h_full)
+    else:
+        col_mask = None
+    col_x = jnp.swapaxes(xc, 1, 2).reshape(b * w_local, h_full, d)
+    col_out = attention_apply(
+        params["attn_width"], cfg, col_x, mask=col_mask, rng=rng_col
+    )
+    col_out = jnp.swapaxes(col_out.reshape(b, w_local, h_full, d), 1, 2)
+    col_out = axial_alltoall_transpose(col_out, axis_name, row_sharded=False)
+
+    return row_out + col_out
+
+
 def axial_alltoall_transpose(x, axis_name: str, row_sharded: bool = True):
     """Swap the sharded grid axis of a pair-representation shard.
 

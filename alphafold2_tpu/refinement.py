@@ -161,13 +161,22 @@ def run_fast_relax(coords, sequence: str, iters: int = 100, peptide_mask=None):
     peptide_mask: (L-1,) bool, False across chain breaks / residue-number
     gaps so the fallback never welds unrelated residues.
     """
-    if _HAS_PYROSETTA:
+    has_breaks = peptide_mask is not None and not bool(np.all(peptide_mask))
+    if _HAS_PYROSETTA and not has_breaks:
         pose = coords_to_pose(np.asarray(coords), sequence)
         scorefxn = pyrosetta.get_fa_scorefxn()
         relax = pyrosetta.rosetta.protocols.relax.FastRelax()
         relax.set_scorefxn(scorefxn)
         relax.apply(pose)
         return pose_to_coords(pose)
+    if _HAS_PYROSETTA and has_breaks:
+        # the pose contract renumbers residues into one continuous chain
+        # (geometry/pdb.py coords_to_structure), so FastRelax would bond the
+        # breaks — the exact welding peptide_mask exists to prevent
+        print(
+            "run_fast_relax: chain breaks present; using jax_relax fallback "
+            "(the single-chain pose contract cannot represent breaks)"
+        )
     relaxed, _ = jax_relax(
         np.asarray(coords, np.float32), iters=iters, peptide_mask=peptide_mask
     )
