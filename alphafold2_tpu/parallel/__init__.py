@@ -20,6 +20,8 @@ from alphafold2_tpu.parallel.train import (
 from alphafold2_tpu.parallel.sequence import (
     axial_alltoall_transpose,
     ring_attention,
+    sequence_parallel_axial_attention,
+    tied_row_attention_sharded,
     ulysses_attention,
 )
 
@@ -27,6 +29,8 @@ __all__ = [
     "ring_attention",
     "ulysses_attention",
     "axial_alltoall_transpose",
+    "sequence_parallel_axial_attention",
+    "tied_row_attention_sharded",
     "make_mesh",
     "data_parallel_mesh",
     "param_spec",

@@ -221,6 +221,58 @@ def sequence_parallel_axial_attention(params, cfg, x, axis_name: str, mask=None,
     return row_out + col_out
 
 
+def tied_row_attention_sharded(params, cfg, x, axis_name: str, mask=None, rng=None):
+    """MSA tied-row attention with the ROW axis sharded over the mesh.
+
+    Tied-row attention shares one logit matrix across all MSA rows
+    (reference alphafold2.py:142-150; ops/attention.py tie_dim). When rows
+    are sharded, each chip holds a partial logit sum over its resident
+    rows; one `psum` over `axis_name` completes the contraction
+    (SURVEY.md §2.2: 'if rows are sharded, logits need a psum over the
+    row-shard axis'). Everything else — softmax, per-row value mixing,
+    output projection — stays local.
+
+    Call inside `shard_map`: x (b, r_local, n, dim) with the row axis
+    sharded; mask (b, r_local, n). Exactly matches
+    `attention_apply(..., tie_dim=r_total)` on the gathered rows (dropout
+    included: the shared logits mean every shard must draw the same mask
+    from the same key — do NOT fold in the shard index).
+
+    Returns (b, r_local, n, dim).
+    """
+    from alphafold2_tpu.ops.core import dropout as _dropout, linear as _linear
+
+    dtype = cfg.dtype
+    b, r_local, n, _ = x.shape
+    h, dh = cfg.heads, cfg.dim_head
+    num_shards = jax.lax.psum(1, axis_name)
+    r_total = r_local * num_shards
+
+    q = _linear(params["to_q"], x, dtype=dtype)
+    kv = _linear(params["to_kv"], x, dtype=dtype)
+    k, v = jnp.split(kv, 2, axis=-1)
+    q, k, v = (t.reshape(b, r_local, n, h, dh) for t in (q, k, v))
+
+    # partial logit sum over resident rows, completed by ONE psum over ICI
+    scale = dh ** -0.5 * r_total ** -0.5
+    logits = jnp.einsum("brihd,brjhd->bhij", q, k).astype(jnp.float32) * scale
+    logits = jax.lax.psum(logits, axis_name)
+
+    if mask is not None:
+        # a position is valid only if valid in EVERY row, across all shards
+        # (ops/attention.py tie_dim mask collapse, generalized)
+        local_all = jnp.all(mask, axis=1)  # (b, n)
+        global_all = jax.lax.psum(local_all.astype(jnp.int32), axis_name) == num_shards
+        pair = global_all[:, None, :, None] & global_all[:, None, None, :]
+        logits = jnp.where(pair, logits, jnp.finfo(jnp.float32).min)
+
+    attn = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    attn = _dropout(rng, attn, cfg.dropout)
+
+    out = jnp.einsum("bhij,brjhd->brihd", attn, v).reshape(b, r_local, n, h * dh)
+    return _linear(params["to_out"], out, dtype=dtype)
+
+
 def axial_alltoall_transpose(x, axis_name: str, row_sharded: bool = True):
     """Swap the sharded grid axis of a pair-representation shard.
 

@@ -38,8 +38,16 @@ from alphafold2_tpu.training.checkpoint import (
     open_or_init,
     restore_or_init,
 )
+from alphafold2_tpu.training.resilience import (
+    BadStepError,
+    StepGuard,
+    run_resilient,
+)
 
 __all__ = [
+    "BadStepError",
+    "StepGuard",
+    "run_resilient",
     "CheckpointManager",
     "abstract_like",
     "finish",

@@ -1,0 +1,145 @@
+"""Failure detection and elastic recovery for training loops.
+
+The reference has nothing here — "not even try/except around training"
+(SURVEY.md §5); a crashed or NaN-poisoned run simply dies. This module is
+the recovery layer our checkpoint subsystem makes possible:
+
+  * `StepGuard` — NaN/Inf watchdog over step metrics: poisoned steps are
+    detected on the host (one scalar sync that the metrics logger pays
+    anyway), the update is rolled back to the last good state, and
+    training continues; repeated poisoning within a window aborts with a
+    clear error instead of silently training on garbage.
+  * `run_resilient` — a supervisor loop: runs the jitted step, checkpoints
+    on cadence, and on ANY exception (device OOM, preemption-style
+    interrupts, data errors) restores from the latest checkpoint and
+    resumes, up to `max_restarts`. This is single-process elastic recovery
+    — the multi-host story composes the same primitive with
+    `jax.distributed` restart semantics.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Iterator, Optional
+
+import jax
+import numpy as np
+
+
+class BadStepError(RuntimeError):
+    """Raised when non-finite steps persist beyond the tolerated window."""
+
+
+class StepGuard:
+    """Rolls back non-finite steps; aborts when they persist.
+
+    Keeps a reference to the last known-good state (a no-copy pytree
+    reference — jax arrays are immutable, so 'keeping' it is free).
+    """
+
+    def __init__(self, state, max_consecutive_bad: int = 3):
+        self.good_state = state
+        self.max_consecutive_bad = max_consecutive_bad
+        self.bad_streak = 0
+        self.bad_total = 0
+
+    def check(self, new_state, metrics) -> tuple:
+        """Returns (state_to_continue_from, step_was_good)."""
+        loss = float(np.asarray(jax.device_get(metrics["loss"])))
+        if math.isfinite(loss):
+            self.good_state = new_state
+            self.bad_streak = 0
+            return new_state, True
+        self.bad_streak += 1
+        self.bad_total += 1
+        if self.bad_streak >= self.max_consecutive_bad:
+            raise BadStepError(
+                f"{self.bad_streak} consecutive non-finite losses; "
+                "aborting instead of training on garbage"
+            )
+        return self.good_state, False
+
+
+def run_resilient(
+    step_fn: Callable,
+    state,
+    batches: Iterator,
+    *,
+    steps: int,
+    make_rng: Callable[[int], object],
+    mgr=None,
+    on_metrics: Optional[Callable[[int, dict], None]] = None,
+    max_restarts: int = 3,
+    max_consecutive_bad: int = 3,
+):
+    """Supervised training loop with rollback and checkpoint-restore retry.
+
+    Args:
+      step_fn: jitted (state, batch, rng) -> (state, metrics).
+      state: initial TrainState (its "step" entry drives numbering).
+      batches: batch iterator (consumed once per attempted step).
+      steps: number of steps to run from the CURRENT state step.
+      make_rng: step index -> PRNG key (use jax.random.fold_in for
+        resume-stable schedules).
+      mgr: optional CheckpointManager; saves ride its save_interval_steps
+        cadence and recovery restores from it.
+      on_metrics: callback(step, metrics) for logging.
+      max_restarts: exception-recovery budget.
+
+    Returns the final state.
+    """
+    start = int(np.asarray(jax.device_get(state["step"])))
+    target = start + steps
+    restarts = 0
+    guard = StepGuard(state, max_consecutive_bad=max_consecutive_bad)
+
+    while True:
+        step = int(np.asarray(jax.device_get(state["step"])))
+        if step >= target:
+            break
+        try:
+            try:
+                batch = next(batches)
+            except StopIteration:
+                raise RuntimeError(
+                    f"data exhausted at step {step} (before target {target}); "
+                    "not a recoverable fault"
+                ) from None
+            new_state, metrics = step_fn(state, batch, make_rng(step))
+            state, ok = guard.check(new_state, metrics)
+            if ok:
+                # a successful step clears the restart budget: the limit is
+                # on CONSECUTIVE failures, not failures over the run's life
+                restarts = 0
+                if on_metrics is not None:
+                    on_metrics(step, metrics)
+                if mgr is not None:
+                    mgr.save(state)
+            else:
+                print(f"step {step}: non-finite loss — rolled back, retrying")
+        except (BadStepError, KeyboardInterrupt):
+            raise
+        except Exception as e:  # crash-recovery path
+            restarts += 1
+            if restarts > max_restarts:
+                raise
+            if mgr is not None and mgr.latest_step() is not None:
+                from alphafold2_tpu.training.checkpoint import abstract_like
+
+                state = mgr.restore(abstract_like(guard.good_state))
+                where = f"checkpoint step {int(np.asarray(state['step']))}"
+            else:
+                state = guard.good_state
+                where = "last good in-memory state"
+            guard.good_state = state
+            guard.bad_streak = 0  # restored state is clean; stale NaN counts
+            # from before the crash must not count against it
+            print(
+                f"step {step}: {type(e).__name__}: {e} — "
+                f"restart {restarts}/{max_restarts} from {where}"
+            )
+    if mgr is not None:
+        from alphafold2_tpu.training.checkpoint import finish
+
+        finish(mgr, state)
+    return state

@@ -1,0 +1,130 @@
+"""Failure-detection / elastic-recovery tests (all new surface — the
+reference has no try/except around training at all, SURVEY.md §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu.models import Alphafold2Config
+from alphafold2_tpu.training import (
+    BadStepError,
+    CheckpointManager,
+    DataConfig,
+    StepGuard,
+    TrainConfig,
+    make_train_step,
+    run_resilient,
+    stack_microbatches,
+    synthetic_batches,
+    train_state_init,
+)
+
+CFG = Alphafold2Config(dim=32, depth=1, heads=2, dim_head=8, max_seq_len=64)
+TCFG = TrainConfig(learning_rate=1e-3, grad_accum=2)
+
+
+def _batches():
+    return stack_microbatches(synthetic_batches(DataConfig(batch_size=1, max_len=8)), 2)
+
+
+def test_step_guard_rolls_back_nan():
+    state = {"step": jnp.asarray(0), "w": jnp.asarray(1.0)}
+    guard = StepGuard(state)
+    bad = {"step": jnp.asarray(1), "w": jnp.asarray(999.0)}
+    out, ok = guard.check(bad, {"loss": jnp.asarray(float("nan"))})
+    assert not ok and float(out["w"]) == 1.0  # rolled back
+    good = {"step": jnp.asarray(1), "w": jnp.asarray(2.0)}
+    out, ok = guard.check(good, {"loss": jnp.asarray(0.5)})
+    assert ok and float(out["w"]) == 2.0
+    assert guard.bad_streak == 0
+
+
+def test_step_guard_aborts_on_streak():
+    guard = StepGuard({"w": jnp.asarray(1.0)}, max_consecutive_bad=2)
+    guard.check({"w": jnp.asarray(2.0)}, {"loss": jnp.asarray(float("inf"))})
+    with pytest.raises(BadStepError):
+        guard.check({"w": jnp.asarray(3.0)}, {"loss": jnp.asarray(float("nan"))})
+
+
+def test_run_resilient_happy_path(tmp_path):
+    state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+    step = jax.jit(make_train_step(CFG, TCFG))
+    seen = []
+    with CheckpointManager(str(tmp_path / "ckpt")) as mgr:
+        state = run_resilient(
+            step, state, _batches(), steps=3,
+            make_rng=lambda i: jax.random.fold_in(jax.random.PRNGKey(1), i),
+            mgr=mgr, on_metrics=lambda s, m: seen.append(s),
+        )
+    assert int(state["step"]) == 3
+    assert seen == [0, 1, 2]
+
+
+def test_run_resilient_recovers_from_crash(tmp_path):
+    """A step that raises once: the loop restores and finishes."""
+    state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+    real_step = jax.jit(make_train_step(CFG, TCFG))
+    crashes = {"left": 1}
+
+    def flaky_step(state, batch, rng):
+        if int(np.asarray(state["step"])) == 1 and crashes["left"]:
+            crashes["left"] -= 1
+            raise RuntimeError("simulated device failure")
+        return real_step(state, batch, rng)
+
+    with CheckpointManager(str(tmp_path / "ckpt")) as mgr:
+        state = run_resilient(
+            flaky_step, state, _batches(), steps=3,
+            make_rng=lambda i: jax.random.fold_in(jax.random.PRNGKey(1), i),
+            mgr=mgr,
+        )
+    assert int(state["step"]) == 3
+    assert crashes["left"] == 0
+
+
+def test_run_resilient_exhausts_restarts():
+    state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+
+    def always_crash(state, batch, rng):
+        raise RuntimeError("hard failure")
+
+    with pytest.raises(RuntimeError, match="hard failure"):
+        run_resilient(
+            always_crash, state, _batches(), steps=2,
+            make_rng=lambda i: jax.random.PRNGKey(i), max_restarts=2,
+        )
+
+
+def test_data_exhaustion_is_not_a_crash(tmp_path):
+    """StopIteration surfaces as a clear error, not a restart spiral."""
+    state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+    step = jax.jit(make_train_step(CFG, TCFG))
+    short = iter([next(_batches())])  # exactly one batch
+    with pytest.raises(RuntimeError, match="data exhausted"):
+        run_resilient(
+            step, state, short, steps=3,
+            make_rng=lambda i: jax.random.PRNGKey(i),
+        )
+
+
+def test_restart_budget_is_consecutive():
+    """Failures separated by successful steps don't accumulate."""
+    state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+    real = jax.jit(make_train_step(CFG, TCFG))
+    fail_at = {1, 2, 4}  # 2 faults, success, 1 more fault: budget 2 suffices
+    fired = set()
+
+    def flaky(s, b, r):
+        step = int(np.asarray(s["step"]))
+        if step in fail_at and step not in fired:
+            fired.add(step)
+            raise RuntimeError("transient")
+        return real(s, b, r)
+
+    state = run_resilient(
+        flaky, state, _batches(), steps=6,
+        make_rng=lambda i: jax.random.fold_in(jax.random.PRNGKey(1), i),
+        max_restarts=2,
+    )
+    assert int(state["step"]) == 6 and fired == fail_at

@@ -174,3 +174,32 @@ def test_sequence_parallel_axial_matches_single_device():
     )
     got = fn(params, x, mask)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_tied_row_attention_sharded_parity():
+    """Row-sharded tied-row attention == attention_apply(tie_dim=R) on the
+    gathered rows (the psum-completed logit contraction)."""
+    from alphafold2_tpu.ops.attention import AttentionConfig, attention_apply, attention_init
+    from alphafold2_tpu.parallel.sequence import tied_row_attention_sharded
+
+    mesh = _mesh()
+    cfg = AttentionConfig(dim=32, heads=4, dim_head=8)
+    params = attention_init(jax.random.PRNGKey(0), cfg)
+    rs = np.random.RandomState(7)
+    b, R, n = 2, 16, 12
+    x = jnp.asarray(rs.randn(b, R, n, 32).astype(np.float32))
+    mask = jnp.asarray(rs.rand(b, R, n) > 0.1)
+
+    # oracle: flat (b*R, n, d) with tie_dim=R
+    want = attention_apply(
+        params, cfg, x.reshape(b * R, n, 32),
+        mask=mask.reshape(b * R, n), tie_dim=R,
+    ).reshape(b, R, n, 32)
+
+    spec = P(None, "sp", None, None)
+    fn = shard_map(
+        lambda p, x, m: tied_row_attention_sharded(p, cfg, x, "sp", mask=m),
+        mesh=mesh, in_specs=(P(), spec, P(None, "sp", None)), out_specs=spec,
+    )
+    got = fn(params, x, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
