@@ -42,7 +42,7 @@ def main():
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     ap.add_argument(
-        "--data", choices=["synthetic", "sidechainnet"], default="synthetic"
+        "--data", choices=["synthetic", "sidechainnet", "native"], default="synthetic"
     )
     ap.add_argument("--ckpt-dir", default=None, help="checkpoint/resume directory")
     ap.add_argument("--ckpt-every", type=int, default=50)
@@ -66,6 +66,40 @@ def main():
         it = sidechainnet_batches(dcfg)
         if it is None:
             print("sidechainnet unavailable; falling back to synthetic data")
+    elif args.data == "native":
+        # C++ threaded prefetch loader (alphafold2_tpu/runtime): batch
+        # assembly runs off the GIL; here it serves a synthetic in-memory
+        # structure pool, the same path a real corpus would use
+        import numpy as np
+
+        from alphafold2_tpu.runtime import NativePrefetchLoader
+
+        rs = np.random.RandomState(dcfg.seed)
+        pool = []
+        for _ in range(256):
+            L = rs.randint(32, 4 * args.max_len)
+            seq = rs.randint(0, 21, L).astype(np.int32)
+            cloud = np.cumsum(
+                3.8 * rs.randn(L, 14, 3).astype(np.float32), axis=0
+            )
+            pool.append((seq, cloud))
+        loader = NativePrefetchLoader(
+            pool, batch_size=args.batch, max_len=args.max_len,
+            seed=dcfg.seed, n_threads=2,
+        )
+        print(f"native prefetch loader: {'C++' if loader.native else 'python fallback'}")
+
+        def native_gen():
+            while True:
+                b = loader.next()
+                yield {
+                    "seq": b["seq"],
+                    "mask": b["mask"],
+                    # CA trace (atom slot 1) drives the distogram labels
+                    "coords": b["coords"][:, :, 1],
+                }
+
+        it = native_gen()
     if it is None:
         it = synthetic_batches(dcfg)
     batches = stack_microbatches(it, tcfg.grad_accum)
