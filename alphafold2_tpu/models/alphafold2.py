@@ -60,13 +60,12 @@ def _prenorm_attn_init(key, cfg: Alphafold2Config):
 
 
 def alphafold2_init(key, cfg: Alphafold2Config):
-    """Initialize all model params (embeddings, template tower, trunk, head)."""
-    if any(cfg.layer_sparse) and cfg.reversible:
-        raise NotImplementedError(
-            "block-sparse attention inside the scanned reversible trunk "
-            "needs a uniform layer body; use the sequential trunk with "
-            "sparse_self_attn, or reversible without it"
-        )
+    """Initialize all model params (embeddings, template tower, trunk, head).
+
+    sparse_self_attn composes with reversible=True: the reversible trunk
+    segments its scan by runs of equal sparse flags (models/reversible.py),
+    matching the reference's `sparse_self_attn=(True, False)*6` with
+    `reversible=True` capability (reference alphafold2.py:349,407-411)."""
     keys = jax.random.split(key, 16)
     params = {
         # embeddings (reference alphafold2.py:351-368)
@@ -222,6 +221,11 @@ def alphafold2_apply(
     )
 
     # axial positional embedding (reference :455-456)
+    if n > cfg.max_seq_len:
+        # out-of-range jnp.take fills NaN under jit (see MSA checks below)
+        raise ValueError(
+            f"sequence length {n} exceeds max_seq_len={cfg.max_seq_len}"
+        )
     n_range = jnp.arange(n)
     pos = (
         embedding(params["pos_emb"], n_range, dtype=cfg.dtype)[:, None, :]
@@ -234,6 +238,19 @@ def alphafold2_apply(
     m_mask = msa_mask
     if msa is not None:
         rows, cols = msa.shape[1], msa.shape[2]
+        # out-of-range jnp.take fills NaN under jit — without these checks an
+        # oversized MSA silently poisons the whole forward
+        if rows > cfg.max_num_msa:
+            raise ValueError(
+                f"msa has {rows} rows but the row-position table holds "
+                f"max_num_msa={cfg.max_num_msa}; raise max_num_msa in the "
+                f"config (reference constants.py MAX_NUM_MSA)"
+            )
+        if cols > cfg.max_seq_len:
+            raise ValueError(
+                f"msa has {cols} columns but the position table holds "
+                f"max_seq_len={cfg.max_seq_len}"
+            )
         m = embedding(params["token_emb"], msa, dtype=cfg.dtype)
         m = m + embedding(params["msa_pos_emb"], jnp.arange(cols), dtype=cfg.dtype)[None, None]
         m = m + embedding(params["msa_num_pos_emb"], jnp.arange(rows), dtype=cfg.dtype)[None, :, None, :]

@@ -53,7 +53,27 @@ class Alphafold2Config:
     # sequences, XLA block-gather for short — see ops/sparse.py)
     sparse_use_kernel: Union[bool, str] = "auto"
     cross_attn_compress_ratio: int = 1
+    # "flat": cross-attention between the fully-flattened pair and MSA
+    # streams (reference alphafold2.py:316-317 semantics — O(n^2 * r*c)
+    # logits, streamed blockwise at scale). "aligned": column-aligned
+    # cross-attention — each pair token attends only the MSA column its grid
+    # column maps to, and each MSA token attends only its column's pair-grid
+    # block. O(n^2 * r) total: the TPU-first redesign that makes the
+    # crop-384 / MSA-128 workload tractable (the pattern the reference built
+    # as per-axis context broadcast but never used, alphafold2.py:269-273).
+    cross_attn_mode: str = "flat"
     msa_tie_row_attn: bool = False
+    # blockwise flash streaming for dense attention: True / False / "auto"
+    # (see ops/attention.py AttentionConfig.flash)
+    attn_flash: Union[bool, str] = "auto"
+    # chunk the folded-batch axis of every dense attention op (QKV/out
+    # projections included) into blocks of this many elements (0 = off; see
+    # ops/attention.py AttentionConfig.batch_chunk)
+    attn_batch_chunk: int = 0
+    # chunk feed-forward token axes into blocks of this many tokens (0 =
+    # off): bounds the GEGLU 8*dim intermediate, which at crop 384 is the
+    # largest single activation in the trunk
+    ff_chunk_size: int = 0
     template_attn_depth: int = 2
     dtype: Any = jnp.float32
 
@@ -62,6 +82,11 @@ class Alphafold2Config:
             raise ValueError(
                 "reversible=True and remat=True are mutually exclusive "
                 "activation-memory strategies; pick one"
+            )
+        if self.cross_attn_mode not in ("flat", "aligned"):
+            raise ValueError(
+                f"cross_attn_mode must be 'flat' or 'aligned', "
+                f"got {self.cross_attn_mode!r}"
             )
 
     @property
@@ -88,6 +113,8 @@ class Alphafold2Config:
             dim_head=self.dim_head,
             dropout=self.attn_dropout,
             dtype=self.dtype,
+            flash=self.attn_flash,
+            batch_chunk=self.attn_batch_chunk,
         )
 
     def cross_attn_config(self) -> AttentionConfig:
@@ -98,4 +125,6 @@ class Alphafold2Config:
             dropout=self.attn_dropout,
             compress_ratio=self.cross_attn_compress_ratio,
             dtype=self.dtype,
+            flash=self.attn_flash,
+            batch_chunk=self.attn_batch_chunk,
         )

@@ -175,9 +175,14 @@ def esm_tokenize(our_tokens, our_mask=None):
     mask = jnp.concatenate(
         [jnp.ones((b, 1), bool), our_mask, jnp.zeros((b, 1), bool)], axis=1
     )
-    # <eos> right after the last valid residue of each row
-    lengths = jnp.sum(our_mask.astype(jnp.int32), axis=1)  # (b,)
-    eos_pos = (1 + lengths)[:, None]
+    # <eos> right after the LAST valid residue of each row — computed from
+    # the last True index, not the mask popcount, so a non-contiguous mask
+    # can never overwrite a valid residue (it lands on a pad slot; the
+    # popcount formula 1+sum(mask) would point inside the sequence)
+    last_valid = jnp.max(
+        jnp.where(our_mask, jnp.arange(L)[None, :], -1), axis=1
+    )  # (b,), -1 for all-masked rows -> eos right after <cls>
+    eos_pos = (last_valid + 2)[:, None]
     idx = jnp.arange(L + 2)[None, :]
     tokens = jnp.where(idx == eos_pos, _EOS, tokens)
     mask = mask | (idx == eos_pos)

@@ -51,6 +51,11 @@ class RefinerConfig:
     # scale on the per-layer coordinate delta; final coord head is
     # zero-initialized so an untrained refiner is the identity on coords.
     coord_scale: float = 1.0
+    # process query atoms in blocks of this size under jax.checkpoint
+    # (0 = off): bounds the (A, A, msg_dim) pair-message tensor, which at
+    # the north-star crop (5376 atoms) is 3.4 GB per copy and the training
+    # backward holds several
+    atom_chunk: int = 0
 
 
 def _mlp_init(key, d_in, d_hidden, d_out):
@@ -112,10 +117,40 @@ def refiner_apply(params, cfg: RefinerConfig, tokens, coords, mask=None):
 
     h = embedding(params["token_emb"], tokens, dtype=dtype)  # (b, A, d)
 
-    for layer in params["layers"]:
-        diff = coords[:, :, None, :] - coords[:, None, :, :]  # (b, A, A, 3)
-        sqdist = jnp.sum(jnp.square(diff), axis=-1, keepdims=True)  # (b, A, A, 1)
+    def message_pass(layer, hq_pre, hk_pre, coords_q, coords_all, pair_mask_q, w_sq, b1):
+        """Messages from all atoms to a block of query atoms.
 
+        hq_pre/coords_q/pair_mask_q: (b, qb, ...) query-block slices;
+        hk_pre/coords_all: (b, A, ...) full key-side tensors. Returns the
+        block's (delta (b, qb, 3), weighted message agg (b, qb, msg)).
+        """
+        diff = coords_q[:, :, None, :] - coords_all[:, None, :, :]  # (b, qb, A, 3)
+        sqdist = jnp.sum(jnp.square(diff), axis=-1, keepdims=True)
+
+        pre = (
+            hq_pre[:, :, None, :]
+            + hk_pre[:, None, :, :]
+            + sqdist.astype(dtype) * w_sq
+            + b1
+        )
+        m = linear(layer["edge_mlp"]["l2"], jax.nn.silu(pre), dtype=dtype)
+        gate = jax.nn.sigmoid(linear(layer["att"], m, dtype=dtype))  # (b, qb, A, 1)
+        gate = jnp.where(pair_mask_q[..., None], gate, 0.0)
+
+        # equivariant coordinate update along normalized difference vectors
+        coef = _mlp(layer["coord_mlp"], m, dtype).astype(jnp.float32)
+        # clamp before sqrt: coincident atoms (the sidechain proto cloud
+        # parks every non-backbone slot at the SAME point) and the diagonal
+        # have sqdist == 0, where sqrt's vjp is inf and 0-gates cannot stop
+        # it (0 * inf = nan); max() routes the gradient to the eps branch
+        norm = jnp.sqrt(jnp.maximum(sqdist, 1e-12))
+        direction = jnp.where(pair_mask_q[..., None], diff, 0.0) / (norm + 1.0)
+        delta = jnp.sum(gate.astype(jnp.float32) * coef * direction, axis=2)
+        agg = jnp.sum(gate * m, axis=2)  # (b, qb, msg)
+        return delta, agg
+
+    chunk = cfg.atom_chunk
+    for layer in params["layers"]:
         # The edge MLP's first layer is linear over concat(h_i, h_j, |.|^2),
         # which is separable: project h once per *node* and broadcast-add,
         # so the largest pair tensor is (b, A, A, msg) rather than
@@ -124,29 +159,56 @@ def refiner_apply(params, cfg: RefinerConfig, tokens, coords, mask=None):
         w1 = layer["edge_mlp"]["l1"]["w"].astype(dtype)
         b1 = layer["edge_mlp"]["l1"]["b"].astype(dtype)
         hd = h.astype(dtype)
-        pre = (
-            (hd @ w1[:d])[:, :, None, :]
-            + (hd @ w1[d : 2 * d])[:, None, :, :]
-            + sqdist.astype(dtype) * w1[2 * d]
-            + b1
-        )
-        m = linear(layer["edge_mlp"]["l2"], jax.nn.silu(pre), dtype=dtype)  # (b, A, A, msg)
-        gate = jax.nn.sigmoid(linear(layer["att"], m, dtype=dtype))  # (b, A, A, 1)
-        gate = jnp.where(pair_mask[..., None], gate, 0.0)
+        hq_pre = hd @ w1[:d]  # (b, A, msg)
+        hk_pre = hd @ w1[d : 2 * d]
+        w_sq = w1[2 * d]
 
-        # equivariant coordinate update along normalized difference vectors
-        coef = _mlp(layer["coord_mlp"], m, dtype).astype(jnp.float32)  # (b, A, A, 1)
-        # clamp before sqrt: coincident atoms (the sidechain proto cloud
-        # parks every non-backbone slot at the SAME point) and the diagonal
-        # have sqdist == 0, where sqrt's vjp is inf and 0-gates cannot stop
-        # it (0 * inf = nan); max() routes the gradient to the eps branch
-        norm = jnp.sqrt(jnp.maximum(sqdist, 1e-12))
-        direction = jnp.where(pair_mask[..., None], diff, 0.0) / (norm + 1.0)
-        delta = jnp.sum(gate.astype(jnp.float32) * coef * direction, axis=2) / denom
+        if not chunk or num_atoms <= chunk:
+            delta, agg = message_pass(
+                layer, hq_pre, hk_pre, coords, coords, pair_mask, w_sq, b1
+            )
+        else:
+            # map query-atom blocks under checkpoint: the (qb, A, msg) pair
+            # tensor is the only live block, recomputed in backward
+            pad = (-num_atoms) % chunk
+            nq = (num_atoms + pad) // chunk
+
+            def pad_q(t, fill=0):
+                if not pad:
+                    return t
+                widths = [(0, 0), (0, pad)] + [(0, 0)] * (t.ndim - 2)
+                return jnp.pad(t, widths, constant_values=fill)
+
+            def to_blocks(t):
+                return jnp.moveaxis(
+                    t.reshape((t.shape[0], nq, chunk) + t.shape[2:]), 1, 0
+                )
+
+            blocks = (
+                to_blocks(pad_q(hq_pre)),
+                to_blocks(pad_q(coords)),
+                to_blocks(pad_q(pair_mask, fill=False)),
+            )
+
+            def body(args):
+                hq_b, cq_b, pm_b = args
+                return message_pass(
+                    layer, hq_b, hk_pre, cq_b, coords, pm_b, w_sq, b1
+                )
+
+            delta_b, agg_b = jax.lax.map(jax.checkpoint(body), blocks)
+            delta = jnp.moveaxis(delta_b, 0, 1).reshape(b, nq * chunk, 3)[
+                :, :num_atoms
+            ]
+            agg = jnp.moveaxis(agg_b, 0, 1).reshape(b, nq * chunk, -1)[
+                :, :num_atoms
+            ]
+
+        delta = delta / denom
         coords = coords + cfg.coord_scale * jnp.where(mask[..., None], delta, 0.0)
 
         # invariant feature update
-        agg = jnp.sum(gate * m, axis=2) / denom.astype(m.dtype)  # (b, A, msg)
+        agg = agg / denom.astype(agg.dtype)
         upd = _mlp(layer["node_mlp"], jnp.concatenate([h, agg], axis=-1), dtype)
         h = layer_norm(layer["norm"], h + upd)
 

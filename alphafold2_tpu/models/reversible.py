@@ -43,8 +43,9 @@ import numpy as np
 
 from alphafold2_tpu.models.config import Alphafold2Config
 from alphafold2_tpu.models.trunk import (
+    cross_apply_grids,
+    make_sparse_axial_fn,
     prenorm_axial_apply,
-    prenorm_cross_apply,
     prenorm_ff_apply,
     trunk_layer_init,
 )
@@ -71,9 +72,15 @@ def stack_layers(layers):
 # --- the four block functions, parameter-explicit for jax.vjp ---------------
 
 
-def _f_seq(cfg, params, x2, x_mask, rng):
-    # seq axial self-attention (reference reversible f, alphafold2.py:393)
-    return prenorm_axial_apply(params, cfg.self_attn_config(), x2, mask=x_mask, rng=rng)
+def _f_seq(cfg, params, x2, x_mask, rng, sparse=False):
+    # seq axial self-attention (reference reversible f, alphafold2.py:393),
+    # block-sparse on layers flagged sparse (reference allows
+    # sparse_self_attn with reversible=True, alphafold2.py:349,407-411)
+    fn = make_sparse_axial_fn(cfg) if sparse else None
+    return prenorm_axial_apply(
+        params, cfg.self_attn_config(), x2, mask=x_mask, rng=rng,
+        attention_fn=fn,
+    )
 
 
 def _j_msa(cfg, params, m2, msa_mask, rng):
@@ -92,25 +99,12 @@ def _ff(cfg, params, t, rng):
     return prenorm_ff_apply(params, cfg, t, rng=rng)
 
 
-def _cross(cfg, params, q_grid, ctx_grid, q_mask, ctx_mask, rng):
-    # cross-attention over flattened grids, optionally KV-compressed
-    # (alphafold2.py:401-403)
-    qb = q_grid.shape[0]
-    d = q_grid.shape[-1]
-    qf = q_grid.reshape(qb, -1, d)
-    cf = ctx_grid.reshape(qb, -1, d)
-    qm = q_mask.reshape(qb, -1) if q_mask is not None else None
-    cm = ctx_mask.reshape(qb, -1) if ctx_mask is not None else None
-    out = prenorm_cross_apply(
-        params,
-        cfg.cross_attn_config(),
-        qf,
-        cf,
-        mask=qm,
-        context_mask=cm,
-        rng=rng,
+def _cross(cfg, params, q_grid, ctx_grid, q_mask, ctx_mask, rng, direction):
+    # cross-attention on grids, flat or column-aligned per
+    # cfg.cross_attn_mode, optionally KV-compressed (alphafold2.py:401-403)
+    return cross_apply_grids(
+        params, cfg, q_grid, ctx_grid, q_mask, ctx_mask, rng, direction
     )
-    return out.reshape(q_grid.shape)
 
 
 def _op_rngs(rng, layer_idx):
@@ -123,27 +117,29 @@ def _op_rngs(rng, layer_idx):
 # --- one layer forward (used by scan in both primal and fwd rule) -----------
 
 
-def _layer_forward(cfg, lp, state, x_mask, msa_mask, rngs):
+def _layer_forward(cfg, lp, state, x_mask, msa_mask, rngs, sparse=False):
     x1, x2, m1, m2 = state
     (r_fs, r_gs, r_js, r_ks, r_fc, r_gc, r_jc, r_kc) = rngs
 
     # self-attention block (reference reversible.py:68-83)
-    y1 = x1 + _f_seq(cfg, lp["seq_attn"], x2, x_mask, r_fs)
+    y1 = x1 + _f_seq(cfg, lp["seq_attn"], x2, x_mask, r_fs, sparse)
     y2 = x2 + _ff(cfg, lp["seq_ff"], y1, r_gs)
     n1 = m1 + _j_msa(cfg, lp["msa_attn"], m2, msa_mask, r_js)
     n2 = m2 + _ff(cfg, lp["msa_ff"], n1, r_ks)
 
     # cross-attention block (reference reversible.py:168-182); note the msa
     # cross attends the UPDATED seq half z2
-    z1 = y1 + _cross(cfg, lp["seq_cross"], y2, n2, x_mask, msa_mask, r_fc)
+    z1 = y1 + _cross(cfg, lp["seq_cross"], y2, n2, x_mask, msa_mask, r_fc,
+                     "pair_from_msa")
     z2 = y2 + _ff(cfg, lp["seq_ff2"], z1, r_gc)
-    o1 = n1 + _cross(cfg, lp["msa_cross"], n2, z2, msa_mask, x_mask, r_jc)
+    o1 = n1 + _cross(cfg, lp["msa_cross"], n2, z2, msa_mask, x_mask, r_jc,
+                     "msa_from_pair")
     o2 = n2 + _ff(cfg, lp["msa_ff2"], o1, r_kc)
 
     return (z1, z2, o1, o2)
 
 
-def _layer_backward(cfg, lp, state, cts, x_mask, msa_mask, rngs):
+def _layer_backward(cfg, lp, state, cts, x_mask, msa_mask, rngs, sparse=False):
     """Invert one layer and propagate cotangents (reference
     reversible.py:85-156 and 184-262, re-derived with jax.vjp)."""
     z1, z2, o1, o2 = state
@@ -158,7 +154,8 @@ def _layer_backward(cfg, lp, state, cts, x_mask, msa_mask, rngs):
     dn1 = do1 + do1_k
     # j: o1 = n1 + J(n2, z2)  — the y2-coupling (reference :213-225)
     jn2, j_vjp = jax.vjp(
-        lambda p, q, c: _cross(cfg, p, q, c, msa_mask, x_mask, r_jc),
+        lambda p, q, c: _cross(cfg, p, q, c, msa_mask, x_mask, r_jc,
+                               "msa_from_pair"),
         lp["msa_cross"],
         n2,
         z2,
@@ -174,7 +171,8 @@ def _layer_backward(cfg, lp, state, cts, x_mask, msa_mask, rngs):
     dy1 = dz1 + dz1_g
     # f: z1 = y1 + F(y2, n2)
     fy2, f_vjp = jax.vjp(
-        lambda p, q, c: _cross(cfg, p, q, c, x_mask, msa_mask, r_fc),
+        lambda p, q, c: _cross(cfg, p, q, c, x_mask, msa_mask, r_fc,
+                               "pair_from_msa"),
         lp["seq_cross"],
         y2,
         n2,
@@ -191,7 +189,7 @@ def _layer_backward(cfg, lp, state, cts, x_mask, msa_mask, rngs):
     dgs, dy1_g = gs_vjp(dy2)
     dx1 = dy1 + dy1_g
     fx2, fs_vjp = jax.vjp(
-        lambda p, t: _f_seq(cfg, p, t, x_mask, r_fs), lp["seq_attn"], x2
+        lambda p, t: _f_seq(cfg, p, t, x_mask, r_fs, sparse), lp["seq_attn"], x2
     )
     x1 = y1 - fx2
     dfs, dx2_f = fs_vjp(dx1)
@@ -225,13 +223,24 @@ def _num_layers(stacked):
     return jax.tree_util.tree_leaves(stacked)[0].shape[0]
 
 
-def _scan_forward(cfg, stacked, state, x_mask, msa_mask, rng):
+def _scan_forward(meta, stacked, state, x_mask, msa_mask, rng):
+    """meta: (cfg, sparse, layer_offset) — static per uniform-flag segment.
+
+    The layer offset keeps `fold_in(rng, layer)` keys GLOBAL layer indices,
+    so a segmented trunk (mixed sparse flags) draws the same dropout keys a
+    single-segment one would.
+    """
+    cfg, sparse, offset = meta
+
     def body(carry, inp):
         lp, li = inp
-        return _layer_forward(cfg, lp, carry, x_mask, msa_mask, _op_rngs(rng, li)), None
+        return (
+            _layer_forward(cfg, lp, carry, x_mask, msa_mask, _op_rngs(rng, li), sparse),
+            None,
+        )
 
     L = _num_layers(stacked)
-    carry, _ = jax.lax.scan(body, state, (stacked, jnp.arange(L)))
+    carry, _ = jax.lax.scan(body, state, (stacked, jnp.arange(offset, offset + L)))
     return carry
 
 
@@ -239,12 +248,12 @@ def _scan_forward(cfg, stacked, state, x_mask, msa_mask, rng):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def _reversible_core(cfg, stacked, x1, x2, m1, m2, x_mask, msa_mask, rng):
-    return _scan_forward(cfg, stacked, (x1, x2, m1, m2), x_mask, msa_mask, rng)
+def _reversible_core(meta, stacked, x1, x2, m1, m2, x_mask, msa_mask, rng):
+    return _scan_forward(meta, stacked, (x1, x2, m1, m2), x_mask, msa_mask, rng)
 
 
-def _reversible_core_fwd(cfg, stacked, x1, x2, m1, m2, x_mask, msa_mask, rng):
-    out = _scan_forward(cfg, stacked, (x1, x2, m1, m2), x_mask, msa_mask, rng)
+def _reversible_core_fwd(meta, stacked, x1, x2, m1, m2, x_mask, msa_mask, rng):
+    out = _scan_forward(meta, stacked, (x1, x2, m1, m2), x_mask, msa_mask, rng)
     # residuals: ONLY the final state (+ params and non-diff aux) — this is
     # the entire point (reference reversible.py:277 saves the same)
     return out, (stacked, out, x_mask, msa_mask, rng)
@@ -257,7 +266,8 @@ def _zero_cotangent(x):
     )
 
 
-def _reversible_core_bwd(cfg, residuals, cts):
+def _reversible_core_bwd(meta, residuals, cts):
+    cfg, sparse, offset = meta
     stacked, out, x_mask, msa_mask, rng = residuals
     L = _num_layers(stacked)
 
@@ -265,12 +275,12 @@ def _reversible_core_bwd(cfg, residuals, cts):
         state, dstate = carry
         lp, li = inp
         state, dstate, dlp = _layer_backward(
-            cfg, lp, state, dstate, x_mask, msa_mask, _op_rngs(rng, li)
+            cfg, lp, state, dstate, x_mask, msa_mask, _op_rngs(rng, li), sparse
         )
         return (state, dstate), dlp
 
     (_, (dx1, dx2, dm1, dm2)), dstacked = jax.lax.scan(
-        body, (out, cts), (stacked, jnp.arange(L)), reverse=True
+        body, (out, cts), (stacked, jnp.arange(offset, offset + L)), reverse=True
     )
     return (
         dstacked,
@@ -324,13 +334,27 @@ def reversible_trunk_apply(
     if isinstance(stacked, (list, tuple)):
         stacked = stack_layers(list(stacked))
 
-    # channel-double: x1 = x2 = x (reference reversible.py:319)
-    if reverse:
-        z1, z2, o1, o2 = _reversible_core(
-            cfg, stacked, x, x, m, m, x_mask, msa_mask, rng
-        )
-    else:
-        z1, z2, o1, o2 = _scan_forward(
-            cfg, stacked, (x, x, m, m), x_mask, msa_mask, rng
-        )
+    # segment the depth by runs of equal sparse flags: each segment scans a
+    # uniform layer body through its own reversible core. A uniform config
+    # ((False,)*depth or (True,)*depth) is one segment — the original single
+    # scan; the reference's interleaved (True, False)*6 becomes 12 chained
+    # cores, whose chaining stores one (4-tensor) boundary state per segment
+    # — still far below storing every layer.
+    flags = cfg.layer_sparse
+    segments = []  # (start, end) with a uniform flag
+    start = 0
+    for i in range(1, len(flags) + 1):
+        if i == len(flags) or flags[i] != flags[start]:
+            segments.append((start, i))
+            start = i
+
+    state = (x, x, m, m)  # channel-double (reference reversible.py:319)
+    for seg_start, seg_end in segments:
+        seg = jax.tree_util.tree_map(lambda t: t[seg_start:seg_end], stacked)
+        meta = (cfg, flags[seg_start], seg_start)
+        if reverse:
+            state = _reversible_core(meta, seg, *state, x_mask, msa_mask, rng)
+        else:
+            state = _scan_forward(meta, seg, state, x_mask, msa_mask, rng)
+    z1, z2, o1, o2 = state
     return (z1 + z2) * 0.5, (o1 + o2) * 0.5

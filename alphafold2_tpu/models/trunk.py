@@ -101,7 +101,108 @@ def prenorm_ff_apply(params, cfg: Alphafold2Config, x, rng=None):
         dropout_rate=cfg.ff_dropout,
         rng=rng,
         dtype=cfg.dtype,
+        chunk=cfg.ff_chunk_size,
     )
+
+
+# --- cross-attention over grids: flat vs column-aligned ---------------------
+
+
+def _fold_by_msa_column(x, m, x_mask, msa_mask):
+    """Group pair-grid columns by the MSA column they map to.
+
+    Pair grid (b, n, n, d) with n = f*c (f = residue elongation factor, e.g.
+    3 backbone atoms per residue, reference train_end2end.py:134-146); MSA
+    (b, r, c, d). Returns per-column folds:
+      xg (b*c, n*f, d) — the pair tokens whose grid column maps to column c;
+      mg (b*c, r, d)   — that column's MSA residues;
+    plus the matching folded masks (or None).
+    """
+    b, n, n2, d = x.shape
+    r, c = m.shape[1], m.shape[2]
+    if n != n2 or n % c != 0:
+        raise ValueError(
+            f"aligned cross-attention needs a square pair grid whose side is "
+            f"a multiple of the MSA column count; got pair ({n}, {n2}), "
+            f"msa cols {c}"
+        )
+    f = n // c
+    xg = x.reshape(b, n, c, f, d).transpose(0, 2, 1, 3, 4).reshape(b * c, n * f, d)
+    mg = jnp.swapaxes(m, 1, 2).reshape(b * c, r, d)
+    xg_mask = (
+        x_mask.reshape(b, n, c, f).transpose(0, 2, 1, 3).reshape(b * c, n * f)
+        if x_mask is not None
+        else None
+    )
+    mg_mask = (
+        jnp.swapaxes(msa_mask, 1, 2).reshape(b * c, r)
+        if msa_mask is not None
+        else None
+    )
+    return xg, mg, xg_mask, mg_mask, f
+
+
+def _unfold_pair(xg, b, n, f, d):
+    c = xg.shape[0] // b
+    return xg.reshape(b, c, n, f, d).transpose(0, 2, 1, 3, 4).reshape(b, n, n, d)
+
+
+def _unfold_msa(mg, b, r, d):
+    c = mg.shape[0] // b
+    return jnp.swapaxes(mg.reshape(b, c, r, d), 1, 2)
+
+
+def cross_apply_grids(
+    params, cfg: Alphafold2Config, q_grid, ctx_grid, q_mask, ctx_mask, rng, direction
+):
+    """Pre-norm cross-attention between the pair and MSA streams, on grids.
+
+    direction: "pair_from_msa" (q_grid = pair (b,n,n,d), ctx = MSA
+    (b,r,c,d)) or "msa_from_pair" (the mirror). Dispatches on
+    cfg.cross_attn_mode:
+
+      * "flat" — both streams fully flattened, every query attends every
+        context token (reference alphafold2.py:316-317). O(n^2 * r*c)
+        logits; blockwise-streamed at scale but FLOP-bound beyond small
+        crops.
+      * "aligned" — each pair token attends only the MSA column its grid
+        column maps to; each MSA token attends only its column's pair-grid
+        block. The column fold becomes the attention batch: O(n^2 * r)
+        total. KV compression still applies along the folded key axis.
+
+    Returns the attention output in the query grid's layout (pre-residual).
+    """
+    cross_cfg = cfg.cross_attn_config()
+    if cfg.cross_attn_mode == "flat":
+        qb = q_grid.shape[0]
+        d = q_grid.shape[-1]
+        qf = q_grid.reshape(qb, -1, d)
+        cf = ctx_grid.reshape(qb, -1, d)
+        qm = q_mask.reshape(qb, -1) if q_mask is not None else None
+        cm = ctx_mask.reshape(qb, -1) if ctx_mask is not None else None
+        out = prenorm_cross_apply(
+            params, cross_cfg, qf, cf, mask=qm, context_mask=cm, rng=rng
+        )
+        return out.reshape(q_grid.shape)
+
+    # aligned
+    b = q_grid.shape[0]
+    d = q_grid.shape[-1]
+    if direction == "pair_from_msa":
+        x, m = q_grid, ctx_grid
+        xg, mg, xg_mask, mg_mask, f = _fold_by_msa_column(x, m, q_mask, ctx_mask)
+        out = prenorm_cross_apply(
+            params, cross_cfg, xg, mg, mask=xg_mask, context_mask=mg_mask, rng=rng
+        )
+        return _unfold_pair(out, b, x.shape[1], f, d)
+    elif direction == "msa_from_pair":
+        m, x = q_grid, ctx_grid
+        xg, mg, xg_mask, mg_mask, f = _fold_by_msa_column(x, m, ctx_mask, q_mask)
+        out = prenorm_cross_apply(
+            params, cross_cfg, mg, xg, mask=mg_mask, context_mask=xg_mask, rng=rng
+        )
+        return _unfold_msa(out, b, m.shape[1], d)
+    raise ValueError(f"unknown cross direction {direction!r}")
 
 
 # --- trunk layer ------------------------------------------------------------
@@ -154,14 +255,6 @@ def sequential_trunk_apply(
     Returns: (x, m) in the same layouts.
     """
     self_cfg = cfg.self_attn_config()
-    cross_cfg = cfg.cross_attn_config()
-    b = x.shape[0]
-    n = x.shape[1]
-    d = cfg.dim
-
-    x_mask_flat = x_mask.reshape(b, -1) if x_mask is not None else None
-    msa_mask_flat = msa_mask.reshape(b, -1) if msa_mask is not None else None
-
     layer_sparse = cfg.layer_sparse
     sparse_fn = make_sparse_axial_fn(cfg) if any(layer_sparse) else None
 
@@ -192,30 +285,16 @@ def sequential_trunk_apply(
                     rng=rngs[1],
                 ) + m
 
-                # cross-attention both ways over flattened streams
-                # (reference alphafold2.py:316-317)
-                xf = x.reshape(b, n * n, d)
-                mf = m.reshape(b, -1, d)
-                xf = prenorm_cross_apply(
-                    layer["seq_cross"],
-                    cross_cfg,
-                    xf,
-                    mf,
-                    mask=x_mask_flat,
-                    context_mask=msa_mask_flat,
-                    rng=rngs[2],
-                ) + xf
-                x = xf.reshape(x.shape)
-                mf = prenorm_cross_apply(
-                    layer["msa_cross"],
-                    cross_cfg,
-                    mf,
-                    xf,
-                    mask=msa_mask_flat,
-                    context_mask=x_mask_flat,
-                    rng=rngs[3],
-                ) + mf
-                m = mf.reshape(m.shape)
+                # cross-attention both ways, flat or column-aligned
+                # (reference alphafold2.py:316-317; cfg.cross_attn_mode)
+                x = cross_apply_grids(
+                    layer["seq_cross"], cfg, x, m, x_mask, msa_mask,
+                    rngs[2], "pair_from_msa",
+                ) + x
+                m = cross_apply_grids(
+                    layer["msa_cross"], cfg, m, x, msa_mask, x_mask,
+                    rngs[3], "msa_from_pair",
+                ) + m
 
             # feed-forwards (reference alphafold2.py:321-324)
             x = prenorm_ff_apply(layer["seq_ff"], cfg, x, rng=rngs[4]) + x

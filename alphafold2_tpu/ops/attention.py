@@ -32,12 +32,17 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Optional
+from typing import Any, Optional, Union
 
 import jax
 import jax.numpy as jnp
 
 from alphafold2_tpu.ops.core import _uniform, linear, linear_init, dropout
+from alphafold2_tpu.ops.flash import blockwise_attention
+
+# switch to the blockwise path when the full logit tensor (B*h*i*j) would
+# exceed this many elements (2^27 f32 = 512 MB)
+_FLASH_AUTO_THRESHOLD = 1 << 27
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,6 +55,23 @@ class AttentionConfig:
     dropout: float = 0.0
     compress_ratio: int = 1  # KV compression for cross-attention, 1 = off
     dtype: Any = jnp.float32  # compute dtype (use bfloat16 on TPU)
+    # blockwise (flash-style) streaming instead of materializing the full
+    # logit tensor: True / False / "auto" (stream only when the logits would
+    # exceed _FLASH_AUTO_THRESHOLD elements). Streaming is exact but skips
+    # attention-probability dropout, so it is bypassed while attn dropout is
+    # active. Not used for tied-row attention (its logits are already
+    # row-contracted and small).
+    flash: Union[bool, str] = "auto"
+    # process the (folded) batch axis in chunks of this many elements under
+    # jax.checkpoint (0 = off). Flash tiling bounds the LOGITS, but the
+    # QKV/output projections still materialize over the whole folded batch —
+    # at crop 384 the pair stream is 1.3M tokens, whose (tokens, 512)
+    # projections are 1.3 GB each, and the reversible backward holds several
+    # at once. Chunking the whole op (proj -> attend -> out-proj per chunk)
+    # bounds all of them. Skipped for tied-row attention (chunks would split
+    # tie groups) and while attention dropout is active (per-chunk keys
+    # would change the mask pattern).
+    batch_chunk: int = 0
 
     @property
     def inner_dim(self) -> int:
@@ -160,6 +182,16 @@ def attention_apply(
     Returns: (b, i, dim) in cfg.dtype.
     """
     has_context = context is not None
+    dropout_live = rng is not None and cfg.dropout > 0.0
+    if (
+        cfg.batch_chunk
+        and x.shape[0] > cfg.batch_chunk
+        and tie_dim is None
+        and not dropout_live
+    ):
+        return _batch_chunked_attention(
+            params, cfg, x, context=context, mask=mask, context_mask=context_mask
+        )
     ctx = context if has_context else x
     dtype = cfg.dtype
 
@@ -179,6 +211,28 @@ def attention_apply(
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
     i, j = q.shape[1], k.shape[1]
+
+    # blockwise streaming path: same math, bounded memory (see ops/flash.py).
+    # Key-side masking only — masked query rows yield finite garbage masked
+    # downstream, exactly like the dense path's uniform-attention rows.
+    use_flash = cfg.flash is True or (
+        cfg.flash == "auto" and q.shape[0] * h * i * j > _FLASH_AUTO_THRESHOLD
+    )
+    if use_flash and tie_dim is None and not dropout_live:
+        if context_mask is None and mask is not None and not has_context:
+            context_mask = mask
+        key_bias = (
+            None
+            if context_mask is None
+            else jnp.where(
+                jnp.broadcast_to(context_mask, (k.shape[0], j)),
+                0.0,
+                float("-inf"),
+            ).astype(jnp.float32)
+        )
+        out = blockwise_attention(q, k, v, key_bias, scale=scale)
+        out = out.reshape(out.shape[0], i, h * dh)
+        return linear(params["to_out"], out, dtype=dtype)
 
     if tie_dim is not None:
         # (b*r, n, h, dh) -> (b, r, n, h, dh); share logits across rows r with
@@ -217,6 +271,55 @@ def attention_apply(
         out = out.reshape(out.shape[0], i, h * dh)
 
     return linear(params["to_out"], out, dtype=dtype)
+
+
+def _batch_chunked_attention(params, cfg: AttentionConfig, x, *, context, mask, context_mask):
+    """Run attention_apply in chunks over the (folded) batch axis.
+
+    Each chunk re-runs the full op (QKV projection, attention, output
+    projection) under jax.checkpoint, so no projection ever materializes
+    over the whole folded batch — the memory bound that lets the crop-384
+    pair stream (1.3M tokens) run on one chip. Deterministic (no-dropout)
+    path only; the caller gates on that.
+    """
+    B = x.shape[0]
+    chunk = cfg.batch_chunk
+    inner_cfg = dataclasses.replace(cfg, batch_chunk=0)
+
+    pad = (-B) % chunk
+    arrays = {"x": x, "context": context, "mask": mask, "context_mask": context_mask}
+    padded = {}
+    for name, t in arrays.items():
+        if t is None:
+            padded[name] = None
+            continue
+        if t.shape[0] == 1 and B > 1:  # broadcast batch: share across chunks
+            padded[name] = t
+            continue
+        if pad:
+            t = jnp.pad(t, ((0, pad),) + ((0, 0),) * (t.ndim - 1))
+        padded[name] = t.reshape((-1, chunk) + t.shape[1:])
+
+    def body(i):
+        def pick(name):
+            t = padded[name]
+            if t is None or t.shape[0] != (B + pad) // chunk:
+                return t  # None or broadcast
+            return t[i]
+
+        return attention_apply(
+            params,
+            inner_cfg,
+            pick("x"),
+            context=pick("context"),
+            mask=pick("mask"),
+            context_mask=pick("context_mask"),
+        )
+
+    nb = (B + pad) // chunk
+    out = jax.lax.map(jax.checkpoint(body), jnp.arange(nb))
+    out = out.reshape((nb * chunk,) + out.shape[2:])
+    return out[:B] if pad else out
 
 
 def axial_attention_apply(

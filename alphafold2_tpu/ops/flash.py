@@ -1,0 +1,174 @@
+"""Blockwise (flash-style) exact attention with bounded memory.
+
+The reference materializes the full (i, j) attention matrix per head
+(reference alphafold2_pytorch/alphafold2.py:152-174). At the north-star
+scale (crop 384 -> 1152x1152 pair grid, the grid axis folded into batch for
+axial attention) that matrix is tens of GB per layer — it cannot exist on a
+16G chip. This module computes the same softmax(QK^T)V exactly but tiled:
+query tiles stream over K/V blocks accumulating running-max / sum statistics
+in float32 (the FlashAttention recurrence, shared with ring attention in
+parallel/sequence.py and the Pallas block-sparse kernel in
+ops/sparse_kernel.py). Peak live memory is one (q_tile, kv_block) logit tile
+instead of the full matrix.
+
+Each tile is wrapped in `jax.checkpoint`, so the backward pass recomputes
+tile activations instead of storing them — the memory bound holds for
+training. Tiles stay large and static-shaped so XLA maps them onto the MXU;
+this is the portable (CPU-testable) sibling of a Pallas dense flash kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float("-inf")
+
+
+def stream_block(q, k_blk, v_blk, bias_blk, m, l, acc, scale):
+    """One flash-attention accumulation step against a K/V block.
+
+    q: (b, nq, h, d); k_blk/v_blk: (b, nk, h, d); bias_blk: (b, nk) additive
+    (-inf for masked keys). Running stats m, l: (b, h, nq); acc: (b, h, nq, d).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+    s = s + bias_blk[:, None, None, :]
+
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # alpha/p guards: -inf - -inf = nan. The exp ARGUMENT must be sanitized
+    # too, not just the result: exp(nan) in the unselected where-branch has a
+    # nan primal, and exp's vjp multiplies even a zero cotangent by it
+    # (0 * nan = nan), poisoning dq/dk for fully-masked rows.
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    alpha = jnp.where(
+        jnp.isneginf(m), 0.0, jnp.exp(jnp.where(jnp.isneginf(m), 0.0, m) - m_safe)
+    )
+    p = jnp.where(
+        jnp.isneginf(s),
+        0.0,
+        jnp.exp(jnp.where(jnp.isneginf(s), 0.0, s) - m_safe[..., None]),
+    )
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    acc_new = acc * alpha[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p.astype(v_blk.dtype), v_blk
+    ).astype(jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def _largest_divisor_leq(n: int, cap: int) -> int:
+    cap = max(1, min(n, cap))
+    for c in range(cap, 0, -1):
+        if n % c == 0:
+            return c
+    return 1
+
+
+def _tile_attention(q, k, v, bias, scale, kv_block):
+    """Exact attention for one query tile, streaming K/V blocks."""
+    b, nq, h, dh = q.shape
+    j = k.shape[1]
+    m0 = jnp.full((b, h, nq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, nq), jnp.float32)
+    acc0 = jnp.zeros((b, h, nq, dh), jnp.float32)
+
+    if kv_block is None or j <= kv_block:
+        m, l, acc = stream_block(q, k, v, bias, m0, l0, acc0, scale)
+    else:
+        pad = (-j) % kv_block
+        if pad:
+            k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            bias = jnp.pad(bias, ((0, 0), (0, pad)), constant_values=_NEG_INF)
+        nb = (j + pad) // kv_block
+        ks = k.reshape(b, nb, kv_block, h, dh).transpose(1, 0, 2, 3, 4)
+        vs = v.reshape(b, nb, kv_block, h, dh).transpose(1, 0, 2, 3, 4)
+        bs = bias.reshape(b, nb, kv_block).transpose(1, 0, 2)
+
+        def body(carry, blk):
+            mm, ll, aa = carry
+            kb, vb, bb = blk
+            return stream_block(q, kb, vb, bb, mm, ll, aa, scale), None
+
+        (m, l, acc), _ = jax.lax.scan(body, (m0, l0, acc0), (ks, vs, bs))
+
+    out = acc / jnp.where(l > 0, l, 1.0)[..., None]  # zeros for all-masked q
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def blockwise_attention(
+    q,
+    k,
+    v,
+    key_bias=None,
+    *,
+    scale=None,
+    tile_elems: int = 1 << 25,
+    kv_block: int = 2048,
+    remat: bool = True,
+):
+    """Exact softmax(QK^T * scale + bias)V with bounded-memory tiling.
+
+    Args:
+      q: (B, i, h, dh) queries — B may be a huge folded-batch axis (axial
+        attention) or 1 with huge i (flat cross-attention); tiling adapts.
+      k, v: (B, j, h, dh).
+      key_bias: (B, j) additive float32, 0 for valid keys / -inf for masked
+        (key-side masking only, matching the reference's key-padding
+        semantics, alphafold2.py:156-161). Query-side masking is
+        intentionally absent: masked query rows produce finite values that
+        downstream masking discards — the same contract as the dense path,
+        which gives those rows uniform-attention garbage instead.
+      tile_elems: target max elements per (batch*h*q*kv) logit tile
+        (default 2^25 = 128 MB in f32).
+      kv_block: stream K/V in blocks of this length when j exceeds it.
+      remat: jax.checkpoint each tile so backward recomputes instead of
+        storing tile activations.
+
+    Returns: (B, i, h, dh) in q.dtype. Fully-masked query rows return zeros.
+    """
+    B, i, h, dh = q.shape
+    j = k.shape[1]
+    scale = dh ** -0.5 if scale is None else scale
+    if key_bias is None:
+        key_bias = jnp.zeros((B, j), jnp.float32)
+
+    j_eff = min(j, kv_block) if kv_block else j
+    per_q_row = max(1, h * j_eff)
+    qb = max(1, min(i, tile_elems // per_q_row))
+    bb = _largest_divisor_leq(B, max(1, tile_elems // (per_q_row * min(i, qb))))
+    kvb = kv_block if (kv_block and j > kv_block) else None
+
+    def tile(qt, kt, vt, bt):
+        return _tile_attention(qt, kt, vt, bt, scale, kvb)
+
+    if remat:
+        tile = jax.checkpoint(tile)
+
+    if bb == B and qb >= i:
+        return tile(q, k, v, key_bias)
+
+    pad_i = (-i) % qb
+    if pad_i:
+        q = jnp.pad(q, ((0, 0), (0, pad_i), (0, 0), (0, 0)))
+    nq = (i + pad_i) // qb
+
+    def batch_chunk(args):
+        qc, kc, vc, bc = args  # (bb, i_p, h, dh), (bb, j, h, dh), (bb, j)
+        if nq == 1:
+            return tile(qc, kc, vc, bc)
+        qs = qc.reshape(bb, nq, qb, h, dh).transpose(1, 0, 2, 3, 4)
+        out = jax.lax.map(lambda qt: tile(qt, kc, vc, bc), qs)
+        return out.transpose(1, 0, 2, 3, 4).reshape(bb, nq * qb, h, dh)
+
+    if bb == B:
+        out = batch_chunk((q, k, v, key_bias))
+    else:
+        nb = B // bb
+
+        def resh(t):
+            return t.reshape((nb, bb) + t.shape[1:])
+
+        out = jax.lax.map(batch_chunk, (resh(q), resh(k), resh(v), resh(key_bias)))
+        out = out.reshape((B, nq * qb, h, dh))
+
+    return out[:, :i] if pad_i else out

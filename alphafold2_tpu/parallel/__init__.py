@@ -24,8 +24,10 @@ from alphafold2_tpu.parallel.sequence import (
     tied_row_attention_sharded,
     ulysses_attention,
 )
+from alphafold2_tpu.parallel.sp_trunk import sp_trunk_apply
 
 __all__ = [
+    "sp_trunk_apply",
     "ring_attention",
     "ulysses_attention",
     "axial_alltoall_transpose",

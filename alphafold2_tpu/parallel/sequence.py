@@ -34,37 +34,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from alphafold2_tpu.ops.flash import _tile_attention, stream_block as _stream_block
+
 _NEG_INF = float("-inf")
-
-
-def _stream_block(q, k_blk, v_blk, bias_blk, m, l, acc, scale):
-    """One flash-attention accumulation step against a K/V block.
-
-    q: (b, nq, h, d); k_blk/v_blk: (b, nk, h, d); bias_blk: (b, nk) additive
-    (-inf for masked keys). Running stats m, l: (b, h, nq); acc: (b, h, nq, d).
-    """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
-    s = s + bias_blk[:, None, None, :]
-
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-    # alpha/p guards: -inf - -inf = nan. The exp ARGUMENT must be sanitized
-    # too, not just the result: exp(nan) in the unselected where-branch has a
-    # nan primal, and exp's vjp multiplies even a zero cotangent by it
-    # (0 * nan = nan), poisoning dq/dk for fully-masked rows.
-    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-    alpha = jnp.where(
-        jnp.isneginf(m), 0.0, jnp.exp(jnp.where(jnp.isneginf(m), 0.0, m) - m_safe)
-    )
-    p = jnp.where(
-        jnp.isneginf(s),
-        0.0,
-        jnp.exp(jnp.where(jnp.isneginf(s), 0.0, s) - m_safe[..., None]),
-    )
-    l_new = l * alpha + jnp.sum(p, axis=-1)
-    acc_new = acc * alpha[..., None] + jnp.einsum(
-        "bhqk,bkhd->bhqd", p.astype(v_blk.dtype), v_blk
-    ).astype(jnp.float32)
-    return m_new, l_new, acc_new
 
 
 def ring_attention(q, k, v, axis_name: str, mask=None):
@@ -81,6 +53,7 @@ def ring_attention(q, k, v, axis_name: str, mask=None):
     Returns: (b, n_local, h, d) attention output for the local Q shard.
     """
     b, n_local, h, d = q.shape
+    nk_local = k.shape[1]  # may differ from n_local for cross-attention
     scale = d ** -0.5
     num_shards = jax.lax.psum(1, axis_name)
 
@@ -90,7 +63,7 @@ def ring_attention(q, k, v, axis_name: str, mask=None):
         return jax.lax.pcast(x, (axis_name,), to="varying")
 
     bias = (
-        varying(jnp.zeros((b, n_local), jnp.float32))
+        varying(jnp.zeros((b, nk_local), jnp.float32))
         if mask is None
         else jnp.where(mask, 0.0, _NEG_INF).astype(jnp.float32)
     )
@@ -152,16 +125,10 @@ def ulysses_attention(q, k, v, axis_name: str, mask=None):
             _NEG_INF,
         ).astype(jnp.float32)
 
-    # one _stream_block call over the full gathered sequence: the -inf
-    # softmax edge cases live in exactly one place
-    n_full, h_local = qg.shape[1], qg.shape[2]
-    scale = d ** -0.5
-    m0 = jnp.full((b, h_local, n_full), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h_local, n_full), jnp.float32)
-    acc0 = jnp.zeros((b, h_local, n_full, d), jnp.float32)
-    m, l, acc = _stream_block(qg, kg, vg, bias, m0, l0, acc0, scale)
-    out = acc / jnp.where(l > 0, l, 1.0)[..., None]
-    out = jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    # blockwise K/V streaming over the gathered sequence (ops/flash.py): the
+    # full (n, n) logit tensor never materializes — O(n * kv_block) per chip,
+    # which is the point of sequence parallelism at long n
+    out = _tile_attention(qg, kg, vg, bias, d ** -0.5, kv_block=2048)
 
     # (b, n, h_local, d) -> (b, n_local, h, d)
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)

@@ -89,6 +89,10 @@ def predict_structure(params, ecfg: E2EConfig, seq, mask=None, rng=None, msa=Non
         params["model"], ecfg.model, seq3, msa,
         mask=mask3, msa_mask=msa_mask, embedds=embedds, rng=rng_model,
     )  # (b, 3L, 3L, buckets)
+    # geometry runs in float32 regardless of the trunk's compute dtype:
+    # the distogram -> MDS pipeline divides by pairwise distances (Guttman
+    # B-matrix) and small weights, which overflows/NaNs in bfloat16
+    logits = logits.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
     distances, weights = center_distogram(probs)
 

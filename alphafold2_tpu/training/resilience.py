@@ -30,11 +30,36 @@ class BadStepError(RuntimeError):
     """Raised when non-finite steps persist beyond the tolerated window."""
 
 
+def _assert_live(state, what: str):
+    """Fail loudly if a kept rollback state was invalidated by donation.
+
+    StepGuard keeps a no-copy reference to the pre-step state; if the step
+    function donates its state argument (make_sharded_train_step
+    donate_state=True), those buffers are deleted after the step and a
+    rollback would hand back dead arrays. Catch that here with a clear
+    message instead of a deep XLA 'buffer has been deleted' error.
+    """
+    for leaf in jax.tree_util.tree_leaves(state):
+        if getattr(leaf, "is_deleted", lambda: False)():
+            raise RuntimeError(
+                f"{what} was invalidated by buffer donation — run the guarded "
+                "loop with a non-donating step (donate_state=False), or "
+                "snapshot states before stepping"
+            )
+
+
 class StepGuard:
     """Rolls back non-finite steps; aborts when they persist.
 
     Keeps a reference to the last known-good state (a no-copy pytree
-    reference — jax arrays are immutable, so 'keeping' it is free).
+    reference — jax arrays are immutable, so 'keeping' it is free). This
+    requires a NON-donating step function: donation would delete the kept
+    buffers (checked on rollback with a clear error).
+
+    A step is bad when the loss OR the gradient norm is non-finite: clipped
+    inf gradients can leave a finite loss while the params are already
+    poisoned, so loss alone under-detects (the metrics dict from
+    make_train_step always carries "grad_norm").
     """
 
     def __init__(self, state, max_consecutive_bad: int = 3):
@@ -46,7 +71,10 @@ class StepGuard:
     def check(self, new_state, metrics) -> tuple:
         """Returns (state_to_continue_from, step_was_good)."""
         loss = float(np.asarray(jax.device_get(metrics["loss"])))
-        if math.isfinite(loss):
+        good = math.isfinite(loss)
+        if good and "grad_norm" in metrics:
+            good = math.isfinite(float(np.asarray(jax.device_get(metrics["grad_norm"]))))
+        if good:
             self.good_state = new_state
             self.bad_streak = 0
             return new_state, True
@@ -57,6 +85,7 @@ class StepGuard:
                 f"{self.bad_streak} consecutive non-finite losses; "
                 "aborting instead of training on garbage"
             )
+        _assert_live(self.good_state, "StepGuard rollback state")
         return self.good_state, False
 
 
@@ -129,6 +158,7 @@ def run_resilient(
                 state = mgr.restore(abstract_like(guard.good_state))
                 where = f"checkpoint step {int(np.asarray(state['step']))}"
             else:
+                _assert_live(guard.good_state, "in-memory recovery state")
                 state = guard.good_state
                 where = "last good in-memory state"
             guard.good_state = state

@@ -1,0 +1,89 @@
+"""Sequence-parallel trunk: full-trunk parity vs the replicated sequential
+trunk on the 8-device CPU mesh (VERDICT r1 'integrate SP into the trunk').
+
+The replicated trunk (models/trunk.py) is the oracle: running the SAME
+layer params with the pair grid's row axis and the MSA row axis sharded
+over the mesh must reproduce its outputs to float tolerance — including
+tied-row MSA attention (cross-shard logit psum), both flat cross-attention
+directions (all_gather context / ring K/V streaming), and KV compression.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu.models import Alphafold2Config
+from alphafold2_tpu.models.trunk import sequential_trunk_apply, trunk_layer_init
+from alphafold2_tpu.parallel import make_mesh, sp_trunk_apply
+
+N_DEV = 8
+
+
+def _setup(cfg, n, rows, cols, seed=0, masked=False):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 2 + cfg.depth)
+    layers = [trunk_layer_init(k, cfg) for k in keys[2:]]
+    x = jax.random.normal(keys[0], (1, n, n, cfg.dim))
+    m = jax.random.normal(keys[1], (1, rows, cols, cfg.dim))
+    if masked:
+        x_mask = jnp.ones((1, n, n), bool).at[:, :, -3:].set(False)
+        msa_mask = jnp.ones((1, rows, cols), bool).at[:, :, -2:].set(False)
+    else:
+        x_mask, msa_mask = None, None
+    return layers, x, m, x_mask, msa_mask
+
+
+@pytest.mark.parametrize(
+    "tie,compress,masked",
+    [(False, 1, False), (True, 1, False), (True, 2, True)],
+)
+def test_sp_trunk_matches_replicated(tie, compress, masked):
+    if len(jax.devices()) < N_DEV:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = Alphafold2Config(
+        dim=16,
+        depth=2,
+        heads=2,
+        dim_head=8,
+        max_seq_len=64,
+        msa_tie_row_attn=tie,
+        cross_attn_compress_ratio=compress,
+    )
+    # n and MSA rows divisible by the mesh axis
+    layers, x, m, x_mask, msa_mask = _setup(cfg, n=16, rows=8, cols=16, masked=masked)
+    mesh = make_mesh({"seq": N_DEV})
+
+    want_x, want_m = sequential_trunk_apply(
+        layers, cfg, x, m, x_mask=x_mask, msa_mask=msa_mask
+    )
+    got_x, got_m = sp_trunk_apply(
+        layers, cfg, x, m, mesh, x_mask=x_mask, msa_mask=msa_mask
+    )
+
+    # compare VALID positions only: masked positions are contractually
+    # garbage, and the two paths disagree there by design (dense gives
+    # masked queries uniform-attention output, ring/flash gives key-masked
+    # output — ops/flash.py contract). Tolerance covers f32
+    # accumulation-order noise (ring streaming + psum vs one dense softmax).
+    def valid_sel(mask, arr):
+        return np.asarray(arr)[np.asarray(mask)] if mask is not None else np.asarray(arr)
+
+    np.testing.assert_allclose(
+        valid_sel(x_mask, got_x), valid_sel(x_mask, want_x), atol=5e-4
+    )
+    np.testing.assert_allclose(
+        valid_sel(msa_mask, got_m), valid_sel(msa_mask, want_m), atol=5e-4
+    )
+
+
+def test_sp_trunk_rejects_unsupported_modes():
+    if len(jax.devices()) < N_DEV:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = make_mesh({"seq": N_DEV})
+    cfg = Alphafold2Config(
+        dim=16, depth=1, heads=2, dim_head=8, max_seq_len=64,
+        cross_attn_mode="aligned",
+    )
+    layers, x, m, _, _ = _setup(cfg, n=16, rows=8, cols=16)
+    with pytest.raises(ValueError, match="flat"):
+        sp_trunk_apply(layers, cfg, x, m, mesh)

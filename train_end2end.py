@@ -75,10 +75,17 @@ def main():
     tcfg = TrainConfig(learning_rate=args.lr, grad_accum=args.accum)
     dcfg = DataConfig(batch_size=args.batch, max_len=args.max_len)
 
-    batches = stack_microbatches(synthetic_structure_batches(dcfg), tcfg.grad_accum)
     mgr, state, resumed = open_or_init(
         args.ckpt_dir, e2e_train_state_init, jax.random.PRNGKey(0), ecfg, tcfg,
         save_every=args.ckpt_every,
+    )
+    # synthetic batches are a pure function of their index: a resumed run
+    # jumps the stream to its exact position in O(1), no replay
+    batches = stack_microbatches(
+        synthetic_structure_batches(
+            dcfg, start_index=int(state["step"]) * tcfg.grad_accum
+        ),
+        tcfg.grad_accum,
     )
     train_step = jax.jit(make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn))
 
@@ -93,10 +100,6 @@ def main():
     start = int(state["step"])
     if resumed:
         print(f"resumed from step {start} in {args.ckpt_dir}")
-        # replay the data stream to where the checkpoint left off so the
-        # resumed run continues the stream instead of re-reading from the top
-        for _ in range(start):
-            next(batches)
 
     # bounded profiler window AFTER the compile step, so the trace stays
     # loadable and is not dominated by step-0 compilation; a 1-step run
