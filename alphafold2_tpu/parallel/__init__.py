@@ -25,9 +25,17 @@ from alphafold2_tpu.parallel.sequence import (
     ulysses_attention,
 )
 from alphafold2_tpu.parallel.sp_trunk import sp_trunk_apply
+from alphafold2_tpu.parallel.distributed import (
+    global_mesh,
+    initialize_from_env,
+    process_local_batch_size,
+)
 
 __all__ = [
     "sp_trunk_apply",
+    "initialize_from_env",
+    "global_mesh",
+    "process_local_batch_size",
     "ring_attention",
     "ulysses_attention",
     "axial_alltoall_transpose",

@@ -1,0 +1,88 @@
+"""Multi-host runtime entry: `jax.distributed` + process-spanning meshes.
+
+The reference's multi-node story is an empty DeepSpeed launcher
+(reference training_scripts/deepspeed.py, 0 bytes) that would have carried
+NCCL underneath. The TPU-native runtime is the JAX distributed service:
+every host runs the same program, `jax.distributed.initialize` wires them
+into one runtime, and `jax.devices()` then spans the whole pod — meshes,
+shardings, and collectives (psum over DCN/ICI) work unchanged
+(SURVEY.md §2.2, communication backend row).
+
+Launch contract (one command per host):
+
+    AF2_COORDINATOR=host0:8476 AF2_NUM_PROCESSES=4 AF2_PROCESS_ID=$i \\
+        python train_pre.py ...
+
+On Cloud TPU pods the three variables can be omitted entirely —
+`jax.distributed.initialize()` auto-detects the topology — pass
+`AF2_AUTO_INIT=1` to opt into that. Single-process runs need nothing: with
+no coordinator configured `initialize_from_env` is a no-op.
+
+Verified by a real 2-process CPU smoke test (tests/test_distributed.py):
+two OS processes x 4 virtual devices form one 8-device mesh and reduce a
+process-sharded array to the same global sum on both hosts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Optional
+
+import jax
+
+
+def initialize_from_env(
+    *,
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> bool:
+    """Join the multi-host runtime if one is configured; else no-op.
+
+    Reads AF2_COORDINATOR / AF2_NUM_PROCESSES / AF2_PROCESS_ID (explicit
+    args win), or AF2_AUTO_INIT=1 for TPU-pod auto-detection. Must run
+    before any backend-initializing JAX call. Returns True when the
+    distributed runtime was initialized.
+    """
+    coordinator = coordinator or os.environ.get("AF2_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("AF2_NUM_PROCESSES", "0") or 0)
+    if process_id is None:
+        pid_env = os.environ.get("AF2_PROCESS_ID")
+        process_id = int(pid_env) if pid_env is not None else None
+
+    if coordinator and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+        return True
+    if os.environ.get("AF2_AUTO_INIT") == "1":
+        jax.distributed.initialize()  # TPU-pod metadata auto-detection
+        return True
+    return False
+
+
+def global_mesh(axes: Mapping[str, int]):
+    """Mesh over ALL processes' devices (call after initialize_from_env).
+
+    Axis sizes must multiply to the global device count; the per-host batch
+    a data loader should feed is global_batch * local_device_count /
+    device_count.
+    """
+    from alphafold2_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(axes, jax.devices())
+
+
+def process_local_batch_size(global_batch: int) -> int:
+    """This host's share of a globally-sharded batch axis."""
+    if global_batch % jax.process_count() != 0:
+        raise ValueError(
+            f"global batch {global_batch} must divide across "
+            f"{jax.process_count()} processes"
+        )
+    return global_batch // jax.process_count()

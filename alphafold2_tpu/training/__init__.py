@@ -24,6 +24,7 @@ from alphafold2_tpu.training.data import (
     synthetic_batches,
     synthetic_structure_batches,
     sidechainnet_batches,
+    sidechainnet_structure_batches,
 )
 from alphafold2_tpu.training.e2e import (
     E2EConfig,
@@ -70,4 +71,5 @@ __all__ = [
     "stack_microbatches",
     "synthetic_batches",
     "sidechainnet_batches",
+    "sidechainnet_structure_batches",
 ]

@@ -110,15 +110,24 @@ def stack_microbatches(it: Iterator[dict], grad_accum: int) -> Iterator[dict]:
         yield {k: np.stack([m[k] for m in mbs]) for k in mbs[0]}
 
 
-def sidechainnet_batches(
+def _sidechainnet_gen(
     cfg: DataConfig,
-    casp_version: int = 12,
-    thinning: int = 30,
-    split: str = "train",
+    casp_version: int,
+    thinning: int,
+    split: str,
+    full_atom: bool,
 ) -> Optional[Iterator[dict]]:
-    """Adapter over sidechainnet (reference train_pre.py:44-55), reshaped to
-    static (b, max_len) batches. Returns None when sidechainnet is absent
-    (it is an optional dependency, as in the reference)."""
+    """Shared sidechainnet adapter (reference train_pre.py:44-55 /
+    train_end2end.py:107-120), crop/padded to static (b, max_len) shapes.
+    Returns None when sidechainnet is absent (optional dependency, as in the
+    reference).
+
+    full_atom=False: {"coords": (b, L, 3)} C-alpha traces (train_pre).
+    full_atom=True:  {"coords": (b, L, 14, 3), "atom_mask": (b, L, 14)} —
+    per-ATOM resolution mask, because sidechainnet zero-pads unresolved
+    atoms: a residue whose C-alpha resolved but whose side chain did not
+    would otherwise enter the loss with ground truth at the origin.
+    """
     try:
         import sidechainnet as scn  # type: ignore
     except Exception:
@@ -137,19 +146,48 @@ def sidechainnet_batches(
                 idx = order[start : start + b]
                 seq = np.zeros((b, L), np.int32)
                 mask = np.zeros((b, L), bool)
-                coords = np.zeros((b, L, 3), np.float32)
+                cloud = np.zeros((b, L, 14, 3), np.float32)
                 for row, i in enumerate(idx):
                     s = _encode_seq(seqs[i])[:L]
                     c = np.asarray(coords_all[i], np.float32).reshape(-1, 14, 3)[
-                        : len(s), 1
-                    ]  # C-alpha is atom 1 in sidechainnet's 14-atom layout
+                        : len(s)
+                    ]
                     n = min(len(s), len(c))
                     seq[row, :n] = s[:n]
-                    coords[row, :n] = c[:n]
-                    mask[row, :n] = np.abs(coords[row, :n]).sum(-1) > 0
-                yield {"seq": seq, "mask": mask, "coords": coords}
+                    cloud[row, :n] = c[:n]
+                    # residue valid when its C-alpha (atom slot 1) resolved
+                    mask[row, :n] = np.abs(c[:n, 1]).sum(-1) > 0
+                batch = {"seq": seq, "mask": mask}
+                if full_atom:
+                    batch["coords"] = cloud
+                    batch["atom_mask"] = np.abs(cloud).sum(-1) > 0
+                else:
+                    batch["coords"] = cloud[:, :, 1]
+                yield batch
 
     return gen()
+
+
+def sidechainnet_batches(
+    cfg: DataConfig,
+    casp_version: int = 12,
+    thinning: int = 30,
+    split: str = "train",
+) -> Optional[Iterator[dict]]:
+    """C-alpha sidechainnet adapter for distogram pretraining
+    (reference train_pre.py:44-55)."""
+    return _sidechainnet_gen(cfg, casp_version, thinning, split, full_atom=False)
+
+
+def sidechainnet_structure_batches(
+    cfg: DataConfig,
+    casp_version: int = 12,
+    thinning: int = 30,
+    split: str = "train",
+) -> Optional[Iterator[dict]]:
+    """Full-atom sidechainnet adapter for the end-to-end structure loss
+    (reference train_end2end.py:107-120), with a per-atom resolution mask."""
+    return _sidechainnet_gen(cfg, casp_version, thinning, split, full_atom=True)
 
 
 _AA = "ACDEFGHIKLMNPQRSTVWY"

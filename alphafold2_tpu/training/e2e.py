@@ -138,7 +138,10 @@ def e2e_loss_fn(params, ecfg: E2EConfig, batch, rng):
     (reference train_end2end.py:172-176).
 
     batch: {"seq": (b, L) int, "mask": (b, L) bool,
-            "coords": (b, L, 14, 3) ground-truth atom cloud}.
+            "coords": (b, L, 14, 3) ground-truth atom cloud,
+            optional "atom_mask": (b, L, 14) bool — per-atom resolution
+            (sidechainnet zero-pads unresolved atoms; without this they
+            would enter the loss as ground truth at the origin)}.
     """
     out = predict_structure(
         params, ecfg, batch["seq"], mask=batch.get("mask"), rng=rng,
@@ -148,6 +151,9 @@ def e2e_loss_fn(params, ecfg: E2EConfig, batch, rng):
     b, length = batch["seq"].shape
     num_atoms = length * NUM_COORDS_PER_RES
     w = out["cloud_mask"].reshape(b, num_atoms).astype(jnp.float32)
+    atom_mask = batch.get("atom_mask")
+    if atom_mask is not None:
+        w = w * atom_mask.reshape(b, num_atoms).astype(jnp.float32)
 
     pred = jnp.transpose(out["refined"].reshape(b, num_atoms, 3), (0, 2, 1))
     true = jnp.transpose(

@@ -1,0 +1,94 @@
+"""Multi-host launch path: a REAL 2-process smoke test on CPU.
+
+Two OS processes (4 virtual CPU devices each) join one jax.distributed
+runtime via the env-driven entry (parallel/distributed.py), build a single
+8-device global mesh, and reduce a process-sharded array — both hosts must
+see the same global sum. This is the test strategy SURVEY.md §4 calls for
+('the new framework must invent its own distributed test strategy') at the
+process level, complementing the single-process 8-device mesh tests.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os
+import numpy as np
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from alphafold2_tpu.parallel.distributed import (
+    global_mesh,
+    initialize_from_env,
+    process_local_batch_size,
+)
+
+assert initialize_from_env(), "coordinator env not picked up"
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+
+mesh = global_mesh({"data": 8})
+sharding = NamedSharding(mesh, P("data"))
+
+assert process_local_batch_size(8) == 4
+# each process contributes rows filled with (process_index + 1)
+local = np.full((4, 4), float(jax.process_index() + 1), np.float32)
+arr = jax.make_array_from_process_local_data(sharding, local, (8, 4))
+
+total = jax.jit(
+    lambda x: x.sum(), out_shardings=NamedSharding(mesh, P())
+)(arr)
+# 16 ones + 16 twos = 48, identical on every host
+assert float(total) == 48.0, float(total)
+print(f"WORKER_OK process={jax.process_index()}")
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_mesh_psum():
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU backend in workers
+        env.update(
+            AF2_COORDINATOR=f"127.0.0.1:{port}",
+            AF2_NUM_PROCESSES="2",
+            AF2_PROCESS_ID=str(pid),
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO,
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", WORKER],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out}"
+        assert f"WORKER_OK process={pid}" in out

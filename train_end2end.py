@@ -46,6 +46,22 @@ def main():
     ap.add_argument("--refiner-depth", type=int, default=2)
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
+    # the reference's FEATURES switch (reference train_end2end.py:20-28):
+    # msa = synthetic MSA stream, esm = ESM residue embeddings through the
+    # model's `embedds` path, none = sequence only
+    ap.add_argument("--features", choices=["msa", "esm", "none"], default="msa")
+    ap.add_argument("--msa-rows", type=int, default=4)
+    ap.add_argument("--esm-dim", type=int, default=128,
+                    help="embedder width (1280 = real ESM-1b)")
+    ap.add_argument("--esm-layers", type=int, default=2,
+                    help="embedder depth (33 = real ESM-1b)")
+    ap.add_argument("--esm-heads", type=int, default=4,
+                    help="attention heads (20 = real ESM-1b)")
+    ap.add_argument("--esm-ckpt", default=None,
+                    help="npz of a torch ESM state dict to convert+load "
+                         "(random init otherwise)")
+    ap.add_argument("--data", choices=["synthetic", "sidechainnet"],
+                    default="synthetic")
     ap.add_argument("--ckpt-dir", default=None, help="checkpoint/resume directory")
     ap.add_argument("--ckpt-every", type=int, default=25)
     ap.add_argument("--eval-every", type=int, default=0, help="0 = no eval")
@@ -57,6 +73,15 @@ def main():
     )
     args = ap.parse_args()
 
+    # multi-host entry: no-op unless AF2_COORDINATOR/AF2_NUM_PROCESSES/
+    # AF2_PROCESS_ID (or AF2_AUTO_INIT=1 on TPU pods) are set — one command
+    # per host, see parallel/distributed.py
+    from alphafold2_tpu.parallel.distributed import initialize_from_env
+
+    if initialize_from_env():
+        print(f"joined multi-host runtime: process {jax.process_index()}/"
+              f"{jax.process_count()}, {jax.device_count()} global devices")
+
     import jax.numpy as jnp
 
     ecfg = E2EConfig(
@@ -67,33 +92,98 @@ def main():
             dim_head=args.dim_head,
             # the trunk sees the x3-elongated backbone sequence
             max_seq_len=max(64, 3 * args.max_len),
+            max_num_msa=max(20, args.msa_rows),
+            # only the esm features mode resizes the embedds projection;
+            # other modes keep the default so checkpoints stay resumable
+            # regardless of the (unused) --esm-dim flag
+            **({"num_embedds": args.esm_dim} if args.features == "esm" else {}),
             dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         ),
         refiner=RefinerConfig(num_tokens=14, dim=64, depth=args.refiner_depth),
         mds_iters=args.mds_iters,
     )
     tcfg = TrainConfig(learning_rate=args.lr, grad_accum=args.accum)
-    dcfg = DataConfig(batch_size=args.batch, max_len=args.max_len)
+    dcfg = DataConfig(
+        batch_size=args.batch,
+        max_len=args.max_len,
+        msa_rows=args.msa_rows if args.features == "msa" else 0,
+    )
 
     mgr, state, resumed = open_or_init(
         args.ckpt_dir, e2e_train_state_init, jax.random.PRNGKey(0), ecfg, tcfg,
         save_every=args.ckpt_every,
     )
-    # synthetic batches are a pure function of their index: a resumed run
-    # jumps the stream to its exact position in O(1), no replay
-    batches = stack_microbatches(
-        synthetic_structure_batches(
+
+    it = None
+    if args.data == "sidechainnet":
+        from alphafold2_tpu.training import sidechainnet_structure_batches
+
+        it = sidechainnet_structure_batches(dcfg)
+        if it is None:
+            print("sidechainnet unavailable; falling back to synthetic data")
+        elif resumed:
+            print("note: sidechainnet stream restarts from its top on resume "
+                  "(only synthetic data is positionally resumable)")
+    if it is None:
+        # synthetic batches are a pure function of their index: a resumed
+        # run jumps the stream to its exact position in O(1), no replay
+        it = synthetic_structure_batches(
             dcfg, start_index=int(state["step"]) * tcfg.grad_accum
-        ),
-        tcfg.grad_accum,
-    )
+        )
+
+    if args.features == "esm":
+        # ESM residue embeddings -> the model's `embedds` path (reference
+        # train_end2end.py:37-43,54-59,125-126): embed per residue, then
+        # repeat x3 so every backbone-atom token carries its residue's
+        # embedding (the reference's elongation, train_end2end.py:136-146)
+        import numpy as np
+
+        from alphafold2_tpu.models.embedder import (
+            EmbedderConfig,
+            convert_esm_state_dict,
+            embed_sequences,
+            embedder_init,
+        )
+
+        e_cfg = EmbedderConfig(
+            num_layers=args.esm_layers, dim=args.esm_dim, heads=args.esm_heads,
+            max_len=max(1024, args.max_len + 2),
+        )
+        if args.esm_ckpt:
+            sd = dict(np.load(args.esm_ckpt, allow_pickle=True))
+            e_params = convert_esm_state_dict(sd, e_cfg)
+            print(f"loaded converted ESM weights from {args.esm_ckpt}")
+        else:
+            e_params = embedder_init(jax.random.PRNGKey(42), e_cfg)
+            print("esm features with RANDOM embedder weights (pass "
+                  "--esm-ckpt for real ESM-1b)")
+        embed = jax.jit(
+            lambda seq, mask: embed_sequences(e_params, e_cfg, seq, mask)
+        )
+
+        def with_embedds(src):
+            for b in src:
+                reps = embed(jnp.asarray(b["seq"]), jnp.asarray(b["mask"]))
+                b = dict(b)
+                b["embedds"] = np.repeat(np.asarray(reps), 3, axis=1)
+                yield b
+
+        it = with_embedds(it)
+
+    batches = stack_microbatches(it, tcfg.grad_accum)
     train_step = jax.jit(make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn))
 
     from alphafold2_tpu.training import predict_structure
     from alphafold2_tpu.utils import MetricsLogger, structure_eval
 
+    # eval must see the SAME feature inputs training does — evaluating a
+    # sequence-only forward of an MSA/ESM-trained model would report
+    # metrics for an untrained configuration
     eval_fwd = jax.jit(
-        lambda p, seq, mask, rng: predict_structure(p, ecfg, seq, mask=mask, rng=rng)
+        lambda p, seq, mask, rng, msa, msa_mask, embedds: predict_structure(
+            p, ecfg, seq, mask=mask, rng=rng,
+            msa=msa, msa_mask=msa_mask, embedds=embedds,
+        )
     )
 
     base_rng = jax.random.PRNGKey(1)
@@ -124,7 +214,10 @@ def main():
                 # structure quality on the last microbatch (the reference's
                 # metrics library, finally wired into a loop)
                 mb = {k: v[-1] for k, v in batch.items()}
-                out = eval_fwd(state["params"], mb["seq"], mb["mask"], step_rng)
+                out = eval_fwd(
+                    state["params"], mb["seq"], mb["mask"], step_rng,
+                    mb.get("msa"), mb.get("msa_mask"), mb.get("embedds"),
+                )
                 b = mb["seq"].shape[0]
                 scores = structure_eval(
                     out["refined"].reshape(b, -1, 3),

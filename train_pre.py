@@ -50,6 +50,16 @@ def main():
     ap.add_argument("--metrics-log", default=None, help="JSONL metrics file")
     args = ap.parse_args()
 
+    # multi-host entry: no-op unless AF2_COORDINATOR/AF2_NUM_PROCESSES/
+    # AF2_PROCESS_ID (or AF2_AUTO_INIT=1 on TPU pods) are set — one command
+    # per host, see parallel/distributed.py
+    from alphafold2_tpu.parallel.distributed import initialize_from_env
+
+    if initialize_from_env():
+        import jax as _jax
+        print(f"joined multi-host runtime: process {_jax.process_index()}/"
+              f"{_jax.process_count()}, {_jax.device_count()} global devices")
+
     import jax.numpy as jnp
 
     cfg = Alphafold2Config(
