@@ -232,6 +232,69 @@ def trunk_layer_init(key, cfg: Alphafold2Config, *, reversible: bool = False):
     return params
 
 
+def trunk_layer_apply(
+    layer,
+    cfg: Alphafold2Config,
+    x,
+    m,
+    *,
+    x_mask=None,
+    msa_mask=None,
+    rngs=(None,) * 6,
+    sparse_fn=None,
+):
+    """ONE sequential trunk layer — the single source of the layer order
+    (reference alphafold2.py:309-324), shared by the sequential trunk here
+    and the pipeline-parallel trunk (parallel/pipeline.py).
+
+    rngs: six per-op dropout keys (None = deterministic). sparse_fn: inner
+    block-sparse attention override for the pair self-attention pass, or
+    None for dense.
+    """
+    self_cfg = cfg.self_attn_config()
+    # pair axial self-attention (reference alphafold2.py:309), with the
+    # block-sparse inner attention when sparse_fn is given — applied PER
+    # LAYER, fixing the reference bug that ignores the per-layer tuple
+    # (reference alphafold2.py:392)
+    x = prenorm_axial_apply(
+        layer["seq_attn"],
+        self_cfg,
+        x,
+        mask=x_mask,
+        rng=rngs[0],
+        attention_fn=sparse_fn,
+    ) + x
+
+    if m is not None:
+        # msa axial self-attention, optionally tied rows
+        # (reference alphafold2.py:312)
+        m = prenorm_axial_apply(
+            layer["msa_attn"],
+            self_cfg,
+            m,
+            mask=msa_mask,
+            tie_row=cfg.msa_tie_row_attn,
+            rng=rngs[1],
+        ) + m
+
+        # cross-attention both ways, flat or column-aligned
+        # (reference alphafold2.py:316-317; cfg.cross_attn_mode)
+        x = cross_apply_grids(
+            layer["seq_cross"], cfg, x, m, x_mask, msa_mask,
+            rngs[2], "pair_from_msa",
+        ) + x
+        m = cross_apply_grids(
+            layer["msa_cross"], cfg, m, x, msa_mask, x_mask,
+            rngs[3], "msa_from_pair",
+        ) + m
+
+    # feed-forwards (reference alphafold2.py:321-324)
+    x = prenorm_ff_apply(layer["seq_ff"], cfg, x, rng=rngs[4]) + x
+    if m is not None:
+        m = prenorm_ff_apply(layer["msa_ff"], cfg, m, rng=rngs[5]) + m
+    return x, m
+
+
 def sequential_trunk_apply(
     layers,
     cfg: Alphafold2Config,
@@ -254,53 +317,16 @@ def sequential_trunk_apply(
 
     Returns: (x, m) in the same layouts.
     """
-    self_cfg = cfg.self_attn_config()
     layer_sparse = cfg.layer_sparse
     sparse_fn = make_sparse_axial_fn(cfg) if any(layer_sparse) else None
 
     def one_layer(sparse_this_layer):
         def body(layer, x, m, rngs):
-            # pair axial self-attention (reference alphafold2.py:309), with
-            # the block-sparse inner attention on layers flagged sparse —
-            # applied PER LAYER, fixing the reference bug that ignores the
-            # per-layer tuple (reference alphafold2.py:392)
-            x = prenorm_axial_apply(
-                layer["seq_attn"],
-                self_cfg,
-                x,
-                mask=x_mask,
-                rng=rngs[0],
-                attention_fn=sparse_fn if sparse_this_layer else None,
-            ) + x
-
-            if m is not None:
-                # msa axial self-attention, optionally tied rows
-                # (reference alphafold2.py:312)
-                m = prenorm_axial_apply(
-                    layer["msa_attn"],
-                    self_cfg,
-                    m,
-                    mask=msa_mask,
-                    tie_row=cfg.msa_tie_row_attn,
-                    rng=rngs[1],
-                ) + m
-
-                # cross-attention both ways, flat or column-aligned
-                # (reference alphafold2.py:316-317; cfg.cross_attn_mode)
-                x = cross_apply_grids(
-                    layer["seq_cross"], cfg, x, m, x_mask, msa_mask,
-                    rngs[2], "pair_from_msa",
-                ) + x
-                m = cross_apply_grids(
-                    layer["msa_cross"], cfg, m, x, msa_mask, x_mask,
-                    rngs[3], "msa_from_pair",
-                ) + m
-
-            # feed-forwards (reference alphafold2.py:321-324)
-            x = prenorm_ff_apply(layer["seq_ff"], cfg, x, rng=rngs[4]) + x
-            if m is not None:
-                m = prenorm_ff_apply(layer["msa_ff"], cfg, m, rng=rngs[5]) + m
-            return x, m
+            return trunk_layer_apply(
+                layer, cfg, x, m,
+                x_mask=x_mask, msa_mask=msa_mask, rngs=rngs,
+                sparse_fn=sparse_fn if sparse_this_layer else None,
+            )
 
         if cfg.remat:
             # recompute this layer's activations in the backward pass

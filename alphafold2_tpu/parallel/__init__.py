@@ -25,6 +25,7 @@ from alphafold2_tpu.parallel.sequence import (
     ulysses_attention,
 )
 from alphafold2_tpu.parallel.sp_trunk import sp_trunk_apply
+from alphafold2_tpu.parallel.pipeline import pipeline_trunk_apply
 from alphafold2_tpu.parallel.distributed import (
     global_mesh,
     initialize_from_env,
@@ -33,6 +34,7 @@ from alphafold2_tpu.parallel.distributed import (
 
 __all__ = [
     "sp_trunk_apply",
+    "pipeline_trunk_apply",
     "initialize_from_env",
     "global_mesh",
     "process_local_batch_size",

@@ -1,0 +1,203 @@
+"""Pipeline parallelism: the trunk staged over a mesh axis.
+
+The last absent row of SURVEY.md §2.2 ("optional: stage the depth-48 trunk
+across pods"). GPipe-style schedule, TPU-native mechanics: the depth-stacked
+layer parameters are SHARDED over the "pipe" mesh axis (each device owns
+depth/S consecutive layers), microbatches stream through the stages, and
+the only communication is a neighbor `ppermute` of activations per tick —
+exactly the collective the hardware's ring likes. Everything runs inside
+one `shard_map` + `lax.scan` over ticks; no host round-trips.
+
+Schedule (S stages, M microbatches, T = M + S - 1 ticks):
+
+  tick t: stage 0 ingests microbatch t (zeros once the real ones run out);
+          every stage applies its layer block to its resident activation;
+          activations ppermute stage s -> s+1; the last stage's result for
+          microbatch t - (S-1) lands in the output buffer.
+
+Bubble fraction is (S-1)/T — the standard GPipe cost; pick M >= 4*S to
+amortize. Parity vs the replicated sequential trunk is tested on the
+8-device CPU mesh (tests/test_pipeline.py).
+
+The per-stage body is the REAL trunk layer (models/trunk.py
+`trunk_layer_apply`, deterministic path): pair axial self-attn, MSA axial
+self-attn (tied rows allowed — rows are NOT sharded here, so no psum is
+needed), cross-attention (flat or aligned), feed-forwards.
+
+What this scales — and what it does not (yet): the per-stage PARAMETER and
+optimizer state is 1/S of the trunk (the reason to pipeline depth-48
+across pods). The microbatch input stack and output buffer are currently
+replicated across stages for schedule simplicity, so per-chip ACTIVATION
+memory is bounded by the global batch, not batch/S — compose with smaller
+per-pipeline batches or the SP trunk when activations dominate.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from alphafold2_tpu.models.config import Alphafold2Config
+from alphafold2_tpu.models.reversible import stack_layers
+from alphafold2_tpu.models.trunk import trunk_layer_apply
+
+
+def pipeline_trunk_apply(
+    layers,
+    cfg: Alphafold2Config,
+    x,
+    m,
+    mesh: Mesh,
+    *,
+    axis_name: str = "pipe",
+    microbatches: int = None,
+    x_mask=None,
+    msa_mask=None,
+):
+    """Run the sequential trunk pipelined over `mesh[axis_name]`.
+
+    Args (global layouts):
+      layers: list of trunk_layer_init params (depth % stages == 0);
+      x: (b, n, n, d) pair grid; m: (b, rows, cols, d) MSA or None;
+      microbatches: how many microbatches to split b into (default =
+        stage count; b % microbatches == 0).
+
+    Deterministic path only. Masks must be batch-broadcast (shape (1, ...))
+    or None: microbatch slicing of per-example masks would need them to
+    travel with the activations (not implemented).
+
+    Returns (x, m) in global layouts, numerically identical to
+    sequential_trunk_apply with the same layers.
+    """
+    stages = mesh.shape[axis_name]
+    depth = len(layers)
+    if depth % stages != 0:
+        raise ValueError(f"depth {depth} must divide into {stages} stages")
+    if any(cfg.layer_sparse):
+        raise ValueError(
+            "sparse layers are not supported in the pipeline trunk (the "
+            "scanned stage body is uniform); use the sequential trunk"
+        )
+    for mask in (x_mask, msa_mask):
+        if mask is not None and mask.shape[0] != 1:
+            raise ValueError("pipeline masks must be batch-broadcast (b=1)")
+
+    b = x.shape[0]
+    M = microbatches or stages
+    if b % M != 0:
+        raise ValueError(f"batch {b} must divide into {M} microbatches")
+    mb = b // M
+
+    # materialize broadcast masks at microbatch size so the layer body's
+    # fold-into-batch reshapes line up
+    if x_mask is not None:
+        x_mask = jnp.tile(x_mask, (mb,) + (1,) * (x_mask.ndim - 1))
+    if msa_mask is not None:
+        msa_mask = jnp.tile(msa_mask, (mb,) + (1,) * (msa_mask.ndim - 1))
+
+    has_msa = m is not None
+    stacked = stack_layers(list(layers))  # (depth, ...) leaves
+    per_stage = depth // stages
+    ticks = M + stages - 1
+
+    # microbatch-leading stacks: (M, mb, ...)
+    xs = x.reshape((M, mb) + x.shape[1:])
+    ms = m.reshape((M, mb) + m.shape[1:]) if has_msa else None
+
+    def reshape_stage(t):
+        # (depth, ...) -> (stages, per_stage, ...): shard leading axis
+        return t.reshape((stages, per_stage) + t.shape[1:])
+
+    stage_params = jax.tree_util.tree_map(reshape_stage, stacked)
+
+    in_specs = (
+        jax.tree_util.tree_map(lambda _: P(axis_name), stage_params),
+        P(None),  # xs: every stage sees the full microbatch stack (stage 0 reads it)
+        P(None) if has_msa else None,
+    )
+    out_specs = (P(None), P(None) if has_msa else None)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    def run(sp, xs, ms):
+        # sp leaves: (1, per_stage, ...) — this device's layer block
+        my_layers = jax.tree_util.tree_map(lambda t: t[0], sp)
+        stage = jax.lax.axis_index(axis_name)
+        is_first = stage == 0
+        is_last = stage == stages - 1
+        fwd_perm = [(s, s + 1) for s in range(stages - 1)]
+
+        def apply_block(x_act, m_act):
+            def body(carry, lp):
+                cx, cm = carry
+                cx, cm = trunk_layer_apply(
+                    lp, cfg, cx, cm, x_mask=x_mask, msa_mask=msa_mask
+                )
+                return (cx, cm), None
+
+            (x_act, m_act), _ = jax.lax.scan(
+                body, (x_act, m_act), my_layers
+            )
+            return x_act, m_act
+
+        x0 = jnp.zeros((mb,) + xs.shape[2:], xs.dtype)
+        m0 = jnp.zeros((mb,) + ms.shape[2:], ms.dtype) if has_msa else None
+        out_x = jnp.zeros_like(xs)
+        out_m = jnp.zeros_like(ms) if has_msa else None
+
+        def tick(carry, t):
+            x_act, m_act, out_x, out_m = carry
+            # stage 0 ingests microbatch t (or zeros past the end)
+            feed_idx = jnp.minimum(t, M - 1)
+            x_in = jnp.where(is_first, xs[feed_idx], x_act)
+            m_in = jnp.where(is_first, ms[feed_idx], m_act) if has_msa else None
+
+            x_act, m_act = apply_block(x_in, m_in)
+
+            # the last stage finished microbatch t-(S-1) this tick
+            done = t - (stages - 1)
+            write = is_last & (done >= 0)
+            widx = jnp.maximum(done, 0)
+            out_x = jnp.where(
+                write,
+                jax.lax.dynamic_update_index_in_dim(out_x, x_act, widx, 0),
+                out_x,
+            )
+            if has_msa:
+                out_m = jnp.where(
+                    write,
+                    jax.lax.dynamic_update_index_in_dim(out_m, m_act, widx, 0),
+                    out_m,
+                )
+
+            # hand activations to the next stage (last stage's output is
+            # dropped by the permute — nothing maps to stage 0's input)
+            x_act = jax.lax.ppermute(x_act, axis_name, fwd_perm)
+            if has_msa:
+                m_act = jax.lax.ppermute(m_act, axis_name, fwd_perm)
+            return (x_act, m_act, out_x, out_m), None
+
+        (x_act, m_act, out_x, out_m), _ = jax.lax.scan(
+            tick, (x0, m0, out_x, out_m), jnp.arange(ticks)
+        )
+        # only the last stage holds real outputs; psum with zero
+        # contributions elsewhere replicates them to every shard (a
+        # one-to-all ppermute is not a permutation)
+        out_x = jax.lax.psum(jnp.where(is_last, out_x, 0), axis_name)
+        if has_msa:
+            out_m = jax.lax.psum(jnp.where(is_last, out_m, 0), axis_name)
+        return out_x, out_m
+
+    out_x, out_m = run(stage_params, xs, ms)
+    out_x = out_x.reshape((b,) + x.shape[1:])
+    if has_msa:
+        out_m = out_m.reshape((b,) + m.shape[1:])
+    return out_x, out_m

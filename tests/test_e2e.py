@@ -69,6 +69,7 @@ def test_e2e_loss_and_grads(ecfg, batch):
     assert model_norm > 0 and refiner_norm > 0
 
 
+@pytest.mark.slow
 def test_e2e_train_step_improves(ecfg):
     """A few steps on a fixed batch decrease the loss."""
     tcfg = TrainConfig(learning_rate=1e-3, grad_accum=2)
@@ -85,6 +86,7 @@ def test_e2e_train_step_improves(ecfg):
     assert int(state["step"]) == 5
 
 
+@pytest.mark.slow
 def test_e2e_loss_with_esm_embedds():
     """--features esm path: embedder reps (repeated x3 per backbone atom)
     through the model's embedds input into the full structure loss

@@ -129,6 +129,7 @@ def test_attention_apply_flash_matches_dense():
     )
 
 
+@pytest.mark.slow
 def test_aligned_cross_mode_full_model():
     """cross_attn_mode='aligned' runs the full model (seq len a multiple of
     MSA cols), yields finite outputs and gradients, and differs from flat
@@ -163,6 +164,7 @@ def test_aligned_cross_mode_full_model():
     assert gnorm > 0
 
 
+@pytest.mark.slow
 def test_aligned_mode_reversible_consistent():
     """Aligned cross-attn inside the reversible trunk: reverse=True grads
     match plain autodiff (the reference's reversible parity contract,

@@ -178,6 +178,7 @@ def test_own_init_jit_forward():
     assert not np.allclose(np.asarray(out), np.asarray(out2))
 
 
+@pytest.mark.slow
 def test_remat_trunk_parity():
     """remat=True must be numerically identical to the plain trunk, for
     forward and gradients, with and without an MSA stream."""

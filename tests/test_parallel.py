@@ -7,6 +7,7 @@ must produce the same losses and parameters as the unsharded step.
 """
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -39,6 +40,7 @@ def test_eight_devices_present():
     assert len(jax.devices()) == 8
 
 
+@pytest.mark.slow
 def test_dp_tp_matches_single_device():
     mesh = make_mesh({"data": 2, "model": 2})
     batch = _batch()
@@ -103,6 +105,7 @@ def test_tp_forward_parity_msa_model():
     )
 
 
+@pytest.mark.slow
 def test_reversible_sharded_step():
     """Reversible trunk (scanned custom_vjp) under a DP+TP mesh."""
     import dataclasses

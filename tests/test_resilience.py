@@ -61,6 +61,7 @@ def test_run_resilient_happy_path(tmp_path):
     assert seen == [0, 1, 2]
 
 
+@pytest.mark.slow
 def test_run_resilient_recovers_from_crash(tmp_path):
     """A step that raises once: the loop restores and finishes."""
     state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)

@@ -70,6 +70,7 @@ def test_grad_parity_reversible_vs_autodiff(with_rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_grad_parity_with_dropout_keys():
     """With dropout ON, the custom backward must re-derive the same keys the
     forward used (the reference needs RNG capture/replay for this,
@@ -136,6 +137,7 @@ def test_reversible_model_parity_vs_reference():
     np.testing.assert_allclose(np.asarray(got), want, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_reversible_with_sparse_layers():
     """Mixed sparse/dense layers in the reversible trunk (the reference's
     sparse_self_attn=(True, False)*k with reversible=True, reference

@@ -109,6 +109,7 @@ def test_axial_transpose_roundtrip():
     np.testing.assert_array_equal(np.asarray(z), np.asarray(x))
 
 
+@pytest.mark.slow
 def test_ring_attention_grads():
     """Ring attention is differentiable through the ppermute loop."""
     mesh = _mesh()
@@ -133,6 +134,7 @@ def test_ring_attention_grads():
 
 
 @pytest.mark.parametrize("name", list(PRIMS))
+@pytest.mark.slow
 def test_grads_finite_with_fully_masked_row(name):
     """Fully-padded batch element: gradients stay finite (the exp-vjp
     0 * nan poisoning case)."""

@@ -35,7 +35,11 @@ def _setup(cfg, n, rows, cols, seed=0, masked=False):
 
 @pytest.mark.parametrize(
     "tie,compress,masked",
-    [(False, 1, False), (True, 1, False), (True, 2, True)],
+    [
+        (False, 1, False),
+        pytest.param(True, 1, False, marks=pytest.mark.slow),
+        pytest.param(True, 2, True, marks=pytest.mark.slow),
+    ],
 )
 def test_sp_trunk_matches_replicated(tie, compress, masked):
     if len(jax.devices()) < N_DEV:

@@ -90,6 +90,7 @@ def test_sparse_restricts_attention():
     assert not np.allclose(np.asarray(sparse), np.asarray(dense), atol=1e-3)
 
 
+@pytest.mark.slow
 def test_model_interleaved_sparse():
     """Interleaved dense/sparse depth config (reference README.md:72-79)."""
     cfg = Alphafold2Config(

@@ -77,6 +77,7 @@ def test_train_loss_decreases():
     assert int(state["step"]) == 30
 
 
+@pytest.mark.slow
 def test_train_step_msa_and_reversible():
     cfg = Alphafold2Config(
         dim=32, depth=2, heads=2, dim_head=8, max_seq_len=64, reversible=True
