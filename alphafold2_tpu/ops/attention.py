@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from alphafold2_tpu.ops.core import _uniform, linear, linear_init, dropout
-from alphafold2_tpu.ops.flash import blockwise_attention
+from alphafold2_tpu.ops.flash import flash_attention
 
 # switch to the blockwise path when the full logit tensor (B*h*i*j) would
 # exceed this many elements (2^27 f32 = 512 MB)
@@ -230,7 +230,9 @@ def attention_apply(
                 float("-inf"),
             ).astype(jnp.float32)
         )
-        out = blockwise_attention(q, k, v, key_bias, scale=scale)
+        # Pallas fused kernel on TPU (supported shapes), XLA streaming
+        # otherwise (ops/flash.py dispatch)
+        out = flash_attention(q, k, v, key_bias, scale=scale)
         out = out.reshape(out.shape[0], i, h * dh)
         return linear(params["to_out"], out, dtype=dtype)
 

@@ -172,3 +172,47 @@ def blockwise_attention(
         out = out.reshape((B, nq * qb, h, dh))
 
     return out[:, :i] if pad_i else out
+
+
+def flash_attention(q, k, v, key_bias=None, *, scale=None, use_kernel="auto", **blockwise_kwargs):
+    """Exact attention: fused Pallas kernel on TPU, XLA blockwise otherwise.
+
+    Same contract as `blockwise_attention` (q (B, i, h, dh); k, v
+    (B, j, h, dh); key-side (B, j) additive bias). use_kernel: True forces
+    the kernel (interpret mode off-TPU — for tests), False forces XLA
+    streaming, "auto" uses the kernel on TPU for supported shapes
+    (ops/flash_kernel.py `supported`).
+    """
+    from alphafold2_tpu.ops import flash_kernel
+
+    B, i, h, dh = q.shape
+    j = k.shape[1]
+    scale = dh ** -0.5 if scale is None else scale
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if use_kernel is True and not flash_kernel.supported(i, j, dh):
+        # forcing the kernel must not silently fall back — tests rely on
+        # use_kernel=True actually exercising it
+        raise ValueError(
+            f"flash kernel does not support shapes i={i}, j={j}, dh={dh} "
+            f"(VMEM residency bound, see ops/flash_kernel.py supported)"
+        )
+    use = use_kernel is True or (use_kernel == "auto" and on_tpu)
+    if use and flash_kernel.supported(i, j, dh):
+        def fold(t):
+            return t.transpose(0, 2, 1, 3).reshape(B * h, t.shape[1], dh)
+
+        bias = (
+            jnp.zeros((B, j), jnp.float32)
+            if key_bias is None
+            else jnp.broadcast_to(key_bias, (B, j)).astype(jnp.float32)
+        )
+        bias = jnp.repeat(bias, h, axis=0)  # per (batch, head) grid row
+        out = flash_kernel.flash_attention_tpu(
+            fold(q), fold(k), fold(v), bias, scale
+        )
+        return out.reshape(B, h, i, dh).transpose(0, 2, 1, 3)
+
+    return blockwise_attention(
+        q, k, v, key_bias, scale=scale, **blockwise_kwargs
+    )

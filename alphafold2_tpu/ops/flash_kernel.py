@@ -1,0 +1,289 @@
+"""Pallas TPU kernel for DENSE flash attention (forward + backward).
+
+The fused fast path under ops/flash.py's blockwise streaming: QK^T ->
+streaming softmax -> AV runs entirely in VMEM per (query-block, key-block)
+tile, so logits never round-trip HBM between accumulation steps — the HBM
+traffic the XLA-level `stream_block` scan pays. Sibling of the block-sparse
+kernel (ops/sparse_kernel.py), without the index table, and supporting
+CROSS attention (query and key lengths differ) — the shape the aligned
+cross-attention mode produces (models/trunk.py).
+
+Layout and numerics follow ops/sparse_kernel.py: (b*h, n, dh) flattened
+heads, float32 streaming statistics with -inf masking (fully-masked rows
+return zeros; +inf lse makes the backward's recomputed p vanish for them),
+key-side additive bias only (ops/flash.py contract). Backward recomputes
+tile logits from the saved lse: a dq kernel loops key blocks per query
+block; a dk/dv kernel loops query blocks per key block.
+
+Keys/values are VMEM-resident per (batch*head) row, which bounds the
+supported key length (see `supported`); longer contexts fall back to the
+XLA streaming path in ops/flash.py. On non-TPU backends the kernels run in
+interpreter mode (tests), keeping one code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = float("-inf")
+
+# VMEM budget for the resident operands of the worst kernel: the dk/dv
+# backward keeps the FULL Q and G f32 copies per grid row, the forward/dq
+# kernels the full K and V — so both i and j bound residency jointly.
+# ~12 MB leaves headroom under the ~16 MB/core VMEM for tiles and spills.
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def _interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+def supported(i: int, j: int, dh: int) -> bool:
+    """Shapes the kernel handles; everything else streams via XLA.
+
+    Joint (i + j) * dh byte bound: each kernel keeps two full f32 copies of
+    either the query-side (Q, G in dk/dv) or key-side (K, V in fwd/dq)
+    arrays VMEM-resident per (batch*head) grid row.
+    """
+    resident = 2 * 4 * dh * (i + j)
+    return resident <= _VMEM_BUDGET_BYTES and dh % 8 == 0 and dh <= 512
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
+                *, kb, dh, nkb, scale):
+    qb_idx = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # (qb, dh)
+
+    def body(a, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(a * kb, kb), :].astype(jnp.float32)  # (kb, dh)
+        v = v_ref[0, pl.ds(a * kb, kb), :].astype(jnp.float32)
+        b = bias_ref[0, a]  # (kb,)
+        s = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale + b[None, :]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # -inf - -inf = nan guards (all-masked-so-far rows)
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        p = jnp.where(jnp.isneginf(s), 0.0, jnp.exp(s - m_safe))
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    qb = q.shape[0]
+    m0 = jnp.full((qb, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((qb, 1), jnp.float32)
+    acc0 = jnp.zeros((qb, dh), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nkb, body, (m0, l0, acc0))
+
+    out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
+    out_ref[0] = out.astype(out_ref.dtype)
+    # +inf for rows with no active mass: exp(s - inf) = 0 zeroes every
+    # recomputed p in the backward (lse travels as (1, nQB, qb) blocks —
+    # Mosaic rejects (1, qb) row blocks over 2-D arrays)
+    lse = jnp.where(l > 0, m + jnp.log(jnp.where(l > 0, l, 1.0)), jnp.inf)
+    lse_ref[0, qb_idx] = lse[:, 0]
+
+
+def _pad_args(q, k, v, bias, qb, kb):
+    """Pad query/key lengths to block multiples (-inf bias on padded keys)."""
+    BH, i, dh = q.shape
+    j = k.shape[1]
+    pad_i = (-i) % qb
+    pad_j = (-j) % kb
+    if pad_i:
+        q = jnp.pad(q, ((0, 0), (0, pad_i), (0, 0)))
+    if pad_j:
+        k = jnp.pad(k, ((0, 0), (0, pad_j), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_j), (0, 0)))
+        bias = jnp.pad(bias, ((0, 0), (0, pad_j)), constant_values=_NEG)
+    return q, k, v, bias, i + pad_i, j + pad_j
+
+
+def _forward(q, k, v, bias, scale, qb, kb):
+    """q: (BH, i, dh); k, v: (BH, j, dh); bias: (BHB, j) where BHB is BH or
+    a broadcastable batch dim handled by the caller (here: exactly BH)."""
+    BH, i0, dh = q.shape
+    j0 = k.shape[1]
+    q, k, v, bias, i, j = _pad_args(q, k, v, bias, qb, kb)
+    nqb, nkb = i // qb, j // kb
+    bias3 = bias.reshape(BH, nkb, kb)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, kb=kb, dh=dh, nkb=nkb, scale=scale),
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, i, dh), q.dtype),
+            jax.ShapeDtypeStruct((BH, nqb, qb), jnp.float32),
+        ],
+        grid=(BH, nqb),
+        in_specs=[
+            pl.BlockSpec((1, qb, dh), lambda b, qi: (b, qi, 0)),
+            pl.BlockSpec((1, j, dh), lambda b, qi: (b, 0, 0)),
+            pl.BlockSpec((1, j, dh), lambda b, qi: (b, 0, 0)),
+            pl.BlockSpec((1, nkb, kb), lambda b, qi: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, qb, dh), lambda b, qi: (b, qi, 0)),
+            pl.BlockSpec((1, nqb, qb), lambda b, qi: (b, 0, 0)),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, bias3)
+    return out[:, :i0], (q, k, v, bias3, lse, i0, j0)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
+               dq_ref, *, kb, dh, nkb, scale):
+    qb_idx = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, qb_idx][:, None]
+    delta = delta_ref[0, qb_idx][:, None]
+
+    def body(a, dq):
+        k = k_ref[0, pl.ds(a * kb, kb), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(a * kb, kb), :].astype(jnp.float32)
+        b = bias_ref[0, a]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale + b[None, :]
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            g, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    qb = q.shape[0]
+    dq = jax.lax.fori_loop(0, nkb, body, jnp.zeros((qb, dh), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, qb, dh, nqb, scale):
+    kb_idx = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # (kb, dh)
+    v = v_ref[0].astype(jnp.float32)
+    b = bias_ref[0, kb_idx]            # (kb,)
+
+    def body(a, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(a * qb, qb), :].astype(jnp.float32)
+        g = g_ref[0, pl.ds(a * qb, qb), :].astype(jnp.float32)
+        lse = lse_ref[0, a][:, None]
+        delta = delta_ref[0, a][:, None]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale + b[None, :]
+        p = jnp.exp(s - lse)           # (qb, kb)
+        dv = dv + jax.lax.dot_general(
+            p, g, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            g, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    kbs = k.shape[0]
+    zero = jnp.zeros((kbs, dh), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, nqb, body, (zero, zero))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention_tpu(q, k, v, key_bias, scale, qb=256, kb=512):
+    """Fused dense flash attention. q: (BH, i, dh); k, v: (BH, j, dh);
+    key_bias: (BH, j) additive f32 (0 valid / -inf masked). Returns
+    (BH, i, dh). The bias cotangent is not computed (masks are data, not
+    parameters)."""
+    out, _ = _forward(q, k, v, key_bias, scale, qb, kb)
+    return out
+
+
+def _fwd(q, k, v, key_bias, scale, qb, kb):
+    out, (qp, kp, vp, bias3, lse, i0, j0) = _forward(q, k, v, key_bias, scale, qb, kb)
+    return out, (qp, kp, vp, bias3, lse, out, i0, j0)
+
+
+def _bwd(scale, qb, kb, res, g):
+    qp, kp, vp, bias3, lse, out, i0, j0 = res
+    BH, i, dh = qp.shape
+    j = kp.shape[1]
+    nqb, nkb = i // qb, j // kb
+
+    pad_i = i - i0
+    if pad_i:
+        g = jnp.pad(g, ((0, 0), (0, pad_i), (0, 0)))
+        out = jnp.pad(out, ((0, 0), (0, pad_i), (0, 0)))
+
+    # delta_i = rowsum(dO_i * O_i), the softmax-jacobian diagonal term
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).reshape(BH, nqb, qb)
+
+    blk_q = pl.BlockSpec((1, qb, dh), lambda b, qi: (b, qi, 0))
+    blk_k = pl.BlockSpec((1, kb, dh), lambda b, ki: (b, ki, 0))
+    full_q = pl.BlockSpec((1, i, dh), lambda b, x: (b, 0, 0))
+    full_k = pl.BlockSpec((1, j, dh), lambda b, x: (b, 0, 0))
+    rows_q = pl.BlockSpec((1, nqb, qb), lambda b, x: (b, 0, 0))
+    rows_k = pl.BlockSpec((1, nkb, kb), lambda b, x: (b, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, kb=kb, dh=dh, nkb=nkb, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((BH, i, dh), qp.dtype),
+        grid=(BH, nqb),
+        in_specs=[blk_q, full_k, full_k, rows_k, blk_q, rows_q, rows_q],
+        out_specs=blk_q,
+        interpret=_interpret(),
+    )(qp, kp, vp, bias3, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, qb=qb, dh=dh, nqb=nqb, scale=scale),
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, j, dh), kp.dtype),
+            jax.ShapeDtypeStruct((BH, j, dh), vp.dtype),
+        ],
+        grid=(BH, nkb),
+        in_specs=[full_q, blk_k, blk_k, rows_k, full_q, rows_q, rows_q],
+        out_specs=[blk_k, blk_k],
+        interpret=_interpret(),
+    )(qp, kp, vp, bias3, g, lse, delta)
+
+    # cotangents must match the ORIGINAL (unpadded) primal shapes; the bias
+    # is a mask, not a parameter — its cotangent is declared zero
+    return (
+        dq[:, :i0],
+        dk[:, :j0],
+        dv[:, :j0],
+        jnp.zeros((qp.shape[0], j0), jnp.float32),
+    )
+
+
+flash_attention_tpu.defvjp(_fwd, _bwd)

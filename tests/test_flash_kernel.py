@@ -1,0 +1,108 @@
+"""Dense flash Pallas kernel: forward + gradient parity vs the dense
+einsum oracle, run in interpreter mode on CPU (the same single-code-path
+strategy as the block-sparse kernel tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu.ops.flash import flash_attention
+from alphafold2_tpu.ops.flash_kernel import flash_attention_tpu, supported
+
+
+def _dense(q, k, v, bias, scale):
+    logits = jnp.einsum("bihd,bjhd->bhij", q, k).astype(jnp.float32) * scale
+    logits = logits + bias[:, None, None, :]
+    attn = jax.nn.softmax(logits, axis=-1)
+    # fully-masked rows: dense softmax of all -inf is nan — zero them like
+    # the kernel does
+    attn = jnp.where(jnp.isnan(attn), 0.0, attn)
+    return jnp.einsum("bhij,bjhd->bihd", attn.astype(q.dtype), v)
+
+
+def test_supported_shapes():
+    assert supported(1024, 2048, 64)
+    assert not supported(16, 10 ** 6, 64)  # keys exceed VMEM residency
+    assert not supported(262144, 16384, 64)  # queries count too (dkv kernel)
+    assert not supported(16, 16, 7)
+
+
+def test_use_kernel_true_raises_on_unsupported():
+    q = jnp.zeros((1, 8, 1, 7))  # dh=7 unsupported
+    k = v = jnp.zeros((1, 8, 1, 7))
+    with pytest.raises(ValueError, match="does not support"):
+        flash_attention(q, k, v, use_kernel=True)
+
+
+@pytest.mark.parametrize(
+    "B,i,j,qb,kb",
+    [
+        (2, 64, 64, 16, 16),    # square, multiple blocks
+        (1, 40, 72, 16, 32),    # cross shapes + padding both axes
+        (2, 16, 16, 16, 16),    # single tile
+    ],
+)
+def test_kernel_matches_dense(B, i, j, qb, kb):
+    h, dh = 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = jax.random.normal(ks[0], (B, i, h, dh))
+    k = jax.random.normal(ks[1], (B, j, h, dh))
+    v = jax.random.normal(ks[2], (B, j, h, dh))
+    mask = jax.random.bernoulli(ks[3], 0.8, (B, j)).at[:, 0].set(True)
+    bias = jnp.where(mask, 0.0, float("-inf")).astype(jnp.float32)
+
+    def fold(t):
+        return t.transpose(0, 2, 1, 3).reshape(B * h, t.shape[1], dh)
+
+    out = flash_attention_tpu(
+        fold(q), fold(k), fold(v), jnp.repeat(bias, h, axis=0),
+        dh ** -0.5, qb, kb,
+    )
+    got = out.reshape(B, h, i, dh).transpose(0, 2, 1, 3)
+    want = _dense(q, k, v, bias, dh ** -0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_kernel_gradients_match_dense():
+    B, i, j, h, dh = 1, 48, 40, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(1), 4)
+    q = jax.random.normal(ks[0], (B, i, h, dh))
+    k = jax.random.normal(ks[1], (B, j, h, dh))
+    v = jax.random.normal(ks[2], (B, j, h, dh))
+    mask = jax.random.bernoulli(ks[3], 0.75, (B, j)).at[:, 0].set(True)
+    bias = jnp.where(mask, 0.0, float("-inf")).astype(jnp.float32)
+
+    def loss_kernel(q, k, v):
+        o = flash_attention(
+            q, k, v, bias, scale=dh ** -0.5, use_kernel=True
+        )
+        return jnp.sum(jnp.sin(o))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.sin(_dense(q, k, v, bias, dh ** -0.5)))
+
+    g1 = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_kernel_fully_masked_rows():
+    B, i, j, h, dh = 1, 16, 16, 1, 8
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (B, i, h, dh))
+    k = jax.random.normal(ks[1], (B, j, h, dh))
+    v = jax.random.normal(ks[2], (B, j, h, dh))
+    bias = jnp.full((B, j), float("-inf"), jnp.float32)
+
+    out = flash_attention(q, k, v, bias, scale=dh ** -0.5, use_kernel=True)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), 0.0)
+
+    g = jax.grad(
+        lambda q: jnp.sum(
+            flash_attention(q, k, v, bias, scale=dh ** -0.5, use_kernel=True)
+        )
+    )(q)
+    assert np.isfinite(np.asarray(g)).all()
