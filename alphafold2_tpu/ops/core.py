@@ -98,3 +98,12 @@ def dropout(rng, x, rate: float, deterministic: bool = False):
         return x
     keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
     return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+
+
+def pallas_interpret() -> bool:
+    """Run Pallas kernels in interpreter mode off-TPU (one code path for
+    CPU tests and TPU execution; shared by ops/sparse_kernel.py and
+    ops/flash_kernel.py)."""
+    import jax
+
+    return jax.devices()[0].platform != "tpu"

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from alphafold2_tpu.ops.core import pallas_interpret as _interpret
+
 _NEG = float("-inf")
 
 # VMEM budget for the resident operands of the worst kernel: the dk/dv
@@ -36,10 +38,6 @@ _NEG = float("-inf")
 # kernels the full K and V — so both i and j bound residency jointly.
 # ~12 MB leaves headroom under the ~16 MB/core VMEM for tiles and spills.
 _VMEM_BUDGET_BYTES = 12 * 1024 * 1024
-
-
-def _interpret() -> bool:
-    return jax.devices()[0].platform != "tpu"
 
 
 def supported(i: int, j: int, dh: int) -> bool:

@@ -29,6 +29,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from alphafold2_tpu.ops.core import pallas_interpret as _interpret
 from alphafold2_tpu.ops.sparse import (
     SparseConfig,
     layout_block_indices,
@@ -40,8 +41,7 @@ from alphafold2_tpu.ops.sparse import (
 _NEG = float("-inf")
 
 
-def _interpret() -> bool:
-    return jax.devices()[0].platform != "tpu"
+
 
 
 # ---------------------------------------------------------------------------

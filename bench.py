@@ -66,27 +66,59 @@ def main():
     ap.add_argument("--single-depth", type=int, default=None)
     args = ap.parse_args()
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
-
     if args.single_depth is not None:
-        print(json.dumps(_run(dev, on_tpu, args.single_depth)))
+        dev = jax.devices()[0]
+        print(json.dumps(_run(dev, dev.platform == "tpu", args.single_depth)))
         return
+
+    # The orchestrating parent NEVER initializes JAX: a wedged TPU tunnel
+    # (observed after worker crashes) hangs backend init indefinitely, and
+    # the parent must stay alive to fall back. A 2-minute SUBPROCESS probe
+    # decides whether a healthy TPU is reachable — env sniffing alone would
+    # miss an auto-detected local libtpu, and in-process jax.devices()
+    # could hang forever.
+    try:
+        probe = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; print(jax.devices()[0].platform)",
+            ],
+            capture_output=True, text=True, timeout=120,
+        )
+        tpu_env = probe.returncode == 0 and "tpu" in probe.stdout.lower()
+    except subprocess.TimeoutExpired:
+        tpu_env = False
+    if not tpu_env:
+        print("TPU health probe failed; benching CPU smoke config only",
+              file=sys.stderr)
 
     # Depth ladder at the north-star crop/MSA (BASELINE.md config 5 is
     # depth 48). Single executions beyond ~60 s of device time have crashed
-    # the tunneled single-chip worker (observed repeatedly at depth 48,
-    # ~96 s/step), and a crashed worker leaves the in-process JAX client
-    # dead — so every attempt runs in a FRESH subprocess, and on failure
-    # the bench reports the deepest config that completes, saying so.
-    attempts = [48, 24] if on_tpu else [2]
+    # the tunneled single-chip worker (~96 s/step at depth 48); on failure
+    # the bench reports the deepest config that completes, saying so. The
+    # terminal entry is a CPU smoke run so the driver always records a
+    # line even with the TPU unreachable.
+
+    attempts = [(48, None), (24, None), (2, "cpu")] if tpu_env else [(2, "cpu")]
     last_msg = "no attempts"
-    for i, depth in enumerate(attempts):
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--single-depth", str(depth)],
-            capture_output=True,
-            text=True,
-        )
+    for i, (depth, platform) in enumerate(attempts):
+        env = dict(os.environ)
+        if platform == "cpu":
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--single-depth", str(depth)],
+                capture_output=True,
+                text=True,
+                env=env,
+                timeout=2400,
+            )
+        except subprocess.TimeoutExpired:
+            last_msg = f"depth-{depth} attempt timed out (wedged TPU tunnel?)"
+            continue
         if proc.returncode == 0:
             for line in reversed(proc.stdout.strip().splitlines()):
                 try:
@@ -98,7 +130,7 @@ def main():
                 last_msg = "subprocess succeeded but printed no JSON"
                 continue
             if i > 0:
-                result["fallback_from_depth"] = attempts[0]
+                result["fallback_from_depth"] = attempts[0][0]
                 result["fallback_reason"] = last_msg[-200:]
             print(json.dumps(result))
             return
