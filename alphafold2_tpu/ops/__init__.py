@@ -26,6 +26,7 @@ from alphafold2_tpu.ops.feedforward import (
     feed_forward_init,
     feed_forward_apply,
 )
+from alphafold2_tpu.ops.flash import blockwise_attention, flash_attention
 
 __all__ = [
     "linear_init",
@@ -42,4 +43,6 @@ __all__ = [
     "axial_attention_apply",
     "feed_forward_init",
     "feed_forward_apply",
+    "blockwise_attention",
+    "flash_attention",
 ]
