@@ -194,6 +194,9 @@ def alphafold2_apply(
     embedds=None,
     seq_pos=None,  # accepted and ignored (reference alphafold2.py:435-436)
     rng=None,
+    trunk_fn=None,  # override the trunk, e.g. the sequence-parallel trunk
+    # (parallel/sp_trunk.py alphafold2_apply_sp); called as
+    # trunk_fn(params["trunk"], cfg, x, m, x_mask, msa_mask, rng)
 ):
     """Forward pass.
 
@@ -271,7 +274,9 @@ def alphafold2_apply(
         )
 
     # trunk (reference :528-535)
-    if cfg.reversible:
+    if trunk_fn is not None:
+        x, m = trunk_fn(params["trunk"], cfg, x, m, x_mask, m_mask, rng_trunk)
+    elif cfg.reversible:
         x, m = reversible_trunk_apply(
             params["trunk"],
             cfg,

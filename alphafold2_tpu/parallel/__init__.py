@@ -24,7 +24,7 @@ from alphafold2_tpu.parallel.sequence import (
     tied_row_attention_sharded,
     ulysses_attention,
 )
-from alphafold2_tpu.parallel.sp_trunk import sp_trunk_apply
+from alphafold2_tpu.parallel.sp_trunk import alphafold2_apply_sp, sp_trunk_apply
 from alphafold2_tpu.parallel.pipeline import pipeline_trunk_apply
 from alphafold2_tpu.parallel.distributed import (
     global_mesh,
@@ -34,6 +34,7 @@ from alphafold2_tpu.parallel.distributed import (
 
 __all__ = [
     "sp_trunk_apply",
+    "alphafold2_apply_sp",
     "pipeline_trunk_apply",
     "initialize_from_env",
     "global_mesh",

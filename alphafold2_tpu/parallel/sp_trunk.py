@@ -266,3 +266,53 @@ def sp_trunk_apply(
         return x, m
 
     return run(x, m, x_mask, msa_mask)
+
+
+def alphafold2_apply_sp(
+    params,
+    cfg: Alphafold2Config,
+    seq,
+    msa,
+    mesh: Mesh,
+    *,
+    axis_name: str = "seq",
+    mask=None,
+    msa_mask=None,
+    templates=None,
+    templates_mask=None,
+):
+    """FULL-model forward with the trunk sequence-parallel over the mesh.
+
+    Embeddings, the (optional) template tower, and the distogram head run
+    replicated — they are a negligible share of the FLOPs and memory; the
+    trunk (where the pair grid lives) runs under shard_map with its row
+    axes sharded. Parity with the replicated `alphafold2_apply` is tested
+    full-model on the 8-device mesh (tests/test_sp_trunk.py).
+
+    Requires a token MSA (the embedds grid-stream substitute has no row
+    axis to shard), the sequential trunk, and the sp_trunk_apply
+    constraints (deterministic, flat cross-attention, no sparse layers).
+    """
+    from alphafold2_tpu.models.alphafold2 import alphafold2_apply
+
+    if cfg.reversible:
+        raise ValueError(
+            "sequence-parallel trunk uses the sequential layer list; "
+            "set reversible=False (memory scales via sharding instead)"
+        )
+    if msa is None:
+        raise ValueError("alphafold2_apply_sp requires a token MSA")
+
+    def trunk_fn(layers, cfg_, x, m, x_mask, m_mask, rng):
+        del rng  # deterministic path (sp_trunk_apply contract)
+        return sp_trunk_apply(
+            layers, cfg_, x, m, mesh,
+            axis_name=axis_name, x_mask=x_mask, msa_mask=m_mask,
+        )
+
+    return alphafold2_apply(
+        params, cfg, seq, msa,
+        mask=mask, msa_mask=msa_mask,
+        templates=templates, templates_mask=templates_mask,
+        trunk_fn=trunk_fn,
+    )

@@ -91,3 +91,26 @@ def test_sp_trunk_rejects_unsupported_modes():
     layers, x, m, _, _ = _setup(cfg, n=16, rows=8, cols=16)
     with pytest.raises(ValueError, match="flat"):
         sp_trunk_apply(layers, cfg, x, m, mesh)
+
+
+def test_full_model_sp_matches_replicated():
+    """FULL-model parity (VERDICT r1 item 4): embeddings + trunk + head,
+    trunk sequence-parallel over the 8-device mesh, vs alphafold2_apply."""
+    from alphafold2_tpu.models import alphafold2_apply, alphafold2_init
+    from alphafold2_tpu.parallel import alphafold2_apply_sp
+
+    if len(jax.devices()) < N_DEV:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = Alphafold2Config(
+        dim=16, depth=2, heads=2, dim_head=8, max_seq_len=32,
+        msa_tie_row_attn=True,
+    )
+    params = alphafold2_init(jax.random.PRNGKey(0), cfg)
+    rs = jax.random.PRNGKey(1)
+    seq = jax.random.randint(jax.random.fold_in(rs, 0), (1, 16), 0, 21)
+    msa = jax.random.randint(jax.random.fold_in(rs, 1), (1, 8, 16), 0, 21)
+    mesh = make_mesh({"seq": N_DEV})
+
+    want = alphafold2_apply(params, cfg, seq, msa)
+    got = alphafold2_apply_sp(params, cfg, seq, msa, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=5e-4)
