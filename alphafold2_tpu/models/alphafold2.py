@@ -275,6 +275,15 @@ def alphafold2_apply(
 
     # trunk (reference :528-535)
     if trunk_fn is not None:
+        if cfg.reversible:
+            # params["trunk"] is the depth-STACKED pytree when reversible
+            # (reversible_trunk_init), not the layer list the hook's
+            # contract documents — reject rather than hand over the wrong
+            # structure
+            raise ValueError(
+                "trunk_fn overrides receive the sequential layer list; "
+                "set reversible=False"
+            )
         x, m = trunk_fn(params["trunk"], cfg, x, m, x_mask, m_mask, rng_trunk)
     elif cfg.reversible:
         x, m = reversible_trunk_apply(

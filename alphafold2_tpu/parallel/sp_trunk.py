@@ -243,6 +243,17 @@ def sp_trunk_apply(
     if any(cfg.layer_sparse):
         raise ValueError("sparse layers are not sequence-parallel; use the "
                          "replicated trunk")
+    shards = mesh.shape[axis_name]
+    if x.shape[1] % shards != 0:
+        raise ValueError(
+            f"pair-grid rows ({x.shape[1]}) must divide by the "
+            f"'{axis_name}' mesh axis ({shards})"
+        )
+    if m is not None and m.shape[1] % shards != 0:
+        raise ValueError(
+            f"MSA rows ({m.shape[1]}) must divide by the "
+            f"'{axis_name}' mesh axis ({shards})"
+        )
 
     spec_x = P(None, axis_name)
     spec_m = P(None, axis_name)
