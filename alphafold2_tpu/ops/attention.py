@@ -62,6 +62,11 @@ class AttentionConfig:
     # active. Not used for tied-row attention (its logits are already
     # row-contracted and small).
     flash: Union[bool, str] = "auto"
+    # XLA streaming-path tile knobs (ignored by the Pallas kernel): target
+    # logit-tile elements and K/V streaming block. Bigger tiles = better
+    # MXU utilization, more live memory — tune per chip generation
+    flash_tile_elems: int = 1 << 25
+    flash_kv_block: int = 2048
     # process the (folded) batch axis in chunks of this many elements under
     # jax.checkpoint (0 = off). Flash tiling bounds the LOGITS, but the
     # QKV/output projections still materialize over the whole folded batch —
@@ -232,7 +237,10 @@ def attention_apply(
         )
         # Pallas fused kernel on TPU (supported shapes), XLA streaming
         # otherwise (ops/flash.py dispatch)
-        out = flash_attention(q, k, v, key_bias, scale=scale)
+        out = flash_attention(
+            q, k, v, key_bias, scale=scale,
+            tile_elems=cfg.flash_tile_elems, kv_block=cfg.flash_kv_block,
+        )
         out = out.reshape(out.shape[0], i, h * dh)
         return linear(params["to_out"], out, dtype=dtype)
 
