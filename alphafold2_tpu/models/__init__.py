@@ -8,6 +8,7 @@ from alphafold2_tpu.models.alphafold2 import (
     alphafold2_init,
     alphafold2_apply,
 )
+from alphafold2_tpu.models.convert import convert_alphafold2
 from alphafold2_tpu.models.trunk import (
     trunk_layer_init,
     sequential_trunk_apply,
@@ -49,4 +50,5 @@ __all__ = [
     "reversible_trunk_init",
     "reversible_trunk_apply",
     "stack_layers",
+    "convert_alphafold2",
 ]

@@ -11,8 +11,6 @@ from __future__ import annotations
 import sys
 import types
 
-import numpy as np
-
 
 def load_reference():
     """Import alphafold2_pytorch from /root/reference with stubbed externals.
@@ -45,150 +43,16 @@ def load_reference():
     sys.modules["_ref_af2_patched"] = module
     return module
 
-
-def t2n(t):
-    return t.detach().cpu().numpy().astype(np.float32)
-
-
-def convert_linear(torch_linear):
-    """torch.nn.Linear (out, in) -> {'w': (in, out), 'b': (out,)}."""
-    p = {"w": t2n(torch_linear.weight).T}
-    if torch_linear.bias is not None:
-        p["b"] = t2n(torch_linear.bias)
-    return p
-
-
-def convert_layernorm(torch_ln):
-    return {"scale": t2n(torch_ln.weight), "bias": t2n(torch_ln.bias)}
-
-
-def convert_attention(torch_attn):
-    """Reference Attention module -> our attention params pytree."""
-    p = {
-        "to_q": convert_linear(torch_attn.to_q),
-        "to_kv": convert_linear(torch_attn.to_kv),
-        "to_out": convert_linear(torch_attn.to_out),
-    }
-    if torch_attn.compress_fn is not None:
-        # torch Conv1d weight (out, in/groups, k) -> ours (k, in/groups, out)
-        w = t2n(torch_attn.compress_fn.weight)
-        p["compress"] = {
-            "w": np.transpose(w, (2, 1, 0)),
-            "b": t2n(torch_attn.compress_fn.bias),
-        }
-    return p
-
-
-def convert_axial_attention(torch_axial):
-    return {
-        "attn_width": convert_attention(torch_axial.attn_width),
-        "attn_height": convert_attention(torch_axial.attn_height),
-    }
-
-
-def convert_feed_forward(torch_ff):
-    return {
-        "proj_in": convert_linear(torch_ff.net[0]),
-        "proj_out": convert_linear(torch_ff.net[3]),
-    }
-
-
-def convert_embedding(torch_emb):
-    return {"table": t2n(torch_emb.weight)}
-
-
-def _convert_prenorm_axial(m):
-    return {"norm": convert_layernorm(m.norm), "attn": convert_axial_attention(m.fn)}
-
-
-def _convert_prenorm_attn(m):
-    return {"norm": convert_layernorm(m.norm), "attn": convert_attention(m.fn)}
-
-
-def _convert_prenorm_cross(m):
-    return {
-        "norm": convert_layernorm(m.norm),
-        "norm_context": convert_layernorm(m.norm_context),
-        "attn": convert_attention(m.fn),
-    }
-
-
-def _convert_prenorm_ff(m):
-    return {"norm": convert_layernorm(m.norm), "ff": convert_feed_forward(m.fn)}
-
-
-def convert_reversible_trunk(rev_sequence):
-    """Reference ReversibleSequence -> our per-layer params list (unstacked).
-
-    Reference block layout (reversible.py:304-313): blocks alternate
-    ReversibleSelfAttnBlock(f=seq axial attn, g=seq ff, j=msa axial attn,
-    k=msa ff) and ReversibleCrossAttnBlock(f=seq cross, g=seq ff2,
-    j=msa cross, k=msa ff2); each sub-fn is wrapped in Deterministic (.net).
-    """
-    blocks = list(rev_sequence.blocks)
-    layers = []
-    for self_blk, cross_blk in zip(*[iter(blocks)] * 2):
-        layers.append(
-            {
-                "seq_attn": _convert_prenorm_axial(self_blk.f.net),
-                "seq_ff": _convert_prenorm_ff(self_blk.g.net),
-                "msa_attn": _convert_prenorm_axial(self_blk.j.net),
-                "msa_ff": _convert_prenorm_ff(self_blk.k.net),
-                "seq_cross": _convert_prenorm_cross(cross_blk.f.net),
-                "seq_ff2": _convert_prenorm_ff(cross_blk.g.net),
-                "msa_cross": _convert_prenorm_cross(cross_blk.j.net),
-                "msa_ff2": _convert_prenorm_ff(cross_blk.k.net),
-            }
-        )
-    return layers
-
-
-def convert_alphafold2(model):
-    """Reference Alphafold2 module -> our full params pytree (sequential)."""
-    p = {
-        "token_emb": convert_embedding(model.token_emb),
-        "pos_emb": convert_embedding(model.pos_emb),
-        "pos_emb_ax": convert_embedding(model.pos_emb_ax),
-        "msa_pos_emb": convert_embedding(model.msa_pos_emb),
-        "msa_num_pos_emb": convert_embedding(model.msa_num_pos_emb),
-        "template_emb": convert_embedding(model.template_emb),
-        "template_pos_emb": convert_embedding(model.template_pos_emb),
-        "template_pos_emb_ax": convert_embedding(model.template_pos_emb_ax),
-        "embedd_project": convert_linear(model.embedd_project),
-        "head_norm": convert_layernorm(model.to_distogram_logits[0]),
-        "head_out": convert_linear(model.to_distogram_logits[1]),
-    }
-
-    tower = []
-    for seq_attn, tmpl_attn, joint_attn, ff in model.template_attn_net:
-        tower.append(
-            {
-                "seq_attn": _convert_prenorm_axial(seq_attn),
-                "template_attn": _convert_prenorm_axial(tmpl_attn),
-                "joint_attn": _convert_prenorm_attn(joint_attn),
-                "template_ff": _convert_prenorm_ff(ff),
-            }
-        )
-    p["template_tower"] = tower
-
-    if type(model.net).__name__ == "ReversibleSequence":
-        p["trunk"] = convert_reversible_trunk(model.net)
-        return p
-
-    trunk = []
-    blocks = list(model.net.blocks)
-    for g1, g2 in zip(*[iter(blocks)] * 2):
-        attn, ff, msa_attn = g1[0], g1[1], g1[2]
-        cross, msa_ff, msa_cross = g2[0], g2[1], g2[2]
-        trunk.append(
-            {
-                "seq_attn": _convert_prenorm_axial(attn),
-                "seq_ff": _convert_prenorm_ff(ff),
-                "msa_attn": _convert_prenorm_axial(msa_attn),
-                "seq_cross": _convert_prenorm_cross(cross),
-                "msa_ff": _convert_prenorm_ff(msa_ff),
-                "msa_cross": _convert_prenorm_cross(msa_cross),
-            }
-        )
-    p["trunk"] = trunk
-    return p
+# the weight converter is library API (alphafold2_tpu/models/convert.py);
+# re-exported here so the parity tests keep their historical imports
+from alphafold2_tpu.models.convert import (  # noqa: E402,F401
+    convert_alphafold2,
+    convert_attention,
+    convert_axial_attention,
+    convert_embedding,
+    convert_feed_forward,
+    convert_layernorm,
+    convert_linear,
+    convert_reversible_trunk,
+    t2n,
+)
