@@ -40,6 +40,11 @@ class Alphafold2Config:
     # ~33% extra FLOPs — the remat sibling of the reversible trunk; works
     # with or without an MSA stream (reversible requires one)
     remat: bool = False
+    # lax.scan the sequential trunk over depth (uniform-sparse-flag runs
+    # scan as segments): ONE compiled layer body instead of depth copies —
+    # at depth 48 this is the difference between minutes and seconds of
+    # XLA compile time. The reversible trunk always scans.
+    scan_layers: bool = False
     # bool, or a per-layer tuple of bools (reference cast_tuple semantics,
     # alphafold2.py:25-26,349 — the reference ignores the per-layer value at
     # alphafold2.py:392, a bug; we apply it per layer)

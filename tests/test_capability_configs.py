@@ -80,3 +80,30 @@ def test_config5_e2e_miniature():
         reversible=True, msa_tie_row_attn=True,
         cross_attn_compress_ratio=2, cross_attn_mode="aligned",
     ), seq_len=16, rows=3, cols=8)
+
+
+def test_scan_layers_matches_unrolled():
+    """cfg.scan_layers (segmented lax.scan over depth) must be numerically
+    identical to the unrolled trunk — including mixed sparse flags and
+    per-layer dropout keys."""
+    from alphafold2_tpu.models.trunk import sequential_trunk_apply, trunk_layer_init
+
+    base = dict(
+        dim=16, depth=4, heads=2, dim_head=8, max_seq_len=32,
+        sparse_self_attn=(True, True, False, False),
+        sparse_block_size=4, sparse_num_random_blocks=1,
+        sparse_num_local_blocks=2, sparse_use_kernel=False,
+        attn_dropout=0.1, ff_dropout=0.1,
+    )
+    cfg_u = Alphafold2Config(**base, scan_layers=False)
+    cfg_s = Alphafold2Config(**base, scan_layers=True)
+    keys = jax.random.split(jax.random.PRNGKey(0), 2 + cfg_u.depth)
+    layers = [trunk_layer_init(k, cfg_u) for k in keys[2:]]
+    x = jax.random.normal(keys[0], (1, 8, 8, 16))
+    m = jax.random.normal(keys[1], (1, 2, 8, 16))
+    rng = jax.random.PRNGKey(7)
+
+    want = sequential_trunk_apply(layers, cfg_u, x, m, rng=rng)
+    got = sequential_trunk_apply(layers, cfg_s, x, m, rng=rng)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-5)
