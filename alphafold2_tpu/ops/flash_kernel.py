@@ -215,14 +215,46 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def pick_block(n: int, target: int = 512, mult: int = 128, tol: float = 0.15) -> int:
+    """Pick a Pallas block size for a length-n axis.
+
+    Among multiples of `mult` (MXU-friendly) up to `target`, take the
+    LARGEST block whose padded length is within `tol` of the minimum
+    achievable — large blocks amortize grid/loop overhead, but gross
+    padding waste is real FLOPs: n=1152 picks 384 (zero padding) where a
+    fixed 512 pads to 1536 (+33%), while n=896 keeps 512 (+14% padding
+    beats 7x the grid steps of 128). The tol knob is a heuristic pending
+    on-chip measurement (PERF.md)."""
+    if n <= mult:
+        return mult
+    padded = {b: ((n + b - 1) // b) * b for b in range(mult, target + 1, mult)}
+    best = min(padded.values())
+    return max(b for b, p in padded.items() if p <= best * (1 + tol))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def flash_attention_tpu(q, k, v, key_bias, scale, qb=256, kb=512):
+def _flash_core(q, k, v, key_bias, scale, qb, kb):
+    out, _ = _forward(q, k, v, key_bias, scale, qb, kb)
+    return out
+
+
+def _block_target(dh: int) -> int:
+    """Cap block size so per-grid-step tiles fit the VMEM headroom left by
+    `supported`'s 12 MB resident budget (~4 MB): the worst kernel holds ~6
+    f32 tiles of (block, dh) plus a (qb, kb) logit tile per step. dh=64
+    (the framework's head dim) keeps the full 512; dh=512 drops to 256."""
+    return max(128, min(512, (4 << 20) // (24 * dh) // 128 * 128))
+
+
+def flash_attention_tpu(q, k, v, key_bias, scale, qb=None, kb=None):
     """Fused dense flash attention. q: (BH, i, dh); k, v: (BH, j, dh);
     key_bias: (BH, j) additive f32 (0 valid / -inf masked). Returns
     (BH, i, dh). The bias cotangent is not computed (masks are data, not
-    parameters)."""
-    out, _ = _forward(q, k, v, key_bias, scale, qb, kb)
-    return out
+    parameters). qb/kb: query/key block sizes (None = padding-aware pick)."""
+    dh = q.shape[-1]
+    qb = pick_block(q.shape[1], target=_block_target(dh)) if qb is None else qb
+    kb = pick_block(k.shape[1], target=_block_target(dh)) if kb is None else kb
+    return _flash_core(q, k, v, key_bias, scale, qb, kb)
 
 
 def _fwd(q, k, v, key_bias, scale, qb, kb):
@@ -284,4 +316,4 @@ def _bwd(scale, qb, kb, res, g):
     )
 
 
-flash_attention_tpu.defvjp(_fwd, _bwd)
+_flash_core.defvjp(_fwd, _bwd)

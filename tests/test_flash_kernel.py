@@ -106,3 +106,32 @@ def test_kernel_fully_masked_rows():
         )
     )(q)
     assert np.isfinite(np.asarray(g)).all()
+
+
+def test_pick_block_minimizes_padding():
+    from alphafold2_tpu.ops.flash_kernel import pick_block
+
+    # n=1152: 384 pads to exactly 1152; a fixed 512 would pad to 1536
+    assert pick_block(1152) == 384
+    assert pick_block(512) == 512
+    assert pick_block(100) == 128   # below one block: round up to mult
+    assert pick_block(1280) == 256  # 1280 = 5*256, zero padding
+    # small padding savings don't justify tiny blocks: 896 keeps 512
+    # (+14% padding) over 128 (0% padding, 7x the grid steps)
+    assert pick_block(896) == 512
+    for n in (8, 96, 640, 1000, 4096):
+        b = pick_block(n)
+        assert b % 128 == 0 and b <= 512
+        padded = -(-n // b) * b
+        # never worse than the fixed-512 legacy choice
+        assert padded <= -(-n // 512) * 512
+
+
+def test_block_target_shrinks_with_head_dim():
+    from alphafold2_tpu.ops.flash_kernel import _block_target
+
+    assert _block_target(64) == 512    # framework head dim: full blocks
+    assert _block_target(512) == 256   # near the VMEM residency cap
+    for dh in (8, 64, 128, 256, 512):
+        t = _block_target(dh)
+        assert 128 <= t <= 512 and t % 128 == 0
