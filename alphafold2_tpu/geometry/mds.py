@@ -27,8 +27,15 @@ def _pairwise_dist(coords, eps=1e-12):
     return jnp.sqrt(d2 + eps)
 
 
-@partial(jax.jit, static_argnames=("iters",))
-def mds(pre_dist_mat, weights=None, iters: int = 10, tol: float = 1e-5, key=None):
+@partial(jax.jit, static_argnames=("iters", "bwd_iters"))
+def mds(
+    pre_dist_mat,
+    weights=None,
+    iters: int = 10,
+    tol: float = 1e-5,
+    key=None,
+    bwd_iters: int | None = None,
+):
     """Stress-majorization MDS.
 
     Args:
@@ -40,6 +47,21 @@ def mds(pre_dist_mat, weights=None, iters: int = 10, tol: float = 1e-5, key=None
         `utils.py:343-347`).
       key: PRNG key for the random init (explicit, unlike the reference's
         implicit global RNG at `utils.py:326`).
+      bwd_iters: if set (< iters), backprop is TRUNCATED to the last
+        `bwd_iters` iterations: the earlier ones run under stop_gradient,
+        and the differentiable tail ignores the convergence freeze (a frozen
+        update would pass the detached carry through unchanged and zero the
+        gradient). The gradient is the K-term truncation of the full
+        unrolled chain — near the fixed point this approximates implicit
+        differentiation (each extra term is a power of the contractive
+        Guttman-map Jacobian) — while the backward stores and traverses K
+        instead of `iters` per-iteration (N, N) residuals. Forward deviates
+        from the default path only when the freeze would have fired: the
+        tail's extra Guttman steps move coords by at most K x the
+        (tol-scale) per-iteration movement at freeze. bwd_iters=0 detaches MDS entirely
+        (no gradient to distances/weights). The end-to-end loss backprops
+        through MDS (reference train_end2end.py:152-176), where iters=200
+        makes the full unroll the dominant memory/latency cost.
 
     Returns:
       coords: (batch, 3, N)
@@ -59,29 +81,67 @@ def mds(pre_dist_mat, weights=None, iters: int = 10, tol: float = 1e-5, key=None
     init_coords = 2.0 * jax.random.uniform(key, (batch, n, 3), pre_dist_mat.dtype) - 1.0
     eye = jnp.eye(n, dtype=pre_dist_mat.dtype)
 
-    def step(carry, _):
-        coords, best_stress, done = carry
-        dist = _pairwise_dist(coords)
-        stress = 0.5 * jnp.sum(weights * (dist - pre_dist_mat) ** 2, axis=(-1, -2))
-        # Guttman transform (reference utils.py:333-338)
-        dist = jnp.where(dist == 0.0, 1e-7, dist)
-        ratio = weights * (pre_dist_mat / dist)
-        B = -ratio + eye[None] * jnp.sum(ratio, axis=-1, keepdims=True)
-        new_coords = jnp.matmul(B, coords) / n
-        dis = jnp.linalg.norm(new_coords, axis=(-1, -2))
-        norm_stress = stress / dis
-        improvement = jnp.mean(best_stress - norm_stress)
-        # once converged, the update is not taken (mirrors the reference's
-        # break-before-assign at utils.py:343-350)
-        new_done = done | (improvement <= tol)
-        coords = jnp.where(new_done, coords, new_coords)
-        best_stress = jnp.where(new_done, best_stress, norm_stress)
-        return (coords, best_stress, new_done), best_stress
+    def make_step(allow_freeze: bool):
+        def step(carry, _):
+            coords, best_stress, done = carry
+            dist = _pairwise_dist(coords)
+            stress = 0.5 * jnp.sum(weights * (dist - pre_dist_mat) ** 2, axis=(-1, -2))
+            # Guttman transform (reference utils.py:333-338)
+            dist = jnp.where(dist == 0.0, 1e-7, dist)
+            ratio = weights * (pre_dist_mat / dist)
+            B = -ratio + eye[None] * jnp.sum(ratio, axis=-1, keepdims=True)
+            new_coords = jnp.matmul(B, coords) / n
+            dis = jnp.linalg.norm(new_coords, axis=(-1, -2))
+            norm_stress = stress / dis
+            improvement = jnp.mean(best_stress - norm_stress)
+            if allow_freeze:
+                # once converged, the update is not taken (mirrors the
+                # reference's break-before-assign at utils.py:343-350)
+                new_done = done | (improvement <= tol)
+                coords = jnp.where(new_done, coords, new_coords)
+                best_stress = jnp.where(new_done, best_stress, norm_stress)
+            else:
+                # differentiable tail of the truncated-backprop path: keep
+                # updating even past convergence. A frozen update would be a
+                # pure pass-through of the stop_gradient'd carry — the
+                # gradient through coords would be identically ZERO whenever
+                # convergence fires before the cut, which at iters=200 /
+                # tol=1e-5 is the common case. Extra Guttman steps at a
+                # converged point are near-no-ops forward, so this costs only
+                # a small (K x tol-scale-step) forward deviation from the
+                # freeze semantics.
+                new_done = done
+                best_stress = norm_stress
+                coords = new_coords
+            return (coords, best_stress, new_done), best_stress
+
+        return step
 
     best_stress0 = jnp.full((batch,), jnp.inf, pre_dist_mat.dtype)
-    (coords, _, _), history = jax.lax.scan(
-        step, (init_coords, best_stress0, jnp.array(False)), None, length=iters
-    )
+    carry = (init_coords, best_stress0, jnp.array(False))
+
+    if bwd_iters is not None and bwd_iters < iters:
+        carry, head = jax.lax.scan(
+            make_step(True), carry, None, length=iters - bwd_iters
+        )
+        # cut the chain: no gradient flows into (or residuals are kept for)
+        # the first iters-bwd_iters steps. `done` is boolean (no gradient).
+        # The history rows of the head are detached too, so a loss touching
+        # them cannot silently re-materialize all head residuals.
+        head = jax.lax.stop_gradient(head)
+        carry = jax.tree_util.tree_map(jax.lax.stop_gradient, carry)
+        if bwd_iters == 0:
+            # explicit opt-out of MDS gradients entirely
+            history = head
+        else:
+            carry, tail = jax.lax.scan(
+                make_step(False), carry, None, length=bwd_iters
+            )
+            history = jnp.concatenate([head, tail], axis=0)
+    else:
+        carry, history = jax.lax.scan(make_step(True), carry, None, length=iters)
+
+    coords = carry[0]
     return jnp.transpose(coords, (0, 2, 1)), history
 
 
@@ -95,6 +155,7 @@ def mdscaling(
     CA_mask=None,
     C_mask=None,
     key=None,
+    bwd_iters: int | None = None,
 ):
     """MDS + chirality (mirror-image) correction.
 
@@ -105,7 +166,10 @@ def mdscaling(
     here the flip is decided per structure with `jnp.where` — jit-friendly and
     correct for batch > 1.
     """
-    preds, stresses = mds(pre_dist_mat, weights=weights, iters=iters, tol=tol, key=key)
+    preds, stresses = mds(
+        pre_dist_mat, weights=weights, iters=iters, tol=tol, key=key,
+        bwd_iters=bwd_iters,
+    )
     if not fix_mirror:
         return preds, stresses
     if N_mask is None or CA_mask is None:

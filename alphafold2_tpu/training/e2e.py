@@ -54,6 +54,12 @@ class E2EConfig:
     model: Alphafold2Config
     refiner: RefinerConfig = RefinerConfig(num_tokens=NUM_COORDS_PER_RES)
     mds_iters: int = 200  # reference train_end2end.py:157
+    # truncate MDS backprop to the last K Guttman iterations (None = full
+    # unroll). Near convergence this approximates implicit differentiation
+    # (geometry/mds.py) and removes iters-K per-iteration (3L, 3L) residuals
+    # from the backward — the MDS unroll is a dominant latency/memory cost
+    # at the north-star scale (PERF.md)
+    mds_bwd_iters: int | None = None
     fix_mirror: bool = True  # reference fix_mirror=5 -> boolean here; the
     # reference's int is a retry count for an eigen-fallback that its own
     # mds_torch never triggers (utils.py:637-642)
@@ -106,6 +112,7 @@ def predict_structure(params, ecfg: E2EConfig, seq, mask=None, rng=None, msa=Non
         N_mask=n_mask,
         CA_mask=ca_mask,
         key=rng_mds,
+        bwd_iters=ecfg.mds_bwd_iters,
     )  # (b, 3, 3L)
 
     backbone = jnp.transpose(coords, (0, 2, 1))  # (b, 3L, 3)

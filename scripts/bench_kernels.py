@@ -29,13 +29,19 @@ import numpy as np
 
 
 def _time_scan(fn, args, iters):
-    """Run fn(args) `iters` times in one jitted scan; return sec/iter."""
+    """Run fn(args) `iters` times in one jitted scan; return sec/iter.
+
+    The carry perturbs the first argument each iteration (by a numerically
+    negligible but compiler-opaque amount), so the body is NOT loop-
+    invariant: without this, XLA's loop-invariant code motion would hoist
+    the whole computation out of the scan and the timing would measure one
+    iteration, not `iters`.
+    """
 
     def body(c, _):
-        out = fn(*args)
-        # fold the output into the carry so the scan cannot be DCE'd and
-        # iterations serialize on a data dependency
-        return c + jnp.sum(out.astype(jnp.float32)), None
+        first = args[0] + (c * 1e-30).astype(args[0].dtype)
+        out = fn(first, *args[1:])
+        return jnp.sum(out.astype(jnp.float32)), None
 
     run = jax.jit(lambda: jax.lax.scan(body, jnp.float32(0.0), None, length=iters)[0])
     np.asarray(run())  # compile + warmup, fetched

@@ -132,6 +132,54 @@ def test_mds_differentiable():
     assert float(jnp.abs(g).sum()) > 0
 
 
+def test_mds_truncated_backprop():
+    key = jax.random.PRNGKey(5)
+    n = 16
+    truth = jax.random.normal(key, (1, n, 3)) * 2.0
+    dist = jnp.sqrt(jnp.sum((truth[:, :, None] - truth[:, None]) ** 2, axis=-1) + 1e-9)
+
+    def run(bwd_iters, tol=1e-5):
+        return mds(dist, iters=120, tol=tol, key=jax.random.PRNGKey(0),
+                   bwd_iters=bwd_iters)
+
+    # forward matches the default path up to a small deviation where the
+    # freeze would have stopped updates but the differentiable tail keeps
+    # iterating (bounded by tail length x per-iteration movement at freeze)
+    full_c, _ = run(None)
+    trunc_c, trunc_h = run(10)
+    np.testing.assert_allclose(
+        np.asarray(full_c), np.asarray(trunc_c), atol=5e-2
+    )
+    assert trunc_h.shape[0] == 120
+
+    def loss(d, bwd_iters, tol=1e-5):
+        coords, _ = mds(d, iters=120, tol=tol, key=jax.random.PRNGKey(0),
+                        bwd_iters=bwd_iters)
+        return jnp.sum(coords ** 2)
+
+    # bwd_iters >= iters is exactly the full unroll
+    g_full = jax.grad(loss)(dist, None)
+    g_same = jax.grad(loss)(dist, 120)
+    np.testing.assert_allclose(np.asarray(g_full), np.asarray(g_same), atol=1e-6)
+
+    # REGRESSION: with a converging tol (freeze fires long before the cut)
+    # the truncated gradient must NOT vanish — the tail ignores the freeze
+    g_tr = jax.grad(loss)(dist, 10)
+    assert np.all(np.isfinite(np.asarray(g_tr)))
+    assert float(jnp.abs(g_tr).sum()) > 0, "frozen tail zeroed the gradient"
+    # and it points the same way as the full-unroll gradient
+    a, b = np.asarray(g_full).ravel(), np.asarray(g_tr).ravel()
+    cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-30))
+    assert cos > 0.9, f"truncated grad misaligned: cos={cos}"
+
+    # bwd_iters=0 detaches MDS entirely: zero gradient, forward intact
+    g0 = jax.grad(loss)(dist, 0)
+    np.testing.assert_array_equal(np.asarray(g0), 0.0)
+    zero_c, zero_h = run(0)
+    np.testing.assert_array_equal(np.asarray(zero_c), np.asarray(full_c))
+    assert zero_h.shape[0] == 120
+
+
 def test_nerf_and_dihedral():
     # reference tests/test_utils.py:37-63 — hand-computed ground truth
     a = jnp.array([1.0, 2.0, 3.0])

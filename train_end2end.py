@@ -43,6 +43,9 @@ def main():
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--accum", type=int, default=2)
     ap.add_argument("--mds-iters", type=int, default=20)
+    ap.add_argument("--mds-bwd-iters", type=int, default=None,
+                    help="truncate MDS backprop to the last K iterations "
+                         "(implicit-diff approximation; None = full unroll)")
     ap.add_argument("--refiner-depth", type=int, default=2)
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
@@ -101,6 +104,7 @@ def main():
         ),
         refiner=RefinerConfig(num_tokens=14, dim=64, depth=args.refiner_depth),
         mds_iters=args.mds_iters,
+        mds_bwd_iters=args.mds_bwd_iters,
     )
     tcfg = TrainConfig(learning_rate=args.lr, grad_accum=args.accum)
     dcfg = DataConfig(
