@@ -75,6 +75,11 @@ class Alphafold2Config:
     # projections included) into blocks of this many elements (0 = off; see
     # ops/attention.py AttentionConfig.batch_chunk)
     attn_batch_chunk: int = 0
+    # XLA flash-streaming tile knobs (AttentionConfig.flash_tile_elems /
+    # flash_kv_block): target logit-tile elements and K/V streaming block.
+    # Bigger tiles = better MXU utilization, more live memory
+    attn_flash_tile_elems: int = 1 << 25
+    attn_flash_kv_block: int = 2048
     # chunk feed-forward token axes into blocks of this many tokens (0 =
     # off): bounds the GEGLU 8*dim intermediate, which at crop 384 is the
     # largest single activation in the trunk
@@ -120,6 +125,8 @@ class Alphafold2Config:
             dtype=self.dtype,
             flash=self.attn_flash,
             batch_chunk=self.attn_batch_chunk,
+            flash_tile_elems=self.attn_flash_tile_elems,
+            flash_kv_block=self.attn_flash_kv_block,
         )
 
     def cross_attn_config(self) -> AttentionConfig:
@@ -132,4 +139,6 @@ class Alphafold2Config:
             dtype=self.dtype,
             flash=self.attn_flash,
             batch_chunk=self.attn_batch_chunk,
+            flash_tile_elems=self.attn_flash_tile_elems,
+            flash_kv_block=self.attn_flash_kv_block,
         )

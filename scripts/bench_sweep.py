@@ -1,0 +1,168 @@
+"""On-chip tuning sweep for the north-star e2e workload.
+
+Runs a sequence of single-measurement subprocesses (bench.py's isolation
+pattern: a crashed/wedged TPU worker must not take the orchestrator down)
+covering the tuning axes PERF.md lists as unmeasured:
+
+  * dense flash Pallas kernel vs XLA streaming (scripts/bench_kernels.py)
+    at the axial shape the crop-384 workload produces;
+  * e2e depth-12 step time across {kernel on/off}, {attn_batch_chunk},
+    {flash_tile_elems}, {mds_bwd_iters}.
+
+Each attempt gets its own timeout; on the first TIMEOUT the sweep assumes
+the tunnel wedged and stops launching (a wedged worker hangs every later
+backend init), reporting what completed. Results append to
+PERF_SWEEP.jsonl (one JSON line per measurement).
+
+Usage: python scripts/bench_sweep.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "PERF_SWEEP.jsonl")
+
+E2E_WORKER = r"""
+import json, sys, time
+import jax
+import numpy as np
+
+spec = json.loads(sys.argv[1])
+
+import jax.numpy as jnp
+from alphafold2_tpu.models import Alphafold2Config, RefinerConfig
+from alphafold2_tpu.training import (
+    DataConfig, E2EConfig, TrainConfig, e2e_loss_fn, e2e_train_state_init,
+    make_train_step, stack_microbatches, synthetic_structure_batches,
+)
+
+crop, msa_rows, depth = 384, 128, spec["depth"]
+ecfg = E2EConfig(
+    model=Alphafold2Config(
+        dim=256, depth=depth, heads=8, dim_head=64, max_seq_len=2048,
+        max_num_msa=128, dtype=jnp.bfloat16, reversible=True,
+        msa_tie_row_attn=True, cross_attn_compress_ratio=4,
+        cross_attn_mode="aligned",
+        attn_flash="auto",
+        attn_batch_chunk=spec["batch_chunk"],
+        attn_flash_tile_elems=spec["tile_elems"],
+        ff_chunk_size=32768,
+    ),
+    refiner=RefinerConfig(num_tokens=14, dim=64, depth=2, msg_dim=64,
+                          dtype=jnp.bfloat16, atom_chunk=256),
+    mds_iters=200,
+    mds_bwd_iters=spec["mds_bwd_iters"],
+)
+# The Pallas kernel is gated by flash_kernel.supported and platform inside
+# ops/flash.py ("auto"); to force XLA-only streaming, monkeypatch
+# supported() off before anything compiles.
+if not spec["kernel"]:
+    from alphafold2_tpu.ops import flash_kernel
+    flash_kernel.supported = lambda *a, **k: False
+
+tcfg = TrainConfig(learning_rate=3e-4, grad_accum=1)
+dcfg = DataConfig(batch_size=1, max_len=crop, msa_rows=msa_rows, seed=0)
+batch = jax.device_put(next(stack_microbatches(synthetic_structure_batches(dcfg), 1)))
+state = e2e_train_state_init(jax.random.PRNGKey(0), ecfg, tcfg)
+step = make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn)
+
+def run_one(state, batch, rng):
+    s2, metrics = step(state, batch, rng)
+    return s2, metrics["loss"]
+
+compiled = jax.jit(run_one, donate_argnums=(0,)).lower(
+    state, batch, jax.random.PRNGKey(1)).compile()
+state, loss = compiled(state, batch, jax.random.PRNGKey(1))
+np.asarray(loss)  # fetch: dispatch-proof warmup
+t0 = time.perf_counter()
+state, loss = compiled(state, batch, jax.random.PRNGKey(2))
+loss = float(np.asarray(loss))
+dt = time.perf_counter() - t0
+assert np.isfinite(loss), loss
+print(json.dumps({"sec_per_step": round(dt, 2), "loss": round(loss, 4)}))
+"""
+
+
+def run_sub(code_or_path, argv, timeout):
+    t0 = time.time()
+    if os.path.exists(code_or_path):
+        cmd = [sys.executable, code_or_path, *argv]
+    else:
+        cmd = [sys.executable, "-c", code_or_path, *argv]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return None, "timeout", time.time() - t0
+    if proc.returncode != 0:
+        err = (proc.stderr or "").strip().splitlines()
+        return None, (err[-1] if err else f"rc={proc.returncode}"), time.time() - t0
+    results = []
+    for line in proc.stdout.strip().splitlines():
+        try:
+            results.append(json.loads(line))
+        except ValueError:
+            continue
+    if not results:
+        return None, "no JSON in output", time.time() - t0
+    return (results if len(results) > 1 else results[0]), None, time.time() - t0
+
+
+def record(entry):
+    with open(OUT, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    print(json.dumps(entry), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="kernel microbench + one e2e config only")
+    ap.add_argument("--depth", type=int, default=12)
+    args = ap.parse_args()
+
+    # 1) kernel vs XLA microbench at the north-star axial shape
+    micro = os.path.join(REPO, "scripts", "bench_kernels.py")
+    for paths in ("kernel", "xla"):
+        res, err, dt = run_sub(
+            micro,
+            ["--b", "1152", "--n", "1152", "--iters", "4", "--paths", paths],
+            timeout=1500,
+        )
+        record({"bench": f"micro_{paths}", "result": res, "error": err,
+                "wall": round(dt, 1)})
+        if err == "timeout":
+            record({"bench": "sweep", "error": "tunnel wedged; stopping"})
+            return
+
+    # 2) e2e step-time sweep
+    base = dict(depth=args.depth, kernel=True, batch_chunk=32,
+                tile_elems=1 << 25, mds_bwd_iters=None)
+    variants = [("e2e_base", base)]
+    if not args.quick:
+        variants += [
+            ("e2e_nokernel", {**base, "kernel": False}),
+            ("e2e_chunk96", {**base, "batch_chunk": 96}),
+            ("e2e_chunk0", {**base, "batch_chunk": 0}),
+            ("e2e_tile26", {**base, "tile_elems": 1 << 26}),
+            ("e2e_mdsbwd25", {**base, "mds_bwd_iters": 25}),
+        ]
+    for name, spec in variants:
+        res, err, dt = run_sub(E2E_WORKER, [json.dumps(spec)], timeout=2100)
+        record({"bench": name, "spec": spec, "result": res, "error": err,
+                "wall": round(dt, 1)})
+        if err == "timeout":
+            record({"bench": "sweep", "error": "tunnel wedged; stopping"})
+            return
+
+
+if __name__ == "__main__":
+    main()

@@ -19,3 +19,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+# persistent compilation cache: the suite is COMPILE-dominated (tiny shapes,
+# but dozens of jit/shard_map programs — the worst single test spends ~95%
+# of its 99 s compiling). With the cache warm, re-runs pay only execution.
+# Safe across processes (content-addressed); scoped to a repo-local dir so
+# `git clean` or deleting .pytest_jax_cache resets it.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(__file__), ".pytest_jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -82,6 +82,7 @@ def test_config5_e2e_miniature():
     ), seq_len=16, rows=3, cols=8)
 
 
+@pytest.mark.slow
 def test_scan_layers_matches_unrolled():
     """cfg.scan_layers (segmented lax.scan over depth) must be numerically
     identical to the unrolled trunk — including mixed sparse flags and
@@ -89,8 +90,8 @@ def test_scan_layers_matches_unrolled():
     from alphafold2_tpu.models.trunk import sequential_trunk_apply, trunk_layer_init
 
     base = dict(
-        dim=16, depth=4, heads=2, dim_head=8, max_seq_len=32,
-        sparse_self_attn=(True, True, False, False),
+        dim=16, depth=3, heads=2, dim_head=8, max_seq_len=32,
+        sparse_self_attn=(True, False, False),
         sparse_block_size=4, sparse_num_random_blocks=1,
         sparse_num_local_blocks=2, sparse_use_kernel=False,
         attn_dropout=0.1, ff_dropout=0.1,

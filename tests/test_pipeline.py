@@ -23,14 +23,18 @@ def _setup(cfg, b, n, rows, cols, seed=0):
 
 
 @pytest.mark.parametrize(
-    "stages,microbatches,tie",
-    [(4, 4, False), pytest.param(2, 4, True, marks=pytest.mark.slow)],
+    "stages,microbatches,tie,depth",
+    [
+        (2, 2, False, 2),  # cheap fast-tier parity case
+        pytest.param(4, 4, False, 4, marks=pytest.mark.slow),
+        pytest.param(2, 4, True, 4, marks=pytest.mark.slow),
+    ],
 )
-def test_pipeline_matches_sequential(stages, microbatches, tie):
+def test_pipeline_matches_sequential(stages, microbatches, tie, depth):
     if len(jax.devices()) < N_DEV:
         pytest.skip("needs the 8-device CPU mesh")
     cfg = Alphafold2Config(
-        dim=16, depth=4, heads=2, dim_head=8, max_seq_len=32,
+        dim=16, depth=depth, heads=2, dim_head=8, max_seq_len=32,
         msa_tie_row_attn=tie,
     )
     layers, x, m = _setup(cfg, b=microbatches, n=8, rows=3, cols=8)
@@ -44,6 +48,7 @@ def test_pipeline_matches_sequential(stages, microbatches, tie):
     np.testing.assert_allclose(np.asarray(got_m), np.asarray(want_m), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_with_broadcast_masks():
     if len(jax.devices()) < N_DEV:
         pytest.skip("needs the 8-device CPU mesh")

@@ -94,6 +94,7 @@ def test_jit_and_grad(setup):
     assert all(jnp.all(jnp.isfinite(x)) for x in jax.tree_util.tree_leaves(g))
 
 
+@pytest.mark.slow
 def test_atom_chunked_refiner_matches_unchunked():
     """cfg.atom_chunk must reproduce the unchunked refiner exactly,
     including with a non-divisible atom count and masked atoms."""

@@ -47,7 +47,9 @@ def _loss_fn(reverse, with_rng):
     return loss
 
 
-@pytest.mark.parametrize("with_rng", [False, True])
+@pytest.mark.parametrize(
+    "with_rng", [False, pytest.param(True, marks=pytest.mark.slow)]
+)
 def test_grad_parity_reversible_vs_autodiff(with_rng):
     # with_rng threads a key through both paths (dropout rates are 0 here,
     # so outputs stay equal; live-dropout parity is covered by

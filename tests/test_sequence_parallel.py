@@ -59,7 +59,9 @@ def _shard_mapped(prim, mesh, masked):
 
 
 @pytest.mark.parametrize("name", list(PRIMS))
-@pytest.mark.parametrize("masked", [False, True])
+@pytest.mark.parametrize(
+    "masked", [False, pytest.param(True, marks=pytest.mark.slow)]
+)
 def test_attention_parity(name, masked):
     mesh = _mesh()
     q, k, v, mask = _data(seed=1, h=8, masked=masked)
