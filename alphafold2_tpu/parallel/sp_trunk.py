@@ -13,14 +13,16 @@ ROW axis sharded over one mesh axis, inside a single `shard_map`:
     all_to_all transpose for the along-rows pass; untied: the same
     axial primitive as the pair grid;
   * pair<-MSA cross      -> the MSA stream is small: one all_gather of the
-    context, then local dense cross-attention over the resident pair rows;
+    context, then local dense cross-attention over the resident pair rows
+    (per column group when cross_attn_mode="aligned");
   * MSA<-pair cross      -> the pair stream is the big one: ring
     cross-attention — resident MSA queries stream the pair K/V shards
-    around the ring (`ppermute`), nothing is ever gathered;
+    around the ring (`ppermute`), nothing is ever gathered (per column
+    group when "aligned");
   * feed-forwards, norms, residuals — elementwise, shard-local.
 
-Semantics match the replicated sequential trunk (cross_attn_mode="flat",
-dropout off) to float tolerance; `tests/test_sp_trunk.py` asserts
+Semantics match the replicated sequential trunk (flat OR aligned
+cross-attention, dropout off) to float tolerance; `tests/test_sp_trunk.py` asserts
 full-model parity on the 8-device CPU mesh. KV compression for
 cross-attention applies per-shard and therefore requires the local key
 length to divide the ratio (checked).
@@ -99,19 +101,26 @@ def _msa_self_attention(params, cfg: Alphafold2Config, m, axis_name, msa_mask):
     return row_out + col_out
 
 
+def _gather_msa(m_local, msa_mask, axis_name):
+    """all_gather the (small) MSA stream and its mask over the row shards:
+    (b, r_local, c, d) -> (b, R, c, d)."""
+    m_full = jax.lax.all_gather(m_local, axis_name, axis=1, tiled=True)
+    mm_full = None
+    if msa_mask is not None:
+        mm_full = jax.lax.all_gather(
+            msa_mask.astype(jnp.int32), axis_name, axis=1, tiled=True
+        ) > 0
+    return m_full, mm_full
+
+
 def _gathered_cross(params, cfg: Alphafold2Config, q_flat, ctx_local, q_mask, ctx_mask, axis_name):
     """pair<-MSA flat cross-attention: all_gather the (small) MSA context,
     attend locally over the resident pair-row queries."""
     cross_cfg = cfg.cross_attn_config()
-    ctx = jax.lax.all_gather(ctx_local, axis_name, axis=1, tiled=True)  # (b, R, c, d)
+    ctx, cm_grid = _gather_msa(ctx_local, ctx_mask, axis_name)
     b = ctx.shape[0]
     ctx = ctx.reshape(b, -1, ctx.shape[-1])
-    if ctx_mask is not None:
-        cm = jax.lax.all_gather(
-            ctx_mask.astype(jnp.int32), axis_name, axis=1, tiled=True
-        ).reshape(b, -1) > 0
-    else:
-        cm = None
+    cm = cm_grid.reshape(b, -1) if cm_grid is not None else None
     out = attention_apply(
         params["attn"],
         cross_cfg,
@@ -123,20 +132,22 @@ def _gathered_cross(params, cfg: Alphafold2Config, q_flat, ctx_local, q_mask, ct
     return out
 
 
-def _ring_cross(params, cfg: Alphafold2Config, q_flat, ctx_flat_local, q_mask, ctx_mask_local, axis_name):
-    """MSA<-pair flat cross-attention via ring K/V streaming.
+def _ring_cross_tokens(params, cfg: Alphafold2Config, q_tokens, ctx_tokens_local, ctx_mask_local, axis_name):
+    """Cross-attention with resident queries and ring-streamed K/V shards.
 
-    q_flat: (b, nq, d) resident queries; ctx_flat_local: (b, nk_local, d)
-    the resident pair-token shard. K/V (and the key mask) rotate around the
-    ring; the full pair stream never materializes on one chip. KV
+    q_tokens: (B, nq, d) resident queries; ctx_tokens_local: (B, nk_local, d)
+    this chip's key/value token shard. K/V (and the key mask) rotate around
+    the ring; the full key stream never materializes on one chip. KV
     compression applies to the LOCAL shard before the ring (requires the
     local key length to be a multiple of the ratio so per-shard compression
-    tiles the global one).
+    tiles the global one — the shard is a contiguous slice of the global
+    key order). Key-side masking only (ops/flash.py contract): query-side
+    masks are intentionally not applied, like the dense path.
     """
     cross_cfg = cfg.cross_attn_config()
     h, dh = cross_cfg.heads, cross_cfg.dim_head
-    qn = layer_norm(params["norm"], q_flat)
-    cn = layer_norm(params["norm_context"], ctx_flat_local)
+    qn = layer_norm(params["norm"], q_tokens)
+    cn = layer_norm(params["norm_context"], ctx_tokens_local)
     dtype = cross_cfg.dtype
 
     q = _split_heads(linear(params["attn"]["to_q"], qn, dtype=dtype), h, dh)
@@ -160,8 +171,101 @@ def _ring_cross(params, cfg: Alphafold2Config, q_flat, ctx_flat_local, q_mask, c
 
     out = ring_attention(q, k, v, axis_name, mask=ctx_mask_local)
     out = out.reshape(out.shape[0], out.shape[1], h * dh)
-    del q_mask  # key-side masking only (ops/flash.py contract)
     return linear(params["attn"]["to_out"], out, dtype=dtype)
+
+
+def _ring_cross(params, cfg: Alphafold2Config, q_flat, ctx_flat_local, q_mask, ctx_mask_local, axis_name):
+    """MSA<-pair flat cross-attention via ring K/V streaming."""
+    del q_mask  # key-side masking only (ops/flash.py contract)
+    return _ring_cross_tokens(
+        params, cfg, q_flat, ctx_flat_local, ctx_mask_local, axis_name
+    )
+
+
+def _fold_pair_local(x_local, c, x_mask_local=None):
+    """Column-fold the LOCAL pair-row shard (models/trunk.py
+    `_fold_by_msa_column` with the row axis restricted to this shard):
+    (b, n_loc, n, d) -> (b*c, n_loc*f, d), grouped by which chunk of f grid
+    columns maps to MSA column c. Queries/keys are per-position, so the
+    shard-local fold is exactly the replicated fold's row-slice."""
+    b, n_loc, n, d = x_local.shape
+    if n % c != 0:
+        raise ValueError(
+            f"aligned cross-attention needs the pair side ({n}) divisible "
+            f"by the MSA column count ({c})"
+        )
+    f = n // c
+    xg = (
+        x_local.reshape(b, n_loc, c, f, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b * c, n_loc * f, d)
+    )
+    mg = None
+    if x_mask_local is not None:
+        mg = (
+            x_mask_local.reshape(b, n_loc, c, f)
+            .transpose(0, 2, 1, 3)
+            .reshape(b * c, n_loc * f)
+        )
+    return xg, mg, f
+
+
+def _aligned_gathered_cross(params, cfg: Alphafold2Config, x_local, m_local, x_mask, msa_mask, axis_name):
+    """pair<-MSA ALIGNED cross-attention, rows sharded.
+
+    Each pair token attends only its grid column's MSA column
+    (models/trunk.py cross_apply_grids "aligned"). The MSA context is small,
+    so it is all_gathered over the row shards; queries are the resident
+    pair rows, column-folded locally. O(n_loc * n * r) per chip — the
+    sharded version of the O(n^2 * r) redesign.
+    """
+    cross_cfg = cfg.cross_attn_config()
+    b, n_loc, n, d = x_local.shape
+    c = m_local.shape[2]
+
+    m_full, mm_full = _gather_msa(m_local, msa_mask, axis_name)  # (b, R, c, d)
+    r_full = m_full.shape[1]
+    mg = jnp.swapaxes(m_full, 1, 2).reshape(b * c, r_full, d)
+    mg_mask = (
+        jnp.swapaxes(mm_full, 1, 2).reshape(b * c, r_full)
+        if mm_full is not None
+        else None
+    )
+
+    xg, xg_mask, f = _fold_pair_local(x_local, c, x_mask)
+    out = attention_apply(
+        params["attn"],
+        cross_cfg,
+        layer_norm(params["norm"], xg),
+        context=layer_norm(params["norm_context"], mg),
+        mask=xg_mask,
+        context_mask=mg_mask,
+    )
+    return (
+        out.reshape(b, c, n_loc, f, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, n_loc, n, d)
+    )
+
+
+def _aligned_ring_cross(params, cfg: Alphafold2Config, m_local, x_local, msa_mask, x_mask, axis_name):
+    """MSA<-pair ALIGNED cross-attention, rows sharded.
+
+    Each MSA token attends only its column's pair-grid block. Queries are
+    the resident MSA rows (column-folded); each column group's pair keys
+    are sharded over the row axis, so the K/V shards stream around the ring
+    (`_ring_cross_tokens` per group) — the full pair stream never gathers.
+    Key-side masking only; `msa_mask` (query side) is intentionally unused,
+    like the flat twin.
+    """
+    del msa_mask  # key-side masking only (ops/flash.py contract)
+    b, r_loc, c, d = m_local.shape
+
+    mg = jnp.swapaxes(m_local, 1, 2).reshape(b * c, r_loc, d)
+    xg, xg_mask, _ = _fold_pair_local(x_local, c, x_mask)
+
+    out = _ring_cross_tokens(params, cfg, mg, xg, xg_mask, axis_name)
+    return jnp.swapaxes(out.reshape(b, c, r_loc, d), 1, 2)
 
 
 def _sp_layer(layer, cfg: Alphafold2Config, x, m, x_mask, msa_mask, axis_name):
@@ -193,19 +297,27 @@ def _sp_layer(layer, cfg: Alphafold2Config, x, m, x_mask, msa_mask, axis_name):
             msa_mask,
         )
 
-        xf = x.reshape(b, n_local * n, d)
-        xm_flat = x_mask.reshape(b, -1) if x_mask is not None else None
-        mm_flat = msa_mask.reshape(b, -1) if msa_mask is not None else None
-        xf = xf + _gathered_cross(
-            layer["seq_cross"], cfg, xf, m, xm_flat, msa_mask, axis_name
-        )
-        x = xf.reshape(b, n_local, n, d)
+        if cfg.cross_attn_mode == "aligned":
+            x = x + _aligned_gathered_cross(
+                layer["seq_cross"], cfg, x, m, x_mask, msa_mask, axis_name
+            )
+            m = m + _aligned_ring_cross(
+                layer["msa_cross"], cfg, m, x, msa_mask, x_mask, axis_name
+            )
+        else:
+            xf = x.reshape(b, n_local * n, d)
+            xm_flat = x_mask.reshape(b, -1) if x_mask is not None else None
+            mm_flat = msa_mask.reshape(b, -1) if msa_mask is not None else None
+            xf = xf + _gathered_cross(
+                layer["seq_cross"], cfg, xf, m, xm_flat, msa_mask, axis_name
+            )
+            x = xf.reshape(b, n_local, n, d)
 
-        mf = m.reshape(b, -1, d)
-        mf = mf + _ring_cross(
-            layer["msa_cross"], cfg, mf, xf, mm_flat, xm_flat, axis_name
-        )
-        m = mf.reshape(m.shape)
+            mf = m.reshape(b, -1, d)
+            mf = mf + _ring_cross(
+                layer["msa_cross"], cfg, mf, xf, mm_flat, xm_flat, axis_name
+            )
+            m = mf.reshape(m.shape)
 
     x = x + prenorm_ff_apply(layer["seq_ff"], cfg, x)
     if m is not None:
@@ -232,18 +344,26 @@ def sp_trunk_apply(
       masks as in models/trunk.py.
 
     Deterministic path only (dropout needs per-shard key plumbing; train
-    with the replicated trunk or rng=None). cross_attn_mode="flat" only —
-    the aligned mode's column folds are orthogonal to row sharding and run
-    replicated (its memory already scales, see models/trunk.py).
+    with the replicated trunk or rng=None). Both cross_attn_mode values are
+    supported: "flat" (all_gather MSA / ring pair K/V over the whole
+    streams) and "aligned" (the O(n^2 * r) column-aligned redesign — the
+    mode the north-star workload uses — with the same gather/ring split
+    applied per column group).
 
     Returns (x, m) in global layouts.
     """
-    if cfg.cross_attn_mode != "flat":
-        raise ValueError("sp_trunk_apply implements cross_attn_mode='flat'")
     if any(cfg.layer_sparse):
         raise ValueError("sparse layers are not sequence-parallel; use the "
                          "replicated trunk")
     shards = mesh.shape[axis_name]
+    if cfg.cross_attn_mode == "aligned" and x.shape[1] != x.shape[2]:
+        # same contract as the replicated fold (models/trunk.py
+        # _fold_by_msa_column) — the local fold can't see the global row
+        # count, so check here
+        raise ValueError(
+            f"aligned cross-attention needs a square pair grid; got "
+            f"({x.shape[1]}, {x.shape[2]})"
+        )
     if x.shape[1] % shards != 0:
         raise ValueError(
             f"pair-grid rows ({x.shape[1]}) must divide by the "
@@ -302,7 +422,7 @@ def alphafold2_apply_sp(
 
     Requires a token MSA (the embedds grid-stream substitute has no row
     axis to shard), the sequential trunk, and the sp_trunk_apply
-    constraints (deterministic, flat cross-attention, no sparse layers).
+    constraints (deterministic, no sparse layers).
     """
     from alphafold2_tpu.models.alphafold2 import alphafold2_apply
 

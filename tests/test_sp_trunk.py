@@ -86,11 +86,55 @@ def test_sp_trunk_rejects_unsupported_modes():
     mesh = make_mesh({"seq": N_DEV})
     cfg = Alphafold2Config(
         dim=16, depth=1, heads=2, dim_head=8, max_seq_len=64,
-        cross_attn_mode="aligned",
+        sparse_self_attn=True,
     )
     layers, x, m, _, _ = _setup(cfg, n=16, rows=8, cols=16)
-    with pytest.raises(ValueError, match="flat"):
+    with pytest.raises(ValueError, match="sparse"):
         sp_trunk_apply(layers, cfg, x, m, mesh)
+
+
+@pytest.mark.parametrize(
+    "tie,compress,masked",
+    [
+        (False, 1, False),  # cheap fast-tier parity case
+        pytest.param(True, 2, True, marks=pytest.mark.slow),
+    ],
+)
+def test_sp_trunk_aligned_matches_replicated(tie, compress, masked):
+    """ALIGNED cross-attention inside the SP trunk (the north-star mode):
+    per-column-group gather/ring must reproduce the replicated aligned
+    trunk. Pair side 16 over 8 MSA cols -> elongation factor f=2."""
+    if len(jax.devices()) < N_DEV:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = Alphafold2Config(
+        dim=16,
+        depth=1,
+        heads=2,
+        dim_head=8,
+        max_seq_len=64,
+        msa_tie_row_attn=tie,
+        cross_attn_compress_ratio=compress,
+        cross_attn_mode="aligned",
+    )
+    layers, x, m, x_mask, msa_mask = _setup(cfg, n=16, rows=8, cols=8, masked=masked)
+    mesh = make_mesh({"seq": N_DEV})
+
+    want_x, want_m = sequential_trunk_apply(
+        layers, cfg, x, m, x_mask=x_mask, msa_mask=msa_mask
+    )
+    got_x, got_m = sp_trunk_apply(
+        layers, cfg, x, m, mesh, x_mask=x_mask, msa_mask=msa_mask
+    )
+
+    def valid_sel(mask, arr):
+        return np.asarray(arr)[np.asarray(mask)] if mask is not None else np.asarray(arr)
+
+    np.testing.assert_allclose(
+        valid_sel(x_mask, got_x), valid_sel(x_mask, want_x), atol=5e-4
+    )
+    np.testing.assert_allclose(
+        valid_sel(msa_mask, got_m), valid_sel(msa_mask, want_m), atol=5e-4
+    )
 
 
 @pytest.mark.slow
