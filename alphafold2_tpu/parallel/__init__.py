@@ -15,6 +15,8 @@ from alphafold2_tpu.parallel.sharding import (
 )
 from alphafold2_tpu.parallel.train import (
     make_sharded_train_step,
+    make_sp_train_step,
+    sp_distogram_loss_fn,
     sharded_train_state_init,
 )
 from alphafold2_tpu.parallel.sequence import (
@@ -51,5 +53,7 @@ __all__ = [
     "batch_shardings",
     "replicated",
     "make_sharded_train_step",
+    "make_sp_train_step",
+    "sp_distogram_loss_fn",
     "sharded_train_state_init",
 ]

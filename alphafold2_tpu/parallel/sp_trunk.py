@@ -420,9 +420,11 @@ def alphafold2_apply_sp(
     axes sharded. Parity with the replicated `alphafold2_apply` is tested
     full-model on the 8-device mesh (tests/test_sp_trunk.py).
 
-    Requires a token MSA (the embedds grid-stream substitute has no row
-    axis to shard), the sequential trunk, and the sp_trunk_apply
-    constraints (deterministic, no sparse layers).
+    Works with a token MSA (rows sharded) or msa=None (pair-grid-only
+    distogram pretraining — the MSA branch is skipped, reference
+    alphafold2.py:311). The embedds path is unsupported (its substitute
+    stream has no row axis to shard). Requires the sequential trunk and the
+    sp_trunk_apply constraints (deterministic, no sparse layers).
     """
     from alphafold2_tpu.models.alphafold2 import alphafold2_apply
 
@@ -431,8 +433,6 @@ def alphafold2_apply_sp(
             "sequence-parallel trunk uses the sequential layer list; "
             "set reversible=False (memory scales via sharding instead)"
         )
-    if msa is None:
-        raise ValueError("alphafold2_apply_sp requires a token MSA")
 
     def trunk_fn(layers, cfg_, x, m, x_mask, m_mask, rng):
         del rng  # deterministic path (sp_trunk_apply contract)

@@ -89,3 +89,46 @@ def make_sharded_train_step(
         donate_argnums=(0,) if donate_state else (),
     )
     return jitted, st_shardings
+
+
+def sp_distogram_loss_fn(mesh: Mesh, axis_name: str = "seq"):
+    """Distogram loss with the trunk SEQUENCE-parallel over `mesh[axis_name]`.
+
+    The training configuration the north-star workload actually runs in:
+    per-step batch 1, the pair grid too big for one chip, so the grid (not
+    the batch) is what shards. Params and optimizer state stay replicated;
+    gradients of the shard_map trunk are globally correct through the
+    collective transposes (psum/ppermute/all_to_all) — parity-tested in
+    tests/test_sp_trunk.py. Deterministic path (rng unused: sp_trunk_apply
+    contract).
+    """
+    from alphafold2_tpu.parallel.sp_trunk import alphafold2_apply_sp
+    from alphafold2_tpu.training.harness import make_distogram_loss_fn
+
+    def sp_apply(params, cfg, seq, msa, *, mask, msa_mask, rng):
+        del rng  # deterministic path (sp_trunk_apply contract)
+        return alphafold2_apply_sp(
+            params, cfg, seq, msa, mesh,
+            axis_name=axis_name, mask=mask, msa_mask=msa_mask,
+        )
+
+    return make_distogram_loss_fn(sp_apply)
+
+
+def make_sp_train_step(
+    cfg,
+    tcfg: TrainConfig,
+    mesh: Mesh,
+    *,
+    axis_name: str = "seq",
+    donate_state: bool = True,
+):
+    """Jitted distogram train step with the trunk sequence-parallel.
+
+    The step signature matches make_train_step: (state, batch, rng) ->
+    (state, metrics), batch leaves carrying (grad_accum, batch, ...)
+    leading axes. The sequence length must satisfy the sp_trunk_apply
+    divisibility constraints for `mesh[axis_name]`.
+    """
+    step = make_train_step(cfg, tcfg, sp_distogram_loss_fn(mesh, axis_name))
+    return jax.jit(step, donate_argnums=(0,) if donate_state else ())

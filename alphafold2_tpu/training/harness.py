@@ -63,24 +63,32 @@ def train_state_init(key, cfg: Alphafold2Config, tcfg: TrainConfig):
     }
 
 
-def distogram_loss_fn(params, cfg: Alphafold2Config, batch, rng):
-    """Distogram pretraining loss on one microbatch
-    (reference train_pre.py:82-95).
+def make_distogram_loss_fn(apply_fn):
+    """Build the distogram pretraining loss around any model apply function
+    with the alphafold2_apply signature — ONE label/loss construction shared
+    by the replicated and sequence-parallel training paths
+    (parallel/train.py sp_distogram_loss_fn)."""
 
-    batch: {"seq": (b, L) int, "mask": (b, L) bool, "coords": (b, L, 3)
-    C-alpha coords} and optionally {"msa": (b, r, c), "msa_mask"}.
-    """
-    labels = bucketed_distance_matrix(batch["coords"], batch["mask"])
-    logits = alphafold2_apply(
-        params,
-        cfg,
-        batch["seq"],
-        batch.get("msa"),
-        mask=batch["mask"],
-        msa_mask=batch.get("msa_mask"),
-        rng=rng,
-    )
-    return distogram_cross_entropy(logits, labels)
+    def loss_fn(params, cfg: Alphafold2Config, batch, rng):
+        labels = bucketed_distance_matrix(batch["coords"], batch["mask"])
+        logits = apply_fn(
+            params,
+            cfg,
+            batch["seq"],
+            batch.get("msa"),
+            mask=batch["mask"],
+            msa_mask=batch.get("msa_mask"),
+            rng=rng,
+        )
+        return distogram_cross_entropy(logits, labels)
+
+    return loss_fn
+
+
+# Distogram pretraining loss on one microbatch (reference train_pre.py:82-95).
+# batch: {"seq": (b, L) int, "mask": (b, L) bool, "coords": (b, L, 3)
+# C-alpha coords} and optionally {"msa": (b, r, c), "msa_mask"}.
+distogram_loss_fn = make_distogram_loss_fn(alphafold2_apply)
 
 
 def make_train_step(

@@ -48,6 +48,10 @@ def main():
     ap.add_argument("--ckpt-dir", default=None, help="checkpoint/resume directory")
     ap.add_argument("--ckpt-every", type=int, default=50)
     ap.add_argument("--metrics-log", default=None, help="JSONL metrics file")
+    ap.add_argument("--sp-shards", type=int, default=0,
+                    help="shard the pair grid over this many devices "
+                         "(sequence-parallel trunk; --len must be a "
+                         "multiple of it; 0 = replicated)")
     args = ap.parse_args()
 
     # multi-host entry: no-op unless AF2_COORDINATOR/AF2_NUM_PROCESSES/
@@ -130,7 +134,15 @@ def main():
               "resume (only synthetic data is positionally resumable)")
     batches = stack_microbatches(it, tcfg.grad_accum)
 
-    train_step = jax.jit(make_train_step(cfg, tcfg))
+    if args.sp_shards:
+        # sequence-parallel trunk: the pair grid (not the batch) shards —
+        # the regime where crops outgrow one chip (parallel/sp_trunk.py)
+        from alphafold2_tpu.parallel import make_mesh, make_sp_train_step
+
+        mesh = make_mesh({"seq": args.sp_shards})
+        train_step = make_sp_train_step(cfg, tcfg, mesh)
+    else:
+        train_step = jax.jit(make_train_step(cfg, tcfg))
     logger = MetricsLogger(args.metrics_log)
 
     base_rng = jax.random.PRNGKey(1)
