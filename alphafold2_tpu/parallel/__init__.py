@@ -16,6 +16,7 @@ from alphafold2_tpu.parallel.sharding import (
 from alphafold2_tpu.parallel.train import (
     make_sharded_train_step,
     make_sp_train_step,
+    sp_e2e_loss_fn,
     sp_distogram_loss_fn,
     sharded_train_state_init,
 )
@@ -54,6 +55,7 @@ __all__ = [
     "replicated",
     "make_sharded_train_step",
     "make_sp_train_step",
+    "sp_e2e_loss_fn",
     "sp_distogram_loss_fn",
     "sharded_train_state_init",
 ]

@@ -102,17 +102,49 @@ def sp_distogram_loss_fn(mesh: Mesh, axis_name: str = "seq"):
     tests/test_sp_trunk.py. Deterministic path (rng unused: sp_trunk_apply
     contract).
     """
-    from alphafold2_tpu.parallel.sp_trunk import alphafold2_apply_sp
     from alphafold2_tpu.training.harness import make_distogram_loss_fn
 
-    def sp_apply(params, cfg, seq, msa, *, mask, msa_mask, rng):
+    return make_distogram_loss_fn(_sp_model_apply(mesh, axis_name))
+
+
+def _sp_model_apply(mesh: Mesh, axis_name: str):
+    """alphafold2_apply-signature adapter over the sequence-parallel trunk."""
+    from alphafold2_tpu.parallel.sp_trunk import alphafold2_apply_sp
+
+    def apply_fn(params, cfg, seq, msa, *, mask=None, msa_mask=None,
+                 embedds=None, rng=None):
+        if embedds is not None:
+            raise ValueError(
+                "the embedds path has no row axis to shard; use the "
+                "replicated model for embedds input"
+            )
+        if cfg.attn_dropout > 0.0 or cfg.ff_dropout > 0.0:
+            # rng is silently dropped below (sp_trunk_apply is
+            # deterministic); with dropout configured that would train a
+            # silently-different model than the replicated path
+            raise ValueError(
+                "the sequence-parallel trunk is deterministic; set "
+                "attn_dropout=0 and ff_dropout=0 (or train replicated)"
+            )
         del rng  # deterministic path (sp_trunk_apply contract)
         return alphafold2_apply_sp(
             params, cfg, seq, msa, mesh,
             axis_name=axis_name, mask=mask, msa_mask=msa_mask,
         )
 
-    return make_distogram_loss_fn(sp_apply)
+    return apply_fn
+
+
+def sp_e2e_loss_fn(mesh: Mesh, axis_name: str = "seq"):
+    """The FULL structure loss (distogram -> MDS -> sidechain -> refiner ->
+    Kabsch RMSD) with the trunk sequence-parallel — the north-star
+    multi-chip training configuration. Trunk runs under shard_map; the
+    geometry pipeline and refiner run replicated (negligible share). The
+    elongated pair side (3L) and MSA rows must divide `mesh[axis_name]`.
+    """
+    from alphafold2_tpu.training.e2e import make_e2e_loss_fn
+
+    return make_e2e_loss_fn(_sp_model_apply(mesh, axis_name))
 
 
 def make_sp_train_step(
@@ -122,13 +154,18 @@ def make_sp_train_step(
     *,
     axis_name: str = "seq",
     donate_state: bool = True,
+    loss_fn: Optional[Callable] = None,
 ):
-    """Jitted distogram train step with the trunk sequence-parallel.
+    """Jitted train step with the trunk sequence-parallel.
 
-    The step signature matches make_train_step: (state, batch, rng) ->
-    (state, metrics), batch leaves carrying (grad_accum, batch, ...)
-    leading axes. The sequence length must satisfy the sp_trunk_apply
+    loss_fn defaults to the distogram pretraining loss; pass
+    `sp_e2e_loss_fn(mesh)` (with cfg=E2EConfig) for the full structure
+    workload. The step signature matches make_train_step: (state, batch,
+    rng) -> (state, metrics), batch leaves carrying (grad_accum, batch,
+    ...) leading axes. The sequence length must satisfy the sp_trunk_apply
     divisibility constraints for `mesh[axis_name]`.
     """
-    step = make_train_step(cfg, tcfg, sp_distogram_loss_fn(mesh, axis_name))
+    step = make_train_step(
+        cfg, tcfg, loss_fn or sp_distogram_loss_fn(mesh, axis_name)
+    )
     return jax.jit(step, donate_argnums=(0,) if donate_state else ())

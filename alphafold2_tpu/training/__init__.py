@@ -29,6 +29,7 @@ from alphafold2_tpu.training.data import (
 from alphafold2_tpu.training.e2e import (
     E2EConfig,
     e2e_loss_fn,
+    make_e2e_loss_fn,
     e2e_train_state_init,
     predict_structure,
 )
@@ -56,6 +57,7 @@ __all__ = [
     "restore_or_init",
     "E2EConfig",
     "e2e_loss_fn",
+    "make_e2e_loss_fn",
     "e2e_train_state_init",
     "predict_structure",
     "synthetic_structure_batches",

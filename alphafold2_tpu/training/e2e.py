@@ -74,14 +74,20 @@ def elongate(seq, factor: int = 3):
     return jnp.repeat(seq, factor, axis=-1)
 
 
-def predict_structure(params, ecfg: E2EConfig, seq, mask=None, rng=None, msa=None, msa_mask=None, embedds=None):
+def predict_structure(params, ecfg: E2EConfig, seq, mask=None, rng=None, msa=None, msa_mask=None, embedds=None, model_apply_fn=None):
     """Full forward: sequence -> refined (b, L, 14, 3) atom cloud.
 
     params: {"model": ..., "refiner": ...}.
 
+    model_apply_fn: override for the trunk forward with the
+    alphafold2_apply signature — e.g. the sequence-parallel apply
+    (parallel/train.py sp_e2e_loss_fn). Geometry, MDS, and the refiner
+    always run replicated (negligible FLOPs/memory share).
+
     Returns dict with refined cloud, proto cloud, distogram weights, and the
     atom cloud mask.
     """
+    apply_fn = model_apply_fn if model_apply_fn is not None else alphafold2_apply
     b, length = seq.shape
     seq3 = elongate(seq)
     mask3 = elongate(mask) if mask is not None else None
@@ -91,7 +97,7 @@ def predict_structure(params, ecfg: E2EConfig, seq, mask=None, rng=None, msa=Non
     else:
         rng_model, rng_mds = None, jax.random.PRNGKey(0)
 
-    logits = alphafold2_apply(
+    logits = apply_fn(
         params["model"], ecfg.model, seq3, msa,
         mask=mask3, msa_mask=msa_mask, embedds=embedds, rng=rng_model,
     )  # (b, 3L, 3L, buckets)
@@ -140,47 +146,59 @@ def predict_structure(params, ecfg: E2EConfig, seq, mask=None, rng=None, msa=Non
     }
 
 
-def e2e_loss_fn(params, ecfg: E2EConfig, batch, rng):
-    """Kabsch-aligned RMSD + dispersion loss on one microbatch
-    (reference train_end2end.py:172-176).
+def make_e2e_loss_fn(model_apply_fn=None):
+    """Build the e2e structure loss around any model apply function — ONE
+    loss construction shared by the replicated and sequence-parallel paths
+    (parallel/train.py sp_e2e_loss_fn)."""
 
-    batch: {"seq": (b, L) int, "mask": (b, L) bool,
-            "coords": (b, L, 14, 3) ground-truth atom cloud,
-            optional "atom_mask": (b, L, 14) bool — per-atom resolution
-            (sidechainnet zero-pads unresolved atoms; without this they
-            would enter the loss as ground truth at the origin)}.
-    """
-    out = predict_structure(
-        params, ecfg, batch["seq"], mask=batch.get("mask"), rng=rng,
-        msa=batch.get("msa"), msa_mask=batch.get("msa_mask"),
-        embedds=batch.get("embedds"),
-    )
-    b, length = batch["seq"].shape
-    num_atoms = length * NUM_COORDS_PER_RES
-    w = out["cloud_mask"].reshape(b, num_atoms).astype(jnp.float32)
-    atom_mask = batch.get("atom_mask")
-    if atom_mask is not None:
-        w = w * atom_mask.reshape(b, num_atoms).astype(jnp.float32)
+    def loss_fn(params, ecfg: E2EConfig, batch, rng):
+        """Kabsch-aligned RMSD + dispersion loss on one microbatch
+        (reference train_end2end.py:172-176).
 
-    pred = jnp.transpose(out["refined"].reshape(b, num_atoms, 3), (0, 2, 1))
-    true = jnp.transpose(
-        jnp.asarray(batch["coords"], jnp.float32).reshape(b, num_atoms, 3), (0, 2, 1)
-    )
-    pred_aligned, true_centered = kabsch(pred, true, weights=w)
+        batch: {"seq": (b, L) int, "mask": (b, L) bool,
+                "coords": (b, L, 14, 3) ground-truth atom cloud,
+                optional "atom_mask": (b, L, 14) bool — per-atom resolution
+                (sidechainnet zero-pads unresolved atoms; without this they
+                would enter the loss as ground truth at the origin)}.
+        """
+        out = predict_structure(
+            params, ecfg, batch["seq"], mask=batch.get("mask"), rng=rng,
+            msa=batch.get("msa"), msa_mask=batch.get("msa_mask"),
+            embedds=batch.get("embedds"), model_apply_fn=model_apply_fn,
+        )
+        b, length = batch["seq"].shape
+        num_atoms = length * NUM_COORDS_PER_RES
+        w = out["cloud_mask"].reshape(b, num_atoms).astype(jnp.float32)
+        atom_mask = batch.get("atom_mask")
+        if atom_mask is not None:
+            w = w * atom_mask.reshape(b, num_atoms).astype(jnp.float32)
 
-    sq = jnp.sum(jnp.square(pred_aligned - true_centered), axis=-2)  # (b, A)
-    denom = jnp.maximum(jnp.sum(w, axis=-1), 1.0)
-    rmsd = jnp.sqrt(jnp.sum(sq * w, axis=-1) / denom)  # (b,)
+        pred = jnp.transpose(out["refined"].reshape(b, num_atoms, 3), (0, 2, 1))
+        true = jnp.transpose(
+            jnp.asarray(batch["coords"], jnp.float32).reshape(b, num_atoms, 3),
+            (0, 2, 1),
+        )
+        pred_aligned, true_centered = kabsch(pred, true, weights=w)
 
-    # dispersion penalty over UNCENSORED pairs only: censored pairs (weight
-    # hard-zeroed by center_distogram for beyond-last-bucket predictions)
-    # would add a huge ~1/eps constant with exactly zero gradient, drowning
-    # the RMSD signal in the reported loss
-    dw = out["distogram_weights"]
-    valid = (dw > 0).astype(jnp.float32)
-    per_pair = jnp.abs(1.0 / (dw + ecfg.weights_eps) - 1.0) * valid
-    dispersion = jnp.sum(per_pair) / jnp.maximum(jnp.sum(valid), 1.0)
-    return jnp.mean(rmsd) + ecfg.dispersion_weight * dispersion
+        sq = jnp.sum(jnp.square(pred_aligned - true_centered), axis=-2)  # (b, A)
+        denom = jnp.maximum(jnp.sum(w, axis=-1), 1.0)
+        rmsd = jnp.sqrt(jnp.sum(sq * w, axis=-1) / denom)  # (b,)
+
+        # dispersion penalty over UNCENSORED pairs only: censored pairs
+        # (weight hard-zeroed by center_distogram for beyond-last-bucket
+        # predictions) would add a huge ~1/eps constant with exactly zero
+        # gradient, drowning the RMSD signal in the reported loss
+        dw = out["distogram_weights"]
+        valid = (dw > 0).astype(jnp.float32)
+        per_pair = jnp.abs(1.0 / (dw + ecfg.weights_eps) - 1.0) * valid
+        dispersion = jnp.sum(per_pair) / jnp.maximum(jnp.sum(valid), 1.0)
+        return jnp.mean(rmsd) + ecfg.dispersion_weight * dispersion
+
+    return loss_fn
+
+
+# the default (replicated-model) e2e loss
+e2e_loss_fn = make_e2e_loss_fn()
 
 
 def e2e_train_state_init(key, ecfg: E2EConfig, tcfg):
