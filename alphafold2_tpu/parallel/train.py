@@ -140,7 +140,8 @@ def sp_e2e_loss_fn(mesh: Mesh, axis_name: str = "seq"):
     Kabsch RMSD) with the trunk sequence-parallel — the north-star
     multi-chip training configuration. Trunk runs under shard_map; the
     geometry pipeline and refiner run replicated (negligible share). The
-    elongated pair side (3L) and MSA rows must divide `mesh[axis_name]`.
+    `mesh[axis_name]` size must divide the elongated pair side (3L) and
+    the MSA row count.
     """
     from alphafold2_tpu.training.e2e import make_e2e_loss_fn
 

@@ -31,6 +31,10 @@ def main():
     ap.add_argument("--ckpt-dir", default=None, help="restore trained params")
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sp-shards", type=int, default=0,
+                    help="run the trunk sequence-parallel over this many "
+                         "devices (sequence length must be a multiple of "
+                         "it; 0 = single-device)")
     args = ap.parse_args()
 
     import jax.numpy as jnp
@@ -70,9 +74,18 @@ def main():
         print("no --ckpt-dir: using randomly initialized params")
         params = alphafold2_init(jax.random.PRNGKey(0), cfg)
 
-    logits = jax.jit(
-        lambda p, t: alphafold2_apply(p, cfg, t, None)
-    )(params, tokens)  # (1, L, L, 37)
+    if args.sp_shards:
+        # trunk sequence-parallel over the mesh; embeddings/head replicated
+        from alphafold2_tpu.parallel import alphafold2_apply_sp, make_mesh
+
+        mesh = make_mesh({"seq": args.sp_shards})
+        logits = jax.jit(
+            lambda p, t: alphafold2_apply_sp(p, cfg, t, None, mesh)
+        )(params, tokens)  # (1, L, L, 37)
+    else:
+        logits = jax.jit(
+            lambda p, t: alphafold2_apply(p, cfg, t, None)
+        )(params, tokens)  # (1, L, L, 37)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     distances, weights = center_distogram(probs)
 

@@ -47,6 +47,10 @@ def main():
                     help="truncate MDS backprop to the last K iterations "
                          "(implicit-diff approximation; None = full unroll)")
     ap.add_argument("--refiner-depth", type=int, default=2)
+    ap.add_argument("--sp-shards", type=int, default=0,
+                    help="shard the trunk sequence-parallel over this many "
+                         "devices (3*--len and MSA rows must be multiples "
+                         "of it; deterministic path; 0 = replicated)")
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     # the reference's FEATURES switch (reference train_end2end.py:20-28):
@@ -175,7 +179,15 @@ def main():
         it = with_embedds(it)
 
     batches = stack_microbatches(it, tcfg.grad_accum)
-    train_step = jax.jit(make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn))
+    if args.sp_shards:
+        from alphafold2_tpu.parallel import make_mesh, make_sp_train_step, sp_e2e_loss_fn
+
+        mesh = make_mesh({"seq": args.sp_shards})
+        train_step = make_sp_train_step(
+            ecfg, tcfg, mesh, loss_fn=sp_e2e_loss_fn(mesh)
+        )
+    else:
+        train_step = jax.jit(make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn))
 
     from alphafold2_tpu.training import predict_structure
     from alphafold2_tpu.utils import MetricsLogger, structure_eval
