@@ -205,7 +205,12 @@ def alphafold2_apply(
       msa: (b, rows, cols) int tokens, or None.
       mask: (b, n) bool.
       msa_mask: (b, rows, cols) bool.
-      templates: (b, T, n, n) int distogram buckets.
+      templates: (b, T, n, n) — int distogram buckets, or FLOAT raw
+        pairwise distances in Angstroms, which are binned internally with
+        the library thresholds (completes the reference's declared TODO
+        "allow the main network to take care of binning raw template
+        distograms", reference README.md:158; binning matches
+        geometry.bucketize_distances / utils.py:29 thresholds).
       templates_mask: (b, T, n, n) bool.
       embedds: (b, n, num_embedds) precomputed language-model embeddings,
         used as the MSA-replacement stream when msa is None.
@@ -269,6 +274,19 @@ def alphafold2_apply(
 
     # template tower (reference :479-524)
     if templates is not None:
+        if jnp.issubdtype(jnp.asarray(templates).dtype, jnp.floating):
+            # raw Angstrom distances -> bucket ints (reference README.md:158
+            # TODO, completed): same thresholds as the distogram head
+            # thresholds scale with the config's bucket count so labels
+            # always fit the template_emb table; at the default
+            # num_buckets=37 this IS constants.DISTANCE_THRESHOLDS
+            # (linspace(2, 20, 37), reference utils.py:29)
+            bins = jnp.linspace(2.0, 20.0, cfg.num_buckets)
+            # searchsorted over bins[:-1] -> labels in [0, num_buckets-1],
+            # identical to geometry.bucketize_distances
+            templates = jnp.searchsorted(
+                bins[:-1], jnp.asarray(templates, jnp.float32)
+            ).astype(jnp.int32)
         x = _template_tower_apply(
             params, cfg, x, x_mask, templates, templates_mask, rng_tower
         )

@@ -108,3 +108,41 @@ def test_scan_layers_matches_unrolled():
     got = sequential_trunk_apply(layers, cfg_s, x, m, rng=rng)
     for g, w in zip(got, want):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-5)
+
+
+def test_raw_distance_templates_match_prebinned():
+    """Float templates (raw Angstrom distances) are binned internally with
+    the library thresholds — the model output must equal passing the same
+    distances pre-binned by geometry.bucketize_distances semantics
+    (completes the reference README.md:158 TODO)."""
+    import numpy as np
+
+    from alphafold2_tpu.constants import DISTANCE_THRESHOLDS
+    from alphafold2_tpu.models import (
+        Alphafold2Config,
+        alphafold2_apply,
+        alphafold2_init,
+    )
+
+    cfg = Alphafold2Config(dim=32, depth=1, heads=2, dim_head=8, max_seq_len=32)
+    params = alphafold2_init(jax.random.PRNGKey(0), cfg)
+    rs = np.random.RandomState(0)
+    seq = jnp.asarray(rs.randint(0, 21, (1, 12)))
+    msa = jnp.asarray(rs.randint(0, 21, (1, 3, 12)))
+    # raw distances spanning below/inside/above the [2, 20] threshold range
+    raw = jnp.asarray(rs.uniform(0.0, 25.0, (1, 2, 12, 12)).astype(np.float32))
+    tmask = jnp.ones((1, 2, 12, 12), bool)
+
+    bins = np.asarray(DISTANCE_THRESHOLDS, np.float32)
+    prebinned = jnp.asarray(
+        np.searchsorted(bins[:-1], np.asarray(raw)).astype(np.int32)
+    )
+    assert int(prebinned.max()) == cfg.num_buckets - 1  # top bucket exercised
+
+    out_raw = alphafold2_apply(
+        params, cfg, seq, msa, templates=raw, templates_mask=tmask
+    )
+    out_pre = alphafold2_apply(
+        params, cfg, seq, msa, templates=prebinned, templates_mask=tmask
+    )
+    np.testing.assert_array_equal(np.asarray(out_raw), np.asarray(out_pre))

@@ -207,3 +207,4 @@ def test_remat_trunk_parity():
 def test_reversible_and_remat_mutually_exclusive():
     with pytest.raises(ValueError):
         Alphafold2Config(dim=32, depth=2, reversible=True, remat=True)
+
