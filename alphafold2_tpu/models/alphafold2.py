@@ -277,13 +277,17 @@ def alphafold2_apply(
         if jnp.issubdtype(jnp.asarray(templates).dtype, jnp.floating):
             # raw Angstrom distances -> bucket ints (reference README.md:158
             # TODO, completed): same thresholds as the distogram head
-            # thresholds scale with the config's bucket count so labels
-            # always fit the template_emb table; at the default
-            # num_buckets=37 this IS constants.DISTANCE_THRESHOLDS
-            # (linspace(2, 20, 37), reference utils.py:29)
-            bins = jnp.linspace(2.0, 20.0, cfg.num_buckets)
-            # searchsorted over bins[:-1] -> labels in [0, num_buckets-1],
-            # identical to geometry.bucketize_distances
+            from alphafold2_tpu.constants import DISTANCE_THRESHOLDS
+
+            # one source of truth: the library threshold table, resampled
+            # to the config's bucket count so labels always fit the
+            # template_emb table. At the default num_buckets=37 this IS
+            # DISTANCE_THRESHOLDS, and searchsorted over bins[:-1] matches
+            # geometry.bucketize_distances exactly.
+            thresholds = jnp.asarray(DISTANCE_THRESHOLDS, jnp.float32)
+            bins = jnp.linspace(
+                thresholds[0], thresholds[-1], cfg.num_buckets
+            )
             templates = jnp.searchsorted(
                 bins[:-1], jnp.asarray(templates, jnp.float32)
             ).astype(jnp.int32)

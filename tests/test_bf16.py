@@ -1,0 +1,111 @@
+"""bfloat16 numerics: the north-star workload computes in bf16 (bench.py,
+PERF.md) but parity tests run f32 — this file closes that gap on CPU.
+
+Contract being tested (models/config.py dtype, training/e2e.py): params
+live in f32, compute casts to cfg.dtype, softmax/statistics accumulate in
+f32 (ops/attention.py, ops/flash.py), and the geometry pipeline always
+runs f32 regardless of the trunk dtype (predict_structure casts logits).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu.models import Alphafold2Config, alphafold2_apply, alphafold2_init
+
+
+def _toy(dtype, **kw):
+    return Alphafold2Config(
+        dim=32, depth=2, heads=2, dim_head=8, max_seq_len=32, dtype=dtype, **kw
+    )
+
+
+def test_model_forward_bf16_close_to_f32():
+    cfg16 = _toy(jnp.bfloat16, msa_tie_row_attn=True, cross_attn_compress_ratio=2)
+    cfg32 = _toy(jnp.float32, msa_tie_row_attn=True, cross_attn_compress_ratio=2)
+    params = alphafold2_init(jax.random.PRNGKey(0), cfg32)  # f32 params shared
+
+    rs = np.random.RandomState(0)
+    seq = jnp.asarray(rs.randint(0, 21, (1, 12)))
+    msa = jnp.asarray(rs.randint(0, 21, (1, 3, 12)))
+
+    out16 = alphafold2_apply(params, cfg16, seq, msa)
+    out32 = alphafold2_apply(params, cfg32, seq, msa)
+    assert out16.dtype == jnp.bfloat16
+    a, b = np.asarray(out16, np.float32), np.asarray(out32)
+    assert np.isfinite(a).all()
+    # bf16 has ~3 decimal digits; logits are O(1) at init
+    np.testing.assert_allclose(a, b, atol=0.15)
+    # and the derived distogram distributions agree closely
+    p16 = np.asarray(jax.nn.softmax(jnp.asarray(a), axis=-1))
+    p32 = np.asarray(jax.nn.softmax(jnp.asarray(b), axis=-1))
+    assert np.abs(p16 - p32).max() < 0.02
+
+
+def test_reversible_bf16_forward_and_grad_finite():
+    cfg = _toy(jnp.bfloat16, reversible=True, msa_tie_row_attn=True)
+    params = alphafold2_init(jax.random.PRNGKey(0), cfg)
+    rs = np.random.RandomState(1)
+    seq = jnp.asarray(rs.randint(0, 21, (1, 12)))
+    msa = jnp.asarray(rs.randint(0, 21, (1, 3, 12)))
+    targets = jnp.asarray(rs.randint(0, 37, (1, 12, 12)))
+
+    def loss(p):
+        logits = alphafold2_apply(p, cfg, seq, msa).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, targets[..., None], -1))
+
+    val, grads = jax.value_and_grad(loss)(params)
+    assert np.isfinite(float(val))
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in leaves)
+    assert sum(float(jnp.abs(g).sum()) for g in leaves) > 0
+
+
+def test_e2e_bf16_keeps_geometry_f32():
+    """The structure pipeline divides by distances/weights — bf16 there
+    NaNs. predict_structure must cast to f32 before geometry even when the
+    trunk computes bf16 (training/e2e.py)."""
+    from alphafold2_tpu.models import RefinerConfig
+    from alphafold2_tpu.training import E2EConfig, predict_structure
+
+    ecfg = E2EConfig(
+        model=_toy(jnp.bfloat16),
+        refiner=RefinerConfig(num_tokens=14, dim=16, depth=1, msg_dim=16,
+                              dtype=jnp.bfloat16),
+        mds_iters=3,
+    )
+    params = {
+        "model": alphafold2_init(jax.random.PRNGKey(0), ecfg.model),
+    }
+    from alphafold2_tpu.models import refiner_init
+
+    params["refiner"] = refiner_init(jax.random.PRNGKey(1), ecfg.refiner)
+    rs = np.random.RandomState(2)
+    seq = jnp.asarray(rs.randint(0, 21, (1, 6)))
+    out = predict_structure(params, ecfg, seq, rng=jax.random.PRNGKey(3))
+    refined = np.asarray(out["refined"], np.float32)
+    assert np.isfinite(refined).all()
+    assert out["distogram_weights"].dtype == jnp.float32
+
+
+def test_flash_streaming_bf16_matches_dense_bf16():
+    from alphafold2_tpu.ops.flash import blockwise_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    q = jax.random.normal(ks[0], (2, 40, 2, 8), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (2, 40, 2, 8), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (2, 40, 2, 8), jnp.bfloat16)
+    bias = jnp.where(jnp.arange(40) < 33, 0.0, -jnp.inf)[None].repeat(2, 0)
+
+    got = blockwise_attention(q, k, v, bias, tile_elems=1 << 10, kv_block=16)
+    # dense oracle in the SAME dtype discipline: f32 logits/softmax, bf16 AV
+    logits = jnp.einsum("bihd,bjhd->bhij", q, k).astype(jnp.float32) * 8 ** -0.5
+    logits = logits + bias[:, None, None, :]
+    attn = jax.nn.softmax(logits, axis=-1)
+    want = jnp.einsum("bhij,bjhd->bihd", attn.astype(jnp.bfloat16), v)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), atol=0.05
+    )

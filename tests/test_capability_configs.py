@@ -115,14 +115,7 @@ def test_raw_distance_templates_match_prebinned():
     the library thresholds — the model output must equal passing the same
     distances pre-binned by geometry.bucketize_distances semantics
     (completes the reference README.md:158 TODO)."""
-    import numpy as np
-
     from alphafold2_tpu.constants import DISTANCE_THRESHOLDS
-    from alphafold2_tpu.models import (
-        Alphafold2Config,
-        alphafold2_apply,
-        alphafold2_init,
-    )
 
     cfg = Alphafold2Config(dim=32, depth=1, heads=2, dim_head=8, max_seq_len=32)
     params = alphafold2_init(jax.random.PRNGKey(0), cfg)
