@@ -277,19 +277,22 @@ def alphafold2_apply(
         if jnp.issubdtype(jnp.asarray(templates).dtype, jnp.floating):
             # raw Angstrom distances -> bucket ints (reference README.md:158
             # TODO, completed): same thresholds as the distogram head
+            import numpy as np
+
             from alphafold2_tpu.constants import DISTANCE_THRESHOLDS
 
-            # one source of truth: the library threshold table, resampled
-            # to the config's bucket count so labels always fit the
-            # template_emb table. At the default num_buckets=37 this IS
-            # DISTANCE_THRESHOLDS, and searchsorted over bins[:-1] matches
-            # geometry.bucketize_distances exactly.
-            thresholds = jnp.asarray(DISTANCE_THRESHOLDS, jnp.float32)
-            bins = jnp.linspace(
-                thresholds[0], thresholds[-1], cfg.num_buckets
-            )
+            # one source of truth: the library threshold table itself at
+            # the default bucket count (searchsorted over bins[:-1] then
+            # matches geometry.bucketize_distances EXACTLY, whatever the
+            # table's spacing); other bucket counts resample its range so
+            # labels always fit the template_emb table
+            table = np.asarray(DISTANCE_THRESHOLDS, np.float32)
+            if cfg.num_buckets == len(table):
+                bins = table
+            else:
+                bins = np.linspace(table[0], table[-1], cfg.num_buckets)
             templates = jnp.searchsorted(
-                bins[:-1], jnp.asarray(templates, jnp.float32)
+                jnp.asarray(bins[:-1]), jnp.asarray(templates, jnp.float32)
             ).astype(jnp.int32)
         x = _template_tower_apply(
             params, cfg, x, x_mask, templates, templates_mask, rng_tower

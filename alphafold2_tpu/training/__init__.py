@@ -20,6 +20,8 @@ from alphafold2_tpu.training.harness import (
 )
 from alphafold2_tpu.training.data import (
     DataConfig,
+    bucket_batches,
+    bucketed_microbatches,
     stack_microbatches,
     synthetic_batches,
     synthetic_structure_batches,
@@ -70,6 +72,8 @@ __all__ = [
     "make_train_step",
     "train_state_init",
     "DataConfig",
+    "bucket_batches",
+    "bucketed_microbatches",
     "stack_microbatches",
     "synthetic_batches",
     "sidechainnet_batches",

@@ -110,6 +110,75 @@ def stack_microbatches(it: Iterator[dict], grad_accum: int) -> Iterator[dict]:
         yield {k: np.stack([m[k] for m in mbs]) for k in mbs[0]}
 
 
+def bucket_batches(
+    items: Iterator[tuple],
+    cfg: DataConfig,
+    buckets: tuple,
+    full_atom: bool = False,
+) -> Iterator[dict]:
+    """Static-shape LENGTH BUCKETING over a stream of variable-length
+    proteins (SURVEY.md hard-part #3: the reference filters `len < 250`
+    dynamically, reference train_pre.py:54 — XLA wants a small closed set
+    of shapes instead).
+
+    items: yields (seq_ints (L,), cloud (L, 14, 3)) pairs of arbitrary L —
+      the native prefetch pool's item layout (runtime/native.py).
+    buckets: ascending lengths, e.g. (64, 128, 256). A protein goes to the
+      smallest bucket that holds it (cropped to the largest otherwise),
+      padded to the bucket length; a batch is emitted when its bucket has
+      `cfg.batch_size` proteins. Each emitted batch carries a `bucket` key
+      (python int — jit recompiles once per bucket, then caches).
+
+    Yields the same dict layout as the other sources: seq/mask + coords
+    (b, L, 3) C-alpha, or full_atom coords (b, L, 14, 3) + atom_mask.
+    """
+    buckets = tuple(sorted(int(x) for x in buckets))
+    if not buckets:
+        raise ValueError("need at least one bucket length")
+    pending: dict = {bl: [] for bl in buckets}
+    b = cfg.batch_size
+    for seq, cloud in items:
+        L = len(seq)
+        bl = next((x for x in buckets if L <= x), buckets[-1])
+        pending[bl].append((np.asarray(seq)[:bl], np.asarray(cloud)[:bl]))
+        if len(pending[bl]) < b:
+            continue
+        group, pending[bl] = pending[bl], []
+        seq_out = np.zeros((b, bl), np.int32)
+        mask = np.zeros((b, bl), bool)
+        cloud_out = np.zeros((b, bl, 14, 3), np.float32)
+        for row, (s, c) in enumerate(group):
+            n = min(len(s), len(c))
+            seq_out[row, :n] = s[:n]
+            cloud_out[row, :n] = c[:n]
+            mask[row, :n] = True
+        batch = {"seq": seq_out, "mask": mask, "bucket": bl}
+        if full_atom:
+            batch["coords"] = cloud_out
+            batch["atom_mask"] = np.abs(cloud_out).sum(-1) > 0
+        else:
+            batch["coords"] = cloud_out[:, :, 1]  # C-alpha slot
+        yield batch
+
+
+def bucketed_microbatches(it: Iterator[dict], grad_accum: int) -> Iterator[dict]:
+    """stack_microbatches for a bucketed stream: microbatches in one
+    stacked group must share a shape, so accumulation is per bucket —
+    groups are emitted as soon as any bucket has `grad_accum` batches."""
+    pending: dict = {}
+    for batch in it:
+        bl = batch["bucket"]
+        pending.setdefault(bl, []).append(batch)
+        if len(pending[bl]) < grad_accum:
+            continue
+        mbs = pending.pop(bl)
+        out = {
+            k: np.stack([m[k] for m in mbs]) for k in mbs[0] if k != "bucket"
+        }
+        out["bucket"] = bl
+        yield out
+
+
 def _sidechainnet_gen(
     cfg: DataConfig,
     casp_version: int,

@@ -48,6 +48,14 @@ def main():
     ap.add_argument("--ckpt-dir", default=None, help="checkpoint/resume directory")
     ap.add_argument("--ckpt-every", type=int, default=50)
     ap.add_argument("--metrics-log", default=None, help="JSONL metrics file")
+    ap.add_argument("--len-buckets", default=None,
+                    help="comma-separated static length buckets (e.g. "
+                         "64,128,256): variable-length proteins batch into "
+                         "the smallest holding bucket instead of all "
+                         "padding to --len (one jit compile per bucket). "
+                         "Applies to --data native; batch assembly runs on "
+                         "the Python thread (bypasses the C++ prefetch "
+                         "loader).")
     ap.add_argument("--sp-shards", type=int, default=0,
                     help="shard the pair grid over this many devices "
                          "(sequence-parallel trunk; --len must be a "
@@ -105,23 +113,47 @@ def main():
                 3.8 * rs.randn(L, 14, 3).astype(np.float32), axis=0
             )
             pool.append((seq, cloud))
-        loader = NativePrefetchLoader(
-            pool, batch_size=args.batch, max_len=args.max_len,
-            seed=dcfg.seed, n_threads=2,
-        )
-        print(f"native prefetch loader: {'C++' if loader.native else 'python fallback'}")
+        if args.len_buckets:
+            # length bucketing: a closed set of static shapes instead of
+            # one big pad target (training/data.py bucket_batches)
+            from alphafold2_tpu.training import bucket_batches
 
-        def native_gen():
-            while True:
-                b = loader.next()
-                yield {
-                    "seq": b["seq"],
-                    "mask": b["mask"],
-                    # CA trace (atom slot 1) drives the distogram labels
-                    "coords": b["coords"][:, :, 1],
-                }
+            buckets = tuple(int(x) for x in args.len_buckets.split(","))
+            if args.sp_shards:
+                bad = [b for b in buckets if b % args.sp_shards]
+                if bad:
+                    raise SystemExit(
+                        f"--len-buckets {bad} not divisible by "
+                        f"--sp-shards {args.sp_shards} (sp_trunk needs the "
+                        f"pair side to divide the mesh axis)"
+                    )
 
-        it = native_gen()
+            def pool_items():
+                prng = np.random.RandomState(dcfg.seed + 1)
+                while True:
+                    yield pool[prng.randint(len(pool))]
+
+            it = bucket_batches(pool_items(), dcfg, buckets)
+            print(f"length buckets: {buckets}")
+        else:
+            loader = NativePrefetchLoader(
+                pool, batch_size=args.batch, max_len=args.max_len,
+                seed=dcfg.seed, n_threads=2,
+            )
+            print("native prefetch loader: "
+                  f"{'C++' if loader.native else 'python fallback'}")
+
+            def native_gen():
+                while True:
+                    b = loader.next()
+                    yield {
+                        "seq": b["seq"],
+                        "mask": b["mask"],
+                        # CA trace (atom slot 1) drives the distogram labels
+                        "coords": b["coords"][:, :, 1],
+                    }
+
+            it = native_gen()
     if it is None:
         # synthetic batches are a pure function of their index, so a resumed
         # run jumps the stream to the exact position in O(1) (no replay)
@@ -132,7 +164,12 @@ def main():
         # with a fresh shuffle — documented divergence, not silent
         print(f"note: --data {args.data} stream restarts from its top on "
               "resume (only synthetic data is positionally resumable)")
-    batches = stack_microbatches(it, tcfg.grad_accum)
+    if args.len_buckets and args.data == "native":
+        from alphafold2_tpu.training import bucketed_microbatches
+
+        batches = bucketed_microbatches(it, tcfg.grad_accum)
+    else:
+        batches = stack_microbatches(it, tcfg.grad_accum)
 
     if args.sp_shards:
         # sequence-parallel trunk: the pair grid (not the batch) shards —
@@ -153,7 +190,9 @@ def main():
         # per-step key derived from the step index: identical schedule
         # whether the run is fresh or resumed
         step_rng = jax.random.fold_in(base_rng, step)
-        state, metrics = train_step(state, next(batches), step_rng)
+        batch = next(batches)
+        batch.pop("bucket", None)  # shape bookkeeping, not model input
+        state, metrics = train_step(state, batch, step_rng)
         logger.log(step, metrics)
         if step % 10 == 0 or step == start + args.steps - 1:
             dt = time.time() - t0
