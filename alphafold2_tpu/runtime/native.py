@@ -55,6 +55,15 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
             ctypes.c_int, ctypes.c_int,
         ]
+        lib.af2_loader_create2.restype = ctypes.c_void_p
+        lib.af2_loader_create2.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ]
+        lib.af2_loader_next.restype = ctypes.c_int
         lib.af2_loader_next.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
@@ -102,9 +111,18 @@ class NativePrefetchLoader:
 
     def __init__(self, dataset, batch_size: int, max_len: int,
                  atoms_per_res: int = 14, pad_token: int = 20, seed: int = 0,
-                 n_threads: int = 2, queue_capacity: int = 4):
+                 n_threads: int = 2, queue_capacity: int = 4,
+                 buckets: Optional[tuple] = None):
         if not dataset:
             raise ValueError("NativePrefetchLoader needs a non-empty dataset")
+        if buckets:
+            buckets = tuple(sorted(set(int(x) for x in buckets)))
+            if max_len != buckets[-1]:
+                raise ValueError(
+                    f"max_len ({max_len}) must equal the largest bucket "
+                    f"({buckets[-1]}) — the top bucket IS the crop length"
+                )
+        self.buckets = buckets or None
         self.batch = batch_size
         self.max_len = max_len
         self.atoms = atoms_per_res
@@ -127,17 +145,20 @@ class NativePrefetchLoader:
         lib = _load()
         if lib is not None:
             self._lib = lib
-            self._handle = lib.af2_loader_create(
+            bk = np.asarray(self.buckets or (), np.int32)
+            self._handle = lib.af2_loader_create2(
                 self._seqs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
                 self._offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
                 len(seqs),
                 self._coords.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                atoms_per_res, batch_size, max_len, pad_token, seed,
+                atoms_per_res, batch_size, self.max_len, pad_token, seed,
                 n_threads, queue_capacity,
+                bk.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(bk),
             )
         if self._handle is None:
             # pure-python fallback
             self._rng = np.random.RandomState(seed)
+            self._pending = {bl: [] for bl in (self.buckets or ())}
 
     @property
     def native(self) -> bool:
@@ -152,16 +173,29 @@ class NativePrefetchLoader:
             raise RuntimeError("loader is closed")
         b, L, A = self.batch, self.max_len, self.atoms
         if self._handle is not None:
-            seq = np.empty((b, L), np.int32)
-            mask = np.empty((b, L), np.uint8)
-            coords = np.empty((b, L, A, 3), np.float32)
-            self._lib.af2_loader_next(
+            # flat max-size buffers; the C++ side writes COMPACT rows at the
+            # batch's bucket length and returns it, so the filled prefix
+            # reshapes to contiguous (b, bl, ...) arrays with no re-copy
+            seq = np.empty(b * L, np.int32)
+            mask = np.empty(b * L, np.uint8)
+            coords = np.empty(b * L * A * 3, np.float32)
+            bl = self._lib.af2_loader_next(
                 self._handle,
                 seq.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
                 mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
                 coords.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             )
-            return {"seq": seq, "mask": mask.astype(bool), "coords": coords}
+            out = {
+                "seq": seq[: b * bl].reshape(b, bl),
+                "mask": mask[: b * bl].reshape(b, bl).astype(bool),
+                "coords": coords[: b * bl * A * 3].reshape(b, bl, A, 3),
+            }
+            if self.buckets:
+                out["bucket"] = int(bl)
+            return out
+
+        if self.buckets:
+            return self._next_bucketed_py()
 
         seq = np.full((b, L), self.pad_token, np.int32)
         mask = np.zeros((b, L), bool)
@@ -178,6 +212,34 @@ class NativePrefetchLoader:
             mask[i, :length] = True
             coords[i, :length] = self._coords.reshape(-1, A, 3)[sl]
         return {"seq": seq, "mask": mask, "coords": coords}
+
+    def _next_bucketed_py(self) -> dict:
+        """Python-fallback mirror of the C++ bucketed assembly."""
+        b, A = self.batch, self.atoms
+        n_seqs = len(self._offsets) - 1
+        while True:
+            idx = self._rng.randint(n_seqs)
+            length = int(self._offsets[idx + 1] - self._offsets[idx])
+            bl = next((x for x in self.buckets if length <= x), self.buckets[-1])
+            self._pending[bl].append(idx)
+            if len(self._pending[bl]) < b:
+                continue
+            group, self._pending[bl] = self._pending[bl], []
+            seq = np.full((b, bl), self.pad_token, np.int32)
+            mask = np.zeros((b, bl), bool)
+            coords = np.zeros((b, bl, A, 3), np.float32)
+            for i, idx in enumerate(group):
+                beg, end = self._offsets[idx], self._offsets[idx + 1]
+                length = int(end - beg)
+                start = (
+                    self._rng.randint(0, length - bl + 1) if length > bl else 0
+                )
+                length = min(length, bl)
+                sl = slice(int(beg) + start, int(beg) + start + length)
+                seq[i, :length] = self._seqs[sl]
+                mask[i, :length] = True
+                coords[i, :length] = self._coords.reshape(-1, A, 3)[sl]
+            return {"seq": seq, "mask": mask, "coords": coords, "bucket": bl}
 
     def close(self):
         self._closed = True

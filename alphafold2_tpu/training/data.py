@@ -132,15 +132,20 @@ def bucket_batches(
     Yields the same dict layout as the other sources: seq/mask + coords
     (b, L, 3) C-alpha, or full_atom coords (b, L, 14, 3) + atom_mask.
     """
-    buckets = tuple(sorted(int(x) for x in buckets))
+    buckets = tuple(sorted(set(int(x) for x in buckets)))
     if not buckets:
         raise ValueError("need at least one bucket length")
+    rng = np.random.RandomState(cfg.seed)
     pending: dict = {bl: [] for bl in buckets}
     b = cfg.batch_size
     for seq, cloud in items:
         L = len(seq)
         bl = next((x for x in buckets if L <= x), buckets[-1])
-        pending[bl].append((np.asarray(seq)[:bl], np.asarray(cloud)[:bl]))
+        start = rng.randint(0, L - bl + 1) if L > bl else 0  # random crop,
+        # matching the native loader's policy (csrc/af2_runtime.cc fill_row)
+        pending[bl].append(
+            (np.asarray(seq)[start : start + bl], np.asarray(cloud)[start : start + bl])
+        )
         if len(pending[bl]) < b:
             continue
         group, pending[bl] = pending[bl], []

@@ -33,9 +33,14 @@ extern "C" {
 // ---------------------------------------------------------------------------
 
 struct Af2Batch {
-  std::vector<int32_t> seq;    // (batch, max_len)
-  std::vector<uint8_t> mask;   // (batch, max_len)
-  std::vector<float> coords;   // (batch, max_len, atoms_per_res, 3)
+  // COMPACT layout at the batch's own length: (batch, bucket_len[, ...]).
+  // bucket_len == max_len in single-shape mode; bucketed batches carry no
+  // padding columns beyond their bucket, so queue memory and the next()
+  // memcpy scale with the bucket, not the largest bucket.
+  std::vector<int32_t> seq;    // (batch, bucket_len)
+  std::vector<uint8_t> mask;   // (batch, bucket_len)
+  std::vector<float> coords;   // (batch, bucket_len, atoms_per_res, 3)
+  int bucket_len = 0;
 };
 
 struct Af2Loader {
@@ -48,6 +53,11 @@ struct Af2Loader {
   int max_len = 128;
   int atoms_per_res = 14;
   int pad_token = 20;
+  // ascending static length buckets (empty = single-shape mode). A protein
+  // goes to the smallest bucket holding it (random-cropped to the largest
+  // otherwise); batches are emitted per bucket, with buffers laid out at
+  // max_len (== buckets.back()) and bucket_len marking the valid columns.
+  std::vector<int32_t> buckets;
 
   // queue
   size_t capacity = 4;
@@ -58,45 +68,86 @@ struct Af2Loader {
   std::vector<std::thread> workers;
   uint64_t seed = 0;
 
+  void fill_row(std::mt19937_64& rng, Af2Batch& b, int i, int idx) {
+    const int row_len = b.bucket_len;
+    int64_t beg = offsets[idx], end = offsets[idx + 1];
+    int len = (int)(end - beg);
+    int start = 0;
+    if (len > row_len) {  // random crop
+      std::uniform_int_distribution<int> off(0, len - row_len);
+      start = off(rng);
+      len = row_len;
+    }
+    std::memcpy(&b.seq[(size_t)i * row_len], &seqs[beg + start],
+                sizeof(int32_t) * len);
+    std::memset(&b.mask[(size_t)i * row_len], 1, len);
+    std::memcpy(&b.coords[(size_t)i * row_len * atoms_per_res * 3],
+                &coords[(beg + start) * atoms_per_res * 3],
+                sizeof(float) * (size_t)len * atoms_per_res * 3);
+  }
+
+  Af2Batch fresh_batch(int bucket_len_) {
+    Af2Batch b;
+    b.seq.assign((size_t)batch * bucket_len_, pad_token);
+    b.mask.assign((size_t)batch * bucket_len_, 0);
+    b.coords.assign((size_t)batch * bucket_len_ * atoms_per_res * 3, 0.0f);
+    b.bucket_len = bucket_len_;
+    return b;
+  }
+
+  void push(Af2Batch&& b) {
+    std::unique_lock<std::mutex> lk(mu);
+    cv_push.wait(lk, [&] { return stop.load() || queue.size() < capacity; });
+    if (stop.load()) return;
+    queue.push_back(std::move(b));
+    cv_pop.notify_one();
+  }
+
   void worker(int wid) {
     std::mt19937_64 rng(seed ^ (0x9e3779b97f4a7c15ULL * (wid + 1)));
     std::uniform_int_distribution<int> pick(0, n_seqs - 1);
-    while (!stop.load()) {
-      Af2Batch b;
-      b.seq.assign((size_t)batch * max_len, pad_token);
-      b.mask.assign((size_t)batch * max_len, 0);
-      b.coords.assign((size_t)batch * max_len * atoms_per_res * 3, 0.0f);
-      for (int i = 0; i < batch; ++i) {
-        int idx = pick(rng);
-        int64_t beg = offsets[idx], end = offsets[idx + 1];
-        int len = (int)(end - beg);
-        int start = 0;
-        if (len > max_len) {  // random crop
-          std::uniform_int_distribution<int> off(0, len - max_len);
-          start = off(rng);
-          len = max_len;
-        }
-        std::memcpy(&b.seq[(size_t)i * max_len], &seqs[beg + start],
-                    sizeof(int32_t) * len);
-        std::memset(&b.mask[(size_t)i * max_len], 1, len);
-        std::memcpy(&b.coords[(size_t)i * max_len * atoms_per_res * 3],
-                    &coords[(beg + start) * atoms_per_res * 3],
-                    sizeof(float) * (size_t)len * atoms_per_res * 3);
+    if (buckets.empty()) {
+      while (!stop.load()) {
+        Af2Batch b = fresh_batch(max_len);
+        for (int i = 0; i < batch; ++i) fill_row(rng, b, i, pick(rng));
+        push(std::move(b));
       }
-      std::unique_lock<std::mutex> lk(mu);
-      cv_push.wait(lk, [&] { return stop.load() || queue.size() < capacity; });
-      if (stop.load()) return;
-      queue.push_back(std::move(b));
-      cv_pop.notify_one();
+      return;
+    }
+    // bucketed mode: accumulate picked proteins per bucket (worker-local —
+    // no cross-thread pending state), emit when a bucket fills
+    std::vector<std::vector<int>> pending(buckets.size());
+    while (!stop.load()) {
+      int idx = pick(rng);
+      int len = (int)(offsets[idx + 1] - offsets[idx]);
+      size_t bi = buckets.size() - 1;
+      for (size_t k = 0; k < buckets.size(); ++k)
+        if (len <= buckets[k]) { bi = k; break; }
+      pending[bi].push_back(idx);
+      if ((int)pending[bi].size() < batch) continue;
+      Af2Batch b = fresh_batch(buckets[bi]);
+      for (int i = 0; i < batch; ++i)
+        fill_row(rng, b, i, pending[bi][i]);
+      pending[bi].clear();
+      push(std::move(b));
     }
   }
 };
 
-void* af2_loader_create(const int32_t* seqs, const int64_t* offsets,
-                        int n_seqs, const float* coords, int atoms_per_res,
-                        int batch, int max_len, int pad_token, uint64_t seed,
-                        int n_threads, int queue_capacity) {
+// buckets: ascending static lengths, or n_buckets == 0 for single-shape
+// mode; bucketed loaders require max_len == buckets[n_buckets-1] (buffers
+// are laid out at max_len).
+void* af2_loader_create2(const int32_t* seqs, const int64_t* offsets,
+                         int n_seqs, const float* coords, int atoms_per_res,
+                         int batch, int max_len, int pad_token, uint64_t seed,
+                         int n_threads, int queue_capacity,
+                         const int32_t* buckets, int n_buckets) {
   if (n_seqs <= 0 || batch <= 0 || max_len <= 0) return nullptr;
+  if (n_buckets > 0) {
+    for (int i = 1; i < n_buckets; ++i)
+      if (buckets[i] <= buckets[i - 1]) return nullptr;  // must ascend
+    if (buckets[n_buckets - 1] != max_len) return nullptr;
+  }
   auto* L = new Af2Loader();
   int64_t total = offsets[n_seqs];
   L->seqs.assign(seqs, seqs + total);
@@ -109,14 +160,28 @@ void* af2_loader_create(const int32_t* seqs, const int64_t* offsets,
   L->pad_token = pad_token;
   L->seed = seed;
   L->capacity = queue_capacity > 0 ? queue_capacity : 4;
+  if (n_buckets > 0) L->buckets.assign(buckets, buckets + n_buckets);
   int nt = n_threads > 0 ? n_threads : 1;
   for (int i = 0; i < nt; ++i)
     L->workers.emplace_back([L, i] { L->worker(i); });
   return L;
 }
 
-void af2_loader_next(void* handle, int32_t* seq_out, uint8_t* mask_out,
-                     float* coords_out) {
+void* af2_loader_create(const int32_t* seqs, const int64_t* offsets,
+                        int n_seqs, const float* coords, int atoms_per_res,
+                        int batch, int max_len, int pad_token, uint64_t seed,
+                        int n_threads, int queue_capacity) {
+  return af2_loader_create2(seqs, offsets, n_seqs, coords, atoms_per_res,
+                            batch, max_len, pad_token, seed, n_threads,
+                            queue_capacity, nullptr, 0);
+}
+
+// Returns the batch's bucket length (== max_len in single-shape mode).
+// Output is written COMPACT at the returned length — row i of seq/mask
+// starts at i*bucket_len, coords at i*bucket_len*atoms*3 — so callers size
+// buffers for max_len but reshape the filled prefix to (batch, bucket_len).
+int af2_loader_next(void* handle, int32_t* seq_out, uint8_t* mask_out,
+                    float* coords_out) {
   auto* L = static_cast<Af2Loader*>(handle);
   Af2Batch b;
   {
@@ -129,6 +194,7 @@ void af2_loader_next(void* handle, int32_t* seq_out, uint8_t* mask_out,
   std::memcpy(seq_out, b.seq.data(), b.seq.size() * sizeof(int32_t));
   std::memcpy(mask_out, b.mask.data(), b.mask.size());
   std::memcpy(coords_out, b.coords.data(), b.coords.size() * sizeof(float));
+  return b.bucket_len;
 }
 
 void af2_loader_destroy(void* handle) {

@@ -53,9 +53,9 @@ def main():
                          "64,128,256): variable-length proteins batch into "
                          "the smallest holding bucket instead of all "
                          "padding to --len (one jit compile per bucket). "
-                         "Applies to --data native; batch assembly runs on "
-                         "the Python thread (bypasses the C++ prefetch "
-                         "loader).")
+                         "Applies to --data native; batches are assembled "
+                         "off-GIL inside the C++ prefetch loader. The "
+                         "largest bucket must equal --len.")
     ap.add_argument("--sp-shards", type=int, default=0,
                     help="shard the pair grid over this many devices "
                          "(sequence-parallel trunk; --len must be a "
@@ -113,12 +113,19 @@ def main():
                 3.8 * rs.randn(L, 14, 3).astype(np.float32), axis=0
             )
             pool.append((seq, cloud))
+        buckets = None
         if args.len_buckets:
             # length bucketing: a closed set of static shapes instead of
-            # one big pad target (training/data.py bucket_batches)
-            from alphafold2_tpu.training import bucket_batches
-
-            buckets = tuple(int(x) for x in args.len_buckets.split(","))
+            # one big pad target. Assembled INSIDE the C++ loader (off the
+            # GIL) — csrc/af2_runtime.cc bucketed worker mode.
+            buckets = tuple(sorted(set(
+                int(x) for x in args.len_buckets.split(","))))
+            if buckets[-1] != args.max_len:
+                raise SystemExit(
+                    f"--len-buckets largest bucket ({buckets[-1]}) must "
+                    f"equal --len ({args.max_len}) — the top bucket is the "
+                    f"crop length the model is sized for"
+                )
             if args.sp_shards:
                 bad = [b for b in buckets if b % args.sp_shards]
                 if bad:
@@ -127,33 +134,28 @@ def main():
                         f"--sp-shards {args.sp_shards} (sp_trunk needs the "
                         f"pair side to divide the mesh axis)"
                     )
-
-            def pool_items():
-                prng = np.random.RandomState(dcfg.seed + 1)
-                while True:
-                    yield pool[prng.randint(len(pool))]
-
-            it = bucket_batches(pool_items(), dcfg, buckets)
             print(f"length buckets: {buckets}")
-        else:
-            loader = NativePrefetchLoader(
-                pool, batch_size=args.batch, max_len=args.max_len,
-                seed=dcfg.seed, n_threads=2,
-            )
-            print("native prefetch loader: "
-                  f"{'C++' if loader.native else 'python fallback'}")
+        loader = NativePrefetchLoader(
+            pool, batch_size=args.batch, max_len=args.max_len,
+            seed=dcfg.seed, n_threads=2, buckets=buckets,
+        )
+        print("native prefetch loader: "
+              f"{'C++' if loader.native else 'python fallback'}")
 
-            def native_gen():
-                while True:
-                    b = loader.next()
-                    yield {
-                        "seq": b["seq"],
-                        "mask": b["mask"],
-                        # CA trace (atom slot 1) drives the distogram labels
-                        "coords": b["coords"][:, :, 1],
-                    }
+        def native_gen():
+            while True:
+                b = loader.next()
+                out = {
+                    "seq": b["seq"],
+                    "mask": b["mask"],
+                    # CA trace (atom slot 1) drives the distogram labels
+                    "coords": b["coords"][:, :, 1],
+                }
+                if "bucket" in b:
+                    out["bucket"] = b["bucket"]
+                yield out
 
-            it = native_gen()
+        it = native_gen()
     if it is None:
         # synthetic batches are a pure function of their index, so a resumed
         # run jumps the stream to the exact position in O(1) (no replay)
