@@ -256,12 +256,20 @@ def _run(dev, on_tpu: bool, depth: int) -> dict:
     infer_sec = time.perf_counter() - t0
 
     baseline = 1.0  # driver target: >=1 optimizer step/sec/chip (BASELINE.md)
+    # the target is defined ON TPU at the north-star shapes; a CPU smoke
+    # fallback must not read as progress against it (bench honesty —
+    # VERDICT r1 weakness #3)
+    vs_baseline = round(steps_per_sec / baseline, 4) if on_tpu else 0.0
     return {
         "metric": f"train_end2end_steps_per_sec_crop{crop}_msa{msa_rows}"
                   f"_depth{depth}_{dev.platform}",
         "value": round(steps_per_sec, 4),
         "unit": "steps/sec",
-        "vs_baseline": round(steps_per_sec / baseline, 4),
+        "vs_baseline": vs_baseline,
+        **({} if on_tpu else
+           {"note": f"non-TPU run ({dev.platform}) at smoke shapes; "
+                    "vs_baseline deliberately 0 — the target is "
+                    "TPU-defined"}),
         "sec_per_step": round(dt / steps, 3),
         "tflops_per_step": round(flops_per_step / 1e12, 2),
         "achieved_tflops_per_sec": round(achieved / 1e12, 2),
