@@ -17,6 +17,7 @@ from alphafold2_tpu.parallel.train import (
     make_sharded_train_step,
     make_sp_train_step,
     sp_e2e_loss_fn,
+    sp_model_apply,
     sp_distogram_loss_fn,
     sharded_train_state_init,
 )
@@ -56,6 +57,7 @@ __all__ = [
     "make_sharded_train_step",
     "make_sp_train_step",
     "sp_e2e_loss_fn",
+    "sp_model_apply",
     "sp_distogram_loss_fn",
     "sharded_train_state_init",
 ]

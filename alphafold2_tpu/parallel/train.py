@@ -104,11 +104,13 @@ def sp_distogram_loss_fn(mesh: Mesh, axis_name: str = "seq"):
     """
     from alphafold2_tpu.training.harness import make_distogram_loss_fn
 
-    return make_distogram_loss_fn(_sp_model_apply(mesh, axis_name))
+    return make_distogram_loss_fn(sp_model_apply(mesh, axis_name))
 
 
-def _sp_model_apply(mesh: Mesh, axis_name: str):
-    """alphafold2_apply-signature adapter over the sequence-parallel trunk."""
+def sp_model_apply(mesh: Mesh, axis_name: str = "seq"):
+    """alphafold2_apply-signature adapter over the sequence-parallel
+    trunk — the public hook for running any alphafold2_apply consumer
+    (predict_structure, custom losses) with the trunk under shard_map."""
     from alphafold2_tpu.parallel.sp_trunk import alphafold2_apply_sp
 
     def apply_fn(params, cfg, seq, msa, *, mask=None, msa_mask=None,
@@ -145,7 +147,7 @@ def sp_e2e_loss_fn(mesh: Mesh, axis_name: str = "seq"):
     """
     from alphafold2_tpu.training.e2e import make_e2e_loss_fn
 
-    return make_e2e_loss_fn(_sp_model_apply(mesh, axis_name))
+    return make_e2e_loss_fn(sp_model_apply(mesh, axis_name))
 
 
 def make_sp_train_step(

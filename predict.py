@@ -9,6 +9,12 @@ chirality fix, optional geometric relaxation, and writes a PDB.
 Usage:
   python predict.py --seq ACDEFGHIKLMNPQRSTVWY --out structure.pdb
   python predict.py --seq ... --ckpt-dir runs/pre --dim 256 --depth 12
+  python predict.py --seq ... --full-atom --ckpt-dir runs/e2e   # model+refiner
+
+--full-atom runs the complete structure pipeline (trunk -> distogram ->
+MDS with chirality fix -> sidechain lift -> SE(3) refiner) from an
+end-to-end checkpoint (train_end2end.py --ckpt-dir) and writes an
+N/CA/C/O backbone PDB that scripts/refinement.py can relax.
 """
 
 from __future__ import annotations
@@ -31,6 +37,14 @@ def main():
     ap.add_argument("--ckpt-dir", default=None, help="restore trained params")
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-seq-len", type=int, default=None,
+                    help="positional-table size; MUST match the training "
+                         "config when restoring a checkpoint (default: "
+                         "derived from the input sequence)")
+    ap.add_argument("--full-atom", action="store_true",
+                    help="full structure pipeline incl. SE(3) refiner from "
+                         "an end-to-end checkpoint; writes N/CA/C/O backbone")
+    ap.add_argument("--refiner-depth", type=int, default=2)
     ap.add_argument("--sp-shards", type=int, default=0,
                     help="run the trunk sequence-parallel over this many "
                          "devices (sequence length must be a multiple of "
@@ -54,9 +68,16 @@ def main():
         depth=args.depth,
         heads=args.heads,
         dim_head=args.dim_head,
-        max_seq_len=max(64, L),
+        # full-atom mode elongates x3 (one token per backbone atom);
+        # --max-seq-len pins the table to the training value for restore
+        max_seq_len=args.max_seq_len
+        or max(64, 3 * L if args.full_atom else L),
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
     )
+
+    if args.full_atom:
+        _predict_full_atom(args, cfg, tokens, seq_str)
+        return
 
     if args.ckpt_dir is not None:
         from alphafold2_tpu.training import CheckpointManager, restore_or_init
@@ -103,6 +124,69 @@ def main():
     # N/CA/C backbones; a CA-only trace has no bond structure to relax
     coords_to_pdb(args.out, trace, sequence=seq_str, atom_names=("CA",))
     print(f"wrote {args.out} ({L} residues)")
+
+
+def _predict_full_atom(args, cfg, tokens, seq_str):
+    """sequence -> refined 14-atom cloud -> N/CA/C/O backbone PDB."""
+    import jax.numpy as jnp
+
+    from alphafold2_tpu.geometry.pdb import coords_to_pdb
+    from alphafold2_tpu.models import RefinerConfig
+    from alphafold2_tpu.training import (
+        E2EConfig,
+        TrainConfig,
+        e2e_train_state_init,
+        predict_structure,
+    )
+
+    ecfg = E2EConfig(
+        model=cfg,
+        refiner=RefinerConfig(num_tokens=14, dim=64, depth=args.refiner_depth),
+        mds_iters=args.mds_iters,
+    )
+    if args.ckpt_dir is not None:
+        from alphafold2_tpu.training import open_or_init
+
+        mgr, state, resumed = open_or_init(
+            args.ckpt_dir, e2e_train_state_init, jax.random.PRNGKey(0), ecfg,
+            TrainConfig(),
+        )
+        if mgr is not None:
+            mgr.close()  # inference only reads; no saves to flush
+        print(
+            f"restored step-{int(state['step'])} params from {args.ckpt_dir}"
+            if resumed
+            else f"warning: no checkpoint in {args.ckpt_dir}; random params"
+        )
+        params = state["params"]
+    else:
+        from alphafold2_tpu.models import alphafold2_init, refiner_init
+
+        print("no --ckpt-dir: using randomly initialized params")
+        k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+        params = {
+            "model": alphafold2_init(k1, cfg),
+            "refiner": refiner_init(k2, ecfg.refiner),
+        }
+
+    model_apply_fn = None
+    if args.sp_shards:
+        from alphafold2_tpu.parallel import make_mesh, sp_model_apply
+
+        model_apply_fn = sp_model_apply(make_mesh({"seq": args.sp_shards}))
+
+    out = jax.jit(
+        lambda p, t: predict_structure(
+            p, ecfg, t, rng=jax.random.PRNGKey(args.seed),
+            model_apply_fn=model_apply_fn,
+        )["refined"]
+    )(params, tokens)  # (1, L, 14, 3)
+    backbone = np.asarray(out)[0, :, :4]  # N, CA, C, O slots
+    coords_to_pdb(
+        args.out, backbone.reshape(-1, 3), sequence=seq_str,
+        atom_names=("N", "CA", "C", "O"),
+    )
+    print(f"wrote {args.out} ({tokens.shape[1]} residues, full pipeline)")
 
 
 if __name__ == "__main__":
