@@ -246,3 +246,35 @@ def test_sp_e2e_train_step_matches_replicated():
         jax.tree_util.tree_leaves(sp_state["params"]),
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+@pytest.mark.slow
+def test_full_model_sp_with_templates_matches_replicated():
+    """The template tower runs replicated ahead of the SP trunk; the full
+    model with templates + tied rows must still match alphafold2_apply."""
+    from alphafold2_tpu.models import alphafold2_apply, alphafold2_init
+    from alphafold2_tpu.parallel import alphafold2_apply_sp
+
+    if len(jax.devices()) < N_DEV:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = Alphafold2Config(
+        dim=16, depth=1, heads=2, dim_head=8, max_seq_len=32,
+        msa_tie_row_attn=True, template_attn_depth=1,
+    )
+    params = alphafold2_init(jax.random.PRNGKey(0), cfg)
+    rs = jax.random.PRNGKey(1)
+    seq = jax.random.randint(jax.random.fold_in(rs, 0), (1, 16), 0, 21)
+    msa = jax.random.randint(jax.random.fold_in(rs, 1), (1, 8, 16), 0, 21)
+    templates = jax.random.randint(
+        jax.random.fold_in(rs, 2), (1, 2, 16, 16), 0, 37
+    )
+    tmask = jnp.ones((1, 2, 16, 16), bool)
+    mesh = make_mesh({"seq": N_DEV})
+
+    want = alphafold2_apply(
+        params, cfg, seq, msa, templates=templates, templates_mask=tmask
+    )
+    got = alphafold2_apply_sp(
+        params, cfg, seq, msa, mesh, templates=templates, templates_mask=tmask
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=5e-4)
