@@ -316,3 +316,45 @@ def test_pdb_roundtrip(tmp_path):
     assert len(structure.atoms) == 30
     assert structure.sequence() == "ACDEFGHIKL"
     assert np.allclose(structure.coords(), coords, atol=1e-3)
+
+
+def test_weighted_kabsch_ignores_masked_garbage():
+    """Weighted Kabsch (the static-shape stand-in for the reference's
+    boolean indexing, train_end2end.py:172): zero-weight points must not
+    influence the alignment, however wild their values."""
+    from alphafold2_tpu.geometry.kabsch import kabsch
+
+    key = jax.random.PRNGKey(10)
+    n_valid, n_pad = 24, 8
+    X_valid = jax.random.normal(key, (3, n_valid))
+    angle = 1.1
+    R = jnp.array(
+        [
+            [np.cos(angle), 0.0, np.sin(angle)],
+            [0.0, 1.0, 0.0],
+            [-np.sin(angle), 0.0, np.cos(angle)],
+        ]
+    )
+    Y_valid = R @ X_valid + jnp.array([[0.5], [-2.0], [4.0]])
+
+    # pad with large garbage on both sides, weight 0
+    junk = 1e3 * jax.random.normal(jax.random.PRNGKey(11), (3, n_pad))
+    X = jnp.concatenate([X_valid, junk], axis=1)
+    Y = jnp.concatenate([Y_valid, -junk], axis=1)
+    w = jnp.concatenate([jnp.ones(n_valid), jnp.zeros(n_pad)])
+
+    Xa, Yc = kabsch(X, Y, weights=w)
+    err = np.sqrt(
+        np.mean(np.sum(np.asarray(Xa - Yc)[:, :n_valid] ** 2, axis=0))
+    )
+    assert err < 1e-2, err
+
+    # parity with plain Kabsch on the valid slice alone — both the aligned
+    # X and the centered Y (a mis-weighted Y centroid would shift Yc)
+    Xa_ref, Yc_ref = kabsch(X_valid, Y_valid)
+    np.testing.assert_allclose(
+        np.asarray(Xa)[:, :n_valid], np.asarray(Xa_ref), atol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(Yc)[:, :n_valid], np.asarray(Yc_ref), atol=1e-3
+    )
