@@ -178,3 +178,30 @@ def test_bucketed_training_steps_run_per_shape():
         state, metrics = step(state, g, None)
         assert np.isfinite(float(metrics["loss"]))
     assert len(seen) == 2
+
+
+def test_bucket_batches_full_atom_layout():
+    """full_atom=True yields the e2e batch contract: (b, L, 14, 3) clouds
+    plus the per-atom resolution mask."""
+    from alphafold2_tpu.training import DataConfig, bucket_batches
+
+    rng = np.random.RandomState(3)
+
+    def items():
+        while True:
+            L = int(rng.randint(6, 30))
+            cloud = rng.randn(L, 14, 3).astype(np.float32)
+            cloud[:, 5:] = 0.0  # unresolved side-chain atoms
+            yield rng.randint(0, 21, L).astype(np.int32), cloud
+
+    b = next(bucket_batches(items(), DataConfig(batch_size=2), (16, 32),
+                            full_atom=True))
+    bl = b["bucket"]
+    assert b["coords"].shape == (2, bl, 14, 3)
+    assert b["atom_mask"].shape == (2, bl, 14)
+    # zeroed (unresolved) atom slots are masked out everywhere
+    assert not b["atom_mask"][:, :, 5:].any()
+    # resolved backbone slots are marked exactly on real (unpadded) residues
+    np.testing.assert_array_equal(
+        b["atom_mask"][:, :, :5].all(axis=-1), b["mask"]
+    )
