@@ -40,6 +40,13 @@ class Alphafold2Config:
     # ~33% extra FLOPs — the remat sibling of the reversible trunk; works
     # with or without an MSA stream (reversible requires one)
     remat: bool = False
+    # rematerialization policy: what the per-layer checkpoint SAVES instead
+    # of recomputing. None = save nothing (maximum recompute, minimum
+    # memory); "dots" = save all matmul outputs (recompute only elementwise
+    # — much cheaper backward, higher residency); "dots_no_batch" = save
+    # matmuls without batch dims only (the usual TPU sweet spot). Ignored
+    # unless remat=True.
+    remat_policy: Optional[str] = None
     # lax.scan the sequential trunk over depth (uniform-sparse-flag runs
     # scan as segments): ONE compiled layer body instead of depth copies —
     # at depth 48 this is the difference between minutes and seconds of
@@ -97,6 +104,11 @@ class Alphafold2Config:
             raise ValueError(
                 f"cross_attn_mode must be 'flat' or 'aligned', "
                 f"got {self.cross_attn_mode!r}"
+            )
+        if self.remat_policy not in (None, "dots", "dots_no_batch"):
+            raise ValueError(
+                f"remat_policy must be None, 'dots', or 'dots_no_batch', "
+                f"got {self.remat_policy!r}"
             )
 
     @property

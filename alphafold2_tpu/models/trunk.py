@@ -32,6 +32,19 @@ from alphafold2_tpu.ops.feedforward import feed_forward_apply, feed_forward_init
 from alphafold2_tpu.ops.sparse import sparse_attention_apply
 
 
+_REMAT_POLICIES = {
+    None: None,
+    "dots": "dots_saveable",
+    "dots_no_batch": "dots_with_no_batch_dims_saveable",
+}
+
+
+def _remat_policy(cfg: Alphafold2Config):
+    # membership is validated eagerly in Alphafold2Config.__post_init__
+    name = _REMAT_POLICIES[cfg.remat_policy]
+    return getattr(jax.checkpoint_policies, name) if name else None
+
+
 def make_sparse_axial_fn(cfg: Alphafold2Config):
     """Inner-attention override running each axial pass block-sparsely.
 
@@ -332,8 +345,10 @@ def sequential_trunk_apply(
             # recompute this layer's activations in the backward pass
             # instead of storing them: O(1) trunk activation memory in
             # depth, the jax.checkpoint sibling of the reversible trunk
-            # (reference reversible.py's motivation, SURVEY.md §2.2)
-            return jax.checkpoint(body)
+            # (reference reversible.py's motivation, SURVEY.md §2.2).
+            # cfg.remat_policy trades memory back for backward FLOPs by
+            # saving matmul outputs (models/config.py)
+            return jax.checkpoint(body, policy=_remat_policy(cfg))
         return body
 
     if cfg.scan_layers:
