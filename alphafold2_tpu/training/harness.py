@@ -40,16 +40,54 @@ class TrainConfig:
     grad_accum: int = 16
     max_grad_norm: Optional[float] = None  # reference has no clipping
     weight_decay: float = 0.0
+    # learning-rate schedule (reference: constant lr only). warmup_steps
+    # ramps linearly 0 -> lr; decay_steps (if set) then cosine-decays to
+    # lr * decay_floor over that many post-warmup steps.
+    warmup_steps: int = 0
+    decay_steps: Optional[int] = None
+    decay_floor: float = 0.0
+
+
+def make_schedule(tcfg: TrainConfig):
+    """Scalar lr schedule from the config.
+
+    ALWAYS returns a callable (a constant schedule when no knobs are set):
+    optax's opt_state carries a schedule count leaf exactly when the lr is
+    a callable, so returning a float for the constant case would make the
+    checkpoint pytree STRUCTURE depend on the schedule flags — a
+    constant-lr restore template (e.g. predict.py's TrainConfig()) could
+    then not load checkpoints from scheduled runs.
+    """
+    if tcfg.warmup_steps == 0 and tcfg.decay_steps is None:
+        return optax.constant_schedule(tcfg.learning_rate)
+    if tcfg.decay_steps is None:
+        # warmup then hold (linear_schedule clamps at its end value)
+        return optax.linear_schedule(
+            0.0, tcfg.learning_rate, tcfg.warmup_steps
+        )
+    if tcfg.warmup_steps == 0:
+        # decay only — no phantom zero-lr first step
+        return optax.cosine_decay_schedule(
+            tcfg.learning_rate, tcfg.decay_steps, alpha=tcfg.decay_floor
+        )
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=tcfg.learning_rate,
+        warmup_steps=tcfg.warmup_steps,
+        decay_steps=tcfg.warmup_steps + tcfg.decay_steps,
+        end_value=tcfg.learning_rate * tcfg.decay_floor,
+    )
 
 
 def make_optimizer(tcfg: TrainConfig) -> optax.GradientTransformation:
     tx = []
     if tcfg.max_grad_norm is not None:
         tx.append(optax.clip_by_global_norm(tcfg.max_grad_norm))
+    schedule = make_schedule(tcfg)
     if tcfg.weight_decay > 0.0:
-        tx.append(optax.adamw(tcfg.learning_rate, weight_decay=tcfg.weight_decay))
+        tx.append(optax.adamw(schedule, weight_decay=tcfg.weight_decay))
     else:
-        tx.append(optax.adam(tcfg.learning_rate))
+        tx.append(optax.adam(schedule))
     return optax.chain(*tx)
 
 

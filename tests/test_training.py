@@ -205,3 +205,49 @@ def test_bucket_batches_full_atom_layout():
     np.testing.assert_array_equal(
         b["atom_mask"][:, :, :5].all(axis=-1), b["mask"]
     )
+
+
+def test_lr_schedule_warmup_and_decay():
+    """Warmup ramps the effective update from ~0; cosine decay shrinks it
+    again late. Measured through actual optimizer updates (not the schedule
+    object), so the optax wiring itself is what is under test."""
+    from alphafold2_tpu.training.harness import make_optimizer
+
+    tcfg = TrainConfig(learning_rate=1e-2, warmup_steps=10, decay_steps=20)
+    opt = make_optimizer(tcfg)
+    params = {"w": jnp.ones((4,))}
+    grads = {"w": jnp.ones((4,))}
+    state = opt.init(params)
+
+    sizes = []
+    for _ in range(30):
+        updates, state = opt.update(grads, state, params)
+        sizes.append(float(jnp.abs(updates["w"]).max()))
+    # step 0 (warmup start) much smaller than the peak
+    assert sizes[0] < 0.3 * max(sizes), sizes[:3]
+    # peak lands around the end of warmup
+    assert max(sizes[8:14]) == max(sizes)
+    # decay brings late steps far below peak again
+    assert sizes[-1] < 0.2 * max(sizes), sizes[-3:]
+
+    # default config remains exactly constant-lr Adam
+    tconst = TrainConfig(learning_rate=1e-2)
+    opt2 = make_optimizer(tconst)
+    st2 = opt2.init(params)
+    u2, _ = opt2.update(grads, st2, params)
+    assert abs(float(jnp.abs(u2["w"]).max()) - 1e-2) < 1e-6
+
+    # REGRESSION: opt_state structure must not depend on schedule flags —
+    # otherwise a constant-lr restore template (predict.py) cannot load
+    # checkpoints from scheduled training runs
+    assert jax.tree_util.tree_structure(
+        st2
+    ) == jax.tree_util.tree_structure(state := opt.init(params))
+
+    # warmup_steps=0 with decay: the FIRST step runs at full lr (no
+    # phantom zero-lr step) and decay still completes
+    t0 = TrainConfig(learning_rate=1e-2, decay_steps=10)
+    opt3 = make_optimizer(t0)
+    st3 = opt3.init(params)
+    u3, _ = opt3.update(grads, st3, params)
+    assert abs(float(jnp.abs(u3["w"]).max()) - 1e-2) < 1e-6

@@ -52,6 +52,10 @@ def main():
                          "devices (3*--len and MSA rows must be multiples "
                          "of it; deterministic path; 0 = replicated)")
     ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--warmup-steps", type=int, default=0,
+                    help="linear lr warmup steps (0 = constant lr)")
+    ap.add_argument("--decay-steps", type=int, default=None,
+                    help="cosine-decay the lr over this many post-warmup steps")
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     # the reference's FEATURES switch (reference train_end2end.py:20-28):
     # msa = synthetic MSA stream, esm = ESM residue embeddings through the
@@ -110,7 +114,9 @@ def main():
         mds_iters=args.mds_iters,
         mds_bwd_iters=args.mds_bwd_iters,
     )
-    tcfg = TrainConfig(learning_rate=args.lr, grad_accum=args.accum)
+    tcfg = TrainConfig(learning_rate=args.lr, grad_accum=args.accum,
+                       warmup_steps=args.warmup_steps,
+                       decay_steps=args.decay_steps)
     dcfg = DataConfig(
         batch_size=args.batch,
         max_len=args.max_len,

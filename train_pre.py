@@ -41,6 +41,10 @@ def main():
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--accum", type=int, default=16)
     ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--warmup-steps", type=int, default=0,
+                    help="linear lr warmup steps (0 = constant lr)")
+    ap.add_argument("--decay-steps", type=int, default=None,
+                    help="cosine-decay the lr over this many post-warmup steps")
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     ap.add_argument(
         "--data", choices=["synthetic", "sidechainnet", "native"], default="synthetic"
@@ -82,7 +86,9 @@ def main():
         max_seq_len=max(2048, args.max_len),
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
     )
-    tcfg = TrainConfig(learning_rate=args.lr, grad_accum=args.accum)
+    tcfg = TrainConfig(learning_rate=args.lr, grad_accum=args.accum,
+                       warmup_steps=args.warmup_steps,
+                       decay_steps=args.decay_steps)
     dcfg = DataConfig(batch_size=args.batch, max_len=args.max_len)
 
     mgr, state, resumed = open_or_init(
