@@ -57,6 +57,11 @@ def make_schedule(tcfg: TrainConfig):
     checkpoint pytree STRUCTURE depend on the schedule flags — a
     constant-lr restore template (e.g. predict.py's TrainConfig()) could
     then not load checkpoints from scheduled runs.
+
+    MIGRATION NOTE: checkpoints written before schedules existed (optimizer
+    built from a float lr) lack the schedule count leaf and cannot be
+    restored by this version — re-init or re-train (pre-1.0 break,
+    deliberate: a structure that depends on flag values is worse).
     """
     if tcfg.warmup_steps == 0 and tcfg.decay_steps is None:
         return optax.constant_schedule(tcfg.learning_rate)

@@ -52,10 +52,14 @@ def main():
                          "devices (3*--len and MSA rows must be multiples "
                          "of it; deterministic path; 0 = replicated)")
     ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="base seed for params, data, and per-step rng")
     ap.add_argument("--warmup-steps", type=int, default=0,
                     help="linear lr warmup steps (0 = constant lr)")
     ap.add_argument("--decay-steps", type=int, default=None,
                     help="cosine-decay the lr over this many post-warmup steps")
+    ap.add_argument("--decay-floor", type=float, default=0.0,
+                    help="cosine decay ends at lr * this fraction")
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     # the reference's FEATURES switch (reference train_end2end.py:20-28):
     # msa = synthetic MSA stream, esm = ESM residue embeddings through the
@@ -116,15 +120,17 @@ def main():
     )
     tcfg = TrainConfig(learning_rate=args.lr, grad_accum=args.accum,
                        warmup_steps=args.warmup_steps,
-                       decay_steps=args.decay_steps)
+                       decay_steps=args.decay_steps,
+                       decay_floor=args.decay_floor)
     dcfg = DataConfig(
         batch_size=args.batch,
         max_len=args.max_len,
         msa_rows=args.msa_rows if args.features == "msa" else 0,
+        seed=args.seed,
     )
 
     mgr, state, resumed = open_or_init(
-        args.ckpt_dir, e2e_train_state_init, jax.random.PRNGKey(0), ecfg, tcfg,
+        args.ckpt_dir, e2e_train_state_init, jax.random.PRNGKey(args.seed), ecfg, tcfg,
         save_every=args.ckpt_every,
     )
 
@@ -208,7 +214,7 @@ def main():
         )
     )
 
-    base_rng = jax.random.PRNGKey(1)
+    base_rng = jax.random.fold_in(jax.random.PRNGKey(args.seed), 1)
     start = int(state["step"])
     if resumed:
         print(f"resumed from step {start} in {args.ckpt_dir}")

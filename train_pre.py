@@ -41,10 +41,14 @@ def main():
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--accum", type=int, default=16)
     ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="base seed for params, data, and per-step rng")
     ap.add_argument("--warmup-steps", type=int, default=0,
                     help="linear lr warmup steps (0 = constant lr)")
     ap.add_argument("--decay-steps", type=int, default=None,
                     help="cosine-decay the lr over this many post-warmup steps")
+    ap.add_argument("--decay-floor", type=float, default=0.0,
+                    help="cosine decay ends at lr * this fraction")
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     ap.add_argument(
         "--data", choices=["synthetic", "sidechainnet", "native"], default="synthetic"
@@ -88,11 +92,13 @@ def main():
     )
     tcfg = TrainConfig(learning_rate=args.lr, grad_accum=args.accum,
                        warmup_steps=args.warmup_steps,
-                       decay_steps=args.decay_steps)
-    dcfg = DataConfig(batch_size=args.batch, max_len=args.max_len)
+                       decay_steps=args.decay_steps,
+                       decay_floor=args.decay_floor)
+    dcfg = DataConfig(batch_size=args.batch, max_len=args.max_len,
+                      seed=args.seed)
 
     mgr, state, resumed = open_or_init(
-        args.ckpt_dir, train_state_init, jax.random.PRNGKey(0), cfg, tcfg,
+        args.ckpt_dir, train_state_init, jax.random.PRNGKey(args.seed), cfg, tcfg,
         save_every=args.ckpt_every,
     )
     start = int(state["step"])
@@ -190,7 +196,7 @@ def main():
         train_step = jax.jit(make_train_step(cfg, tcfg))
     logger = MetricsLogger(args.metrics_log)
 
-    base_rng = jax.random.PRNGKey(1)
+    base_rng = jax.random.fold_in(jax.random.PRNGKey(args.seed), 1)
     t0 = time.time()
     if resumed:
         print(f"resumed from step {start} in {args.ckpt_dir}")
