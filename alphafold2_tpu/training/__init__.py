@@ -12,6 +12,8 @@ from alphafold2_tpu.training.losses import (
     distogram_cross_entropy,
 )
 from alphafold2_tpu.training.harness import (
+    add_train_args,
+    tcfg_from_args,
     TrainConfig,
     distogram_loss_fn,
     make_optimizer,
@@ -49,6 +51,8 @@ from alphafold2_tpu.training.resilience import (
 )
 
 __all__ = [
+    "add_train_args",
+    "tcfg_from_args",
     "BadStepError",
     "StepGuard",
     "run_resilient",

@@ -85,15 +85,22 @@ def make_schedule(tcfg: TrainConfig):
 
 
 def make_optimizer(tcfg: TrainConfig) -> optax.GradientTransformation:
-    tx = []
-    if tcfg.max_grad_norm is not None:
-        tx.append(optax.clip_by_global_norm(tcfg.max_grad_norm))
-    schedule = make_schedule(tcfg)
-    if tcfg.weight_decay > 0.0:
-        tx.append(optax.adamw(schedule, weight_decay=tcfg.weight_decay))
-    else:
-        tx.append(optax.adam(schedule))
-    return optax.chain(*tx)
+    """FIXED-ARITY chain — clip (inf = no-op) then adamw (weight_decay=0 is
+    numerically plain Adam) — so the opt_state pytree structure never
+    depends on flag values. A conditionally-present chain element would
+    break checkpoint restore across configs (predict.py restores with a
+    default TrainConfig template); see make_schedule's invariant note.
+    max_grad_norm <= 0 or None means clipping off (clip(0) would silently
+    zero every gradient)."""
+    max_norm = (
+        tcfg.max_grad_norm
+        if tcfg.max_grad_norm is not None and tcfg.max_grad_norm > 0
+        else float("inf")
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(max_norm),
+        optax.adamw(make_schedule(tcfg), weight_decay=tcfg.weight_decay),
+    )
 
 
 def train_state_init(key, cfg: Alphafold2Config, tcfg: TrainConfig):
@@ -180,3 +187,36 @@ def make_train_step(
         return new_state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
 
     return train_step
+
+
+# --- shared trainer CLI surface ---------------------------------------------
+
+
+def add_train_args(ap):
+    """The optimizer/schedule/seed argparse block shared by train_pre.py and
+    train_end2end.py — one place to add the next knob."""
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="base seed for params, data, and per-step rng")
+    ap.add_argument("--warmup-steps", type=int, default=0,
+                    help="linear lr warmup steps (0 = constant lr)")
+    ap.add_argument("--decay-steps", type=int, default=None,
+                    help="cosine-decay the lr over this many post-warmup steps")
+    ap.add_argument("--decay-floor", type=float, default=0.0,
+                    help="cosine decay ends at lr * this fraction")
+    ap.add_argument("--max-grad-norm", type=float, default=None,
+                    help="global-norm gradient clipping (<=0 or unset: off)")
+    ap.add_argument("--weight-decay", type=float, default=0.0,
+                    help="AdamW weight decay (default 0 = plain Adam)")
+
+
+def tcfg_from_args(args, grad_accum: int) -> TrainConfig:
+    return TrainConfig(
+        learning_rate=args.lr,
+        grad_accum=grad_accum,
+        warmup_steps=args.warmup_steps,
+        decay_steps=args.decay_steps,
+        decay_floor=args.decay_floor,
+        max_grad_norm=args.max_grad_norm,
+        weight_decay=args.weight_decay,
+    )

@@ -237,12 +237,23 @@ def test_lr_schedule_warmup_and_decay():
     u2, _ = opt2.update(grads, st2, params)
     assert abs(float(jnp.abs(u2["w"]).max()) - 1e-2) < 1e-6
 
-    # REGRESSION: opt_state structure must not depend on schedule flags —
-    # otherwise a constant-lr restore template (predict.py) cannot load
-    # checkpoints from scheduled training runs
-    assert jax.tree_util.tree_structure(
-        st2
-    ) == jax.tree_util.tree_structure(state := opt.init(params))
+    # REGRESSION: opt_state structure must not depend on ANY optimizer
+    # flag — otherwise a default-TrainConfig restore template (predict.py)
+    # cannot load checkpoints from runs that used the knobs
+    ref_struct = jax.tree_util.tree_structure(st2)
+    for variant in (
+        TrainConfig(warmup_steps=5, decay_steps=9),
+        TrainConfig(max_grad_norm=1.0),
+        TrainConfig(weight_decay=0.01),
+        TrainConfig(max_grad_norm=0.0),  # <=0 means off, not zeroed grads
+    ):
+        sv = make_optimizer(variant).init(params)
+        assert jax.tree_util.tree_structure(sv) == ref_struct, variant
+
+    # max_grad_norm=0 must be a no-op, not a gradient zeroer
+    opt0 = make_optimizer(TrainConfig(learning_rate=1e-2, max_grad_norm=0.0))
+    u0, _ = opt0.update(grads, opt0.init(params), params)
+    assert float(jnp.abs(u0["w"]).max()) > 1e-3
 
     # warmup_steps=0 with decay: the FIRST step runs at full lr (no
     # phantom zero-lr step) and decay still completes

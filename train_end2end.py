@@ -22,6 +22,8 @@ from alphafold2_tpu.training import (
     DataConfig,
     E2EConfig,
     TrainConfig,
+    add_train_args,
+    tcfg_from_args,
     e2e_loss_fn,
     e2e_train_state_init,
     finish,
@@ -51,15 +53,7 @@ def main():
                     help="shard the trunk sequence-parallel over this many "
                          "devices (3*--len and MSA rows must be multiples "
                          "of it; deterministic path; 0 = replicated)")
-    ap.add_argument("--lr", type=float, default=3e-4)
-    ap.add_argument("--seed", type=int, default=0,
-                    help="base seed for params, data, and per-step rng")
-    ap.add_argument("--warmup-steps", type=int, default=0,
-                    help="linear lr warmup steps (0 = constant lr)")
-    ap.add_argument("--decay-steps", type=int, default=None,
-                    help="cosine-decay the lr over this many post-warmup steps")
-    ap.add_argument("--decay-floor", type=float, default=0.0,
-                    help="cosine decay ends at lr * this fraction")
+    add_train_args(ap)
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     # the reference's FEATURES switch (reference train_end2end.py:20-28):
     # msa = synthetic MSA stream, esm = ESM residue embeddings through the
@@ -118,10 +112,7 @@ def main():
         mds_iters=args.mds_iters,
         mds_bwd_iters=args.mds_bwd_iters,
     )
-    tcfg = TrainConfig(learning_rate=args.lr, grad_accum=args.accum,
-                       warmup_steps=args.warmup_steps,
-                       decay_steps=args.decay_steps,
-                       decay_floor=args.decay_floor)
+    tcfg = tcfg_from_args(args, grad_accum=args.accum)
     dcfg = DataConfig(
         batch_size=args.batch,
         max_len=args.max_len,

@@ -20,6 +20,8 @@ from alphafold2_tpu.utils import MetricsLogger
 from alphafold2_tpu.training import (
     DataConfig,
     TrainConfig,
+    add_train_args,
+    tcfg_from_args,
     finish,
     make_train_step,
     open_or_init,
@@ -40,15 +42,7 @@ def main():
     ap.add_argument("--len", dest="max_len", type=int, default=128)
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--accum", type=int, default=16)
-    ap.add_argument("--lr", type=float, default=3e-4)
-    ap.add_argument("--seed", type=int, default=0,
-                    help="base seed for params, data, and per-step rng")
-    ap.add_argument("--warmup-steps", type=int, default=0,
-                    help="linear lr warmup steps (0 = constant lr)")
-    ap.add_argument("--decay-steps", type=int, default=None,
-                    help="cosine-decay the lr over this many post-warmup steps")
-    ap.add_argument("--decay-floor", type=float, default=0.0,
-                    help="cosine decay ends at lr * this fraction")
+    add_train_args(ap)
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     ap.add_argument(
         "--data", choices=["synthetic", "sidechainnet", "native"], default="synthetic"
@@ -90,10 +84,7 @@ def main():
         max_seq_len=max(2048, args.max_len),
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
     )
-    tcfg = TrainConfig(learning_rate=args.lr, grad_accum=args.accum,
-                       warmup_steps=args.warmup_steps,
-                       decay_steps=args.decay_steps,
-                       decay_floor=args.decay_floor)
+    tcfg = tcfg_from_args(args, grad_accum=args.accum)
     dcfg = DataConfig(batch_size=args.batch, max_len=args.max_len,
                       seed=args.seed)
 
