@@ -50,6 +50,9 @@ def main():
     ap.add_argument("--ckpt-dir", default=None, help="checkpoint/resume directory")
     ap.add_argument("--ckpt-every", type=int, default=50)
     ap.add_argument("--metrics-log", default=None, help="JSONL metrics file")
+    ap.add_argument("--eval-every", type=int, default=0,
+                    help="evaluate held-out distogram loss every N steps "
+                         "(0 = off)")
     ap.add_argument("--len-buckets", default=None,
                     help="comma-separated static length buckets (e.g. "
                          "64,128,256): variable-length proteins batch into "
@@ -187,6 +190,34 @@ def main():
         train_step = jax.jit(make_train_step(cfg, tcfg))
     logger = MetricsLogger(args.metrics_log)
 
+    eval_batch, eval_loss_fn, eval_key = None, None, "eval_loss"
+    if args.eval_every:
+        # a FIXED held-out batch from a seed the training stream never
+        # draws (stream seeds derive from args.seed; this one is offset).
+        # The held-out batch is SYNTHETIC regardless of --data (stateful
+        # sources have no clean holdout); when training on another source
+        # the metric is named synthetic_eval_loss so the JSONL curve cannot
+        # be misread as in-distribution generalization.
+        from alphafold2_tpu.training import distogram_loss_fn
+
+        if args.data != "synthetic":
+            eval_key = "synthetic_eval_loss"
+        eval_dcfg = DataConfig(batch_size=args.batch, max_len=args.max_len,
+                               seed=args.seed + 104729)
+        eval_batch = next(synthetic_batches(eval_dcfg))
+        if args.sp_shards:
+            # eval must shard the grid exactly like training: the
+            # replicated forward would materialize the full pair grid on
+            # one chip — the regime --sp-shards exists to avoid
+            from alphafold2_tpu.parallel import sp_distogram_loss_fn
+
+            loss_for_eval = sp_distogram_loss_fn(mesh)
+        else:
+            loss_for_eval = distogram_loss_fn
+        eval_loss_fn = jax.jit(
+            lambda p, b: loss_for_eval(p, cfg, b, None)
+        )
+
     base_rng = jax.random.fold_in(jax.random.PRNGKey(args.seed), 1)
     t0 = time.time()
     if resumed:
@@ -198,6 +229,9 @@ def main():
         batch = next(batches)
         batch.pop("bucket", None)  # shape bookkeeping, not model input
         state, metrics = train_step(state, batch, step_rng)
+        if eval_loss_fn is not None and (step + 1) % args.eval_every == 0:
+            metrics = dict(metrics)
+            metrics[eval_key] = eval_loss_fn(state["params"], eval_batch)
         logger.log(step, metrics)
         if step % 10 == 0 or step == start + args.steps - 1:
             dt = time.time() - t0
