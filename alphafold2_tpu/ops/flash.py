@@ -174,14 +174,17 @@ def blockwise_attention(
     return out[:, :i] if pad_i else out
 
 
-def flash_attention(q, k, v, key_bias=None, *, scale=None, use_kernel="auto", **blockwise_kwargs):
+def flash_attention(q, k, v, key_bias=None, *, scale=None, use_kernel="auto",
+                    kernel_qb=None, kernel_kb=None, **blockwise_kwargs):
     """Exact attention: fused Pallas kernel on TPU, XLA blockwise otherwise.
 
     Same contract as `blockwise_attention` (q (B, i, h, dh); k, v
     (B, j, h, dh); key-side (B, j) additive bias). use_kernel: True forces
     the kernel (interpret mode off-TPU — for tests), False forces XLA
     streaming, "auto" uses the kernel on TPU for supported shapes
-    (ops/flash_kernel.py `supported`).
+    (ops/flash_kernel.py `supported`). kernel_qb/kernel_kb override the
+    kernel's query/key block sizes (None = padding-aware pick_block) —
+    kernel path only, used for block tuning (scripts/bench_kernels.py).
     """
     from alphafold2_tpu.ops import flash_kernel
 
@@ -209,7 +212,8 @@ def flash_attention(q, k, v, key_bias=None, *, scale=None, use_kernel="auto", **
         )
         bias = jnp.repeat(bias, h, axis=0)  # per (batch, head) grid row
         out = flash_kernel.flash_attention_tpu(
-            fold(q), fold(k), fold(v), bias, scale
+            fold(q), fold(k), fold(v), bias, scale,
+            qb=kernel_qb, kb=kernel_kb,
         )
         return out.reshape(B, h, i, dh).transpose(0, 2, 1, 3)
 

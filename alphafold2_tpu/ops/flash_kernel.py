@@ -32,6 +32,24 @@ from jax.experimental import pallas as pl
 from alphafold2_tpu.ops.core import pallas_interpret as _interpret
 
 _NEG = float("-inf")
+# finite running-max sentinel: keeps the streaming-softmax recurrence free
+# of (-inf) - (-inf) = nan without per-tile isneginf/where passes. Logits
+# below this are treated as fully masked (the standard flash-kernel trade).
+_M0 = -1e30
+# K/V-block loops with a static trip count at or below this unroll into
+# straight-line code (Mosaic software-pipelines across blocks); longer
+# loops fall back to fori_loop to bound code size
+_UNROLL_MAX = 8
+
+
+def _block_loop(n, body, init):
+    """fori_loop over blocks, unrolled to straight-line code when short."""
+    if n <= _UNROLL_MAX:
+        carry = init
+        for a in range(n):
+            carry = body(a, carry)
+        return carry
+    return jax.lax.fori_loop(0, n, body, init)
 
 # VMEM budget for the resident operands of the worst kernel: the dk/dv
 # backward keeps the FULL Q and G f32 copies per grid row, the forward/dq
@@ -59,32 +77,39 @@ def supported(i: int, j: int, dh: int) -> bool:
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
                 *, kb, dh, nkb, scale):
     qb_idx = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # (qb, dh)
+    # dots take operands in the INPUT dtype with f32 accumulation
+    # (preferred_element_type): bf16 operands keep the MXU at its bf16 peak
+    # (~4x the f32-operand rate on v5e) while statistics stay f32
+    q = q_ref[0]  # (qb, dh)
 
     def body(a, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(a * kb, kb), :].astype(jnp.float32)  # (kb, dh)
-        v = v_ref[0, pl.ds(a * kb, kb), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(a * kb, kb), :]  # (kb, dh)
+        v = v_ref[0, pl.ds(a * kb, kb), :]
         b = bias_ref[0, a]  # (kb,)
         s = jax.lax.dot_general(
             q, k,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale + b[None, :]
+        # the running max starts at a FINITE sentinel (_M0), so m - m_new is
+        # never (-inf) - (-inf): masked logits (s = -inf from the bias)
+        # reach exp as -inf and underflow to an exact 0 with no nan guard
+        # passes over the (qb, kb) tile
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        # -inf - -inf = nan guards (all-masked-so-far rows)
-        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-        alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
-        p = jnp.where(jnp.isneginf(s), 0.0, jnp.exp(s - m_safe))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        acc_new = acc * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
         return m_new, l_new, acc_new
 
     qb = q.shape[0]
-    m0 = jnp.full((qb, 1), -jnp.inf, jnp.float32)
+    m0 = jnp.full((qb, 1), _M0, jnp.float32)
     l0 = jnp.zeros((qb, 1), jnp.float32)
     acc0 = jnp.zeros((qb, dh), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nkb, body, (m0, l0, acc0))
+    m, l, acc = _block_loop(nkb, body, (m0, l0, acc0))
 
     out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
     out_ref[0] = out.astype(out_ref.dtype)
@@ -149,14 +174,14 @@ def _forward(q, k, v, bias, scale, qb, kb):
 def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
                dq_ref, *, kb, dh, nkb, scale):
     qb_idx = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    g = g_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    g = g_ref[0]
     lse = lse_ref[0, qb_idx][:, None]
     delta = delta_ref[0, qb_idx][:, None]
 
     def body(a, dq):
-        k = k_ref[0, pl.ds(a * kb, kb), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(a * kb, kb), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(a * kb, kb), :]
+        v = v_ref[0, pl.ds(a * kb, kb), :]
         b = bias_ref[0, a]
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -167,41 +192,43 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
             g, v, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta)
+        # ds in the operand dtype: bf16 ds @ k on the MXU bf16 path — the
+        # standard flash-backward precision trade (f32 accumulate)
+        ds = (p * (dp - delta)).astype(k.dtype)
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     qb = q.shape[0]
-    dq = jax.lax.fori_loop(0, nkb, body, jnp.zeros((qb, dh), jnp.float32))
+    dq = _block_loop(nkb, body, jnp.zeros((qb, dh), jnp.float32))
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, *, qb, dh, nqb, scale):
     kb_idx = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)  # (kb, dh)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]  # (kb, dh)
+    v = v_ref[0]
     b = bias_ref[0, kb_idx]            # (kb,)
 
     def body(a, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(a * qb, qb), :].astype(jnp.float32)
-        g = g_ref[0, pl.ds(a * qb, qb), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(a * qb, qb), :]
+        g = g_ref[0, pl.ds(a * qb, qb), :]
         lse = lse_ref[0, a][:, None]
         delta = delta_ref[0, a][:, None]
         s = jax.lax.dot_general(
             q, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale + b[None, :]
-        p = jnp.exp(s - lse)           # (qb, kb)
+        p = jnp.exp(s - lse)           # (qb, kb) f32
         dv = dv + jax.lax.dot_general(
-            p, g, dimension_numbers=(((0,), (0,)), ((), ())),
+            p.astype(g.dtype), g, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             g, v, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q.dtype)
         dk = dk + jax.lax.dot_general(
             ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -210,7 +237,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
 
     kbs = k.shape[0]
     zero = jnp.zeros((kbs, dh), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, nqb, body, (zero, zero))
+    dk, dv = _block_loop(nqb, body, (zero, zero))
     dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 

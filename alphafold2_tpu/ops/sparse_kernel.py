@@ -39,6 +39,8 @@ from alphafold2_tpu.ops.sparse import (
 # DeepSpeed config used additive -1e9 (attn_mask_mode='add', reference :208),
 # which leaks O(ulp) attention to masked keys at float32 — we don't copy that
 _NEG = float("-inf")
+# finite running-max sentinel (see ops/flash_kernel.py _M0)
+_M0 = -1e30
 
 
 
@@ -52,7 +54,9 @@ _NEG = float("-inf")
 def _fwd_kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
                 *, bs, dh, A, scale):
     qb = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # (bs, dh)
+    # operands stay in the input dtype; dots accumulate f32 via
+    # preferred_element_type — bf16 operands keep the MXU bf16 peak
+    q = q_ref[0]  # (bs, dh)
 
     def body(a, carry):
         m, l, acc = carry
@@ -61,28 +65,30 @@ def _fwd_kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
         def active(carry):
             m, l, acc = carry
             start = kidx * bs
-            k = k_ref[0, pl.ds(start, bs), :].astype(jnp.float32)  # (bs, dh)
-            v = v_ref[0, pl.ds(start, bs), :].astype(jnp.float32)
+            k = k_ref[0, pl.ds(start, bs), :]  # (bs, dh)
+            v = v_ref[0, pl.ds(start, bs), :]
             b = bias_ref[0, kidx]  # (bs,)
             s = jax.lax.dot_general(
                 q, k,
                 dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale + b[None, :]
+            # finite running-max sentinel (_M0): m - m_new is never
+            # (-inf) - (-inf), masked logits reach exp as -inf and
+            # underflow to exact 0 — no per-tile isneginf/where passes
+            # (same recurrence as ops/flash_kernel.py)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-            # -inf - -inf = nan guards (all-masked-so-far rows)
-            m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-            alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
-            p = jnp.where(jnp.isneginf(s), 0.0, jnp.exp(s - m_safe))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
             l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
             acc_new = acc * alpha + jnp.dot(
-                p, v, preferred_element_type=jnp.float32
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32
             )
             return m_new, l_new, acc_new
 
         return jax.lax.cond(kidx >= 0, active, lambda c: c, (m, l, acc))
 
-    m0 = jnp.full((bs, 1), -jnp.inf, jnp.float32)
+    m0 = jnp.full((bs, 1), _M0, jnp.float32)
     l0 = jnp.zeros((bs, 1), jnp.float32)
     acc0 = jnp.zeros((bs, dh), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, A, body, (m0, l0, acc0))
@@ -156,8 +162,8 @@ def _forward(q, k, v, scfg: SparseConfig, mask):
 def _dq_kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref,
                delta_ref, dq_ref, *, bs, dh, A, scale):
     qb = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)          # (bs, dh)
-    g = g_ref[0].astype(jnp.float32)          # (bs, dh)
+    q = q_ref[0]                               # (bs, dh)
+    g = g_ref[0]                               # (bs, dh)
     lse = lse_ref[0, qb][:, None]             # (bs, 1)
     delta = delta_ref[0, qb][:, None]         # (bs, 1)
 
@@ -166,8 +172,8 @@ def _dq_kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref,
 
         def active(dq):
             start = kidx * bs
-            k = k_ref[0, pl.ds(start, bs), :].astype(jnp.float32)
-            v = v_ref[0, pl.ds(start, bs), :].astype(jnp.float32)
+            k = k_ref[0, pl.ds(start, bs), :]
+            v = v_ref[0, pl.ds(start, bs), :]
             b = bias_ref[0, kidx]
             s = jax.lax.dot_general(
                 q, k, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -178,7 +184,7 @@ def _dq_kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref,
                 g, v, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )                                   # (bs_q, bs_k)
-            ds = p * (dp - delta)
+            ds = (p * (dp - delta)).astype(k.dtype)
             return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
         return jax.lax.cond(kidx >= 0, active, lambda d: d, dq)
@@ -192,8 +198,8 @@ def _dkv_kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref,
     # grid position j indexes a KEY block; by layout symmetry idx[j] lists
     # exactly the query blocks attending to it
     jb = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)          # (bs, dh)
-    v = v_ref[0].astype(jnp.float32)          # (bs, dh)
+    k = k_ref[0]                               # (bs, dh)
+    v = v_ref[0]                               # (bs, dh)
     b = bias_ref[0, jb]                        # (bs,)
 
     def body(a, carry):
@@ -203,8 +209,8 @@ def _dkv_kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref,
         def active(carry):
             dk, dv = carry
             start = qidx * bs
-            q = q_ref[0, pl.ds(start, bs), :].astype(jnp.float32)
-            g = g_ref[0, pl.ds(start, bs), :].astype(jnp.float32)
+            q = q_ref[0, pl.ds(start, bs), :]
+            g = g_ref[0, pl.ds(start, bs), :]
             lse = lse_ref[0, qidx][:, None]
             delta = delta_ref[0, qidx][:, None]
             s = jax.lax.dot_general(
@@ -213,14 +219,15 @@ def _dkv_kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref,
             ) * scale + b[None, :]
             p = jnp.exp(s - lse)               # (bs_q, bs_k)
             dv_new = dv + jax.lax.dot_general(
-                p, g, dimension_numbers=(((0,), (0,)), ((), ())),
+                p.astype(g.dtype), g,
+                dimension_numbers=(((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )                                   # (bs_k, dh)
             dp = jax.lax.dot_general(
                 g, v, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            ds = p * (dp - delta)               # (bs_q, bs_k)
+            ds = (p * (dp - delta)).astype(q.dtype)  # (bs_q, bs_k)
             dk_new = dk + jax.lax.dot_general(
                 ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,

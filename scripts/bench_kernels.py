@@ -50,7 +50,8 @@ def _time_scan(fn, args, iters):
     return (time.perf_counter() - t0) / iters
 
 
-def bench(B, n, h, dh, iters, dtype, use_kernel, grad, key_frac_masked=0.0):
+def bench(B, n, h, dh, iters, dtype, use_kernel, grad, key_frac_masked=0.0,
+          qb=None, kb=None):
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(ks[0], (B, n, h, dh), dtype)
     k = jax.random.normal(ks[1], (B, n, h, dh), dtype)
@@ -63,7 +64,10 @@ def bench(B, n, h, dh, iters, dtype, use_kernel, grad, key_frac_masked=0.0):
     from alphafold2_tpu.ops.flash import flash_attention
 
     def fwd(q, k, v):
-        return flash_attention(q, k, v, bias, use_kernel=use_kernel)
+        return flash_attention(
+            q, k, v, bias, use_kernel=use_kernel,
+            kernel_qb=qb, kernel_kb=kb,
+        )
 
     if grad:
         def fn(q, k, v):
@@ -92,6 +96,10 @@ def main():
     ap.add_argument("--masked", type=float, default=0.0)
     ap.add_argument("--paths", default="kernel,xla")
     ap.add_argument("--dirs", default="fwd,grad")
+    ap.add_argument("--qb", type=int, default=None,
+                    help="kernel query block (default: pick_block)")
+    ap.add_argument("--kb", type=int, default=None,
+                    help="kernel key block (default: pick_block)")
     args = ap.parse_args()
 
     dev = jax.devices()[0]
@@ -111,10 +119,16 @@ def main():
             sec, tflops = bench(
                 args.b, args.n, args.heads, args.dh, args.iters,
                 dtype, use_kernel, grad, args.masked,
+                qb=args.qb, kb=args.kb,
+            )
+            blocks = (  # qb/kb only affect the kernel path
+                f"_qb{args.qb or 'auto'}_kb{args.kb or 'auto'}"
+                if use_kernel and (args.qb or args.kb) else ""
             )
             print(json.dumps({
                 "path": path, "dir": d,
-                "shape": f"B{args.b}_n{args.n}_h{args.heads}_dh{args.dh}",
+                "shape": f"B{args.b}_n{args.n}_h{args.heads}_dh{args.dh}"
+                         + blocks,
                 "sec_per_iter": round(sec, 4),
                 "model_tflops_per_sec": round(tflops, 1),
                 "platform": dev.platform,
