@@ -122,33 +122,33 @@ def record(entry):
     print(json.dumps(entry), flush=True)
 
 
+def run_and_record(name, code_or_path, argv, timeout, extra=None):
+    """One measurement subprocess; False = tunnel wedged, stop the sweep
+    (a wedged worker hangs every later backend init)."""
+    res, err, dt = run_sub(code_or_path, argv, timeout)
+    record({"bench": name, **(extra or {}), "result": res, "error": err,
+            "wall": round(dt, 1)})
+    if err == "timeout":
+        record({"bench": "sweep", "error": "tunnel wedged; stopping"})
+        return False
+    return True
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="kernel microbench + one e2e config only")
     ap.add_argument("--depth", type=int, default=12)
     ap.add_argument("--skip-micro", action="store_true",
-                    help="go straight to the e2e knob sweep")
+                    help="e2e knob sweep only")
+    ap.add_argument("--xla-micro", action="store_true",
+                    help="also run the XLA-streaming micro leg (known to "
+                         "compile >550s at the chunk shape — see PERF.md; "
+                         "its timeout-kill can wedge the tunnel)")
     args = ap.parse_args()
 
-    # 1) kernel vs XLA microbench at the chunk shape the model actually
-    # calls (attn_batch_chunk=32 folded rows x 8 heads): full-B backward
-    # OOMs from dh=64 lane padding (2x per-operand HBM expansion) and is
-    # not a shape the model ever runs
-    micro = os.path.join(REPO, "scripts", "bench_kernels.py")
-    for paths in ([] if args.skip_micro else ["kernel", "xla"]):
-        res, err, dt = run_sub(
-            micro,
-            ["--b", "32", "--n", "1152", "--iters", "20", "--paths", paths],
-            timeout=1500,
-        )
-        record({"bench": f"micro_{paths}", "result": res, "error": err,
-                "wall": round(dt, 1)})
-        if err == "timeout":
-            record({"bench": "sweep", "error": "tunnel wedged; stopping"})
-            return
-
-    # 2) e2e step-time sweep
+    # 1) e2e step-time sweep FIRST: it is the sweep's purpose, and a hang
+    # in any later micro leg must not cost these measurements
     base = dict(depth=args.depth, kernel=True, batch_chunk=32,
                 tile_elems=1 << 25, mds_bwd_iters=None)
     variants = [("e2e_base", base)]
@@ -161,11 +161,32 @@ def main():
             ("e2e_mdsbwd25", {**base, "mds_bwd_iters": 25}),
         ]
     for name, spec in variants:
-        res, err, dt = run_sub(E2E_WORKER, [json.dumps(spec)], timeout=2100)
-        record({"bench": name, "spec": spec, "result": res, "error": err,
-                "wall": round(dt, 1)})
-        if err == "timeout":
-            record({"bench": "sweep", "error": "tunnel wedged; stopping"})
+        if not run_and_record(name, E2E_WORKER, [json.dumps(spec)],
+                              timeout=2100, extra={"spec": spec}):
+            return
+
+    # 2) kernel microbench + block-size tuning at the chunk shape the model
+    # actually calls (attn_batch_chunk=32 folded rows x 8 heads): the
+    # full-fold backward OOMs from dh=64 lane padding and is not a shape
+    # the model ever runs. The XLA-streaming comparison leg is OPT-IN
+    # (--xla-micro): at this shape its compile ran >550 s (PERF.md) and the
+    # timeout-kill is exactly the worker-crash that wedges the relay.
+    micro = os.path.join(REPO, "scripts", "bench_kernels.py")
+    micro_runs = []
+    if not args.skip_micro:
+        micro_runs.append(("micro_kernel", ["--paths", "kernel"]))
+        for qb, kb in ((1152, 384), (1152, 1152), (384, 1152)):
+            micro_runs.append((
+                f"micro_kernel_qb{qb}_kb{kb}",
+                ["--paths", "kernel", "--qb", str(qb), "--kb", str(kb)],
+            ))
+        if args.xla_micro:
+            micro_runs.append(("micro_xla", ["--paths", "xla"]))
+    for name, extra in micro_runs:
+        if not run_and_record(
+            name, micro, ["--b", "32", "--n", "1152", "--iters", "20", *extra],
+            timeout=1500,
+        ):
             return
 
 

@@ -21,6 +21,7 @@ def _toy(dtype, **kw):
     )
 
 
+@pytest.mark.slow
 def test_model_forward_bf16_close_to_f32():
     cfg16 = _toy(jnp.bfloat16, msa_tie_row_attn=True, cross_attn_compress_ratio=2)
     cfg32 = _toy(jnp.float32, msa_tie_row_attn=True, cross_attn_compress_ratio=2)

@@ -36,6 +36,7 @@ def _run(cfg, seq_len=16, rows=3, cols=8, templates_T=0):
         assert np.isfinite(np.asarray(leaf)).all()
 
 
+@pytest.mark.slow
 def test_config1_readme_toy():
     # BASELINE config 1: plain dense forward (reference README.md:17-48)
     _run(Alphafold2Config(dim=32, depth=2, heads=2, dim_head=8, max_seq_len=32))
@@ -58,6 +59,7 @@ def test_config3_sparse_interleaved():
     ))
 
 
+@pytest.mark.slow
 def test_config4_templates_compress_tied():
     # BASELINE config 4: template tower + KV-compressed cross-attention +
     # tied-row MSA attention, all together
@@ -141,6 +143,7 @@ def test_raw_distance_templates_match_prebinned():
     np.testing.assert_array_equal(np.asarray(out_raw), np.asarray(out_pre))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("policy", [None, "dots", "dots_no_batch"])
 def test_remat_policies_match_no_remat(policy):
     """Remat with any save policy is a pure memory/FLOP trade: outputs and

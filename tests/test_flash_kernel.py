@@ -36,19 +36,23 @@ def test_use_kernel_true_raises_on_unsupported():
 
 
 @pytest.mark.parametrize(
-    "B,i,j,qb,kb",
+    "B,i,j,qb,kb,dtype",
     [
-        (2, 64, 64, 16, 16),    # square, multiple blocks
-        (1, 40, 72, 16, 32),    # cross shapes + padding both axes
-        (2, 16, 16, 16, 16),    # single tile
+        (2, 64, 64, 16, 16, jnp.float32),   # square, multiple blocks
+        (1, 40, 72, 16, 32, jnp.float32),   # cross shapes + padding both axes
+        (2, 16, 16, 16, 16, jnp.float32),   # single tile
+        # bf16 operands: the kernel's p/ds casts and f32-accumulation path
+        # are identity under f32, so this is the ONLY default-tier coverage
+        # of the bf16 dot layout the TPU workload runs
+        (2, 64, 64, 16, 16, jnp.bfloat16),
     ],
 )
-def test_kernel_matches_dense(B, i, j, qb, kb):
+def test_kernel_matches_dense(B, i, j, qb, kb, dtype):
     h, dh = 2, 8
     ks = jax.random.split(jax.random.PRNGKey(0), 4)
-    q = jax.random.normal(ks[0], (B, i, h, dh))
-    k = jax.random.normal(ks[1], (B, j, h, dh))
-    v = jax.random.normal(ks[2], (B, j, h, dh))
+    q = jax.random.normal(ks[0], (B, i, h, dh), dtype)
+    k = jax.random.normal(ks[1], (B, j, h, dh), dtype)
+    v = jax.random.normal(ks[2], (B, j, h, dh), dtype)
     mask = jax.random.bernoulli(ks[3], 0.8, (B, j)).at[:, 0].set(True)
     bias = jnp.where(mask, 0.0, float("-inf")).astype(jnp.float32)
 
@@ -59,17 +63,26 @@ def test_kernel_matches_dense(B, i, j, qb, kb):
         fold(q), fold(k), fold(v), jnp.repeat(bias, h, axis=0),
         dh ** -0.5, qb, kb,
     )
+    assert out.dtype == dtype
     got = out.reshape(B, h, i, dh).transpose(0, 2, 1, 3)
-    want = _dense(q, k, v, bias, dh ** -0.5)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+    # the f32 oracle bounds the bf16 path's rounding, not its math
+    want = _dense(q.astype(jnp.float32), k.astype(jnp.float32),
+                  v.astype(jnp.float32), bias, dh ** -0.5)
+    atol = 1e-5 if dtype == jnp.float32 else 2e-2
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want), atol=atol
+    )
 
 
-def test_kernel_gradients_match_dense():
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_kernel_gradients_match_dense(dtype):
+    # bf16 exercises the backward's ds/p operand-dtype casts in the
+    # dq/dkv kernels (identity under f32); the f32 oracle bounds rounding
     B, i, j, h, dh = 1, 48, 40, 2, 8
     ks = jax.random.split(jax.random.PRNGKey(1), 4)
-    q = jax.random.normal(ks[0], (B, i, h, dh))
-    k = jax.random.normal(ks[1], (B, j, h, dh))
-    v = jax.random.normal(ks[2], (B, j, h, dh))
+    q = jax.random.normal(ks[0], (B, i, h, dh), dtype)
+    k = jax.random.normal(ks[1], (B, j, h, dh), dtype)
+    v = jax.random.normal(ks[2], (B, j, h, dh), dtype)
     mask = jax.random.bernoulli(ks[3], 0.75, (B, j)).at[:, 0].set(True)
     bias = jnp.where(mask, 0.0, float("-inf")).astype(jnp.float32)
 
@@ -77,15 +90,21 @@ def test_kernel_gradients_match_dense():
         o = flash_attention(
             q, k, v, bias, scale=dh ** -0.5, use_kernel=True
         )
-        return jnp.sum(jnp.sin(o))
+        return jnp.sum(jnp.sin(o.astype(jnp.float32)))
 
     def loss_dense(q, k, v):
-        return jnp.sum(jnp.sin(_dense(q, k, v, bias, dh ** -0.5)))
+        o = _dense(q.astype(jnp.float32), k.astype(jnp.float32),
+                   v.astype(jnp.float32), bias, dh ** -0.5)
+        return jnp.sum(jnp.sin(o))
 
     g1 = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
     g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    atol = 1e-4 if dtype == jnp.float32 else 5e-2
     for a, b in zip(g1, g2):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+        assert a.dtype == dtype
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), atol=atol
+        )
 
 
 def test_kernel_fully_masked_rows():

@@ -119,9 +119,11 @@ def test_model_interleaved_sparse():
         assert np.isfinite(np.asarray(leaf)).all()
 
 
-def test_pallas_kernel_matches_xla_path():
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_pallas_kernel_matches_xla_path(dtype):
     """Pallas flash-style kernel (interpret mode on CPU) == XLA block-gather
-    path, forward and gradients."""
+    path, forward and gradients. The bf16 case exercises the kernel's
+    operand-dtype dots and p/ds casts, which are identity under f32."""
     from alphafold2_tpu.ops.sparse import block_sparse_attention
     from alphafold2_tpu.ops.sparse_kernel import block_sparse_attention_tpu
 
@@ -129,27 +131,40 @@ def test_pallas_kernel_matches_xla_path():
                         num_random_blocks=2, max_seq_len=64)
     rs = np.random.RandomState(5)
     b, n, h, dh = 2, 16, 2, 8
-    q = jnp.asarray(rs.randn(b, n, h, dh).astype(np.float32))
-    k = jnp.asarray(rs.randn(b, n, h, dh).astype(np.float32))
-    v = jnp.asarray(rs.randn(b, n, h, dh).astype(np.float32))
+    q = jnp.asarray(rs.randn(b, n, h, dh).astype(np.float32)).astype(dtype)
+    k = jnp.asarray(rs.randn(b, n, h, dh).astype(np.float32)).astype(dtype)
+    v = jnp.asarray(rs.randn(b, n, h, dh).astype(np.float32)).astype(dtype)
     mask = jnp.asarray(rs.rand(b, n) > 0.2)
+    atol_out = 1e-5 if dtype == jnp.float32 else 2e-2
+    atol_grad = 1e-4 if dtype == jnp.float32 else 1e-1
 
     ref_out = block_sparse_attention(q, k, v, scfg, mask=mask)
     ker_out = block_sparse_attention_tpu(q, k, v, scfg, mask)
+    assert ker_out.dtype == dtype
     np.testing.assert_allclose(
-        np.asarray(ker_out), np.asarray(ref_out), atol=1e-5
+        np.asarray(ker_out, np.float32), np.asarray(ref_out, np.float32),
+        atol=atol_out,
     )
 
     def loss_ref(q, k, v):
-        return jnp.sum(block_sparse_attention(q, k, v, scfg, mask=mask) ** 2)
+        return jnp.sum(
+            block_sparse_attention(q, k, v, scfg, mask=mask)
+            .astype(jnp.float32) ** 2
+        )
 
     def loss_ker(q, k, v):
-        return jnp.sum(block_sparse_attention_tpu(q, k, v, scfg, mask) ** 2)
+        return jnp.sum(
+            block_sparse_attention_tpu(q, k, v, scfg, mask)
+            .astype(jnp.float32) ** 2
+        )
 
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     g_ker = jax.grad(loss_ker, argnums=(0, 1, 2))(q, k, v)
     for a, b_ in zip(g_ref, g_ker):
-        np.testing.assert_allclose(np.asarray(b_), np.asarray(a), atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(b_, np.float32), np.asarray(a, np.float32),
+            atol=atol_grad,
+        )
 
 
 def test_sparse_coexists_with_tied_rows():
