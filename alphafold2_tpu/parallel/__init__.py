@@ -6,7 +6,7 @@ distribution story (reference training_scripts/, install_deepspeed.sh):
 collectives. See SURVEY.md §2.2 for the strategy-by-strategy mapping.
 """
 
-from alphafold2_tpu.parallel.mesh import data_parallel_mesh, make_mesh
+from alphafold2_tpu.parallel.mesh import data_parallel_mesh, hybrid_mesh, make_mesh
 from alphafold2_tpu.parallel.sharding import (
     batch_shardings,
     param_spec,
@@ -50,6 +50,7 @@ __all__ = [
     "tied_row_attention_sharded",
     "make_mesh",
     "data_parallel_mesh",
+    "hybrid_mesh",
     "param_spec",
     "state_shardings",
     "batch_shardings",

@@ -170,3 +170,64 @@ def test_sp_train_step_with_msa_tied_rows():
         jax.tree_util.tree_leaves(sp_state["params"]),
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_hybrid_mesh_axes_and_step():
+    """hybrid_mesh: DCN-outer / ICI-inner axis layout, runnable step.
+
+    On the virtual CPU platform there is no slice_index, so this exercises
+    the contiguous-grouping fallback: axis names, sizes, device count, and
+    that a DP+TP train step over the hybrid mesh runs and matches the
+    plain make_mesh layout (the fallback is defined to be identical).
+    """
+    from alphafold2_tpu.parallel import hybrid_mesh
+
+    mesh = hybrid_mesh({"data": 2}, {"model": 4})
+    assert mesh.axis_names == ("data", "model")
+    assert mesh.devices.shape == (2, 4)
+    assert mesh.devices.size == 8
+
+    flat = make_mesh({"data": 2, "model": 4})
+    assert (mesh.devices == flat.devices).all()
+
+    batch = _batch()
+    sh_state, _ = sharded_train_state_init(
+        jax.random.PRNGKey(0), CFG, TCFG, mesh
+    )
+    sh_step, _ = make_sharded_train_step(
+        CFG, TCFG, mesh, batch, donate_state=False
+    )
+    _, metrics = sh_step(sh_state, batch, jax.random.PRNGKey(1))
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_hybrid_mesh_rejects_undersized_device_set():
+    from alphafold2_tpu.parallel import hybrid_mesh
+
+    with pytest.raises(ValueError, match="need 16 devices"):
+        hybrid_mesh({"data": 4}, {"model": 4})
+
+
+def test_hybrid_mesh_guards():
+    """Axis-name and slice-topology validation (error paths are testable
+    without real multi-slice hardware via stub device objects)."""
+    from alphafold2_tpu.parallel import hybrid_mesh
+
+    with pytest.raises(ValueError, match="duplicate axis"):
+        hybrid_mesh({"data": 2}, {"data": 4})
+
+    class FakeDev:
+        def __init__(self, slice_index):
+            self.slice_index = slice_index
+
+    # 16 devices on 2 slices cannot satisfy a 4-slice DCN axis
+    devs = [FakeDev(s) for s in (0, 1) for _ in range(8)]
+    with pytest.raises(ValueError, match="needs 4 slices"):
+        hybrid_mesh({"data": 4}, {"model": 4}, devices=devs)
+
+    # partial slices rejected up front: jax's granule builder needs whole
+    # slices (an arbitrary chip subset is not a torus) — 8-chip slices
+    # cannot serve a 6-wide ICI axis
+    devs = [FakeDev(0)] * 8 + [FakeDev(1)] * 4
+    with pytest.raises(ValueError, match="whole slices of exactly 6 chips"):
+        hybrid_mesh({"data": 2}, {"model": 6}, devices=devs)
