@@ -87,6 +87,10 @@ class Alphafold2Config:
     # Bigger tiles = better MXU utilization, more live memory
     attn_flash_tile_elems: int = 1 << 25
     attn_flash_kv_block: int = 2048
+    # Pallas flash-kernel QUERY block-size target (None = auto): each
+    # attention shape picks its own unpadded block up to this size (see
+    # ops/attention.py AttentionConfig.flash_qb_target)
+    attn_flash_qb_target: Optional[int] = None
     # chunk feed-forward token axes into blocks of this many tokens (0 =
     # off): bounds the GEGLU 8*dim intermediate, which at crop 384 is the
     # largest single activation in the trunk
@@ -104,6 +108,12 @@ class Alphafold2Config:
             raise ValueError(
                 f"cross_attn_mode must be 'flat' or 'aligned', "
                 f"got {self.cross_attn_mode!r}"
+            )
+        t = self.attn_flash_qb_target
+        if t is not None and (t <= 0 or t % 128):
+            raise ValueError(
+                f"attn_flash_qb_target must be a positive multiple of 128 "
+                f"(TPU lane alignment), got {t}"
             )
         if self.remat_policy not in (None, "dots", "dots_no_batch"):
             raise ValueError(
@@ -139,6 +149,7 @@ class Alphafold2Config:
             batch_chunk=self.attn_batch_chunk,
             flash_tile_elems=self.attn_flash_tile_elems,
             flash_kv_block=self.attn_flash_kv_block,
+            flash_qb_target=self.attn_flash_qb_target,
         )
 
     def cross_attn_config(self) -> AttentionConfig:
@@ -153,4 +164,5 @@ class Alphafold2Config:
             batch_chunk=self.attn_batch_chunk,
             flash_tile_elems=self.attn_flash_tile_elems,
             flash_kv_block=self.attn_flash_kv_block,
+            flash_qb_target=self.attn_flash_qb_target,
         )

@@ -67,6 +67,14 @@ class AttentionConfig:
     # MXU utilization, more live memory — tune per chip generation
     flash_tile_elems: int = 1 << 25
     flash_kv_block: int = 2048
+    # Pallas-kernel QUERY block-size target (None = auto). The actual
+    # block is pick_block(i, target=this) per attention shape, so short
+    # axes are never padded up: at target 1152, a 1152-long axis gets
+    # whole-row blocks (grid collapsed 3x vs the default 512 cap) while
+    # 384/128-long axes keep their unpadded blocks. Key blocks stay auto
+    # (a (1152, 384) f32 logit tile fits VMEM headroom; qb=kb=1152 would
+    # not). Surfaced up to Alphafold2Config for the e2e sweep.
+    flash_qb_target: Optional[int] = None
     # process the (folded) batch axis in chunks of this many elements under
     # jax.checkpoint (0 = off). Flash tiling bounds the LOGITS, but the
     # QKV/output projections still materialize over the whole folded batch —
@@ -237,9 +245,16 @@ def attention_apply(
         )
         # Pallas fused kernel on TPU (supported shapes), XLA streaming
         # otherwise (ops/flash.py dispatch)
+        if cfg.flash_qb_target is None:
+            qb = None
+        else:
+            from alphafold2_tpu.ops.flash_kernel import pick_block
+
+            qb = pick_block(i, target=cfg.flash_qb_target)
         out = flash_attention(
             q, k, v, key_bias, scale=scale,
             tile_elems=cfg.flash_tile_elems, kv_block=cfg.flash_kv_block,
+            kernel_qb=qb,
         )
         out = out.reshape(out.shape[0], i, h * dh)
         return linear(params["to_out"], out, dtype=dtype)

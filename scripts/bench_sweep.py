@@ -53,6 +53,7 @@ ecfg = E2EConfig(
         attn_flash="auto",
         attn_batch_chunk=spec["batch_chunk"],
         attn_flash_tile_elems=spec["tile_elems"],
+        attn_flash_qb_target=spec.get("qb_target"),
         ff_chunk_size=32768,
     ),
     refiner=RefinerConfig(num_tokens=14, dim=64, depth=2, msg_dim=64,
@@ -159,6 +160,10 @@ def main():
             ("e2e_chunk0", {**base, "batch_chunk": 0}),
             ("e2e_tile26", {**base, "tile_elems": 1 << 26}),
             ("e2e_mdsbwd25", {**base, "mds_bwd_iters": 25}),
+            # whole-row QUERY blocks on the 1152 axes only (pick_block
+            # leaves shorter axes unpadded): collapses the (BH, nqb) grid
+            # 3x — the per-grid-step-overhead lever (PERF.md finding 3)
+            ("e2e_qbt1152", {**base, "qb_target": 1152}),
         ]
     for name, spec in variants:
         if not run_and_record(name, E2E_WORKER, [json.dumps(spec)],
