@@ -2,23 +2,32 @@
 
 The fused fast path under ops/flash.py's blockwise streaming: QK^T ->
 streaming softmax -> AV runs entirely in VMEM per (query-block, key-block)
-tile, so logits never round-trip HBM between accumulation steps — the HBM
-traffic the XLA-level `stream_block` scan pays. Sibling of the block-sparse
-kernel (ops/sparse_kernel.py), without the index table, and supporting
-CROSS attention (query and key lengths differ) — the shape the aligned
-cross-attention mode produces (models/trunk.py).
+tile, so logits never round-trip HBM — the traffic the XLA-level
+`stream_block` scan pays between accumulation steps. Sibling of the
+block-sparse kernel (ops/sparse_kernel.py), without the index table, and
+supporting CROSS attention (query and key lengths differ) — the shape the
+aligned cross-attention mode produces (models/trunk.py).
+
+Streaming layout: each kernel runs a 3-D grid whose LAST dimension walks
+the contraction blocks sequentially (dimension_semantics "arbitrary") with
+running statistics in VMEM scratch, while Mosaic's pipeline double-buffers
+the K/V (or Q/G) block fetches. Nothing is ever fully VMEM-resident per
+grid row — unlike the previous design (whole K/V held per (batch*head)
+row), the supported length is bounded only by the f32 row vectors (bias,
+lse, delta) at 4 bytes per position, so the kernel also covers the long-j
+flat cross-attention shapes that previously fell back to XLA streaming.
 
 Layout and numerics follow ops/sparse_kernel.py: (b*h, n, dh) flattened
-heads, float32 streaming statistics with -inf masking (fully-masked rows
-return zeros; +inf lse makes the backward's recomputed p vanish for them),
-key-side additive bias only (ops/flash.py contract). Backward recomputes
-tile logits from the saved lse: a dq kernel loops key blocks per query
-block; a dk/dv kernel loops query blocks per key block.
-
-Keys/values are VMEM-resident per (batch*head) row, which bounds the
-supported key length (see `supported`); longer contexts fall back to the
-XLA streaming path in ops/flash.py. On non-TPU backends the kernels run in
-interpreter mode (tests), keeping one code path.
+heads, float32 streaming statistics, finite running-max sentinel (_M0) so
+masked logits (-inf bias) underflow to exact 0 with no nan-guard passes,
+key-side additive bias only (ops/flash.py contract; fully-masked rows
+return zeros, +inf lse makes the backward's recomputed p vanish). Dots
+take operands in the INPUT dtype with f32 accumulation
+(preferred_element_type): bf16 operands keep the MXU at its bf16 peak.
+Backward recomputes tile logits from the saved lse: a dq kernel streams
+key blocks per query block; a dk/dv kernel streams query blocks per key
+block. On non-TPU backends the kernels run in interpreter mode (tests),
+keeping one code path.
 """
 
 from __future__ import annotations
@@ -28,6 +37,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from alphafold2_tpu.ops.core import pallas_interpret as _interpret
 
@@ -36,210 +46,23 @@ _NEG = float("-inf")
 # of (-inf) - (-inf) = nan without per-tile isneginf/where passes. Logits
 # below this are treated as fully masked (the standard flash-kernel trade).
 _M0 = -1e30
-# K/V-block loops with a static trip count at or below this unroll into
-# straight-line code (Mosaic software-pipelines across blocks); longer
-# loops fall back to fori_loop to bound code size
-_UNROLL_MAX = 8
 
-
-def _block_loop(n, body, init):
-    """fori_loop over blocks, unrolled to straight-line code when short."""
-    if n <= _UNROLL_MAX:
-        carry = init
-        for a in range(n):
-            carry = body(a, carry)
-        return carry
-    return jax.lax.fori_loop(0, n, body, init)
-
-# VMEM budget for the resident operands of the worst kernel: the dk/dv
-# backward keeps the FULL Q and G f32 copies per grid row, the forward/dq
-# kernels the full K and V — so both i and j bound residency jointly.
-# ~12 MB leaves headroom under the ~16 MB/core VMEM for tiles and spills.
+# VMEM budget for the per-grid-row RESIDENT operands: the f32 row vectors
+# only (key bias at 4 B/key; lse + delta at 8 B/query in the backward).
+# Blocks stream; ~12 MB leaves headroom under the ~16 MB/core VMEM for the
+# double-buffered tiles and scratch.
 _VMEM_BUDGET_BYTES = 12 * 1024 * 1024
 
 
 def supported(i: int, j: int, dh: int) -> bool:
     """Shapes the kernel handles; everything else streams via XLA.
 
-    Joint (i + j) * dh byte bound: each kernel keeps two full f32 copies of
-    either the query-side (Q, G in dk/dv) or key-side (K, V in fwd/dq)
-    arrays VMEM-resident per (batch*head) grid row.
+    Only the f32 row vectors are VMEM-resident per (batch*head) grid row
+    (bias: 4j bytes; lse + delta: 8i bytes in the backward) — K/V and Q/G
+    blocks stream through the grid's sequential dimension.
     """
-    resident = 2 * 4 * dh * (i + j)
+    resident = 4 * j + 8 * i
     return resident <= _VMEM_BUDGET_BYTES and dh % 8 == 0 and dh <= 512
-
-
-# ---------------------------------------------------------------------------
-# forward
-# ---------------------------------------------------------------------------
-
-
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
-                *, kb, dh, nkb, scale):
-    qb_idx = pl.program_id(1)
-    # dots take operands in the INPUT dtype with f32 accumulation
-    # (preferred_element_type): bf16 operands keep the MXU at its bf16 peak
-    # (~4x the f32-operand rate on v5e) while statistics stay f32
-    q = q_ref[0]  # (qb, dh)
-
-    def body(a, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(a * kb, kb), :]  # (kb, dh)
-        v = v_ref[0, pl.ds(a * kb, kb), :]
-        b = bias_ref[0, a]  # (kb,)
-        s = jax.lax.dot_general(
-            q, k,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale + b[None, :]
-        # the running max starts at a FINITE sentinel (_M0), so m - m_new is
-        # never (-inf) - (-inf): masked logits (s = -inf from the bias)
-        # reach exp as -inf and underflow to an exact 0 with no nan guard
-        # passes over the (qb, kb) tile
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32
-        )
-        return m_new, l_new, acc_new
-
-    qb = q.shape[0]
-    m0 = jnp.full((qb, 1), _M0, jnp.float32)
-    l0 = jnp.zeros((qb, 1), jnp.float32)
-    acc0 = jnp.zeros((qb, dh), jnp.float32)
-    m, l, acc = _block_loop(nkb, body, (m0, l0, acc0))
-
-    out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
-    out_ref[0] = out.astype(out_ref.dtype)
-    # +inf for rows with no active mass: exp(s - inf) = 0 zeroes every
-    # recomputed p in the backward (lse travels as (1, nQB, qb) blocks —
-    # Mosaic rejects (1, qb) row blocks over 2-D arrays)
-    lse = jnp.where(l > 0, m + jnp.log(jnp.where(l > 0, l, 1.0)), jnp.inf)
-    lse_ref[0, qb_idx] = lse[:, 0]
-
-
-def _pad_args(q, k, v, bias, qb, kb):
-    """Pad query/key lengths to block multiples (-inf bias on padded keys)."""
-    BH, i, dh = q.shape
-    j = k.shape[1]
-    pad_i = (-i) % qb
-    pad_j = (-j) % kb
-    if pad_i:
-        q = jnp.pad(q, ((0, 0), (0, pad_i), (0, 0)))
-    if pad_j:
-        k = jnp.pad(k, ((0, 0), (0, pad_j), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, pad_j), (0, 0)))
-        bias = jnp.pad(bias, ((0, 0), (0, pad_j)), constant_values=_NEG)
-    return q, k, v, bias, i + pad_i, j + pad_j
-
-
-def _forward(q, k, v, bias, scale, qb, kb):
-    """q: (BH, i, dh); k, v: (BH, j, dh); bias: (BHB, j) where BHB is BH or
-    a broadcastable batch dim handled by the caller (here: exactly BH)."""
-    BH, i0, dh = q.shape
-    j0 = k.shape[1]
-    q, k, v, bias, i, j = _pad_args(q, k, v, bias, qb, kb)
-    nqb, nkb = i // qb, j // kb
-    bias3 = bias.reshape(BH, nkb, kb)
-
-    out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, kb=kb, dh=dh, nkb=nkb, scale=scale),
-        out_shape=[
-            jax.ShapeDtypeStruct((BH, i, dh), q.dtype),
-            jax.ShapeDtypeStruct((BH, nqb, qb), jnp.float32),
-        ],
-        grid=(BH, nqb),
-        in_specs=[
-            pl.BlockSpec((1, qb, dh), lambda b, qi: (b, qi, 0)),
-            pl.BlockSpec((1, j, dh), lambda b, qi: (b, 0, 0)),
-            pl.BlockSpec((1, j, dh), lambda b, qi: (b, 0, 0)),
-            pl.BlockSpec((1, nkb, kb), lambda b, qi: (b, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, qb, dh), lambda b, qi: (b, qi, 0)),
-            pl.BlockSpec((1, nqb, qb), lambda b, qi: (b, 0, 0)),
-        ],
-        interpret=_interpret(),
-    )(q, k, v, bias3)
-    return out[:, :i0], (q, k, v, bias3, lse, i0, j0)
-
-
-# ---------------------------------------------------------------------------
-# backward
-# ---------------------------------------------------------------------------
-
-
-def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
-               dq_ref, *, kb, dh, nkb, scale):
-    qb_idx = pl.program_id(1)
-    q = q_ref[0]
-    g = g_ref[0]
-    lse = lse_ref[0, qb_idx][:, None]
-    delta = delta_ref[0, qb_idx][:, None]
-
-    def body(a, dq):
-        k = k_ref[0, pl.ds(a * kb, kb), :]
-        v = v_ref[0, pl.ds(a * kb, kb), :]
-        b = bias_ref[0, a]
-        s = jax.lax.dot_general(
-            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale + b[None, :]
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(
-            g, v, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        # ds in the operand dtype: bf16 ds @ k on the MXU bf16 path — the
-        # standard flash-backward precision trade (f32 accumulate)
-        ds = (p * (dp - delta)).astype(k.dtype)
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
-
-    qb = q.shape[0]
-    dq = _block_loop(nkb, body, jnp.zeros((qb, dh), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
-
-
-def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, qb, dh, nqb, scale):
-    kb_idx = pl.program_id(1)
-    k = k_ref[0]  # (kb, dh)
-    v = v_ref[0]
-    b = bias_ref[0, kb_idx]            # (kb,)
-
-    def body(a, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(a * qb, qb), :]
-        g = g_ref[0, pl.ds(a * qb, qb), :]
-        lse = lse_ref[0, a][:, None]
-        delta = delta_ref[0, a][:, None]
-        s = jax.lax.dot_general(
-            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale + b[None, :]
-        p = jnp.exp(s - lse)           # (qb, kb) f32
-        dv = dv + jax.lax.dot_general(
-            p.astype(g.dtype), g, dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = jax.lax.dot_general(
-            g, v, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = (p * (dp - delta)).astype(q.dtype)
-        dk = dk + jax.lax.dot_general(
-            ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return dk, dv
-
-    kbs = k.shape[0]
-    zero = jnp.zeros((kbs, dh), jnp.float32)
-    dk, dv = _block_loop(nqb, body, (zero, zero))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def pick_block(n: int, target: int = 512, mult: int = 128, tol: float = 0.15) -> int:
@@ -259,18 +82,219 @@ def pick_block(n: int, target: int = 512, mult: int = 128, tol: float = 0.15) ->
     return max(b for b, p in padded.items() if p <= best * (1 + tol))
 
 
+def _block_target(dh: int) -> int:
+    """Cap block size so per-grid-step tiles fit VMEM: the worst kernel
+    step holds ~6 f32 tiles of (block, dh) plus a (qb, kb) logit tile,
+    double-buffered. dh=64 (the framework's head dim) keeps the full 512;
+    dh=512 drops to 256."""
+    return max(128, min(512, (4 << 20) // (24 * dh) // 128 * 128))
+
+
+def _pad_args(q, k, v, bias, qb, kb):
+    """Pad query/key lengths to block multiples (-inf bias on padded keys)."""
+    BH, i, dh = q.shape
+    j = k.shape[1]
+    pad_i = (-i) % qb
+    pad_j = (-j) % kb
+    if pad_i:
+        q = jnp.pad(q, ((0, 0), (0, pad_i), (0, 0)))
+    if pad_j:
+        k = jnp.pad(k, ((0, 0), (0, pad_j), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_j), (0, 0)))
+        bias = jnp.pad(bias, ((0, 0), (0, pad_j)), constant_values=_NEG)
+    return q, k, v, bias, i + pad_i, j + pad_j
+
+
+# Backward kernels: first two grid dims parallel (their output windows are
+# private per (b, block) pair), streamed contraction dim sequential.
+_BWD_PARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary")
+)
+# Forward: the lse output window (1, nqb, qb) is SHARED across qi, so qi
+# must not be split across megacore TPU cores (each core's private copy of
+# the whole window would clobber the other's rows on write-back) — qi runs
+# sequentially; the (batch*head) dim carries all the parallelism.
+_FWD_PARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "arbitrary", "arbitrary")
+)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, nkb, scale):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _M0, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    q = q_ref[0]          # (qb, dh), input dtype
+    k = k_ref[0]          # (kb, dh)
+    v = v_ref[0]
+    b = bias_ref[0, ki]   # (kb,) f32, resident row vector
+    s = jax.lax.dot_general(
+        q, k,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale + b[None, :]
+
+    m = m_scr[...]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
+    m_scr[...] = m_new
+
+    @pl.when(ki == nkb - 1)
+    def _finish():
+        l = l_scr[...]
+        safe = jnp.where(l > 0, l, 1.0)
+        out_ref[0] = jnp.where(l > 0, acc_scr[...] / safe, 0.0).astype(
+            out_ref.dtype
+        )
+        # +inf for rows with no active mass: exp(s - inf) = 0 zeroes every
+        # recomputed p in the backward (lse rides as a resident
+        # (1, nQB, qb) block — Mosaic rejects (1, qb) row blocks)
+        lse = jnp.where(l > 0, m_scr[...] + jnp.log(safe), jnp.inf)
+        lse_ref[0, qi] = lse[:, 0]
+
+
+def _forward(q, k, v, bias, scale, qb, kb):
+    """q: (BH, i, dh); k, v: (BH, j, dh); bias: (BH, j) additive f32."""
+    BH, i0, dh = q.shape
+    j0 = k.shape[1]
+    q, k, v, bias, i, j = _pad_args(q, k, v, bias, qb, kb)
+    nqb, nkb = i // qb, j // kb
+    bias3 = bias.reshape(BH, nkb, kb)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, nkb=nkb, scale=scale),
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, i, dh), q.dtype),
+            jax.ShapeDtypeStruct((BH, nqb, qb), jnp.float32),
+        ],
+        grid=(BH, nqb, nkb),
+        in_specs=[
+            pl.BlockSpec((1, qb, dh), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, kb, dh), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, kb, dh), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, nkb, kb), lambda b, qi, ki: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, qb, dh), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, nqb, qb), lambda b, qi, ki: (b, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((qb, 1), jnp.float32),
+            pltpu.VMEM((qb, 1), jnp.float32),
+            pltpu.VMEM((qb, dh), jnp.float32),
+        ],
+        compiler_params=_FWD_PARAMS,
+        interpret=_interpret(),
+    )(q, k, v, bias3)
+    return out[:, :i0], (q, k, v, bias3, lse, i0, j0)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
+               dq_ref, dq_scr, *, nkb, scale):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    q = q_ref[0]
+    g = g_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    b = bias_ref[0, ki]
+    lse = lse_ref[0, qi][:, None]
+    delta = delta_ref[0, qi][:, None]
+
+    s = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale + b[None, :]
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        g, v, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    # ds in the operand dtype: bf16 ds @ k on the MXU bf16 path — the
+    # standard flash-backward precision trade (f32 accumulate)
+    ds = (p * (dp - delta)).astype(k.dtype)
+    dq_scr[...] = dq_scr[...] + jnp.dot(
+        ds, k, preferred_element_type=jnp.float32
+    )
+
+    @pl.when(ki == nkb - 1)
+    def _finish():
+        dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, nqb, scale):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    k = k_ref[0]                      # (kb, dh)
+    v = v_ref[0]
+    q = q_ref[0]                      # (qb, dh)
+    g = g_ref[0]
+    b = bias_ref[0, ki]               # (kb,)
+    lse = lse_ref[0, qi][:, None]
+    delta = delta_ref[0, qi][:, None]
+
+    s = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale + b[None, :]
+    p = jnp.exp(s - lse)              # (qb, kb) f32
+    dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+        p.astype(g.dtype), g, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        g, v, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = (p * (dp - delta)).astype(q.dtype)
+    dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+        ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(qi == nqb - 1)
+    def _finish():
+        dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def _flash_core(q, k, v, key_bias, scale, qb, kb):
     out, _ = _forward(q, k, v, key_bias, scale, qb, kb)
     return out
-
-
-def _block_target(dh: int) -> int:
-    """Cap block size so per-grid-step tiles fit the VMEM headroom left by
-    `supported`'s 12 MB resident budget (~4 MB): the worst kernel holds ~6
-    f32 tiles of (block, dh) plus a (qb, kb) logit tile per step. dh=64
-    (the framework's head dim) keeps the full 512; dh=512 drops to 256."""
-    return max(128, min(512, (4 << 20) // (24 * dh) // 128 * 128))
 
 
 def flash_attention_tpu(q, k, v, key_bias, scale, qb=None, kb=None):
@@ -305,31 +329,40 @@ def _bwd(scale, qb, kb, res, g):
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     ).reshape(BH, nqb, qb)
 
-    blk_q = pl.BlockSpec((1, qb, dh), lambda b, qi: (b, qi, 0))
-    blk_k = pl.BlockSpec((1, kb, dh), lambda b, ki: (b, ki, 0))
-    full_q = pl.BlockSpec((1, i, dh), lambda b, x: (b, 0, 0))
-    full_k = pl.BlockSpec((1, j, dh), lambda b, x: (b, 0, 0))
-    rows_q = pl.BlockSpec((1, nqb, qb), lambda b, x: (b, 0, 0))
-    rows_k = pl.BlockSpec((1, nkb, kb), lambda b, x: (b, 0, 0))
+    blk_q = pl.BlockSpec((1, qb, dh), lambda b, x, y: (b, x, 0))
+    blk_q_inner = pl.BlockSpec((1, qb, dh), lambda b, x, y: (b, y, 0))
+    blk_k = pl.BlockSpec((1, kb, dh), lambda b, x, y: (b, x, 0))
+    blk_k_inner = pl.BlockSpec((1, kb, dh), lambda b, x, y: (b, y, 0))
+    rows_q = pl.BlockSpec((1, nqb, qb), lambda b, x, y: (b, 0, 0))
+    rows_k = pl.BlockSpec((1, nkb, kb), lambda b, x, y: (b, 0, 0))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, kb=kb, dh=dh, nkb=nkb, scale=scale),
+        functools.partial(_dq_kernel, nkb=nkb, scale=scale),
         out_shape=jax.ShapeDtypeStruct((BH, i, dh), qp.dtype),
-        grid=(BH, nqb),
-        in_specs=[blk_q, full_k, full_k, rows_k, blk_q, rows_q, rows_q],
+        grid=(BH, nqb, nkb),
+        in_specs=[blk_q, blk_k_inner, blk_k_inner, rows_k, blk_q,
+                  rows_q, rows_q],
         out_specs=blk_q,
+        scratch_shapes=[pltpu.VMEM((qb, dh), jnp.float32)],
+        compiler_params=_BWD_PARAMS,
         interpret=_interpret(),
     )(qp, kp, vp, bias3, g, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, qb=qb, dh=dh, nqb=nqb, scale=scale),
+        functools.partial(_dkv_kernel, nqb=nqb, scale=scale),
         out_shape=[
             jax.ShapeDtypeStruct((BH, j, dh), kp.dtype),
             jax.ShapeDtypeStruct((BH, j, dh), vp.dtype),
         ],
-        grid=(BH, nkb),
-        in_specs=[full_q, blk_k, blk_k, rows_k, full_q, rows_q, rows_q],
+        grid=(BH, nkb, nqb),
+        in_specs=[blk_q_inner, blk_k, blk_k, rows_k, blk_q_inner,
+                  rows_q, rows_q],
         out_specs=[blk_k, blk_k],
+        scratch_shapes=[
+            pltpu.VMEM((kb, dh), jnp.float32),
+            pltpu.VMEM((kb, dh), jnp.float32),
+        ],
+        compiler_params=_BWD_PARAMS,
         interpret=_interpret(),
     )(qp, kp, vp, bias3, g, lse, delta)
 

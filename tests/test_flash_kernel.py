@@ -23,8 +23,14 @@ def _dense(q, k, v, bias, scale):
 
 def test_supported_shapes():
     assert supported(1024, 2048, 64)
-    assert not supported(16, 10 ** 6, 64)  # keys exceed VMEM residency
-    assert not supported(262144, 16384, 64)  # queries count too (dkv kernel)
+    # streaming design: K/V and Q/G blocks are never fully resident, so
+    # long axes previously rejected (whole-K/V-per-row residency) now run
+    # in the kernel instead of falling back to XLA streaming
+    assert supported(16, 10 ** 6, 64)
+    assert supported(262144, 16384, 64)
+    # only the f32 row vectors (bias 4j; lse+delta 8i) bound the length
+    assert not supported(16, 10 ** 7, 64)
+    assert not supported(10 ** 7, 16, 64)
     assert not supported(16, 16, 7)
 
 
