@@ -186,11 +186,20 @@ def flash_attention(q, k, v, key_bias=None, *, scale=None, use_kernel="auto",
     kernel's query/key block sizes (None = padding-aware pick_block) —
     kernel path only, used for block tuning (scripts/bench_kernels.py).
     """
+    import os
+
     from alphafold2_tpu.ops import flash_kernel
 
     B, i, h, dh = q.shape
     j = k.shape[1]
     scale = dh ** -0.5 if scale is None else scale
+
+    # operational escape hatch (read at trace time): lets bench.py retry a
+    # failed TPU attempt with the kernel off, so a kernel-compile regression
+    # degrades to the XLA streaming path instead of losing the measurement
+    disable = os.environ.get("AF2_DISABLE_FLASH_KERNEL", "")
+    if disable.lower() not in ("", "0", "false") and use_kernel == "auto":
+        use_kernel = False
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if use_kernel is True and not flash_kernel.supported(i, j, dh):
