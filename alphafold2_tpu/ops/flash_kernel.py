@@ -90,6 +90,14 @@ def _block_target(dh: int) -> int:
     return max(128, min(512, (4 << 20) // (24 * dh) // 128 * 128))
 
 
+def _out_struct(shape, dtype, *operands):
+    """ShapeDtypeStruct whose `vma` (varying-across-mesh-axes set) is the
+    union of the operands' — required for pallas_call under shard_map with
+    vma checking (e.g. the ring-attention hops)."""
+    vma = frozenset().union(*(jax.typeof(o).vma for o in operands))
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
 def _pad_args(q, k, v, bias, qb, kb):
     """Pad query/key lengths to block multiples (-inf bias on padded keys)."""
     BH, i, dh = q.shape
@@ -180,8 +188,8 @@ def _forward(q, k, v, bias, scale, qb, kb):
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, nkb=nkb, scale=scale),
         out_shape=[
-            jax.ShapeDtypeStruct((BH, i, dh), q.dtype),
-            jax.ShapeDtypeStruct((BH, nqb, qb), jnp.float32),
+            _out_struct((BH, i, dh), q.dtype, q, k, v, bias3),
+            _out_struct((BH, nqb, qb), jnp.float32, q, k, v, bias3),
         ],
         grid=(BH, nqb, nkb),
         in_specs=[
@@ -297,6 +305,30 @@ def _flash_core(q, k, v, key_bias, scale, qb, kb):
     return out
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_core_lse(q, k, v, key_bias, scale, qb, kb):
+    out, (_, _, _, _, lse, i0, _) = _forward(q, k, v, key_bias, scale, qb, kb)
+    return out, lse.reshape(lse.shape[0], -1)[:, :i0]
+
+
+def flash_attention_lse(q, k, v, key_bias, scale, qb=None, kb=None):
+    """`flash_attention_tpu` that ALSO returns the per-row log-sum-exp.
+
+    Returns (out (BH, i, dh), lse (BH, i) f32). lse is +inf for rows with
+    no unmasked keys (zero attention mass — note the INVERTED convention
+    vs the usual -inf-for-empty: +inf makes the backward's recomputed
+    p = exp(s - lse) vanish). Differentiable in q/k/v including through
+    lse — the lse cotangent folds into the softmax-jacobian diagonal
+    (delta_eff = delta - g_lse), so the backward kernels are shared with
+    the plain path. This is the building block for cross-chip softmax
+    combination (ring attention, parallel/sequence.py).
+    """
+    dh = q.shape[-1]
+    qb = pick_block(q.shape[1], target=_block_target(dh)) if qb is None else qb
+    kb = pick_block(k.shape[1], target=_block_target(dh)) if kb is None else kb
+    return _flash_core_lse(q, k, v, key_bias, scale, qb, kb)
+
+
 def flash_attention_tpu(q, k, v, key_bias, scale, qb=None, kb=None):
     """Fused dense flash attention. q: (BH, i, dh); k, v: (BH, j, dh);
     key_bias: (BH, j) additive f32 (0 valid / -inf masked). Returns
@@ -313,7 +345,7 @@ def _fwd(q, k, v, key_bias, scale, qb, kb):
     return out, (qp, kp, vp, bias3, lse, out, i0, j0)
 
 
-def _bwd(scale, qb, kb, res, g):
+def _bwd_impl(scale, qb, kb, res, g, g_lse=None):
     qp, kp, vp, bias3, lse, out, i0, j0 = res
     BH, i, dh = qp.shape
     j = kp.shape[1]
@@ -324,10 +356,19 @@ def _bwd(scale, qb, kb, res, g):
         g = jnp.pad(g, ((0, 0), (0, pad_i), (0, 0)))
         out = jnp.pad(out, ((0, 0), (0, pad_i), (0, 0)))
 
-    # delta_i = rowsum(dO_i * O_i), the softmax-jacobian diagonal term
+    # delta_i = rowsum(dO_i * O_i), the softmax-jacobian diagonal term.
+    # An lse cotangent folds in here: d lse_i / d s_ij = p_ij, so
+    # ds_ij = p_ij * (dp_ij - (delta_i - glse_i)) — same kernels, shifted
+    # diagonal
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    ).reshape(BH, nqb, qb)
+    )
+    if g_lse is not None:
+        glse = g_lse.astype(jnp.float32)
+        if pad_i:
+            glse = jnp.pad(glse, ((0, 0), (0, pad_i)))
+        delta = delta - glse
+    delta = delta.reshape(BH, nqb, qb)
 
     blk_q = pl.BlockSpec((1, qb, dh), lambda b, x, y: (b, x, 0))
     blk_q_inner = pl.BlockSpec((1, qb, dh), lambda b, x, y: (b, y, 0))
@@ -338,7 +379,7 @@ def _bwd(scale, qb, kb, res, g):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, nkb=nkb, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((BH, i, dh), qp.dtype),
+        out_shape=_out_struct((BH, i, dh), qp.dtype, qp, kp, vp, g),
         grid=(BH, nqb, nkb),
         in_specs=[blk_q, blk_k_inner, blk_k_inner, rows_k, blk_q,
                   rows_q, rows_q],
@@ -351,8 +392,8 @@ def _bwd(scale, qb, kb, res, g):
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, nqb=nqb, scale=scale),
         out_shape=[
-            jax.ShapeDtypeStruct((BH, j, dh), kp.dtype),
-            jax.ShapeDtypeStruct((BH, j, dh), vp.dtype),
+            _out_struct((BH, j, dh), kp.dtype, qp, kp, vp, g),
+            _out_struct((BH, j, dh), vp.dtype, qp, kp, vp, g),
         ],
         grid=(BH, nkb, nqb),
         in_specs=[blk_q_inner, blk_k, blk_k, rows_k, blk_q_inner,
@@ -376,4 +417,22 @@ def _bwd(scale, qb, kb, res, g):
     )
 
 
+def _bwd(scale, qb, kb, res, g):
+    return _bwd_impl(scale, qb, kb, res, g)
+
+
 _flash_core.defvjp(_fwd, _bwd)
+
+
+def _fwd_lse(q, k, v, key_bias, scale, qb, kb):
+    out, (qp, kp, vp, bias3, lse, i0, j0) = _forward(q, k, v, key_bias, scale, qb, kb)
+    lse_flat = lse.reshape(lse.shape[0], -1)[:, :i0]
+    return (out, lse_flat), (qp, kp, vp, bias3, lse, out, i0, j0)
+
+
+def _bwd_lse(scale, qb, kb, res, gs):
+    g, g_lse = gs
+    return _bwd_impl(scale, qb, kb, res, g, g_lse=g_lse)
+
+
+_flash_core_lse.defvjp(_fwd_lse, _bwd_lse)

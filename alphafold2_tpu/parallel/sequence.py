@@ -39,7 +39,7 @@ from alphafold2_tpu.ops.flash import _tile_attention, stream_block as _stream_bl
 _NEG_INF = float("-inf")
 
 
-def ring_attention(q, k, v, axis_name: str, mask=None):
+def ring_attention(q, k, v, axis_name: str, mask=None, use_kernel="auto"):
     """Exact ring attention over a sharded sequence axis.
 
     Call inside `shard_map` with the sequence axis sharded over `axis_name`.
@@ -49,6 +49,11 @@ def ring_attention(q, k, v, axis_name: str, mask=None):
       mask: (b, n_local) bool key-validity for the local shard (key-side
         masking, matching the reference's key_padding semantics,
         alphafold2.py:156-161 / DeepSpeed attn_mask_mode='add').
+      use_kernel: per-hop compute path. "auto" uses the Pallas flash
+        kernel on TPU for supported shapes (each hop emits (out, lse) and
+        hops combine in log space — ops/flash_kernel.flash_attention_lse);
+        True forces it (interpret mode off-TPU, for tests); False keeps
+        the XLA stream_block recurrence.
 
     Returns: (b, n_local, h, d) attention output for the local Q shard.
     """
@@ -67,11 +72,24 @@ def ring_attention(q, k, v, axis_name: str, mask=None):
         if mask is None
         else jnp.where(mask, 0.0, _NEG_INF).astype(jnp.float32)
     )
+    perm = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+
+    from alphafold2_tpu.ops import flash_kernel
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    kernel = use_kernel is True or (
+        use_kernel == "auto"
+        and on_tpu
+        and flash_kernel.supported(n_local, nk_local, d)
+    )
+    if kernel:
+        return _ring_attention_kernel(
+            q, k, v, bias, axis_name, scale, num_shards, perm
+        )
 
     m0 = varying(jnp.full((b, h, n_local), _NEG_INF, jnp.float32))
     l0 = varying(jnp.zeros((b, h, n_local), jnp.float32))
     acc0 = varying(jnp.zeros((b, h, n_local, d), jnp.float32))
-    perm = [(i, (i + 1) % num_shards) for i in range(num_shards)]
 
     # resident block first, then rotate-before-compute for the remaining
     # num_shards-1 blocks: exactly P-1 neighbor copies, no discarded final
@@ -93,6 +111,62 @@ def ring_attention(q, k, v, axis_name: str, mask=None):
     )
     out = acc / jnp.where(l > 0, l, 1.0)[..., None]  # zeros for fully-masked q
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def _ring_attention_kernel(q, k, v, bias, axis_name, scale, num_shards, perm):
+    """Ring hops through the Pallas flash kernel: each hop produces its
+    local (out, lse) fused in VMEM (ops/flash_kernel.flash_attention_lse),
+    and hops merge by log-space weighting — the communication pattern is
+    identical to the XLA path (P-1 neighbor ppermutes), only the per-hop
+    compute is fused."""
+    from alphafold2_tpu.ops.flash_kernel import flash_attention_lse
+
+    b, n_local, h, d = q.shape
+
+    def fold(t):
+        return t.transpose(0, 2, 1, 3).reshape(b * h, t.shape[1], d)
+
+    qf = fold(q)
+
+    def hop(kf, vf, bias_blk):
+        out_h, lse_h = flash_attention_lse(
+            qf, kf, vf, jnp.repeat(bias_blk, h, axis=0), scale
+        )
+        # the kernel marks zero-mass rows with +inf lse (backward
+        # convention); for cross-hop combination zero mass must weigh
+        # ZERO — flip to -inf
+        lse_h = jnp.where(jnp.isposinf(lse_h), _NEG_INF, lse_h)
+        return out_h.astype(jnp.float32), lse_h
+
+    out, lse = hop(fold(k), fold(v), bias)
+
+    def body(_, carry):
+        out, lse, k_blk, v_blk, bias_blk = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        bias_blk = jax.lax.ppermute(bias_blk, axis_name, perm)
+        out_h, lse_h = hop(k_blk, v_blk, bias_blk)
+
+        # log-space merge of two normalized partial softmaxes:
+        # new_out = (e^lse*out + e^lse_h*out_h) / (e^lse + e^lse_h)
+        m = jnp.maximum(lse, lse_h)
+        m_safe = jnp.where(jnp.isneginf(m), 0.0, m)  # both-empty rows
+        w_a = jnp.exp(lse - m_safe)
+        w_b = jnp.exp(lse_h - m_safe)
+        tot = w_a + w_b
+        safe_tot = jnp.where(tot > 0, tot, 1.0)
+        out = jnp.where(
+            (tot > 0)[..., None],
+            (out * w_a[..., None] + out_h * w_b[..., None]) / safe_tot[..., None],
+            0.0,
+        )
+        lse = jnp.where(tot > 0, m_safe + jnp.log(safe_tot), _NEG_INF)
+        return out, lse, k_blk, v_blk, bias_blk
+
+    out, lse, _, _, _ = jax.lax.fori_loop(
+        1, num_shards, body, (out, lse, fold(k), fold(v), bias)
+    )
+    return out.reshape(b, h, n_local, d).transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name: str, mask=None):
