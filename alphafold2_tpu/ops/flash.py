@@ -174,6 +174,35 @@ def blockwise_attention(
     return out[:, :i] if pad_i else out
 
 
+def kernel_dispatch(i: int, j: int, dh: int, use_kernel) -> bool:
+    """Resolve the tri-state `use_kernel` into a concrete decision.
+
+    THE single gate for the Pallas dense kernel — flash_attention and
+    ring_attention (parallel/sequence.py) both route here, so the
+    AF2_DISABLE_FLASH_KERNEL escape hatch and the loud unsupported-shape
+    error hold everywhere. True forces the kernel (ValueError on
+    unsupported shapes — forcing must not silently fall back), False
+    forces XLA streaming, "auto" = kernel on TPU for supported shapes,
+    honoring the env kill-switch ("0"/"false" mean enabled).
+    """
+    import os
+
+    from alphafold2_tpu.ops import flash_kernel
+
+    disable = os.environ.get("AF2_DISABLE_FLASH_KERNEL", "")
+    if disable.lower() not in ("", "0", "false") and use_kernel == "auto":
+        use_kernel = False
+    if use_kernel is True and not flash_kernel.supported(i, j, dh):
+        raise ValueError(
+            f"flash kernel does not support shapes i={i}, j={j}, dh={dh} "
+            f"(VMEM residency bound, see ops/flash_kernel.py supported)"
+        )
+    on_tpu = jax.devices()[0].platform == "tpu"
+    return use_kernel is True or (
+        use_kernel == "auto" and on_tpu and flash_kernel.supported(i, j, dh)
+    )
+
+
 def flash_attention(q, k, v, key_bias=None, *, scale=None, use_kernel="auto",
                     kernel_qb=None, kernel_kb=None, **blockwise_kwargs):
     """Exact attention: fused Pallas kernel on TPU, XLA blockwise otherwise.
@@ -186,31 +215,13 @@ def flash_attention(q, k, v, key_bias=None, *, scale=None, use_kernel="auto",
     kernel's query/key block sizes (None = padding-aware pick_block) —
     kernel path only, used for block tuning (scripts/bench_kernels.py).
     """
-    import os
-
     from alphafold2_tpu.ops import flash_kernel
 
     B, i, h, dh = q.shape
     j = k.shape[1]
     scale = dh ** -0.5 if scale is None else scale
 
-    # operational escape hatch (read at trace time): lets bench.py retry a
-    # failed TPU attempt with the kernel off, so a kernel-compile regression
-    # degrades to the XLA streaming path instead of losing the measurement
-    disable = os.environ.get("AF2_DISABLE_FLASH_KERNEL", "")
-    if disable.lower() not in ("", "0", "false") and use_kernel == "auto":
-        use_kernel = False
-
-    on_tpu = jax.devices()[0].platform == "tpu"
-    if use_kernel is True and not flash_kernel.supported(i, j, dh):
-        # forcing the kernel must not silently fall back — tests rely on
-        # use_kernel=True actually exercising it
-        raise ValueError(
-            f"flash kernel does not support shapes i={i}, j={j}, dh={dh} "
-            f"(VMEM residency bound, see ops/flash_kernel.py supported)"
-        )
-    use = use_kernel is True or (use_kernel == "auto" and on_tpu)
-    if use and flash_kernel.supported(i, j, dh):
+    if kernel_dispatch(i, j, dh, use_kernel):
         def fold(t):
             return t.transpose(0, 2, 1, 3).reshape(B * h, t.shape[1], dh)
 

@@ -34,7 +34,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from alphafold2_tpu.ops.flash import _tile_attention, stream_block as _stream_block
+from alphafold2_tpu.ops.flash import (
+    flash_attention as _flash_attention,
+    kernel_dispatch as _kernel_dispatch,
+    stream_block as _stream_block,
+)
 
 _NEG_INF = float("-inf")
 
@@ -74,15 +78,9 @@ def ring_attention(q, k, v, axis_name: str, mask=None, use_kernel="auto"):
     )
     perm = [(i, (i + 1) % num_shards) for i in range(num_shards)]
 
-    from alphafold2_tpu.ops import flash_kernel
-
-    on_tpu = jax.devices()[0].platform == "tpu"
-    kernel = use_kernel is True or (
-        use_kernel == "auto"
-        and on_tpu
-        and flash_kernel.supported(n_local, nk_local, d)
-    )
-    if kernel:
+    # the SHARED gate (ops/flash.py): honors AF2_DISABLE_FLASH_KERNEL and
+    # raises loudly when forcing an unsupported shape
+    if _kernel_dispatch(n_local, nk_local, d, use_kernel):
         return _ring_attention_kernel(
             q, k, v, bias, axis_name, scale, num_shards, perm
         )
@@ -199,10 +197,11 @@ def ulysses_attention(q, k, v, axis_name: str, mask=None):
             _NEG_INF,
         ).astype(jnp.float32)
 
-    # blockwise K/V streaming over the gathered sequence (ops/flash.py): the
-    # full (n, n) logit tensor never materializes — O(n * kv_block) per chip,
-    # which is the point of sequence parallelism at long n
-    out = _tile_attention(qg, kg, vg, bias, d ** -0.5, kv_block=2048)
+    # fused/blockwise attention over the gathered sequence via the standard
+    # dispatch (ops/flash.py): Pallas kernel on TPU, XLA K/V streaming
+    # elsewhere — the full (n, n) logit tensor never materializes either
+    # way, which is the point of sequence parallelism at long n
+    out = _flash_attention(qg, kg, vg, bias, scale=d ** -0.5, kv_block=2048)
 
     # (b, n, h_local, d) -> (b, n_local, h, d)
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
