@@ -174,6 +174,18 @@ def blockwise_attention(
     return out[:, :i] if pad_i else out
 
 
+def kernel_env_disabled() -> bool:
+    """AF2_DISABLE_FLASH_KERNEL kill-switch, shared by BOTH Pallas kernels
+    (dense flash here, block-sparse in ops/sparse.py): bench.py's
+    kernel-off retry must leave no Pallas in the program. "0"/"false"/""
+    mean enabled."""
+    import os
+
+    return os.environ.get(
+        "AF2_DISABLE_FLASH_KERNEL", ""
+    ).lower() not in ("", "0", "false")
+
+
 def kernel_dispatch(i: int, j: int, dh: int, use_kernel) -> bool:
     """Resolve the tri-state `use_kernel` into a concrete decision.
 
@@ -185,12 +197,9 @@ def kernel_dispatch(i: int, j: int, dh: int, use_kernel) -> bool:
     forces XLA streaming, "auto" = kernel on TPU for supported shapes,
     honoring the env kill-switch ("0"/"false" mean enabled).
     """
-    import os
-
     from alphafold2_tpu.ops import flash_kernel
 
-    disable = os.environ.get("AF2_DISABLE_FLASH_KERNEL", "")
-    if disable.lower() not in ("", "0", "false") and use_kernel == "auto":
+    if kernel_env_disabled() and use_kernel == "auto":
         use_kernel = False
     if use_kernel is True and not flash_kernel.supported(i, j, dh):
         raise ValueError(

@@ -198,9 +198,17 @@ def sparse_attention_apply(
     if isinstance(use_kernel, str):
         if use_kernel != "auto":
             raise ValueError(f"use_kernel must be True/False/'auto', got {use_kernel!r}")
-        # only on real TPUs: off-TPU the kernel would run in the Pallas
-        # interpreter, orders of magnitude slower than the XLA path
-        use_kernel = n >= 4096 and jax.devices()[0].platform == "tpu"
+        from alphafold2_tpu.ops.flash import kernel_env_disabled
+
+        # the shared AF2_DISABLE_FLASH_KERNEL kill-switch covers BOTH
+        # Pallas kernels; auto otherwise picks the kernel only on real
+        # TPUs (off-TPU it would run in the Pallas interpreter, orders of
+        # magnitude slower than the XLA path)
+        use_kernel = (
+            not kernel_env_disabled()
+            and n >= 4096
+            and jax.devices()[0].platform == "tpu"
+        )
     dtype = cfg.dtype
     bs = scfg.block_size
 

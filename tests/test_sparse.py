@@ -223,3 +223,48 @@ def test_pallas_kernel_grads_with_fully_masked_rows():
     for a, b_ in zip(g_ref, g_ker):
         assert np.isfinite(np.asarray(b_)).all()
         np.testing.assert_allclose(np.asarray(b_), np.asarray(a), atol=1e-4)
+
+
+def test_sparse_kernel_disable_env_var(monkeypatch):
+    """AF2_DISABLE_FLASH_KERNEL downgrades the sparse auto-dispatch too
+    (bench.py's kernel-off retry must leave no Pallas in the program).
+    Platform and length gates are faked open so only the env var decides;
+    the negative control proves the fake routes to the kernel."""
+    import alphafold2_tpu.ops.sparse as sparse_mod
+    from alphafold2_tpu.ops import sparse_kernel
+
+    calls = []
+
+    def spy(q, k, v, scfg, mask):
+        # dispatch counting only — running the real kernel in interpret
+        # mode at n=4096 would take minutes
+        calls.append("kernel")
+        return jnp.zeros(q.shape, q.dtype)
+
+    class FakeTpu:
+        platform = "tpu"
+
+    monkeypatch.setattr(sparse_mod.jax, "devices", lambda: [FakeTpu()])
+    # sparse.py imports the kernel inside the function at call time, so
+    # patching the source module intercepts it
+    monkeypatch.setattr(sparse_kernel, "block_sparse_attention_tpu", spy)
+
+    cfg = AttentionConfig(dim=32, heads=2, dim_head=8)
+    scfg = SparseConfig(block_size=4, num_local_blocks=64,
+                        num_random_blocks=0, max_seq_len=8192)
+    params = attention_init(jax.random.PRNGKey(0), cfg)
+    rs = np.random.RandomState(9)
+    # n >= 4096 so the length gate passes; tiny dims keep interpret cheap
+    x = jnp.asarray(rs.randn(1, 4096, 32).astype(np.float32))
+
+    # negative control: auto + "TPU" + long seq -> kernel dispatched
+    sparse_mod.sparse_attention_apply(params, cfg, scfg, x)
+    assert calls == ["kernel"]
+
+    monkeypatch.setenv("AF2_DISABLE_FLASH_KERNEL", "1")
+    sparse_mod.sparse_attention_apply(params, cfg, scfg, x)
+    assert calls == ["kernel"]  # kernel NOT invoked again
+
+    monkeypatch.setenv("AF2_DISABLE_FLASH_KERNEL", "false")
+    sparse_mod.sparse_attention_apply(params, cfg, scfg, x)
+    assert calls == ["kernel", "kernel"]  # "false" means enabled
