@@ -103,7 +103,26 @@ def dropout(rng, x, rate: float, deterministic: bool = False):
 def pallas_interpret() -> bool:
     """Run Pallas kernels in interpreter mode off-TPU (one code path for
     CPU tests and TPU execution; shared by ops/sparse_kernel.py and
-    ops/flash_kernel.py)."""
+    ops/flash_kernel.py).
+
+    AF2_PALLAS_INTERPRET overrides the platform default both ways:
+    "0"/"false" forces compiled-mode tracing (used by
+    scripts/check_mosaic_lowering.py to run the Pallas -> Mosaic lowering
+    for the TPU target on a CPU host via jax.export, surfacing
+    BlockSpec/layout errors without a chip); "1"/"true" forces interpret
+    mode (kernel debugging on a TPU host). Other values raise.
+    """
+    import os
+
     import jax
 
+    forced = os.environ.get("AF2_PALLAS_INTERPRET")
+    if forced is not None:
+        if forced.lower() in ("0", "false"):
+            return False
+        if forced.lower() in ("1", "true"):
+            return True
+        raise ValueError(
+            f"AF2_PALLAS_INTERPRET must be 0/false or 1/true, got {forced!r}"
+        )
     return jax.devices()[0].platform != "tpu"

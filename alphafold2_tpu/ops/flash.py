@@ -204,7 +204,8 @@ def kernel_dispatch(i: int, j: int, dh: int, use_kernel) -> bool:
     if use_kernel is True and not flash_kernel.supported(i, j, dh):
         raise ValueError(
             f"flash kernel does not support shapes i={i}, j={j}, dh={dh} "
-            f"(VMEM residency bound, see ops/flash_kernel.py supported)"
+            f"(row-vector VMEM bound / lane alignment, see "
+            f"ops/flash_kernel.py supported)"
         )
     on_tpu = jax.devices()[0].platform == "tpu"
     return use_kernel is True or (

@@ -244,6 +244,7 @@ def test_sparse_kernel_disable_env_var(monkeypatch):
     class FakeTpu:
         platform = "tpu"
 
+    monkeypatch.delenv("AF2_DISABLE_FLASH_KERNEL", raising=False)
     monkeypatch.setattr(sparse_mod.jax, "devices", lambda: [FakeTpu()])
     # sparse.py imports the kernel inside the function at call time, so
     # patching the source module intercepts it
