@@ -117,7 +117,7 @@ def pallas_interpret() -> bool:
     import jax
 
     forced = os.environ.get("AF2_PALLAS_INTERPRET")
-    if forced is not None:
+    if forced:  # empty string = unset, like AF2_DISABLE_FLASH_KERNEL
         if forced.lower() in ("0", "false"):
             return False
         if forced.lower() in ("1", "true"):

@@ -8,9 +8,10 @@ binary step still happens at on-chip compile time). Run after any kernel
 change while the tunnel is down; a lowering error here would otherwise
 first surface as an on-chip compile failure during the round benchmark.
 
-Usage: AF2_PALLAS_INTERPRET=0 JAX_PLATFORMS=cpu \
-           python scripts/check_mosaic_lowering.py
-(the script sets both itself when unset)
+Usage: python scripts/check_mosaic_lowering.py
+(the script pins the CPU platform and AF2_PALLAS_INTERPRET=0 itself —
+the check is host-side by definition, and the ambient environment pins
+JAX_PLATFORMS to the axon TPU tunnel, which must not be touched here)
 """
 
 from __future__ import annotations
@@ -18,13 +19,15 @@ from __future__ import annotations
 import os
 import sys
 
-os.environ.setdefault("AF2_PALLAS_INTERPRET", "0")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["AF2_PALLAS_INTERPRET"] = "0"
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import jax
 
+# the config flag must be pinned too: the axon plugin re-pins the
+# platform over the env var alone
 jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp

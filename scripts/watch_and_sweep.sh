@@ -7,6 +7,7 @@ cd "$(dirname "$0")/.."
 for i in $(seq 1 60); do
   if timeout 240 python -c "
 import jax, jax.numpy as jnp
+assert jax.devices()[0].platform == 'tpu', jax.devices()
 x = jnp.ones((256, 256), jnp.bfloat16)
 assert float(jnp.sum((x @ x).astype(jnp.float32))) > 0
 print('healthy')

@@ -190,7 +190,9 @@ def main():
             ecfg, tcfg, mesh, loss_fn=sp_e2e_loss_fn(mesh)
         )
     else:
-        train_step = jax.jit(make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn))
+        # donated state: see train_pre.py — halves the live state footprint
+        train_step = jax.jit(make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn),
+                             donate_argnums=(0,))
 
     from alphafold2_tpu.training import predict_structure
     from alphafold2_tpu.utils import MetricsLogger, structure_eval

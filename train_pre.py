@@ -187,7 +187,11 @@ def main():
         mesh = make_mesh({"seq": args.sp_shards})
         train_step = make_sp_train_step(cfg, tcfg, mesh)
     else:
-        train_step = jax.jit(make_train_step(cfg, tcfg))
+        # donate the input state: without donation both the input and output
+        # copies of (params + optimizer state) are live across every step
+        # (~2x the state footprint; bench.py does the same). run_resilient
+        # would need a non-donating step — the CLI loop does not roll back.
+        train_step = jax.jit(make_train_step(cfg, tcfg), donate_argnums=(0,))
     logger = MetricsLogger(args.metrics_log)
 
     eval_batch, eval_loss_fn, eval_key = None, None, "eval_loss"
