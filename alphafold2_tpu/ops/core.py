@@ -110,7 +110,9 @@ def pallas_interpret() -> bool:
     scripts/check_mosaic_lowering.py to run the Pallas -> Mosaic lowering
     for the TPU target on a CPU host via jax.export, surfacing
     BlockSpec/layout errors without a chip); "1"/"true" forces interpret
-    mode (kernel debugging on a TPU host). Other values raise.
+    mode (kernel debugging on a TPU host); ""/unset falls through to the
+    platform default (so `AF2_PALLAS_INTERPRET= cmd` blanks an inherited
+    value); anything else raises.
     """
     import os
 

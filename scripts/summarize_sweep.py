@@ -1,0 +1,92 @@
+"""Summarize PERF_SWEEP.jsonl into a comparison table.
+
+Groups e2e step-time variants against e2e_base (speedup column) and lists
+kernel microbench rows with TFLOP/s. Prints markdown suitable for
+pasting into PERF.md.
+
+Usage: python scripts/summarize_sweep.py [path]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PERF_SWEEP.jsonl",
+    )
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+
+    # a sweep ABORT sentinel ({"bench": "sweep", "error": ...}) marks
+    # everything before it as one (possibly partial) run; only summarize
+    # the LAST run so the table never mixes measurements from different
+    # code versions, and surface the abort if that run ended in one
+    runs, cur = [], []
+    for r in rows:
+        if "bench" not in r:
+            continue
+        cur.append(r)
+        if r["bench"] == "sweep":
+            runs.append(cur)
+            cur = []
+    if cur:
+        runs.append(cur)
+    last_run = runs[-1] if runs else []
+    aborted = next(
+        (r["error"] for r in last_run if r["bench"] == "sweep"), None
+    )
+    if aborted:
+        print(f"**sweep aborted: {aborted}** — partial results below\n")
+    latest = {}
+    for r in last_run:
+        if r["bench"] != "sweep":
+            latest[r["bench"]] = r
+
+    e2e = {k: v for k, v in latest.items() if k.startswith("e2e_")}
+    micro = {k: v for k, v in latest.items() if k.startswith("micro_")}
+
+    base = e2e.get("e2e_base", {}).get("result") or {}
+    base_sec = base.get("sec_per_step")
+    if e2e:
+        print("## e2e step-time sweep (depth as configured)\n")
+        print("| variant | sec/step | vs base | loss | error |")
+        print("|---|---|---|---|---|")
+        for name, row in sorted(e2e.items()):
+            res = row.get("result") or {}
+            sec = res.get("sec_per_step")
+            speed = (
+                f"{base_sec / sec:.2f}x" if sec and base_sec else "-"
+            )
+            err = (row.get("error") or "")[:60]
+            print(f"| {name} | {sec if sec is not None else '-'} | {speed} "
+                  f"| {res.get('loss', '-')} | {err} |")
+        print()
+    if micro:
+        print("## kernel microbench\n")
+        print("| bench | dir | sec/iter | TFLOP/s | error |")
+        print("|---|---|---|---|---|")
+        for name, row in sorted(micro.items()):
+            res = row.get("result")
+            entries = res if isinstance(res, list) else [res] if res else []
+            if not entries:
+                print(f"| {name} | - | - | - | {(row.get('error') or '')[:60]} |")
+            for e in entries:
+                if not isinstance(e, dict) or "dir" not in e:
+                    continue
+                print(f"| {name} | {e['dir']} | {e.get('sec_per_iter', '-')} "
+                      f"| {e.get('model_tflops_per_sec', '-')} | |")
+    if not e2e and not micro:
+        print("no sweep rows found in", path)
+
+
+if __name__ == "__main__":
+    main()
