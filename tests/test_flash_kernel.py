@@ -41,21 +41,11 @@ def test_use_kernel_true_raises_on_unsupported():
         flash_attention(q, k, v, use_kernel=True)
 
 
-@pytest.mark.parametrize(
-    "B,i,j,qb,kb,dtype",
-    [
-        (2, 64, 64, 16, 16, jnp.float32),   # square, multiple blocks
-        (1, 40, 72, 16, 32, jnp.float32),   # cross shapes + padding both axes
-        (2, 16, 16, 16, 16, jnp.float32),   # single tile
-        # bf16 operands: the kernel's p/ds casts and f32-accumulation path
-        # are identity under f32, so this is the ONLY default-tier coverage
-        # of the bf16 dot layout the TPU workload runs
-        (2, 64, 64, 16, 16, jnp.bfloat16),
-    ],
-)
-def test_kernel_matches_dense(B, i, j, qb, kb, dtype):
+def _check_matches_dense(B, i, j, qb, kb, dtype, seed=0, label=""):
+    """Kernel-vs-dense-oracle parity at one shape (shared by the
+    parametrized cases and the fuzzed sweep)."""
     h, dh = 2, 8
-    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
     q = jax.random.normal(ks[0], (B, i, h, dh), dtype)
     k = jax.random.normal(ks[1], (B, j, h, dh), dtype)
     v = jax.random.normal(ks[2], (B, j, h, dh), dtype)
@@ -76,8 +66,25 @@ def test_kernel_matches_dense(B, i, j, qb, kb, dtype):
                   v.astype(jnp.float32), bias, dh ** -0.5)
     atol = 1e-5 if dtype == jnp.float32 else 2e-2
     np.testing.assert_allclose(
-        np.asarray(got, np.float32), np.asarray(want), atol=atol
+        np.asarray(got, np.float32), np.asarray(want), atol=atol,
+        err_msg=label,
     )
+
+
+@pytest.mark.parametrize(
+    "B,i,j,qb,kb,dtype",
+    [
+        (2, 64, 64, 16, 16, jnp.float32),   # square, multiple blocks
+        (1, 40, 72, 16, 32, jnp.float32),   # cross shapes + padding both axes
+        (2, 16, 16, 16, 16, jnp.float32),   # single tile
+        # bf16 operands: the kernel's p/ds casts and f32-accumulation path
+        # are identity under f32, so this is the ONLY default-tier coverage
+        # of the bf16 dot layout the TPU workload runs
+        (2, 64, 64, 16, 16, jnp.bfloat16),
+    ],
+)
+def test_kernel_matches_dense(B, i, j, qb, kb, dtype):
+    _check_matches_dense(B, i, j, qb, kb, dtype)
 
 
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
@@ -160,3 +167,29 @@ def test_block_target_shrinks_with_head_dim():
     for dh in (8, 64, 128, 256, 512):
         t = _block_target(dh)
         assert 128 <= t <= 512 and t % 128 == 0
+
+
+@pytest.mark.slow
+def test_kernel_matches_dense_fuzzed_shapes():
+    """Randomized (i, j, block) shapes sweep the padding edge cases —
+    lengths below/above/straddling one block, blocks dividing the padded
+    length unevenly — plus pinned degenerate trials at i=1 and j=1."""
+    rs = np.random.RandomState(0)
+    trials = [  # pinned degenerate rows first
+        (1, 1, 33, 16, 16),
+        (1, 33, 1, 16, 16),
+        (2, 1, 1, 8, 8),
+    ]
+    for _ in range(10):
+        trials.append((
+            int(rs.randint(1, 3)),
+            int(rs.randint(1, 70)),
+            int(rs.randint(1, 70)),
+            int(rs.choice([8, 16, 32])),
+            int(rs.choice([8, 16, 32])),
+        ))
+    for t, (B, i, j, qb, kb) in enumerate(trials):
+        _check_matches_dense(
+            B, i, j, qb, kb, jnp.float32, seed=t,
+            label=f"trial {t}: B={B} i={i} j={j} qb={qb} kb={kb}",
+        )
