@@ -7,6 +7,15 @@ streaming softmax over only the ACTIVE key blocks of each query block:
 logits never materialize in HBM, VMEM holds one (block x block) tile at a
 time, and the active-block index table rides in SMEM via scalar prefetch.
 
+Streaming layout (same design as the dense kernel, ops/flash_kernel.py):
+a 3-D grid whose LAST dimension walks the active-slot table sequentially
+with running statistics in VMEM scratch — and the scalar-prefetched index
+table drives the K/V (or Q/G) BLOCK FETCHES THEMSELVES through the
+BlockSpec index maps, so Mosaic's pipeline double-buffers exactly the
+blocks the sparsity pattern touches. Inactive (padded) slots fetch block
+0 and are skipped under `pl.when`. Nothing is fully VMEM-resident per
+grid row except the f32 row vectors (bias, lse, delta).
+
 Backward is also Pallas: the forward additionally emits the per-row
 log-sum-exp, and two kernels recompute tile logits to accumulate dq (over
 a query block's active key blocks) and dk/dv (over a key block's active
@@ -15,8 +24,11 @@ the layout's bidirectional symmetry, which sparsity_layout guarantees by
 construction (ops/sparse.py `layout |= layout.T`; the reference sparsity
 config is likewise bidirectional, alphafold2.py:204).
 
-On non-TPU backends the kernels run in interpreter mode (tests), keeping
-one code path.
+Numerics follow ops/flash_kernel.py: finite running-max sentinel (_M0) so
+masked logits underflow to exact 0 with no nan-guard passes; dots take
+operands in the INPUT dtype with f32 accumulation (bf16 MXU peak). On
+non-TPU backends the kernels run in interpreter mode (tests), keeping one
+code path.
 """
 
 from __future__ import annotations
@@ -42,8 +54,36 @@ _NEG = float("-inf")
 # finite running-max sentinel (see ops/flash_kernel.py _M0)
 _M0 = -1e30
 
+# Backward kernels: outputs are private per (row, block) pair — first two
+# grid dims parallel, streamed slot dim sequential.
+_BWD_PARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary")
+)
+# Forward: the lse output window (1, B, bs) is SHARED across the
+# query-block dim, so it must not split across megacore cores (see
+# ops/flash_kernel.py _FWD_PARAMS).
+_FWD_PARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "arbitrary", "arbitrary")
+)
 
 
+def _active_block(idx_ref, r, a):
+    """BlockSpec index helper: the a-th active block of row r (block 0 for
+    padded slots — the kernel body skips them under pl.when)."""
+    return jnp.maximum(idx_ref[r, a], 0)
+
+
+def _specs(bs: int, dh: int, B: int, h: int):
+    """The four BlockSpec shapes shared by all three kernels: a row's OWN
+    block, the table-driven ACTIVE block, a resident (1, B, bs) row
+    vector, and the per-batch bias (bias has no head axis -> i // h)."""
+    own = pl.BlockSpec((1, bs, dh), lambda i, j, a, idx: (i, j, 0))
+    active = pl.BlockSpec(
+        (1, bs, dh), lambda i, j, a, idx: (i, _active_block(idx, j, a), 0)
+    )
+    row_full = pl.BlockSpec((1, B, bs), lambda i, j, a, idx: (i, 0, 0))
+    bias_full = pl.BlockSpec((1, B, bs), lambda i, j, a, idx: (i // h, 0, 0))
+    return own, active, row_full, bias_full
 
 
 # ---------------------------------------------------------------------------
@@ -52,56 +92,50 @@ _M0 = -1e30
 
 
 def _fwd_kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
-                *, bs, dh, A, scale):
-    qb = pl.program_id(1)
-    # operands stay in the input dtype; dots accumulate f32 via
-    # preferred_element_type — bf16 operands keep the MXU bf16 peak
-    q = q_ref[0]  # (bs, dh)
+                m_scr, l_scr, acc_scr, *, A, scale):
+    qi = pl.program_id(1)
+    a = pl.program_id(2)
+    kidx = idx_ref[qi, a]
 
-    def body(a, carry):
-        m, l, acc = carry
-        kidx = idx_ref[qb, a]
+    @pl.when(a == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _M0, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-        def active(carry):
-            m, l, acc = carry
-            start = kidx * bs
-            k = k_ref[0, pl.ds(start, bs), :]  # (bs, dh)
-            v = v_ref[0, pl.ds(start, bs), :]
-            b = bias_ref[0, kidx]  # (bs,)
-            s = jax.lax.dot_general(
-                q, k,
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale + b[None, :]
-            # finite running-max sentinel (_M0): m - m_new is never
-            # (-inf) - (-inf), masked logits reach exp as -inf and
-            # underflow to exact 0 — no per-tile isneginf/where passes
-            # (same recurrence as ops/flash_kernel.py)
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-            alpha = jnp.exp(m - m_new)
-            p = jnp.exp(s - m_new)
-            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-            acc_new = acc * alpha + jnp.dot(
-                p.astype(v.dtype), v, preferred_element_type=jnp.float32
-            )
-            return m_new, l_new, acc_new
+    @pl.when(kidx >= 0)
+    def _active():
+        q = q_ref[0]          # (bs, dh), input dtype
+        k = k_ref[0]          # the a-th active key block, fetched by the
+        v = v_ref[0]          # index map from the prefetched table
+        b = bias_ref[0, kidx]  # (bs,)
+        s = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale + b[None, :]
+        m = m_scr[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        m_scr[...] = m_new
 
-        return jax.lax.cond(kidx >= 0, active, lambda c: c, (m, l, acc))
-
-    m0 = jnp.full((bs, 1), _M0, jnp.float32)
-    l0 = jnp.zeros((bs, 1), jnp.float32)
-    acc0 = jnp.zeros((bs, dh), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, A, body, (m0, l0, acc0))
-
-    out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
-    out_ref[0] = out.astype(out_ref.dtype)
-    # +inf for rows with no active mass: exp(s - inf) = 0 zeroes every
-    # recomputed p in the backward, matching the zeroed forward output.
-    # lse rides in a (1, B, bs) block fully covering its last two dims
-    # (Mosaic tiling forbids (1, bs) row blocks); each grid step writes
-    # its own B-slot
-    lse = jnp.where(l > 0, m + jnp.log(jnp.where(l > 0, l, 1.0)), jnp.inf)
-    lse_ref[0, qb] = lse[:, 0]
+    @pl.when(a == A - 1)
+    def _finish():
+        l = l_scr[...]
+        safe = jnp.where(l > 0, l, 1.0)
+        out_ref[0] = jnp.where(l > 0, acc_scr[...] / safe, 0.0).astype(
+            out_ref.dtype
+        )
+        # +inf for rows with no active mass: exp(s - inf) = 0 zeroes every
+        # recomputed p in the backward. lse rides in a resident (1, B, bs)
+        # block (Mosaic rejects (1, bs) row blocks); each qi writes its slot
+        lse = jnp.where(l > 0, m_scr[...] + jnp.log(safe), jnp.inf)
+        lse_ref[0, qi] = lse[:, 0]
 
 
 def _forward(q, k, v, scfg: SparseConfig, mask):
@@ -123,31 +157,27 @@ def _forward(q, k, v, scfg: SparseConfig, mask):
     else:
         bias = jnp.where(mask, 0.0, _NEG).astype(jnp.float32).reshape(b, B, bs)
 
-    # row vectors (bias, lse) travel as (.., B, bs) 3-D views whose last two
-    # dims are fully covered by their blocks — Mosaic's tiling constraint
-    # rejects (1, bs) / (1, n) row blocks over 2-D arrays
+    own, active, row_full, bias_full = _specs(bs, dh, B, h)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b * h, B),
-        in_specs=[
-            pl.BlockSpec((1, bs, dh), lambda i, j, *_: (i, j, 0)),
-            pl.BlockSpec((1, n, dh), lambda i, j, *_: (i, 0, 0)),
-            pl.BlockSpec((1, n, dh), lambda i, j, *_: (i, 0, 0)),
-            pl.BlockSpec((1, B, bs), lambda i, j, *_: (i // h, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bs, dh), lambda i, j, *_: (i, j, 0)),
-            pl.BlockSpec((1, B, bs), lambda i, j, *_: (i, 0, 0)),
+        grid=(b * h, B, A),
+        in_specs=[own, active, active, bias_full],
+        out_specs=[own, row_full],
+        scratch_shapes=[
+            pltpu.VMEM((bs, 1), jnp.float32),
+            pltpu.VMEM((bs, 1), jnp.float32),
+            pltpu.VMEM((bs, dh), jnp.float32),
         ],
     )
 
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, bs=bs, dh=dh, A=A, scale=scale),
+        functools.partial(_fwd_kernel, A=A, scale=scale),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, n, dh), q.dtype),
             jax.ShapeDtypeStruct((b * h, B, bs), jnp.float32),
         ],
         grid_spec=grid_spec,
+        compiler_params=_FWD_PARAMS,
         interpret=_interpret(),
     )(idx, qh, kh, vh, bias)
 
@@ -160,86 +190,89 @@ def _forward(q, k, v, scfg: SparseConfig, mask):
 
 
 def _dq_kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref,
-               delta_ref, dq_ref, *, bs, dh, A, scale):
-    qb = pl.program_id(1)
-    q = q_ref[0]                               # (bs, dh)
-    g = g_ref[0]                               # (bs, dh)
-    lse = lse_ref[0, qb][:, None]             # (bs, 1)
-    delta = delta_ref[0, qb][:, None]         # (bs, 1)
+               delta_ref, dq_ref, dq_scr, *, A, scale):
+    qi = pl.program_id(1)
+    a = pl.program_id(2)
+    kidx = idx_ref[qi, a]
 
-    def body(a, dq):
-        kidx = idx_ref[qb, a]
+    @pl.when(a == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
 
-        def active(dq):
-            start = kidx * bs
-            k = k_ref[0, pl.ds(start, bs), :]
-            v = v_ref[0, pl.ds(start, bs), :]
-            b = bias_ref[0, kidx]
-            s = jax.lax.dot_general(
-                q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale + b[None, :]
-            p = jnp.exp(s - lse)               # (bs_q, bs_k)
-            dp = jax.lax.dot_general(
-                g, v, dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )                                   # (bs_q, bs_k)
-            ds = (p * (dp - delta)).astype(k.dtype)
-            return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+    @pl.when(kidx >= 0)
+    def _active():
+        q = q_ref[0]
+        g = g_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        b = bias_ref[0, kidx]
+        lse = lse_ref[0, qi][:, None]
+        delta = delta_ref[0, qi][:, None]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale + b[None, :]
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            g, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - delta)).astype(k.dtype)
+        dq_scr[...] = dq_scr[...] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32
+        )
 
-        return jax.lax.cond(kidx >= 0, active, lambda d: d, dq)
-
-    dq = jax.lax.fori_loop(0, A, body, jnp.zeros((bs, dh), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(a == A - 1)
+    def _finish():
+        dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(idx_ref, q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref,
-                delta_ref, dk_ref, dv_ref, *, bs, dh, A, scale):
-    # grid position j indexes a KEY block; by layout symmetry idx[j] lists
-    # exactly the query blocks attending to it
-    jb = pl.program_id(1)
-    k = k_ref[0]                               # (bs, dh)
-    v = v_ref[0]                               # (bs, dh)
-    b = bias_ref[0, jb]                        # (bs,)
+                delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, A, scale):
+    # grid position 1 indexes a KEY block; by layout symmetry idx[kb] lists
+    # exactly the query blocks attending to it, and the index maps fetch
+    # the a-th such Q/G block
+    kb = pl.program_id(1)
+    a = pl.program_id(2)
+    qidx = idx_ref[kb, a]
 
-    def body(a, carry):
-        dk, dv = carry
-        qidx = idx_ref[jb, a]
+    @pl.when(a == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
 
-        def active(carry):
-            dk, dv = carry
-            start = qidx * bs
-            q = q_ref[0, pl.ds(start, bs), :]
-            g = g_ref[0, pl.ds(start, bs), :]
-            lse = lse_ref[0, qidx][:, None]
-            delta = delta_ref[0, qidx][:, None]
-            s = jax.lax.dot_general(
-                q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale + b[None, :]
-            p = jnp.exp(s - lse)               # (bs_q, bs_k)
-            dv_new = dv + jax.lax.dot_general(
-                p.astype(g.dtype), g,
-                dimension_numbers=(((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )                                   # (bs_k, dh)
-            dp = jax.lax.dot_general(
-                g, v, dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            ds = (p * (dp - delta)).astype(q.dtype)  # (bs_q, bs_k)
-            dk_new = dk + jax.lax.dot_general(
-                ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )                                   # (bs_k, dh)
-            return dk_new, dv_new
+    @pl.when(qidx >= 0)
+    def _active():
+        k = k_ref[0]                      # (bs, dh)
+        v = v_ref[0]
+        q = q_ref[0]                      # the a-th active query block
+        g = g_ref[0]
+        b = bias_ref[0, kb]               # (bs,)
+        lse = lse_ref[0, qidx][:, None]
+        delta = delta_ref[0, qidx][:, None]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale + b[None, :]
+        p = jnp.exp(s - lse)              # (bs_q, bs_k) f32
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+            p.astype(g.dtype), g, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            g, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+            ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
-        return jax.lax.cond(qidx >= 0, active, lambda c: c, carry)
-
-    zero = jnp.zeros((bs, dh), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, A, body, (zero, zero))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(a == A - 1)
+    def _finish():
+        dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _backward_pallas(q, k, v, scfg, mask, out_flat, lse, g):
@@ -266,35 +299,39 @@ def _backward_pallas(q, k, v, scfg, mask, out_flat, lse, g):
         gh.astype(jnp.float32) * out_flat.astype(jnp.float32), axis=-1
     ).reshape(b * h, B, bs)
 
-    full = pl.BlockSpec((1, n, dh), lambda i, j, *_: (i, 0, 0))
-    blk = pl.BlockSpec((1, bs, dh), lambda i, j, *_: (i, j, 0))
-    row_full = pl.BlockSpec((1, B, bs), lambda i, j, *_: (i, 0, 0))
-    bias_full = pl.BlockSpec((1, B, bs), lambda i, j, *_: (i // h, 0, 0))
+    own, active, row_full, bias_full = _specs(bs, dh, B, h)
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, bs=bs, dh=dh, A=A, scale=scale),
+        functools.partial(_dq_kernel, A=A, scale=scale),
         out_shape=jax.ShapeDtypeStruct((b * h, n, dh), q.dtype),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(b * h, B),
-            in_specs=[blk, full, full, bias_full, blk, row_full, row_full],
-            out_specs=blk,
+            grid=(b * h, B, A),
+            in_specs=[own, active, active, bias_full, own, row_full, row_full],
+            out_specs=own,
+            scratch_shapes=[pltpu.VMEM((bs, dh), jnp.float32)],
         ),
+        compiler_params=_BWD_PARAMS,
         interpret=_interpret(),
     )(idx, qh, kh, vh, bias, gh, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, bs=bs, dh=dh, A=A, scale=scale),
+        functools.partial(_dkv_kernel, A=A, scale=scale),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, n, dh), k.dtype),
             jax.ShapeDtypeStruct((b * h, n, dh), v.dtype),
         ],
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(b * h, B),
-            in_specs=[full, blk, blk, bias_full, full, row_full, row_full],
-            out_specs=[blk, blk],
+            grid=(b * h, B, A),
+            in_specs=[active, own, own, bias_full, active, row_full, row_full],
+            out_specs=[own, own],
+            scratch_shapes=[
+                pltpu.VMEM((bs, dh), jnp.float32),
+                pltpu.VMEM((bs, dh), jnp.float32),
+            ],
         ),
+        compiler_params=_BWD_PARAMS,
         interpret=_interpret(),
     )(idx, qh, kh, vh, bias, gh, lse, delta)
 
