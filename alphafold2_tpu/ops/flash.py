@@ -186,6 +186,33 @@ def kernel_env_disabled() -> bool:
     ).lower() not in ("", "0", "false")
 
 
+# Minimum key length for the Pallas kernel in "auto" mode. Measured on-chip
+# (PERF_SWEEP.jsonl 2026-07-31, depth-12 north-star e2e): blanket kernel
+# dispatch costs 14% end-to-end vs XLA streaming (27.75 vs 24.43 s/step) —
+# at the short-axis self/axial shapes (i=j=1152, many small grid steps) the
+# kernel is grid-overhead-bound, while the long-j streaming shapes NEED it
+# (the XLA streaming program's compile exceeded 550 s there, PERF.md).
+# "auto" therefore prefers XLA streaming below this key length. Pending
+# qb-target tuning legs that may flip the short-j verdict, the threshold is
+# overridable: AF2_FLASH_AUTO_MIN_J=0 force-prefers the kernel everywhere
+# supported (scripts/bench_sweep.py uses this for its kernel-on legs).
+_AUTO_MIN_J = 4096
+
+
+def auto_min_j() -> int:
+    import os
+
+    raw = os.environ.get("AF2_FLASH_AUTO_MIN_J", "")
+    if not raw:
+        return _AUTO_MIN_J
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"AF2_FLASH_AUTO_MIN_J must be an integer, got {raw!r}"
+        ) from None
+
+
 def kernel_dispatch(i: int, j: int, dh: int, use_kernel) -> bool:
     """Resolve the tri-state `use_kernel` into a concrete decision.
 
@@ -194,7 +221,8 @@ def kernel_dispatch(i: int, j: int, dh: int, use_kernel) -> bool:
     AF2_DISABLE_FLASH_KERNEL escape hatch and the loud unsupported-shape
     error hold everywhere. True forces the kernel (ValueError on
     unsupported shapes — forcing must not silently fall back), False
-    forces XLA streaming, "auto" = kernel on TPU for supported shapes,
+    forces XLA streaming, "auto" = kernel on TPU for supported shapes with
+    j >= auto_min_j() (the measured short-j crossover — see _AUTO_MIN_J),
     honoring the env kill-switch ("0"/"false" mean enabled).
     """
     from alphafold2_tpu.ops import flash_kernel
@@ -209,7 +237,10 @@ def kernel_dispatch(i: int, j: int, dh: int, use_kernel) -> bool:
         )
     on_tpu = jax.devices()[0].platform == "tpu"
     return use_kernel is True or (
-        use_kernel == "auto" and on_tpu and flash_kernel.supported(i, j, dh)
+        use_kernel == "auto"
+        and on_tpu
+        and j >= auto_min_j()
+        and flash_kernel.supported(i, j, dh)
     )
 
 
@@ -221,7 +252,9 @@ def flash_attention(q, k, v, key_bias=None, *, scale=None, use_kernel="auto",
     (B, j, h, dh); key-side (B, j) additive bias). use_kernel: True forces
     the kernel (interpret mode off-TPU — for tests), False forces XLA
     streaming, "auto" uses the kernel on TPU for supported shapes
-    (ops/flash_kernel.py `supported`). kernel_qb/kernel_kb override the
+    (ops/flash_kernel.py `supported`) with j >= auto_min_j() — below the
+    measured short-j crossover XLA streaming is faster end-to-end
+    (PERF.md session 4), so "auto" prefers it there. kernel_qb/kernel_kb override the
     kernel's query/key block sizes (None = padding-aware pick_block) —
     kernel path only, used for block tuning (scripts/bench_kernels.py).
     """

@@ -54,10 +54,14 @@ def ring_attention(q, k, v, axis_name: str, mask=None, use_kernel="auto"):
         masking, matching the reference's key_padding semantics,
         alphafold2.py:156-161 / DeepSpeed attn_mask_mode='add').
       use_kernel: per-hop compute path. "auto" uses the Pallas flash
-        kernel on TPU for supported shapes (each hop emits (out, lse) and
-        hops combine in log space — ops/flash_kernel.flash_attention_lse);
-        True forces it (interpret mode off-TPU, for tests); False keeps
-        the XLA stream_block recurrence.
+        kernel on TPU for supported shapes whose PER-HOP key length
+        nk_local >= ops/flash.py auto_min_j() (each hop emits (out, lse)
+        and hops combine in log space —
+        ops/flash_kernel.flash_attention_lse); below that threshold the
+        hop runs the XLA stream_block recurrence — the crossover was
+        measured on single-device e2e shapes (PERF.md session 4), not on
+        ring hops, so force with True (interpret mode off-TPU, for tests)
+        or AF2_FLASH_AUTO_MIN_J=0 to get the kernel on short shards.
 
     Returns: (b, n_local, h, d) attention output for the local Q shard.
     """

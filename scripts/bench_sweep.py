@@ -61,12 +61,23 @@ ecfg = E2EConfig(
     mds_iters=200,
     mds_bwd_iters=spec["mds_bwd_iters"],
 )
-# The Pallas kernel is gated by flash_kernel.supported and platform inside
-# ops/flash.py ("auto"); to force XLA-only streaming, monkeypatch
-# supported() off before anything compiles.
-if not spec["kernel"]:
-    from alphafold2_tpu.ops import flash_kernel
-    flash_kernel.supported = lambda *a, **k: False
+# Kernel policy (spec["kernel"]):
+#   "force" -> zero the auto-dispatch j-threshold so every supported shape
+#              takes the Pallas kernel (AF2_FLASH_AUTO_MIN_J=0);
+#   "auto"  -> exactly what the driver bench runs (shape-aware heuristic);
+#   "off"   -> no Pallas anywhere (the AF2_DISABLE_FLASH_KERNEL kill-switch).
+#              NOTE: stricter than the retired e2e_nokernel leg (24.43
+#              s/step), which monkeypatched only the DENSE kernel off and
+#              left the block-sparse kernel live — an "off" number is not
+#              directly comparable to that baseline in sparse configs.
+# Env is set before any tracing, so the dispatch gate reads it everywhere.
+import os
+if spec["kernel"] == "force":
+    os.environ["AF2_FLASH_AUTO_MIN_J"] = "0"
+elif spec["kernel"] == "off":
+    os.environ["AF2_DISABLE_FLASH_KERNEL"] = "1"
+elif spec["kernel"] != "auto":
+    raise ValueError(f"bad kernel policy {spec['kernel']!r}")
 
 tcfg = TrainConfig(learning_rate=3e-4, grad_accum=1)
 dcfg = DataConfig(batch_size=1, max_len=crop, msa_rows=msa_rows, seed=0)
@@ -146,26 +157,53 @@ def main():
                     help="also run the XLA-streaming micro leg (known to "
                          "compile >550s at the chunk shape — see PERF.md; "
                          "its timeout-kill can wedge the tunnel)")
+    ap.add_argument("--force-all", action="store_true",
+                    help="re-run legs already recorded in PERF_SWEEP.jsonl")
     args = ap.parse_args()
 
+    # Legs that already have a successful measurement recorded are skipped
+    # by default: recovered-tunnel time is scarce, and the watcher restarts
+    # the whole sweep on every recovery.
+    done = set()
+    if not args.force_all and os.path.exists(OUT):
+        with open(OUT) as f:
+            for line in f:
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                if e.get("result") is not None:
+                    done.add(e.get("bench"))
+
     # 1) e2e step-time sweep FIRST: it is the sweep's purpose, and a hang
-    # in any later micro leg must not cost these measurements
-    base = dict(depth=args.depth, kernel=True, batch_chunk=32,
+    # in any later micro leg must not cost these measurements. Order is
+    # by information value per minute of healthy-tunnel time:
+    #   auto     — exactly the driver-bench configuration (validates the
+    #              shape-aware dispatch heuristic on chip);
+    #   qbt1152  — whole-row query blocks: the grid-collapse lever that
+    #              could flip the short-j kernel verdict (PERF.md);
+    #   mdsbwd25/tile26/chunk0 — streaming-path knob legs;
+    #   chunk96  — LAST: it was mid-flight when the tunnel wedged on
+    #              2026-07-31 (8 s CPU in 35 min — blocked before tracing,
+    #              so likely a victim not the cause, but it has form).
+    base = dict(depth=args.depth, kernel="auto", batch_chunk=32,
                 tile_elems=1 << 25, mds_bwd_iters=None)
-    variants = [("e2e_base", base)]
+    variants = [("e2e_auto", base)]
     if not args.quick:
         variants += [
-            ("e2e_nokernel", {**base, "kernel": False}),
-            ("e2e_chunk96", {**base, "batch_chunk": 96}),
-            ("e2e_chunk0", {**base, "batch_chunk": 0}),
-            ("e2e_tile26", {**base, "tile_elems": 1 << 26}),
-            ("e2e_mdsbwd25", {**base, "mds_bwd_iters": 25}),
             # whole-row QUERY blocks on the 1152 axes only (pick_block
             # leaves shorter axes unpadded): collapses the (BH, nqb) grid
             # 3x — the per-grid-step-overhead lever (PERF.md finding 3)
-            ("e2e_qbt1152", {**base, "qb_target": 1152}),
+            ("e2e_qbt1152", {**base, "kernel": "force", "qb_target": 1152}),
+            ("e2e_mdsbwd25", {**base, "mds_bwd_iters": 25}),
+            ("e2e_tile26", {**base, "tile_elems": 1 << 26}),
+            ("e2e_chunk0", {**base, "batch_chunk": 0}),
+            ("e2e_chunk96", {**base, "batch_chunk": 96}),
         ]
     for name, spec in variants:
+        if name in done:
+            print(f"skip {name}: already recorded in {OUT}", flush=True)
+            continue
         if not run_and_record(name, E2E_WORKER, [json.dumps(spec)],
                               timeout=2100, extra={"spec": spec}):
             return
@@ -188,6 +226,9 @@ def main():
         if args.xla_micro:
             micro_runs.append(("micro_xla", ["--paths", "xla"]))
     for name, extra in micro_runs:
+        if name in done:
+            print(f"skip {name}: already recorded in {OUT}", flush=True)
+            continue
         if not run_and_record(
             name, micro, ["--b", "32", "--n", "1152", "--iters", "20", *extra],
             timeout=1500,

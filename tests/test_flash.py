@@ -260,6 +260,9 @@ def test_kernel_disable_env_var(monkeypatch):
     monkeypatch.setattr(flash_mod.jax, "devices", lambda: [FakeTpu()])
     monkeypatch.setattr(flash_kernel, "flash_attention_tpu", spy_kernel)
     monkeypatch.setattr(flash_kernel, "supported", lambda *a: True)
+    # short-j auto-dispatch prefers XLA streaming (measured crossover, see
+    # _AUTO_MIN_J); zero the threshold so these tiny shapes reach the kernel
+    monkeypatch.setenv("AF2_FLASH_AUTO_MIN_J", "0")
 
     from alphafold2_tpu.ops.flash import flash_attention
 
@@ -282,3 +285,32 @@ def test_kernel_disable_env_var(monkeypatch):
     monkeypatch.setenv("AF2_DISABLE_FLASH_KERNEL", "0")
     flash_attention(q, k, v, use_kernel="auto")
     assert calls == ["kernel", "kernel"]
+
+
+def test_kernel_auto_min_j_heuristic(monkeypatch):
+    """auto-mode dispatch is shape-aware: below the measured short-j
+    crossover XLA streaming wins (27.75 vs 24.43 s/step e2e with blanket
+    kernel dispatch, PERF_SWEEP 2026-07-31), so "auto" only takes the
+    kernel at j >= auto_min_j(). use_kernel=True still forces it."""
+    import alphafold2_tpu.ops.flash as flash_mod
+    from alphafold2_tpu.ops import flash_kernel
+    from alphafold2_tpu.ops.flash import kernel_dispatch
+
+    class FakeTpu:
+        platform = "tpu"
+
+    monkeypatch.setattr(flash_mod.jax, "devices", lambda: [FakeTpu()])
+    monkeypatch.setattr(flash_kernel, "supported", lambda *a: True)
+
+    # default threshold: short-j auto -> streaming; long-j auto -> kernel
+    assert not kernel_dispatch(1152, 1152, 64, "auto")
+    assert kernel_dispatch(1152, flash_mod._AUTO_MIN_J, 64, "auto")
+    # forcing bypasses the heuristic at any shape
+    assert kernel_dispatch(16, 16, 8, True)
+    # env override re-admits short-j (the sweep's kernel-on legs)
+    monkeypatch.setenv("AF2_FLASH_AUTO_MIN_J", "0")
+    assert kernel_dispatch(1152, 1152, 64, "auto")
+    # malformed override fails loudly, not silently-default
+    monkeypatch.setenv("AF2_FLASH_AUTO_MIN_J", "many")
+    with pytest.raises(ValueError):
+        flash_mod.auto_min_j()
