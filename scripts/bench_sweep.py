@@ -164,6 +164,11 @@ def main():
     # Legs that already have a successful measurement recorded are skipped
     # by default: recovered-tunnel time is scarce, and the watcher restarts
     # the whole sweep on every recovery.
+    # keyed by (name, spec): a --quick/--depth smoke record must not
+    # suppress the real-configuration measurement of the same leg
+    def done_key(name, spec):
+        return (name, json.dumps(spec, sort_keys=True) if spec else "")
+
     done = set()
     if not args.force_all and os.path.exists(OUT):
         with open(OUT) as f:
@@ -173,7 +178,7 @@ def main():
                 except ValueError:
                     continue
                 if e.get("result") is not None:
-                    done.add(e.get("bench"))
+                    done.add(done_key(e.get("bench"), e.get("spec")))
 
     # 1) e2e step-time sweep FIRST: it is the sweep's purpose, and a hang
     # in any later micro leg must not cost these measurements. Order is
@@ -201,7 +206,7 @@ def main():
             ("e2e_chunk96", {**base, "batch_chunk": 96}),
         ]
     for name, spec in variants:
-        if name in done:
+        if done_key(name, spec) in done:
             print(f"skip {name}: already recorded in {OUT}", flush=True)
             continue
         if not run_and_record(name, E2E_WORKER, [json.dumps(spec)],
@@ -226,7 +231,7 @@ def main():
         if args.xla_micro:
             micro_runs.append(("micro_xla", ["--paths", "xla"]))
     for name, extra in micro_runs:
-        if name in done:
+        if done_key(name, None) in done:
             print(f"skip {name}: already recorded in {OUT}", flush=True)
             continue
         if not run_and_record(

@@ -30,7 +30,15 @@ def _run(cfg, seq_len=16, rows=3, cols=8, templates_T=0):
         out = alphafold2_apply(p, cfg, seq, msa, **kw)
         return jnp.sum(jnp.square(out))
 
-    val, grads = jax.value_and_grad(loss)(params)
+    # jit: eager per-primitive dispatch costs ~3x trace+compile+run for
+    # these program sizes on the CPU test box (and production always jits).
+    # EXCEPT reversible configs: their scanned custom_vjp body compiles
+    # once as an eager scan but gets re-optimized inside an outer jit,
+    # which measures ~2.5x slower here — keep those eager.
+    grad_fn = jax.value_and_grad(loss)
+    if not cfg.reversible:
+        grad_fn = jax.jit(grad_fn)
+    val, grads = grad_fn(params)
     assert np.isfinite(float(val))
     for leaf in jax.tree_util.tree_leaves(grads):
         assert np.isfinite(np.asarray(leaf)).all()
@@ -134,12 +142,17 @@ def test_raw_distance_templates_match_prebinned():
     )
     assert int(prebinned.max()) == cfg.num_buckets - 1  # top bucket exercised
 
-    out_raw = alphafold2_apply(
-        params, cfg, seq, msa, templates=raw, templates_mask=tmask
-    )
-    out_pre = alphafold2_apply(
-        params, cfg, seq, msa, templates=prebinned, templates_mask=tmask
-    )
+    # jit each variant (separate programs: template dtype differs)
+    out_raw = jax.jit(
+        lambda p, t: alphafold2_apply(
+            p, cfg, seq, msa, templates=t, templates_mask=tmask
+        )
+    )(params, raw)
+    out_pre = jax.jit(
+        lambda p, t: alphafold2_apply(
+            p, cfg, seq, msa, templates=t, templates_mask=tmask
+        )
+    )(params, prebinned)
     np.testing.assert_array_equal(np.asarray(out_raw), np.asarray(out_pre))
 
 

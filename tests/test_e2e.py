@@ -42,9 +42,9 @@ def batch():
 
 def test_predict_structure_shapes(ecfg, batch):
     params = e2e_train_state_init(jax.random.PRNGKey(0), ecfg, TrainConfig())["params"]
-    out = predict_structure(
-        params, ecfg, batch["seq"], mask=batch["mask"], rng=jax.random.PRNGKey(1)
-    )
+    out = jax.jit(
+        lambda p, s, m, r: predict_structure(p, ecfg, s, mask=m, rng=r)
+    )(params, batch["seq"], batch["mask"], jax.random.PRNGKey(1))
     b, L = batch["seq"].shape
     assert out["refined"].shape == (b, L, 14, 3)
     assert out["proto"].shape == (b, L, 14, 3)

@@ -56,9 +56,9 @@ def test_mask_isolation():
     rs = np.random.RandomState(1)
     seq = jnp.asarray(rs.randint(0, 20, (1, 8)))
     mask = jnp.asarray([[True] * 5 + [False] * 3])
-    out1 = embed_sequences(params, TINY, seq, mask)
+    out1 = jax.jit(lambda p, s, m: embed_sequences(p, TINY, s, m))(params, seq, mask)
     seq2 = seq.at[:, 5:].set((seq[:, 5:] + 7) % 20)
-    out2 = embed_sequences(params, TINY, seq2, mask)
+    out2 = jax.jit(lambda p, s, m: embed_sequences(p, TINY, s, m))(params, seq2, mask)
     np.testing.assert_allclose(
         np.asarray(out1)[:, :5], np.asarray(out2)[:, :5], atol=1e-5
     )
@@ -108,15 +108,20 @@ def test_convert_torch_state_dict():
 def test_embedder_feeds_model_embedds_path():
     """End-to-end: embedder output drives Alphafold2's embedds input
     (reference train_end2end.py:149 -> alphafold2.py:469-472)."""
-    ecfg = EmbedderConfig(num_layers=1, dim=1280, heads=8, max_len=64)
+    # num_embedds shrunk from the ESM-1b 1280 (the wiring under test is
+    # dim-independent; 1280 costs ~6 s of eager init alone on the test box)
+    ecfg = EmbedderConfig(num_layers=1, dim=64, heads=4, max_len=64)
+    mcfg = Alphafold2Config(dim=32, depth=1, heads=2, dim_head=8, max_seq_len=64,
+                            num_embedds=64)
     eparams = embedder_init(jax.random.PRNGKey(0), ecfg)
-    mcfg = Alphafold2Config(dim=32, depth=1, heads=2, dim_head=8, max_seq_len=64)
     mparams = alphafold2_init(jax.random.PRNGKey(1), mcfg)
 
     rs = np.random.RandomState(3)
     seq = jnp.asarray(rs.randint(0, 20, (1, 8)))
-    embedds = embed_sequences(eparams, ecfg, seq)
-    out = alphafold2_apply(mparams, mcfg, seq, None, embedds=embedds)
+    embedds = jax.jit(lambda p, s: embed_sequences(p, ecfg, s))(eparams, seq)
+    out = jax.jit(
+        lambda p, s, e: alphafold2_apply(p, mcfg, s, None, embedds=e)
+    )(mparams, seq, embedds)
     assert out.shape == (1, 8, 8, 37)
     assert np.isfinite(np.asarray(out)).all()
 
@@ -127,11 +132,11 @@ def test_padded_batch_matches_lone_sequence():
     params = embedder_init(jax.random.PRNGKey(0), TINY)
     rs = np.random.RandomState(4)
     seq5 = jnp.asarray(rs.randint(0, 20, (1, 5)))
-    alone = embed_sequences(params, TINY, seq5)
+    alone = jax.jit(lambda p, s: embed_sequences(p, TINY, s))(params, seq5)
 
     padded = jnp.concatenate([seq5, jnp.full((1, 3), 20)], axis=1)
     mask = jnp.asarray([[True] * 5 + [False] * 3])
-    batched = embed_sequences(params, TINY, padded, mask)
+    batched = jax.jit(lambda p, s, m: embed_sequences(p, TINY, s, m))(params, padded, mask)
     np.testing.assert_allclose(
         np.asarray(batched)[:, :5], np.asarray(alone), atol=1e-5
     )
@@ -153,5 +158,5 @@ def test_near_max_length_positions_in_table():
     params = embedder_init(jax.random.PRNGKey(0), cfg)
     assert params["pos_emb"]["table"].shape[0] == cfg.pos_table_rows
     seq = jnp.zeros((1, cfg.max_len - 2), jnp.int32)  # framed n == max_len
-    out = embed_sequences(params, cfg, seq)
+    out = jax.jit(lambda p, s: embed_sequences(p, cfg, s))(params, seq)
     assert np.isfinite(np.asarray(out)).all()

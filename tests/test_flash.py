@@ -51,9 +51,11 @@ def test_blockwise_matches_dense(B, i, j, tile_elems, kv_block):
     mask = mask.at[:, 0].set(True)  # no fully-masked batch rows
     bias = jnp.where(mask, 0.0, float("-inf")).astype(jnp.float32)
 
-    got = blockwise_attention(
-        q, k, v, bias, scale=dh**-0.5, tile_elems=tile_elems, kv_block=kv_block
-    )
+    got = jax.jit(
+        lambda q, k, v, b: blockwise_attention(
+            q, k, v, b, scale=dh**-0.5, tile_elems=tile_elems, kv_block=kv_block
+        )
+    )(q, k, v, bias)
     want = _dense_reference(q, k, v, bias, dh**-0.5)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
@@ -76,8 +78,8 @@ def test_blockwise_gradients_match_dense():
     def loss_dense(q, k, v):
         return jnp.sum(jnp.sin(_dense_reference(q, k, v, bias, dh**-0.5)))
 
-    g1 = jax.grad(loss_block, argnums=(0, 1, 2))(q, k, v)
-    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    g1 = jax.jit(jax.grad(loss_block, argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))(q, k, v)
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
@@ -89,14 +91,16 @@ def test_fully_masked_keys_give_zeros():
     k = jax.random.normal(ks[1], (B, j, h, dh))
     v = jax.random.normal(ks[2], (B, j, h, dh))
     bias = jnp.full((B, j), float("-inf"), jnp.float32)
-    out = blockwise_attention(q, k, v, bias, scale=dh**-0.5)
+    out = jax.jit(
+        lambda q, k, v, b: blockwise_attention(q, k, v, b, scale=dh**-0.5)
+    )(q, k, v, bias)
     assert np.isfinite(np.asarray(out)).all()
     np.testing.assert_allclose(np.asarray(out), 0.0)
 
     # gradients stay finite through the all-masked edge case
-    g = jax.grad(
+    g = jax.jit(jax.grad(
         lambda q: jnp.sum(blockwise_attention(q, k, v, bias, scale=dh**-0.5))
-    )(q)
+    ))(q)
     assert np.isfinite(np.asarray(g)).all()
 
 
@@ -114,16 +118,20 @@ def test_attention_apply_flash_matches_dense():
 
     # self-attention: compare on valid query rows only (dense gives masked
     # rows uniform-attention garbage, flash gives normal garbage)
-    o_d = attention_apply(params, cfg_d, x, mask=mask)
-    o_f = attention_apply(params, cfg_f, x, mask=mask)
+    o_d = jax.jit(lambda p, x, m: attention_apply(p, cfg_d, x, mask=m))(params, x, mask)
+    o_f = jax.jit(lambda p, x, m: attention_apply(p, cfg_f, x, mask=m))(params, x, mask)
     valid = np.asarray(mask)
     np.testing.assert_allclose(
         np.asarray(o_f)[valid], np.asarray(o_d)[valid], atol=1e-5
     )
 
     # cross-attention with context mask
-    o_d = attention_apply(params, cfg_d, x, context=ctx, mask=mask, context_mask=cmask)
-    o_f = attention_apply(params, cfg_f, x, context=ctx, mask=mask, context_mask=cmask)
+    o_d = jax.jit(
+        lambda p, x, c, m, cm: attention_apply(p, cfg_d, x, context=c, mask=m, context_mask=cm)
+    )(params, x, ctx, mask, cmask)
+    o_f = jax.jit(
+        lambda p, x, c, m, cm: attention_apply(p, cfg_f, x, context=c, mask=m, context_mask=cm)
+    )(params, x, ctx, mask, cmask)
     np.testing.assert_allclose(
         np.asarray(o_f)[valid], np.asarray(o_d)[valid], atol=1e-5
     )
@@ -202,7 +210,9 @@ def test_aligned_mode_rejects_misaligned_shapes():
     seq = jnp.zeros((1, 14), jnp.int32)
     msa = jnp.zeros((1, 2, 9), jnp.int32)  # 14 % 9 != 0
     with pytest.raises(ValueError, match="aligned cross-attention"):
-        alphafold2_apply(params, cfg, seq, msa)
+        # jit: the shape check raises at trace time, skipping eager
+        # execution of the embedding prefix
+        jax.jit(lambda p, s, m: alphafold2_apply(p, cfg, s, m))(params, seq, msa)
 
 
 def test_batch_chunked_attention_matches_dense():
@@ -218,20 +228,24 @@ def test_batch_chunked_attention_matches_dense():
     mask = jax.random.bernoulli(ks[2], 0.8, (B, 12)).at[:, 0].set(True)
     cmask = jax.random.bernoulli(ks[3], 0.8, (B, 7)).at[:, 0].set(True)
 
-    o0 = attention_apply(params, cfg0, x, mask=mask)
-    oc = attention_apply(params, cfgc, x, mask=mask)
+    o0 = jax.jit(lambda p, x, m: attention_apply(p, cfg0, x, mask=m))(params, x, mask)
+    oc = jax.jit(lambda p, x, m: attention_apply(p, cfgc, x, mask=m))(params, x, mask)
     np.testing.assert_allclose(np.asarray(oc), np.asarray(o0), atol=1e-5)
 
-    o0 = attention_apply(params, cfg0, x, context=ctx, context_mask=cmask)
-    oc = attention_apply(params, cfgc, x, context=ctx, context_mask=cmask)
+    o0 = jax.jit(
+        lambda p, x, c, cm: attention_apply(p, cfg0, x, context=c, context_mask=cm)
+    )(params, x, ctx, cmask)
+    oc = jax.jit(
+        lambda p, x, c, cm: attention_apply(p, cfgc, x, context=c, context_mask=cm)
+    )(params, x, ctx, cmask)
     np.testing.assert_allclose(np.asarray(oc), np.asarray(o0), atol=1e-5)
 
     # gradients flow and match
     def loss(p, cfg):
         return jnp.sum(jnp.sin(attention_apply(p, cfg, x, context=ctx, context_mask=cmask)))
 
-    g0 = jax.grad(loss)(params, cfg0)
-    gc = jax.grad(loss)(params, cfgc)
+    g0 = jax.jit(jax.grad(loss), static_argnums=1)(params, cfg0)
+    gc = jax.jit(jax.grad(loss), static_argnums=1)(params, cfgc)
     for a, b in zip(jax.tree_util.tree_leaves(gc), jax.tree_util.tree_leaves(g0)):
         # recompute-order float noise only
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
@@ -301,6 +315,9 @@ def test_kernel_auto_min_j_heuristic(monkeypatch):
 
     monkeypatch.setattr(flash_mod.jax, "devices", lambda: [FakeTpu()])
     monkeypatch.setattr(flash_kernel, "supported", lambda *a: True)
+    # an inherited override (e.g. a shell that exported the sweep's
+    # force-kernel setting) must not leak into the default-threshold asserts
+    monkeypatch.delenv("AF2_FLASH_AUTO_MIN_J", raising=False)
 
     # default threshold: short-j auto -> streaming; long-j auto -> kernel
     assert not kernel_dispatch(1152, 1152, 64, "auto")

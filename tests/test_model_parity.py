@@ -60,9 +60,9 @@ def test_seq_only_forward():
     mask = np.ones((1, N), dtype=bool)
     mask[0, 9:] = False
     want = m(torch.from_numpy(seq), mask=torch.from_numpy(mask)).detach().numpy()
-    got = alphafold2_apply(
-        params, cfg, jnp.asarray(seq), mask=jnp.asarray(mask)
-    )
+    got = jax.jit(
+        lambda p, s, m: alphafold2_apply(p, cfg, s, mask=m)
+    )(params, jnp.asarray(seq), jnp.asarray(mask))
     assert got.shape == (1, N, N, 37)
     np.testing.assert_allclose(np.asarray(got), want, atol=2e-4)
 
@@ -80,14 +80,9 @@ def test_msa_forward():
         mask=torch.from_numpy(mask),
         msa_mask=torch.from_numpy(msa_mask),
     ).detach().numpy()
-    got = alphafold2_apply(
-        params,
-        cfg,
-        jnp.asarray(seq),
-        jnp.asarray(msa),
-        mask=jnp.asarray(mask),
-        msa_mask=jnp.asarray(msa_mask),
-    )
+    got = jax.jit(
+        lambda p, s, ms, mk, mm: alphafold2_apply(p, cfg, s, ms, mask=mk, msa_mask=mm)
+    )(params, jnp.asarray(seq), jnp.asarray(msa), jnp.asarray(mask), jnp.asarray(msa_mask))
     np.testing.assert_allclose(np.asarray(got), want, atol=2e-4)
 
 
@@ -96,7 +91,9 @@ def test_msa_tied_rows():
     seq = _seq(seed=3)
     msa = np.random.RandomState(4).randint(0, 21, size=(1, 4, 10)).astype(np.int64)
     want = m(torch.from_numpy(seq), msa=torch.from_numpy(msa)).detach().numpy()
-    got = alphafold2_apply(params, cfg, jnp.asarray(seq), jnp.asarray(msa))
+    got = jax.jit(lambda p, s, ms: alphafold2_apply(p, cfg, s, ms))(
+        params, jnp.asarray(seq), jnp.asarray(msa)
+    )
     np.testing.assert_allclose(np.asarray(got), want, atol=2e-4)
 
 
@@ -109,7 +106,9 @@ def test_cross_attn_compressed():
     seq = _seq(n=11, seed=5)
     msa = np.random.RandomState(6).randint(0, 21, size=(1, 2, 11)).astype(np.int64)
     want = m(torch.from_numpy(seq), msa=torch.from_numpy(msa)).detach().numpy()
-    got = alphafold2_apply(params, cfg, jnp.asarray(seq), jnp.asarray(msa))
+    got = jax.jit(lambda p, s, ms: alphafold2_apply(p, cfg, s, ms))(
+        params, jnp.asarray(seq), jnp.asarray(msa)
+    )
     np.testing.assert_allclose(np.asarray(got), want, atol=2e-4)
 
 
@@ -128,15 +127,12 @@ def test_templates_forward():
         templates=torch.from_numpy(templates),
         templates_mask=torch.from_numpy(templates_mask),
     ).detach().numpy()
-    got = alphafold2_apply(
-        params,
-        cfg,
-        jnp.asarray(seq),
-        jnp.asarray(msa),
-        mask=jnp.asarray(mask),
-        templates=jnp.asarray(templates),
-        templates_mask=jnp.asarray(templates_mask),
-    )
+    got = jax.jit(
+        lambda p, s, ms, mk, t, tm: alphafold2_apply(
+            p, cfg, s, ms, mask=mk, templates=t, templates_mask=tm
+        )
+    )(params, jnp.asarray(seq), jnp.asarray(msa), jnp.asarray(mask),
+      jnp.asarray(templates), jnp.asarray(templates_mask))
     np.testing.assert_allclose(np.asarray(got), want, atol=2e-4)
 
 
@@ -147,9 +143,9 @@ def test_embedds_path():
     params = alphafold2_init(key, cfg)
     seq = _seq(seed=10)
     embedds = np.random.RandomState(11).randn(1, N, cfg.num_embedds).astype(np.float32)
-    out = alphafold2_apply(
-        params, cfg, jnp.asarray(seq), embedds=jnp.asarray(embedds)
-    )
+    out = jax.jit(
+        lambda p, s, e: alphafold2_apply(p, cfg, s, embedds=e)
+    )(params, jnp.asarray(seq), jnp.asarray(embedds))
     assert out.shape == (1, N, N, 37)
     assert np.isfinite(np.asarray(out)).all()
 

@@ -40,10 +40,15 @@ def test_pipeline_matches_sequential(stages, microbatches, tie, depth):
     layers, x, m = _setup(cfg, b=microbatches, n=8, rows=3, cols=8)
     mesh = make_mesh({"pipe": stages})
 
-    want_x, want_m = sequential_trunk_apply(layers, cfg, x, m)
-    got_x, got_m = pipeline_trunk_apply(
-        layers, cfg, x, m, mesh, microbatches=microbatches
-    )
+    # jit both paths: eager dispatch is ~3x trace+compile+run here
+    want_x, want_m = jax.jit(
+        lambda ls, a, b: sequential_trunk_apply(ls, cfg, a, b)
+    )(layers, x, m)
+    got_x, got_m = jax.jit(
+        lambda ls, a, b: pipeline_trunk_apply(
+            ls, cfg, a, b, mesh, microbatches=microbatches
+        )
+    )(layers, x, m)
     np.testing.assert_allclose(np.asarray(got_x), np.asarray(want_x), atol=1e-5)
     np.testing.assert_allclose(np.asarray(got_m), np.asarray(want_m), atol=1e-5)
 

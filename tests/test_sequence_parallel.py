@@ -55,7 +55,8 @@ def _shard_mapped(prim, mesh, masked):
         if masked
         else (lambda q, k, v: prim(q, k, v, "sp"))
     )
-    return shard_map(body, mesh=mesh, in_specs=args, out_specs=spec)
+    # jit: eager shard_map dispatch is ~3x trace+compile+run here
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=args, out_specs=spec))
 
 
 @pytest.mark.parametrize("name", list(PRIMS))
@@ -77,10 +78,10 @@ def test_ring_handles_fully_masked_batch_row():
     q, k, v, _ = _data(seed=2)
     mask = jnp.ones(q.shape[:2], bool).at[0].set(False)
     spec = P(None, "sp", None, None)
-    fn = shard_map(
+    fn = jax.jit(shard_map(
         lambda q, k, v, m: ring_attention(q, k, v, "sp", mask=m),
         mesh=mesh, in_specs=(spec, spec, spec, P(None, "sp")), out_specs=spec,
-    )
+    ))
     got = np.asarray(fn(q, k, v, mask))
     assert np.isfinite(got).all()
     np.testing.assert_allclose(got[0], 0.0)
@@ -170,12 +171,12 @@ def test_sequence_parallel_axial_matches_single_device():
 
     xspec = P(None, "sp", None, None)
     mspec = P(None, "sp", None)
-    fn = shard_map(
+    fn = jax.jit(shard_map(
         lambda p, x, m: sequence_parallel_axial_attention(p, cfg, x, "sp", mask=m),
         mesh=mesh,
         in_specs=(P(), xspec, mspec),
         out_specs=xspec,
-    )
+    ))
     got = fn(params, x, mask)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
@@ -201,10 +202,10 @@ def test_tied_row_attention_sharded_parity():
     ).reshape(b, R, n, 32)
 
     spec = P(None, "sp", None, None)
-    fn = shard_map(
+    fn = jax.jit(shard_map(
         lambda p, x, m: tied_row_attention_sharded(p, cfg, x, "sp", mask=m),
         mesh=mesh, in_specs=(P(), spec, P(None, "sp", None)), out_specs=spec,
-    )
+    ))
     got = fn(params, x, mask)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
@@ -223,12 +224,12 @@ def test_ring_kernel_path_matches_oracle():
     # check_vma=False: pallas's interpret-mode HLO interpreter trips an
     # internal dynamic_slice vma mismatch under shard_map (jax suggests
     # exactly this workaround); compiled TPU runs keep vma checking
-    fn = shard_map(
+    fn = jax.jit(shard_map(
         lambda q, k, v, m: ring_attention(q, k, v, "sp", mask=m,
                                           use_kernel=True),
         mesh=mesh, in_specs=(spec, spec, spec, P(None, "sp")), out_specs=spec,
         check_vma=False,
-    )
+    ))
     got = fn(q, k, v, mask)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 

@@ -60,12 +60,18 @@ def test_sp_trunk_matches_replicated(tie, compress, masked, depth):
     layers, x, m, x_mask, msa_mask = _setup(cfg, n=16, rows=8, cols=16, masked=masked)
     mesh = make_mesh({"seq": N_DEV})
 
-    want_x, want_m = sequential_trunk_apply(
-        layers, cfg, x, m, x_mask=x_mask, msa_mask=msa_mask
-    )
-    got_x, got_m = sp_trunk_apply(
-        layers, cfg, x, m, mesh, x_mask=x_mask, msa_mask=msa_mask
-    )
+    # jit both paths: eager shard_map/trunk dispatch is ~3x slower than
+    # trace+compile+run at these sizes on the 1-core test box
+    want_x, want_m = jax.jit(
+        lambda ls, a, b: sequential_trunk_apply(
+            ls, cfg, a, b, x_mask=x_mask, msa_mask=msa_mask
+        )
+    )(layers, x, m)
+    got_x, got_m = jax.jit(
+        lambda ls, a, b: sp_trunk_apply(
+            ls, cfg, a, b, mesh, x_mask=x_mask, msa_mask=msa_mask
+        )
+    )(layers, x, m)
 
     # compare VALID positions only: masked positions are contractually
     # garbage, and the two paths disagree there by design (dense gives
@@ -122,12 +128,16 @@ def test_sp_trunk_aligned_matches_replicated(tie, compress, masked):
     layers, x, m, x_mask, msa_mask = _setup(cfg, n=16, rows=8, cols=8, masked=masked)
     mesh = make_mesh({"seq": N_DEV})
 
-    want_x, want_m = sequential_trunk_apply(
-        layers, cfg, x, m, x_mask=x_mask, msa_mask=msa_mask
-    )
-    got_x, got_m = sp_trunk_apply(
-        layers, cfg, x, m, mesh, x_mask=x_mask, msa_mask=msa_mask
-    )
+    want_x, want_m = jax.jit(
+        lambda ls, a, b: sequential_trunk_apply(
+            ls, cfg, a, b, x_mask=x_mask, msa_mask=msa_mask
+        )
+    )(layers, x, m)
+    got_x, got_m = jax.jit(
+        lambda ls, a, b: sp_trunk_apply(
+            ls, cfg, a, b, mesh, x_mask=x_mask, msa_mask=msa_mask
+        )
+    )(layers, x, m)
 
     def valid_sel(mask, arr):
         return np.asarray(arr)[np.asarray(mask)] if mask is not None else np.asarray(arr)
