@@ -27,7 +27,7 @@ def _pairwise_dist(coords, eps=1e-12):
     return jnp.sqrt(d2 + eps)
 
 
-@partial(jax.jit, static_argnames=("iters", "bwd_iters"))
+@partial(jax.jit, static_argnames=("iters", "bwd_iters", "unroll"))
 def mds(
     pre_dist_mat,
     weights=None,
@@ -35,6 +35,7 @@ def mds(
     tol: float = 1e-5,
     key=None,
     bwd_iters: int | None = None,
+    unroll: int = 1,
 ):
     """Stress-majorization MDS.
 
@@ -62,6 +63,12 @@ def mds(
         (no gradient to distances/weights). The end-to-end loss backprops
         through MDS (reference train_end2end.py:152-176), where iters=200
         makes the full unroll the dominant memory/latency cost.
+      unroll: lax.scan unroll factor. The 200 iterations are sequential
+        (1152, 1152)-scale ops at batch 1 — dispatch-overhead territory on
+        TPU (PERF.md "MDS latency"); unrolling amortizes per-iteration loop
+        overhead at the cost of compile time. Same math and trip count;
+        results differ from the rolled scan only by XLA
+        fusion/reassociation float noise.
 
     Returns:
       coords: (batch, 3, N)
@@ -122,7 +129,8 @@ def mds(
 
     if bwd_iters is not None and bwd_iters < iters:
         carry, head = jax.lax.scan(
-            make_step(True), carry, None, length=iters - bwd_iters
+            make_step(True), carry, None, length=iters - bwd_iters,
+            unroll=unroll,
         )
         # cut the chain: no gradient flows into (or residuals are kept for)
         # the first iters-bwd_iters steps. `done` is boolean (no gradient).
@@ -135,11 +143,14 @@ def mds(
             history = head
         else:
             carry, tail = jax.lax.scan(
-                make_step(False), carry, None, length=bwd_iters
+                make_step(False), carry, None, length=bwd_iters,
+                unroll=unroll,
             )
             history = jnp.concatenate([head, tail], axis=0)
     else:
-        carry, history = jax.lax.scan(make_step(True), carry, None, length=iters)
+        carry, history = jax.lax.scan(
+            make_step(True), carry, None, length=iters, unroll=unroll
+        )
 
     coords = carry[0]
     return jnp.transpose(coords, (0, 2, 1)), history
@@ -156,6 +167,7 @@ def mdscaling(
     C_mask=None,
     key=None,
     bwd_iters: int | None = None,
+    unroll: int = 1,
 ):
     """MDS + chirality (mirror-image) correction.
 
@@ -168,7 +180,7 @@ def mdscaling(
     """
     preds, stresses = mds(
         pre_dist_mat, weights=weights, iters=iters, tol=tol, key=key,
-        bwd_iters=bwd_iters,
+        bwd_iters=bwd_iters, unroll=unroll,
     )
     if not fix_mirror:
         return preds, stresses

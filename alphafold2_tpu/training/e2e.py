@@ -60,6 +60,10 @@ class E2EConfig:
     # from the backward — the MDS unroll is a dominant latency/memory cost
     # at the north-star scale (PERF.md)
     mds_bwd_iters: int | None = None
+    # lax.scan unroll factor for the MDS iterations (geometry/mds.py):
+    # amortizes per-iteration dispatch overhead on TPU (same math; float
+    # reassociation noise only)
+    mds_unroll: int = 1
     fix_mirror: bool = True  # reference fix_mirror=5 -> boolean here; the
     # reference's int is a retry count for an eigen-fallback that its own
     # mds_torch never triggers (utils.py:637-642)
@@ -119,6 +123,7 @@ def predict_structure(params, ecfg: E2EConfig, seq, mask=None, rng=None, msa=Non
         CA_mask=ca_mask,
         key=rng_mds,
         bwd_iters=ecfg.mds_bwd_iters,
+        unroll=ecfg.mds_unroll,
     )  # (b, 3, 3L)
 
     backbone = jnp.transpose(coords, (0, 2, 1))  # (b, 3L, 3)

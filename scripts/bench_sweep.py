@@ -60,6 +60,7 @@ ecfg = E2EConfig(
                           dtype=jnp.bfloat16, atom_chunk=256),
     mds_iters=200,
     mds_bwd_iters=spec["mds_bwd_iters"],
+    mds_unroll=spec.get("mds_unroll", 1),
 )
 # Kernel policy (spec["kernel"]):
 #   "force" -> zero the auto-dispatch j-threshold so every supported shape
@@ -201,6 +202,9 @@ def main():
             # 3x — the per-grid-step-overhead lever (PERF.md finding 3)
             ("e2e_qbt1152", {**base, "kernel": "force", "qb_target": 1152}),
             ("e2e_mdsbwd25", {**base, "mds_bwd_iters": 25}),
+            # MDS scan unroll: amortizes the 200 sequential small-kernel
+            # iterations' dispatch overhead (PERF.md "MDS latency")
+            ("e2e_mdsunroll8", {**base, "mds_unroll": 8}),
             ("e2e_tile26", {**base, "tile_elems": 1 << 26}),
             ("e2e_chunk0", {**base, "batch_chunk": 0}),
             ("e2e_chunk96", {**base, "batch_chunk": 96}),

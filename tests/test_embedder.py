@@ -56,9 +56,10 @@ def test_mask_isolation():
     rs = np.random.RandomState(1)
     seq = jnp.asarray(rs.randint(0, 20, (1, 8)))
     mask = jnp.asarray([[True] * 5 + [False] * 3])
-    out1 = jax.jit(lambda p, s, m: embed_sequences(p, TINY, s, m))(params, seq, mask)
+    fn = jax.jit(lambda p, s, m: embed_sequences(p, TINY, s, m))
+    out1 = fn(params, seq, mask)
     seq2 = seq.at[:, 5:].set((seq[:, 5:] + 7) % 20)
-    out2 = jax.jit(lambda p, s, m: embed_sequences(p, TINY, s, m))(params, seq2, mask)
+    out2 = fn(params, seq2, mask)
     np.testing.assert_allclose(
         np.asarray(out1)[:, :5], np.asarray(out2)[:, :5], atol=1e-5
     )

@@ -358,3 +358,25 @@ def test_weighted_kabsch_ignores_masked_garbage():
     np.testing.assert_allclose(
         np.asarray(Yc)[:, :n_valid], np.asarray(Yc_ref), atol=1e-3
     )
+
+
+def test_mds_unroll_matches_rolled():
+    """unroll is a scheduling knob: same math, same trip count — results
+    match the rolled scan up to XLA fusion/reassociation float noise
+    (~1e-6 observed), incl. the truncated-backprop split."""
+    key = jax.random.PRNGKey(3)
+    truth = jax.random.normal(key, (2, 12, 3)) * 3.0
+    dist = jnp.sqrt(
+        jnp.sum((truth[:, :, None] - truth[:, None]) ** 2, axis=-1) + 1e-12
+    )
+    rolled = {}
+    for kw in ({}, {"bwd_iters": 5}):
+        c1, h1 = mds(dist, iters=20, key=jax.random.PRNGKey(4), **kw)
+        c2, h2 = mds(dist, iters=20, key=jax.random.PRNGKey(4), unroll=4, **kw)
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), atol=1e-4)
+        rolled[bool(kw)] = c1
+    # non-divisible unroll factor is legal for lax.scan; baseline is the
+    # PLAIN rolled run (freeze semantics match — not the bwd_iters one)
+    c3, _ = mds(dist, iters=20, key=jax.random.PRNGKey(4), unroll=7)
+    np.testing.assert_allclose(np.asarray(rolled[False]), np.asarray(c3), atol=1e-4)
