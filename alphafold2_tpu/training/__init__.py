@@ -37,6 +37,9 @@ from alphafold2_tpu.training.e2e import (
     e2e_train_state_init,
     predict_structure,
 )
+from alphafold2_tpu.training.presets import (
+    north_star_e2e_config,
+)
 from alphafold2_tpu.training.checkpoint import (
     CheckpointManager,
     abstract_like,
@@ -82,4 +85,5 @@ __all__ = [
     "synthetic_batches",
     "sidechainnet_batches",
     "sidechainnet_structure_batches",
+    "north_star_e2e_config",
 ]

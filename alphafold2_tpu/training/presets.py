@@ -1,0 +1,88 @@
+"""The north-star benchmark configuration, built in exactly one place.
+
+BASELINE.md's operational target is defined over ONE workload (config 5):
+the full end-to-end structure train step — reversible tied-row trunk on
+the (3*384)^2 pair grid, MSA 128 rows, aligned cross-attention, distogram
+-> 200-iter MDS -> sidechain lift -> EGNN refiner -> weighted Kabsch RMSD
+loss — dim 256, heads 8, bf16 compute. Three scripts time it (bench.py,
+scripts/bench_sweep.py, scripts/bench_decompose.py) and their numbers are
+only comparable if they run the SAME program, so the config lives here
+and the scripts import it instead of hand-copying kwargs.
+
+`smoke=True` swaps in tiny CPU-safe shapes (the driver-validated fallback
+bench.py has always run off-TPU); numbers from smoke configs are
+meaningless and exist only to prove the code path end-to-end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from alphafold2_tpu.models import Alphafold2Config, RefinerConfig
+from alphafold2_tpu.training.e2e import E2EConfig
+
+NORTH_STAR_CROP = 384
+NORTH_STAR_MSA_ROWS = 128
+SMOKE_CROP = 16
+SMOKE_MSA_ROWS = 4
+
+
+def north_star_e2e_config(
+    depth: int,
+    *,
+    smoke: bool = False,
+    model_overrides: dict | None = None,
+    e2e_overrides: dict | None = None,
+):
+    """Build the north-star E2EConfig (BASELINE.md config 5).
+
+    Returns (ecfg, crop, msa_rows). model_overrides / e2e_overrides are
+    dataclasses.replace patches on the model / e2e config respectively —
+    the sweep's tuning knobs go through here so a knob rename breaks
+    loudly in every script at once.
+    """
+    crop = SMOKE_CROP if smoke else NORTH_STAR_CROP
+    msa_rows = SMOKE_MSA_ROWS if smoke else NORTH_STAR_MSA_ROWS
+    dim, dim_head = (32, 16) if smoke else (256, 64)
+    dtype = jnp.float32 if smoke else jnp.bfloat16
+
+    model = Alphafold2Config(
+        dim=dim,
+        depth=depth,
+        heads=8,
+        dim_head=dim_head,
+        max_seq_len=2048,
+        max_num_msa=max(msa_rows, 20),
+        dtype=dtype,
+        # O(1) trunk activation memory in depth — mandatory at depth 48
+        reversible=True,
+        msa_tie_row_attn=True,
+        cross_attn_compress_ratio=1 if smoke else 4,
+        # column-aligned cross-attention: the O(n^2 * r) redesign that makes
+        # this workload tractable (flat mode is O(n^2 * r*c) — ~100x more)
+        cross_attn_mode="aligned",
+        attn_flash="auto",
+        # chunk attention ops over the folded-batch axis so QKV/out
+        # projections never materialize over all 1.3M pair tokens
+        attn_batch_chunk=0 if smoke else 32,
+        # bound the 2048-wide GEGLU intermediate on the pair stream
+        ff_chunk_size=0 if smoke else 32768,
+    )
+    if model_overrides:
+        model = dataclasses.replace(model, **model_overrides)
+
+    rdim = 16 if smoke else 64
+    ecfg = E2EConfig(
+        model=model,
+        refiner=RefinerConfig(
+            num_tokens=14, dim=rdim, depth=2, msg_dim=rdim, dtype=dtype,
+            # bound the (A, A, msg) pair-message tensor at 5376 atoms
+            atom_chunk=0 if smoke else 256,
+        ),
+        mds_iters=5 if smoke else 200,  # reference train_end2end.py:157
+    )
+    if e2e_overrides:
+        ecfg = dataclasses.replace(ecfg, **e2e_overrides)
+    return ecfg, crop, msa_rows

@@ -171,66 +171,25 @@ def main():
 
 
 def _run(dev, on_tpu: bool, depth: int) -> dict:
-    import jax.numpy as jnp
-
-    from alphafold2_tpu.models import Alphafold2Config, RefinerConfig
     from alphafold2_tpu.training import (
         DataConfig,
-        E2EConfig,
         TrainConfig,
         e2e_loss_fn,
         e2e_train_state_init,
         make_train_step,
+        north_star_e2e_config,
         predict_structure,
         stack_microbatches,
         synthetic_structure_batches,
     )
 
-    if on_tpu:  # the north-star shapes (BASELINE.md config 5)
-        # steps=1: one optimizer step per device execution — the step is
-        # tens of seconds of device time and longer single executions have
-        # crashed the tunneled TPU worker; the timed call still fetches its
-        # loss, so the measurement stays dispatch-proof
-        crop, msa_rows, dim, steps = 384, 128, 256, 1
-        mds_iters = 200
-    else:  # CPU smoke fallback so the bench always completes
-        crop, msa_rows, dim, steps = 16, 4, 32, 2
-        mds_iters = 5
-
-    dtype = jnp.bfloat16 if on_tpu else jnp.float32
-    ecfg = E2EConfig(
-        model=Alphafold2Config(
-            dim=dim,
-            depth=depth,
-            heads=8,
-            dim_head=64 if on_tpu else 16,
-            max_seq_len=2048,
-            max_num_msa=max(msa_rows, 20),
-            dtype=dtype,
-            # O(1) trunk activation memory in depth — mandatory at depth 48
-            reversible=True,
-            msa_tie_row_attn=True,
-            cross_attn_compress_ratio=4 if on_tpu else 1,
-            # column-aligned cross-attention: the O(n^2 * r) redesign that
-            # makes this workload tractable (flat cross-attention is
-            # O(n^2 * r*c) FLOPs — ~100x more at these shapes)
-            cross_attn_mode="aligned",
-            attn_flash="auto",
-            # chunk attention ops over the folded-batch axis so QKV/out
-            # projections never materialize over all 1.3M pair tokens
-            attn_batch_chunk=32 if on_tpu else 0,
-            # bound the 2048-wide GEGLU intermediate on the 1.3M-token pair
-            # stream
-            ff_chunk_size=32768 if on_tpu else 0,
-        ),
-        refiner=RefinerConfig(num_tokens=14, dim=64 if on_tpu else 16,
-                              depth=2, msg_dim=64 if on_tpu else 16,
-                              dtype=dtype,
-                              # bound the (A, A, msg) pair-message tensor at
-                              # 5376 atoms
-                              atom_chunk=256 if on_tpu else 0),
-        mds_iters=mds_iters,
-    )
+    # steps=1 on TPU: one optimizer step per device execution — the step is
+    # tens of seconds of device time and longer single executions have
+    # crashed the tunneled TPU worker; the timed call still fetches its
+    # loss, so the measurement stays dispatch-proof. The CPU smoke config
+    # (tiny shapes) exists so the bench always completes.
+    steps = 1 if on_tpu else 2
+    ecfg, crop, msa_rows = north_star_e2e_config(depth, smoke=not on_tpu)
     tcfg = TrainConfig(learning_rate=3e-4, grad_accum=1)
     dcfg = DataConfig(batch_size=1, max_len=crop, msa_rows=msa_rows, seed=0)
 

@@ -1,0 +1,310 @@
+"""Where-the-time-goes decomposition of the north-star e2e step.
+
+The knob sweep (scripts/bench_sweep.py, PERF.md session 5) showed the
+depth-12 e2e step pinned at ~24.4 s/step no matter which tuning axis
+moves (kernel policy, attention batch-chunk, flash tile budget, MDS
+backprop truncation/unroll) — so the time is going somewhere those knobs
+do not touch. This bench times each pipeline component in isolation, at
+the exact north-star shapes and model config bench.py runs:
+
+  trunk_fwd   full model forward (embeddings + reversible trunk + head)
+  trunk_vg    model forward + backward (reversible reconstruction)
+  geom_vg     geometry tail fwd+bwd from fixed logits: center_distogram
+              -> 200-iter MDS -> sidechain lift -> EGNN refiner ->
+              weighted Kabsch -> RMSD + dispersion loss
+  ops         one REVERSIBLE trunk layer's pieces (8 blocks), each
+              fwd+bwd in isolation: pair axial self-attn, MSA axial
+              tied-row self-attn, the two aligned cross-attentions, and
+              the TWO GEGLU feed-forwards per stream
+
+Identities: e2e step ~= trunk_vg + geom_vg + optimizer, and
+trunk_vg/depth >~ sum(ops) — a LOWER bound, since the reversible backward
+re-runs each op's forward once more for activation reconstruction
+(expect roughly sum(ops) * (1 + fwd/(fwd+bwd))). Mismatches beyond that
+localize hidden costs (reversible-layout overheads, XLA fusion
+differences between isolated and composed programs).
+
+Each leg runs in its own subprocess (bench_sweep.py's isolation pattern:
+a crashed TPU worker must not take the orchestrator down) and appends one
+JSON line to PERF_DECOMP.jsonl. Timing is dispatch-proof: results are
+fetched to the host before the clock stops (see bench.py methodology).
+
+Usage: python scripts/bench_decompose.py [--depth 12] [--legs trunk_fwd,...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+from bench_sweep import err_tail  # noqa: E402  (shared failure summarizer)
+
+OUT = os.path.join(REPO, "PERF_DECOMP.jsonl")
+
+WORKER = r"""
+import json, sys, time
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+spec = json.loads(sys.argv[1])
+leg, depth = spec["leg"], spec["depth"]
+
+from alphafold2_tpu.models.trunk import (
+    cross_apply_grids, prenorm_axial_apply, prenorm_ff_apply,
+    trunk_layer_init,
+)
+from alphafold2_tpu.training import (
+    DataConfig, TrainConfig, e2e_train_state_init, north_star_e2e_config,
+    stack_microbatches, synthetic_structure_batches,
+)
+from alphafold2_tpu.training.e2e import elongate, make_e2e_loss_fn
+from alphafold2_tpu.models import alphafold2_apply
+
+smoke = spec.get("smoke", False)
+# ONE source for the north-star config (training/presets.py): the
+# decomposition must time the exact program bench.py's 24.4 s/step runs
+ecfg, crop, msa_rows = north_star_e2e_config(depth, smoke=smoke)
+cfg = ecfg.model
+dim, dt_model = cfg.dim, cfg.dtype
+tcfg = TrainConfig(learning_rate=3e-4, grad_accum=1)
+dcfg = DataConfig(batch_size=1, max_len=crop, msa_rows=msa_rows, seed=0)
+batch = jax.device_put(
+    jax.tree_util.tree_map(
+        lambda t: t[0],
+        next(stack_microbatches(synthetic_structure_batches(dcfg), 1)),
+    )
+)
+key = jax.random.PRNGKey(0)
+
+
+def timed(compiled, *args):
+    out = compiled(*args)  # warmup (compile happened in .compile())
+    jax.tree_util.tree_map(np.asarray, out)
+    t0 = time.perf_counter()
+    out = compiled(*args)
+    jax.tree_util.tree_map(np.asarray, out)  # fetch: dispatch-proof
+    return time.perf_counter() - t0
+
+
+def report(**kv):
+    if smoke:
+        kv["smoke"] = True  # CPU validation rows must not read as chip data
+    # flush per row: the orchestrator salvages completed rows from a leg
+    # that later crashes or times out, and a block-buffered pipe would
+    # hold them hostage
+    print(json.dumps(kv), flush=True)
+
+
+n3 = crop * 3
+seq3 = elongate(batch["seq"])
+mask3 = elongate(batch["mask"])
+
+if leg in ("trunk_fwd", "trunk_vg"):
+    state = e2e_train_state_init(key, ecfg, tcfg)
+    params = state["params"]["model"]
+
+    def fwd(p):
+        logits = alphafold2_apply(
+            p, cfg, seq3, batch["msa"], mask=mask3,
+            msa_mask=batch["msa_mask"], rng=None,
+        )
+        # scalar pull so the backward has a cotangent; f32 to match e2e
+        return jnp.mean(jnp.square(logits.astype(jnp.float32)))
+
+    fn = fwd if leg == "trunk_fwd" else jax.value_and_grad(fwd)
+    compiled = jax.jit(fn).lower(params).compile()
+    dt = timed(compiled, params)
+    report(leg=leg, depth=depth, sec=round(dt, 3))
+
+elif leg == "geom_vg":
+    state = e2e_train_state_init(key, ecfg, tcfg)
+    # fixed logits standing in for the trunk output; differentiate the
+    # geometry tail wrt logits AND refiner params (what training does)
+    logits = jax.random.normal(
+        jax.random.PRNGKey(1), (1, n3, n3, cfg.num_buckets), jnp.float32
+    )
+    mb = dict(batch)
+
+    def tail_loss(lg, refiner_params):
+        # the real e2e loss with a stub model-apply returning the fixed
+        # logits: everything downstream of the trunk, nothing of it
+        lf = make_e2e_loss_fn(model_apply_fn=lambda p, c, s, msa, **kw: lg)
+        params = {"model": {}, "refiner": refiner_params}
+        return lf(params, ecfg, mb, key)
+
+    fn = jax.value_and_grad(tail_loss, argnums=(0, 1))
+    compiled = jax.jit(fn).lower(logits, state["params"]["refiner"]).compile()
+    dt = timed(compiled, logits, state["params"]["refiner"])
+    report(leg=leg, depth=depth, sec=round(dt, 3))
+
+elif leg == "ops":
+    # one REVERSIBLE trunk layer's pieces, each fwd+bwd in isolation at
+    # model shapes — 8 blocks: reversible layers carry TWO feed-forwards
+    # per stream (models/trunk.py trunk_layer_init; an identity over only
+    # 6 blocks would undercount every layer by 2 GEGLU passes)
+    layer = trunk_layer_init(key, cfg, reversible=True)
+    self_cfg = cfg.self_attn_config()
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, n3, n3, dim), dt_model)
+    # the MSA stream keeps its own column count (crop, NOT the 3x-elongated
+    # pair length): alphafold2_apply embeds msa at msa.shape[2] columns and
+    # the aligned cross mode folds 3 pair columns per MSA column
+    m = jax.random.normal(jax.random.PRNGKey(3), (1, msa_rows, crop, dim),
+                          dt_model)
+    x_mask = jnp.broadcast_to(mask3[:, :, None] & mask3[:, None, :],
+                              (1, n3, n3))
+    msa_mask = batch["msa_mask"]
+
+    def bench_op(name, f, *args):
+        def loss(*a):
+            return jnp.mean(jnp.square(f(*a).astype(jnp.float32)))
+        vg = jax.value_and_grad(loss, argnums=tuple(range(len(args))))
+        compiled = jax.jit(vg).lower(*args).compile()
+        dt = timed(compiled, *args)
+        report(leg=f"op_{name}", depth=depth, sec=round(dt, 3))
+
+    bench_op(
+        "pair_axial",
+        lambda p, t: prenorm_axial_apply(p, self_cfg, t, mask=x_mask),
+        layer["seq_attn"], x,
+    )
+    bench_op(
+        "msa_axial_tied",
+        lambda p, t: prenorm_axial_apply(
+            p, self_cfg, t, mask=msa_mask, tie_row=cfg.msa_tie_row_attn
+        ),
+        layer["msa_attn"], m,
+    )
+    bench_op(
+        "cross_pair_from_msa",
+        lambda p, a, b_: cross_apply_grids(
+            p, cfg, a, b_, x_mask, msa_mask, None, "pair_from_msa"
+        ),
+        layer["seq_cross"], x, m,
+    )
+    bench_op(
+        "cross_msa_from_pair",
+        lambda p, a, b_: cross_apply_grids(
+            p, cfg, a, b_, msa_mask, x_mask, None, "msa_from_pair"
+        ),
+        layer["msa_cross"], m, x,
+    )
+    bench_op(
+        "ff_pair",
+        lambda p, t: prenorm_ff_apply(p, cfg, t),
+        layer["seq_ff"], x,
+    )
+    bench_op(
+        "ff_pair2",
+        lambda p, t: prenorm_ff_apply(p, cfg, t),
+        layer["seq_ff2"], x,
+    )
+    bench_op(
+        "ff_msa",
+        lambda p, t: prenorm_ff_apply(p, cfg, t),
+        layer["msa_ff"], m,
+    )
+    bench_op(
+        "ff_msa2",
+        lambda p, t: prenorm_ff_apply(p, cfg, t),
+        layer["msa_ff2"], m,
+    )
+else:
+    raise SystemExit(f"unknown leg {leg!r}")
+"""
+
+
+def run_leg(leg, depth, timeout, smoke=False):
+    spec = {"leg": leg, "depth": depth, "smoke": smoke}
+    env = dict(os.environ)
+    if smoke:  # never touch the (possibly busy/wedged) TPU for a smoke run
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+    def parse_rows(stdout):
+        rows = []
+        for line in (stdout or "").strip().splitlines():
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                continue
+        return rows
+
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", WORKER, json.dumps(spec)],
+            capture_output=True, text=True, timeout=timeout, cwd=REPO,
+            env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        # salvage rows the worker already printed (it flushes per row):
+        # chip time spent on completed measurements must reach the record
+        out = e.stdout.decode() if isinstance(e.stdout, bytes) else e.stdout
+        return (parse_rows(out) + [{"leg": leg, "error": "timeout"}],
+                time.time() - t0, True)
+    if proc.returncode != 0:
+        return (
+            parse_rows(proc.stdout)
+            + [{"leg": leg, "error": err_tail(proc.stderr, proc.returncode)}],
+            time.time() - t0,
+            False,
+        )
+    rows = parse_rows(proc.stdout)
+    return (rows or [{"leg": leg, "error": "no JSON"}]), time.time() - t0, False
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--depth", type=int, default=12)
+    ap.add_argument("--legs", default="trunk_fwd,trunk_vg,geom_vg,ops")
+    ap.add_argument("--timeout", type=int, default=1800)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU shapes: validates the worker end-to-end "
+                         "without a chip (numbers are meaningless)")
+    ap.add_argument("--force-all", action="store_true",
+                    help="re-run legs already recorded in PERF_DECOMP.jsonl")
+    args = ap.parse_args()
+
+    # Legs with a successful non-smoke record are skipped by default:
+    # recovered-tunnel time is scarce and the watcher restarts this script
+    # on every recovery. The ops leg emits op_* rows as it goes (partial
+    # rows are salvaged from failed runs), so its done-marker is the LAST
+    # row — a partially-measured ops leg re-runs until every op lands.
+    marker = {"ops": "op_ff_msa2"}
+    done = set()
+    if not args.force_all and os.path.exists(OUT):
+        with open(OUT) as f:
+            for line in f:
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                if "error" not in e and not e.get("smoke"):
+                    done.add((e.get("leg"), e.get("depth")))
+
+    for leg in args.legs.split(","):
+        leg = leg.strip()
+        if not args.smoke and (marker.get(leg, leg), args.depth) in done:
+            print(f"skip {leg}: already recorded in {OUT}", flush=True)
+            continue
+        rows, wall, timed_out = run_leg(leg, args.depth, args.timeout,
+                                        smoke=args.smoke)
+        with open(OUT, "a") as f:
+            for row in rows:
+                row["wall"] = round(wall, 1)
+                f.write(json.dumps(row) + "\n")
+                print(json.dumps(row), flush=True)
+        if timed_out:
+            print(json.dumps({"bench": "decompose",
+                              "error": "tunnel wedged; stopping"}), flush=True)
+            sys.exit(3)  # wedged-tunnel code: watchers retry later
+
+
+if __name__ == "__main__":
+    main()

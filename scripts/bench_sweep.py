@@ -36,31 +36,26 @@ import numpy as np
 
 spec = json.loads(sys.argv[1])
 
-import jax.numpy as jnp
-from alphafold2_tpu.models import Alphafold2Config, RefinerConfig
 from alphafold2_tpu.training import (
-    DataConfig, E2EConfig, TrainConfig, e2e_loss_fn, e2e_train_state_init,
-    make_train_step, stack_microbatches, synthetic_structure_batches,
+    DataConfig, TrainConfig, e2e_loss_fn, e2e_train_state_init,
+    make_train_step, north_star_e2e_config, stack_microbatches,
+    synthetic_structure_batches,
 )
 
-crop, msa_rows, depth = 384, 128, spec["depth"]
-ecfg = E2EConfig(
-    model=Alphafold2Config(
-        dim=256, depth=depth, heads=8, dim_head=64, max_seq_len=2048,
-        max_num_msa=128, dtype=jnp.bfloat16, reversible=True,
-        msa_tie_row_attn=True, cross_attn_compress_ratio=4,
-        cross_attn_mode="aligned",
-        attn_flash="auto",
+depth = spec["depth"]
+# ONE source for the north-star config (training/presets.py); the sweep's
+# tuning axes are override patches so a knob rename breaks loudly here
+ecfg, crop, msa_rows = north_star_e2e_config(
+    depth,
+    model_overrides=dict(
         attn_batch_chunk=spec["batch_chunk"],
         attn_flash_tile_elems=spec["tile_elems"],
         attn_flash_qb_target=spec.get("qb_target"),
-        ff_chunk_size=32768,
     ),
-    refiner=RefinerConfig(num_tokens=14, dim=64, depth=2, msg_dim=64,
-                          dtype=jnp.bfloat16, atom_chunk=256),
-    mds_iters=200,
-    mds_bwd_iters=spec["mds_bwd_iters"],
-    mds_unroll=spec.get("mds_unroll", 1),
+    e2e_overrides=dict(
+        mds_bwd_iters=spec["mds_bwd_iters"],
+        mds_unroll=spec.get("mds_unroll", 1),
+    ),
 )
 # Kernel policy (spec["kernel"]):
 #   "force" -> zero the auto-dispatch j-threshold so every supported shape
@@ -103,6 +98,32 @@ print(json.dumps({"sec_per_step": round(dt, 2), "loss": round(loss, 4)}))
 """
 
 
+def err_tail(stderr: str, returncode: int) -> str:
+    """Diagnostic-bearing error summary of a failed subprocess.
+
+    The last stderr line alone is useless for XLA/jax failures — an OOM's
+    final line is a bar of '=' signs (PERF_SWEEP e2e_chunk0, session 5).
+    Prefer the last line that names an error; fall back to the last
+    non-blank line; always include the tail for context.
+    """
+    lines = [ln for ln in (stderr or "").splitlines() if ln.strip()]
+    if not lines:
+        return f"rc={returncode} (no stderr)"
+    import re
+
+    marker = None
+    for ln in reversed(lines):
+        if re.search(r"Error|Exception|RESOURCE_EXHAUSTED|OOM|Aborted|"
+                     r"assert|Traceback", ln):
+            marker = ln.strip()
+            break
+    tail = " | ".join(ln.strip() for ln in lines[-3:])
+    msg = marker if marker else tail
+    if marker and marker not in tail:
+        msg = f"{marker} | {tail}"
+    return msg[-400:]
+
+
 def run_sub(code_or_path, argv, timeout):
     t0 = time.time()
     if os.path.exists(code_or_path):
@@ -116,8 +137,7 @@ def run_sub(code_or_path, argv, timeout):
     except subprocess.TimeoutExpired:
         return None, "timeout", time.time() - t0
     if proc.returncode != 0:
-        err = (proc.stderr or "").strip().splitlines()
-        return None, (err[-1] if err else f"rc={proc.returncode}"), time.time() - t0
+        return None, err_tail(proc.stderr, proc.returncode), time.time() - t0
     results = []
     for line in proc.stdout.strip().splitlines():
         try:
@@ -215,7 +235,7 @@ def main():
             continue
         if not run_and_record(name, E2E_WORKER, [json.dumps(spec)],
                               timeout=2100, extra={"spec": spec}):
-            return
+            sys.exit(3)  # wedged-tunnel code: watchers retry later
 
     # 2) kernel microbench + block-size tuning at the chunk shape the model
     # actually calls (attn_batch_chunk=32 folded rows x 8 heads): the
@@ -242,7 +262,7 @@ def main():
             name, micro, ["--b", "32", "--n", "1152", "--iters", "20", *extra],
             timeout=1500,
         ):
-            return
+            sys.exit(3)  # wedged-tunnel code: watchers retry later
 
 
 if __name__ == "__main__":

@@ -1,0 +1,45 @@
+#!/bin/bash
+# Probe the TPU tunnel every 8 minutes; on a healthy probe, run the
+# remaining measurements in information-value order: the e2e decomposition
+# (where-the-time-goes — the sweep showed the knobs are all noise, so the
+# decomposition is what identifies the real sink), then the sweep's
+# remaining micro legs (already-recorded legs are skipped by both). Both
+# scripts exit 3 when they detect a wedged tunnel — the watcher goes back
+# to probing instead of hammering a dead relay; any other exit code counts
+# as done. The probe is a tiny subprocess matmul under a generous
+# timeout — killing a client that is merely waiting on a wedged relay
+# does not worsen the wedge (PERF.md).
+cd "$(dirname "$0")/.."
+decomp_done=0
+sweep_done=0
+for i in $(seq 1 60); do
+  if timeout 240 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform == 'tpu', jax.devices()
+x = jnp.ones((256, 256), jnp.bfloat16)
+assert float(jnp.sum((x @ x).astype(jnp.float32))) > 0
+print('healthy')
+" 2>/dev/null | grep -q healthy; then
+    echo "$(date -u +%H:%M:%S) chip healthy on probe $i; measuring"
+    if [ "$decomp_done" -eq 0 ]; then
+      python scripts/bench_decompose.py --depth 12
+      rc=$?
+      echo "$(date -u +%H:%M:%S) decompose finished rc=$rc"
+      if [ "$rc" -eq 3 ]; then sleep 480; continue; fi
+      decomp_done=1
+    fi
+    if [ "$sweep_done" -eq 0 ]; then
+      python scripts/bench_sweep.py
+      rc=$?
+      echo "$(date -u +%H:%M:%S) sweep finished rc=$rc"
+      if [ "$rc" -eq 3 ]; then sleep 480; continue; fi
+      sweep_done=1
+    fi
+    echo "$(date -u +%H:%M:%S) all measurements recorded"
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) probe $i: wedged"
+  sleep 480
+done
+echo "no recovery within the watch window"
+exit 1
