@@ -51,6 +51,7 @@ ecfg, crop, msa_rows = north_star_e2e_config(
         attn_batch_chunk=spec["batch_chunk"],
         attn_flash_tile_elems=spec["tile_elems"],
         attn_flash_qb_target=spec.get("qb_target"),
+        **({"ff_chunk_size": spec["ff_chunk"]} if "ff_chunk" in spec else {}),
     ),
     e2e_overrides=dict(
         mds_bwd_iters=spec["mds_bwd_iters"],
@@ -217,6 +218,14 @@ def main():
     variants = [("e2e_auto", base)]
     if not args.quick:
         variants += [
+            # FF chunk size: the session-5 sweep left it fixed at 32768 —
+            # 40 sequential lax.map+checkpoint blocks per FF pass, and the
+            # pair stream runs TWO GEGLU FFs per reversible layer (~30% of
+            # layer FLOPs). Bigger blocks = fewer sequential programs;
+            # memory headroom exists at depth<=24 (intermediate is
+            # chunk*2048*2B, so 262144 -> ~1 GB live per block)
+            ("e2e_ff131072", {**base, "ff_chunk": 131072}),
+            ("e2e_ff262144", {**base, "ff_chunk": 262144}),
             # whole-row QUERY blocks on the 1152 axes only (pick_block
             # leaves shorter axes unpadded): collapses the (BH, nqb) grid
             # 3x — the per-grid-step-overhead lever (PERF.md finding 3)
