@@ -215,6 +215,58 @@ elif leg == "ops":
         lambda p, t: prenorm_ff_apply(p, cfg, t),
         layer["msa_ff2"], m,
     )
+
+elif leg == "ops_detail":
+    # sub-op isolation: answers the follow-up questions the ops leg will
+    # raise, in the same chip window. All fwd+bwd, model shapes.
+    import dataclasses
+
+    layer = trunk_layer_init(key, cfg, reversible=True)
+    self_cfg = cfg.self_attn_config()
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, n3, n3, dim), dt_model)
+    x_mask = jnp.broadcast_to(mask3[:, :, None] & mask3[:, None, :],
+                              (1, n3, n3))
+
+    def bench_fn(name, f, *args):
+        def loss(*a):
+            return jnp.mean(jnp.square(f(*a).astype(jnp.float32)))
+        vg = jax.value_and_grad(loss, argnums=tuple(range(len(args))))
+        compiled = jax.jit(vg).lower(*args).compile()
+        dt = timed(compiled, *args)
+        report(leg=f"detail_{name}", depth=depth, sec=round(dt, 3))
+
+    # FF chunk-size ladder on the pair stream: isolates the 40-sequential-
+    # blocks serialization question without a 4-minute e2e leg per point
+    for chunk in (32768, 131072, 262144, 0):
+        ccfg = dataclasses.replace(cfg, ff_chunk_size=chunk)
+        bench_fn(
+            f"ff_pair_chunk{chunk}",
+            lambda p, t, c=ccfg: prenorm_ff_apply(p, c, t),
+            layer["seq_ff"], x,
+        )
+
+    # axial passes separately: column (w folded into batch) vs row — the
+    # two halves of op_pair_axial (prenorm_axial_init: {"norm", "attn":
+    # {"attn_width", "attn_height"}}), to see whether one dominates
+    from alphafold2_tpu.ops.attention import attention_apply
+
+    axial_params = layer["seq_attn"]["attn"]
+    bench_fn(
+        "pair_attn_colpass",
+        lambda p, t: attention_apply(
+            p, self_cfg,
+            jnp.swapaxes(t, 1, 2).reshape(-1, t.shape[1], t.shape[-1]),
+        ),
+        axial_params["attn_width"], x,
+    )
+    bench_fn(
+        "pair_attn_rowpass",
+        lambda p, t: attention_apply(
+            p, self_cfg,
+            t.reshape(-1, t.shape[2], t.shape[-1]),
+        ),
+        axial_params["attn_height"], x,
+    )
 else:
     raise SystemExit(f"unknown leg {leg!r}")
 """
@@ -262,7 +314,8 @@ def run_leg(leg, depth, timeout, smoke=False):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--depth", type=int, default=12)
-    ap.add_argument("--legs", default="trunk_fwd,trunk_vg,geom_vg,ops")
+    ap.add_argument("--legs",
+                    default="trunk_fwd,trunk_vg,geom_vg,ops,ops_detail")
     ap.add_argument("--timeout", type=int, default=1800)
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CPU shapes: validates the worker end-to-end "
@@ -276,7 +329,8 @@ def main():
     # on every recovery. The ops leg emits op_* rows as it goes (partial
     # rows are salvaged from failed runs), so its done-marker is the LAST
     # row — a partially-measured ops leg re-runs until every op lands.
-    marker = {"ops": "op_ff_msa2"}
+    marker = {"ops": "op_ff_msa2",
+              "ops_detail": "detail_pair_attn_rowpass"}
     done = set()
     if not args.force_all and os.path.exists(OUT):
         with open(OUT) as f:
