@@ -267,6 +267,63 @@ elif leg == "ops_detail":
         ),
         axial_params["attn_height"], x,
     )
+elif leg == "profile":
+    # op-level breakdown via a perfetto trace of one trunk fwd+bwd step.
+    # The image's xplane->tools converter is broken
+    # (tensorflow _pywrap_profiler lacks xspace_to_tools_data), but the
+    # perfetto JSON jax.profiler emits is parseable by hand. Whether
+    # device tracing works at all through the axon relay is unknown —
+    # this leg is the cheap experiment that finds out.
+    import glob
+    import gzip
+    import os
+    import shutil
+
+    state = e2e_train_state_init(key, ecfg, tcfg)
+    params = state["params"]["model"]
+
+    def fwd(p):
+        logits = alphafold2_apply(
+            p, cfg, seq3, batch["msa"], mask=mask3,
+            msa_mask=batch["msa_mask"], rng=None,
+        )
+        return jnp.mean(jnp.square(logits.astype(jnp.float32)))
+
+    compiled = jax.jit(jax.value_and_grad(fwd)).lower(params).compile()
+    out = compiled(params)
+    jax.tree_util.tree_map(np.asarray, out)  # warmup + fetch
+
+    tmpdir = os.path.join(os.getcwd(), "profile_tmp")
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    with jax.profiler.trace(tmpdir, create_perfetto_trace=True):
+        out = compiled(params)
+        jax.tree_util.tree_map(np.asarray, out)
+
+    traces = glob.glob(
+        os.path.join(tmpdir, "**", "*perfetto_trace.json.gz"), recursive=True
+    )
+    if not traces:
+        raise SystemExit(f"no perfetto trace produced under {tmpdir}")
+    with gzip.open(traces[0], "rt") as f:
+        events = json.load(f).get("traceEvents", [])
+    totals = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "?")
+        dur = ev.get("dur", 0)  # microseconds
+        t = totals.setdefault(name, [0.0, 0])
+        t[0] += dur
+        t[1] += 1
+    top = sorted(totals.items(), key=lambda kv: -kv[1][0])[:25]
+    for name, (dur_us, count) in top:
+        report(leg="profile_op", depth=depth, name=name[:120],
+               total_ms=round(dur_us / 1e3, 1), count=count)
+    report(leg="profile_total", depth=depth,
+           total_ms=round(sum(v[0] for v in totals.values()) / 1e3, 1),
+           events=len(events))
+    shutil.rmtree(tmpdir, ignore_errors=True)
+
 else:
     raise SystemExit(f"unknown leg {leg!r}")
 """
@@ -298,12 +355,14 @@ def run_leg(leg, depth, timeout, smoke=False):
         # salvage rows the worker already printed (it flushes per row):
         # chip time spent on completed measurements must reach the record
         out = e.stdout.decode() if isinstance(e.stdout, bytes) else e.stdout
-        return (parse_rows(out) + [{"leg": leg, "error": "timeout"}],
+        return (parse_rows(out) + [{"leg": leg, "depth": depth,
+                                    "error": "timeout"}],
                 time.time() - t0, True)
     if proc.returncode != 0:
         return (
             parse_rows(proc.stdout)
-            + [{"leg": leg, "error": err_tail(proc.stderr, proc.returncode)}],
+            + [{"leg": leg, "depth": depth,
+                "error": err_tail(proc.stderr, proc.returncode)}],
             time.time() - t0,
             False,
         )
@@ -315,7 +374,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--depth", type=int, default=12)
     ap.add_argument("--legs",
-                    default="trunk_fwd,trunk_vg,geom_vg,ops,ops_detail")
+                    default="trunk_fwd,trunk_vg,geom_vg,ops,ops_detail,"
+                            "profile")
     ap.add_argument("--timeout", type=int, default=1800)
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CPU shapes: validates the worker end-to-end "
@@ -330,7 +390,8 @@ def main():
     # rows are salvaged from failed runs), so its done-marker is the LAST
     # row — a partially-measured ops leg re-runs until every op lands.
     marker = {"ops": "op_ff_msa2",
-              "ops_detail": "detail_pair_attn_rowpass"}
+              "ops_detail": "detail_pair_attn_rowpass",
+              "profile": "profile_total"}
     done = set()
     if not args.force_all and os.path.exists(OUT):
         with open(OUT) as f:
@@ -341,13 +402,22 @@ def main():
                     continue
                 if "error" not in e and not e.get("smoke"):
                     done.add((e.get("leg"), e.get("depth")))
+                elif e.get("leg") == "profile":
+                    # the profile leg is an EXPERIMENT (tracing may hang the
+                    # relay client): one recorded attempt — success or
+                    # failure — is final, or a hang would loop the watcher
+                    done.add(("profile_total", e.get("depth")))
 
     for leg in args.legs.split(","):
         leg = leg.strip()
-        if not args.smoke and (marker.get(leg, leg), args.depth) in done:
+        # profile runs at depth 2: the per-layer op mix is depth-invariant,
+        # and short device executions shrink the window in which a
+        # timeout-kill could land mid-execution (the relay-wedging move)
+        depth = 2 if leg == "profile" else args.depth
+        if not args.smoke and (marker.get(leg, leg), depth) in done:
             print(f"skip {leg}: already recorded in {OUT}", flush=True)
             continue
-        rows, wall, timed_out = run_leg(leg, args.depth, args.timeout,
+        rows, wall, timed_out = run_leg(leg, depth, args.timeout,
                                         smoke=args.smoke)
         with open(OUT, "a") as f:
             for row in rows:
