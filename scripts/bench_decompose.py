@@ -93,6 +93,29 @@ def timed(compiled, *args):
     return time.perf_counter() - t0
 
 
+def compiled_tflop(compiled):
+    # Model TFLOPs of the compiled program per XLA cost analysis (0 if
+    # opaque) -- turns measured seconds into roofline-relative TF/s.
+    # (comment, not docstring: this code lives inside the WORKER
+    # triple-quoted string, which a nested triple-quote would terminate)
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0)) / 1e12
+    except Exception:
+        return 0.0
+
+
+def perf_fields(compiled, dt):
+    tf = compiled_tflop(compiled)
+    out = {"sec": round(dt, 3)}
+    if tf:
+        out["tflop"] = round(tf, 3)
+        out["tf_per_s"] = round(tf / dt, 1)
+    return out
+
+
 def report(**kv):
     if smoke:
         kv["smoke"] = True  # CPU validation rows must not read as chip data
@@ -121,7 +144,7 @@ if leg in ("trunk_fwd", "trunk_vg"):
     fn = fwd if leg == "trunk_fwd" else jax.value_and_grad(fwd)
     compiled = jax.jit(fn).lower(params).compile()
     dt = timed(compiled, params)
-    report(leg=leg, depth=depth, sec=round(dt, 3))
+    report(leg=leg, depth=depth, **perf_fields(compiled, dt))
 
 elif leg == "geom_vg":
     state = e2e_train_state_init(key, ecfg, tcfg)
@@ -142,7 +165,7 @@ elif leg == "geom_vg":
     fn = jax.value_and_grad(tail_loss, argnums=(0, 1))
     compiled = jax.jit(fn).lower(logits, state["params"]["refiner"]).compile()
     dt = timed(compiled, logits, state["params"]["refiner"])
-    report(leg=leg, depth=depth, sec=round(dt, 3))
+    report(leg=leg, depth=depth, **perf_fields(compiled, dt))
 
 elif leg == "ops":
     # one REVERSIBLE trunk layer's pieces, each fwd+bwd in isolation at
@@ -167,7 +190,7 @@ elif leg == "ops":
         vg = jax.value_and_grad(loss, argnums=tuple(range(len(args))))
         compiled = jax.jit(vg).lower(*args).compile()
         dt = timed(compiled, *args)
-        report(leg=f"op_{name}", depth=depth, sec=round(dt, 3))
+        report(leg=f"op_{name}", depth=depth, **perf_fields(compiled, dt))
 
     bench_op(
         "pair_axial",
@@ -233,7 +256,7 @@ elif leg == "ops_detail":
         vg = jax.value_and_grad(loss, argnums=tuple(range(len(args))))
         compiled = jax.jit(vg).lower(*args).compile()
         dt = timed(compiled, *args)
-        report(leg=f"detail_{name}", depth=depth, sec=round(dt, 3))
+        report(leg=f"detail_{name}", depth=depth, **perf_fields(compiled, dt))
 
     # FF chunk-size ladder on the pair stream: isolates the 40-sequential-
     # blocks serialization question without a 4-minute e2e leg per point
