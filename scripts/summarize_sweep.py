@@ -2,7 +2,8 @@
 
 Groups e2e step-time variants against e2e_base (speedup column) and lists
 kernel microbench rows with TFLOP/s. Prints markdown suitable for
-pasting into PERF.md.
+pasting into PERF.md. If PERF_DECOMP.jsonl exists alongside (see
+scripts/bench_decompose.py), renders the component decomposition too.
 
 Usage: python scripts/summarize_sweep.py [path]
 """
@@ -86,6 +87,46 @@ def main():
                       f"| {e.get('model_tflops_per_sec', '-')} | |")
     if not e2e and not micro:
         print("no sweep rows found in", path)
+
+    decomp_path = os.path.join(os.path.dirname(path), "PERF_DECOMP.jsonl")
+    if os.path.exists(decomp_path):
+        summarize_decomp(decomp_path)
+
+
+def summarize_decomp(path):
+    """Render PERF_DECOMP.jsonl: latest row per (leg, depth), non-smoke."""
+    latest, profile_ops = {}, []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if r.get("smoke"):
+                continue
+            if r.get("leg") == "profile_op":
+                profile_ops.append(r)
+                continue
+            latest[(r.get("leg"), r.get("depth"))] = r
+    if not latest and not profile_ops:
+        return
+    print("\n## component decomposition (PERF_DECOMP.jsonl)\n")
+    print("| leg | depth | sec | TFLOP | TF/s | error |")
+    print("|---|---|---|---|---|---|")
+    for (leg, depth), r in sorted(latest.items(), key=lambda kv: str(kv[0])):
+        print(f"| {leg} | {depth} | {r.get('sec', '-')} "
+              f"| {r.get('tflop', '-')} | {r.get('tf_per_s', '-')} "
+              f"| {(r.get('error') or '')[:60]} |")
+    if profile_ops:
+        print("\n### top ops by device time (perfetto trace, one step)\n")
+        print("| op | total ms | count |")
+        print("|---|---|---|")
+        for r in profile_ops[-25:]:
+            print(f"| {r.get('name', '?')[:80]} | {r.get('total_ms', '-')} "
+                  f"| {r.get('count', '-')} |")
 
 
 if __name__ == "__main__":
