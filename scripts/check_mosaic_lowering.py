@@ -66,6 +66,23 @@ def main():
         checks.append((f"{name}_fwdbwd", fwdbwd, (q, k, v, bias)))
         checks.append((f"{name}_lse", lse, (q, k, v, bias)))
 
+    # whole-row query blocks (attn_flash_qb_target=1152): the e2e sweep
+    # leg forcing this crashed the REMOTE compile (session 5) — check
+    # whether the lowering itself is the problem or the relay was
+    qw = jax.ShapeDtypeStruct((256, 1152, 64), jnp.bfloat16)
+    bw = jax.ShapeDtypeStruct((256, 1152), jnp.float32)
+
+    def fwdbwd_qb1152(q, k, v, bias):
+        out, vjp = jax.vjp(
+            lambda q, k, v: flash_attention_tpu(
+                q, k, v, bias, 64 ** -0.5, qb=1152, kb=384
+            ),
+            q, k, v,
+        )
+        return vjp(out)
+
+    checks.append(("flash_self_qb1152_fwdbwd", fwdbwd_qb1152, (qw, qw, qw, bw)))
+
     # block-sparse at its kernel-dispatch regime (n >= 4096)
     scfg = SparseConfig(block_size=128, max_seq_len=8192)
     sb, sn, sh, sdh = 1, 4096, 8, 64
