@@ -7,6 +7,8 @@ from alphafold2_tpu.models.alphafold2 import (
     Alphafold2Config,
     alphafold2_init,
     alphafold2_apply,
+    alphafold2_front,
+    alphafold2_head,
 )
 from alphafold2_tpu.models.convert import convert_alphafold2
 from alphafold2_tpu.models.trunk import (
@@ -45,6 +47,8 @@ __all__ = [
     "Alphafold2Config",
     "alphafold2_init",
     "alphafold2_apply",
+    "alphafold2_front",
+    "alphafold2_head",
     "trunk_layer_init",
     "sequential_trunk_apply",
     "reversible_trunk_init",

@@ -219,6 +219,69 @@ def alphafold2_apply(
     Returns: distogram logits (b, n, n, num_buckets).
     """
     del seq_pos
+    x, m, x_mask, m_mask, rng_trunk = alphafold2_front(
+        params, cfg, seq, msa,
+        mask=mask, msa_mask=msa_mask, templates=templates,
+        templates_mask=templates_mask, embedds=embedds, rng=rng,
+    )
+
+    # trunk (reference :528-535)
+    if trunk_fn is not None:
+        if cfg.reversible:
+            # params["trunk"] is the depth-STACKED pytree when reversible
+            # (reversible_trunk_init), not the layer list the hook's
+            # contract documents — reject rather than hand over the wrong
+            # structure
+            raise ValueError(
+                "trunk_fn overrides receive the sequential layer list; "
+                "set reversible=False"
+            )
+        x, m = trunk_fn(params["trunk"], cfg, x, m, x_mask, m_mask, rng_trunk)
+    elif cfg.reversible:
+        x, m = reversible_trunk_apply(
+            params["trunk"],
+            cfg,
+            x,
+            m,
+            x_mask=x_mask,
+            msa_mask=m_mask,
+            rng=rng_trunk,
+        )
+    else:
+        x, m = sequential_trunk_apply(
+            params["trunk"],
+            cfg,
+            x,
+            m,
+            x_mask=x_mask,
+            msa_mask=m_mask,
+            rng=rng_trunk,
+        )
+
+    return alphafold2_head(params, cfg, x)
+
+
+def alphafold2_front(
+    params,
+    cfg: Alphafold2Config,
+    seq,
+    msa=None,
+    *,
+    mask=None,
+    msa_mask=None,
+    templates=None,
+    templates_mask=None,
+    embedds=None,
+    rng=None,
+):
+    """Everything before the trunk: embeddings, MSA stream, template tower.
+
+    Split out of `alphafold2_apply` so multi-execution drivers
+    (training/segmented.py) can run front / trunk segments / head as
+    separate device executions. Returns (x, m, x_mask, m_mask, rng_trunk):
+    the pair grid, the MSA stream (or None), their masks, and the dropout
+    key for the trunk (rng split mirrors the monolithic apply exactly).
+    """
     b, n = seq.shape
 
     # pair representation: outer sum of token embeddings (reference :440-444)
@@ -297,41 +360,11 @@ def alphafold2_apply(
         x = _template_tower_apply(
             params, cfg, x, x_mask, templates, templates_mask, rng_tower
         )
+    return x, m, x_mask, m_mask, rng_trunk
 
-    # trunk (reference :528-535)
-    if trunk_fn is not None:
-        if cfg.reversible:
-            # params["trunk"] is the depth-STACKED pytree when reversible
-            # (reversible_trunk_init), not the layer list the hook's
-            # contract documents — reject rather than hand over the wrong
-            # structure
-            raise ValueError(
-                "trunk_fn overrides receive the sequential layer list; "
-                "set reversible=False"
-            )
-        x, m = trunk_fn(params["trunk"], cfg, x, m, x_mask, m_mask, rng_trunk)
-    elif cfg.reversible:
-        x, m = reversible_trunk_apply(
-            params["trunk"],
-            cfg,
-            x,
-            m,
-            x_mask=x_mask,
-            msa_mask=m_mask,
-            rng=rng_trunk,
-        )
-    else:
-        x, m = sequential_trunk_apply(
-            params["trunk"],
-            cfg,
-            x,
-            m,
-            x_mask=x_mask,
-            msa_mask=m_mask,
-            rng=rng_trunk,
-        )
 
-    # head: symmetrize + project (reference :543-545)
+def alphafold2_head(params, cfg: Alphafold2Config, x):
+    """Distogram head: symmetrize + LayerNorm + project (reference :543-545)."""
     x = (x + jnp.swapaxes(x, 1, 2)) * 0.5
     x = layer_norm(params["head_norm"], x)
     return linear(params["head_out"], x, dtype=cfg.dtype)

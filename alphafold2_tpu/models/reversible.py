@@ -223,6 +223,21 @@ def _num_layers(stacked):
     return jax.tree_util.tree_leaves(stacked)[0].shape[0]
 
 
+def uniform_flag_runs(flags):
+    """[(start, end)] runs of equal per-layer flags — the segmentation
+    invariant shared by the reversible trunk, the sequential scan trunk
+    (trunk.py), and the segmented multi-execution step
+    (training/segmented.py): a scanned layer body is specialized on its
+    flag, so segment boundaries must never cross a flag change."""
+    runs = []
+    start = 0
+    for i in range(1, len(flags) + 1):
+        if i == len(flags) or flags[i] != flags[start]:
+            runs.append((start, i))
+            start = i
+    return runs
+
+
 def _scan_forward(meta, stacked, state, x_mask, msa_mask, rng):
     """meta: (cfg, sparse, layer_offset) — static per uniform-flag segment.
 
@@ -341,12 +356,7 @@ def reversible_trunk_apply(
     # cores, whose chaining stores one (4-tensor) boundary state per segment
     # — still far below storing every layer.
     flags = cfg.layer_sparse
-    segments = []  # (start, end) with a uniform flag
-    start = 0
-    for i in range(1, len(flags) + 1):
-        if i == len(flags) or flags[i] != flags[start]:
-            segments.append((start, i))
-            start = i
+    segments = uniform_flag_runs(flags)
 
     state = (x, x, m, m)  # channel-double (reference reversible.py:319)
     for seg_start, seg_end in segments:

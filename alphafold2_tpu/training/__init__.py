@@ -40,6 +40,9 @@ from alphafold2_tpu.training.e2e import (
 from alphafold2_tpu.training.presets import (
     north_star_e2e_config,
 )
+from alphafold2_tpu.training.segmented import (
+    make_segmented_train_step,
+)
 from alphafold2_tpu.training.checkpoint import (
     CheckpointManager,
     abstract_like,
@@ -86,4 +89,5 @@ __all__ = [
     "sidechainnet_batches",
     "sidechainnet_structure_batches",
     "north_star_e2e_config",
+    "make_segmented_train_step",
 ]

@@ -64,11 +64,18 @@ def main():
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--single-depth", type=int, default=None)
+    ap.add_argument("--segments", type=int, default=0,
+                    help="run the train step as this many reversible trunk "
+                         "segments in SEPARATE device executions "
+                         "(training/segmented.py) — the tunneled worker "
+                         "kills single executions beyond ~60 s of device "
+                         "time, which a monolithic depth-48 step exceeds")
     args = ap.parse_args()
 
     if args.single_depth is not None:
         dev = jax.devices()[0]
-        print(json.dumps(_run(dev, dev.platform == "tpu", args.single_depth)))
+        print(json.dumps(_run(dev, dev.platform == "tpu", args.single_depth,
+                              segments=args.segments)))
         return
 
     # The orchestrating parent NEVER initializes JAX: a wedged TPU tunnel
@@ -102,7 +109,7 @@ def main():
     # a depth-48 wedge costs the upgrade, not the whole measurement. The
     # terminal CPU smoke entry guarantees the driver always records a line.
 
-    def attempt(depth, platform, timeout, disable_kernel=False):
+    def attempt(depth, platform, timeout, disable_kernel=False, segments=0):
         env = dict(os.environ)
         if platform == "cpu":
             env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -112,7 +119,8 @@ def main():
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
-                 "--single-depth", str(depth)],
+                 "--single-depth", str(depth),
+                 *(["--segments", str(segments)] if segments else [])],
                 capture_output=True, text=True, env=env, timeout=timeout,
             )
         except subprocess.TimeoutExpired:
@@ -131,15 +139,26 @@ def main():
 
     best, best_depth, errors = None, None, []
     if tpu_env:
-        for depth in (24, 48):
-            result, err, timed_out = attempt(depth, None, timeout=2400)
+        # depth 24 runs monolithic (fits the worker's ~60 s single-execution
+        # budget); depth 48 runs SEGMENTED (training/segmented.py, 4 trunk
+        # segments -> every device execution stays ~16 s or less) — the
+        # monolithic depth-48 step is ~96 s in one execution and CRASHES
+        # the tunneled worker (PERF.md), which is why it went unmeasured
+        # for four sessions
+        for depth, segments in ((24, 0), (48, 4)):
+            budget = 2400 + (600 if segments else 0)
+            result, err, timed_out = attempt(
+                depth, None, timeout=budget, segments=segments,
+            )
             if result is None and not timed_out:
                 # non-timeout failure: retry once with the Pallas kernel
                 # disabled, so a kernel-compile regression costs the fused
-                # path, not the whole on-chip measurement
+                # path, not the whole on-chip measurement (same budget —
+                # the XLA fallback is the slower path)
                 errors.append(err)
                 result, err, timed_out = attempt(
-                    depth, None, timeout=2400, disable_kernel=True
+                    depth, None, timeout=budget, disable_kernel=True,
+                    segments=segments,
                 )
                 if result is not None:
                     result["flash_kernel_disabled"] = True
@@ -170,7 +189,7 @@ def main():
     print(json.dumps(best))
 
 
-def _run(dev, on_tpu: bool, depth: int) -> dict:
+def _run(dev, on_tpu: bool, depth: int, segments: int = 0) -> dict:
     from alphafold2_tpu.training import (
         DataConfig,
         TrainConfig,
@@ -197,39 +216,62 @@ def _run(dev, on_tpu: bool, depth: int) -> dict:
         next(stack_microbatches(synthetic_structure_batches(dcfg), 1))
     )
     state = e2e_train_state_init(jax.random.PRNGKey(0), ecfg, tcfg)
-    step = make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn)
 
-    def run_steps(state, batch, rng):
-        def body(s, k):
-            s2, metrics = step(s, batch, k)
-            return s2, metrics["loss"]
+    if segments:
+        # multi-execution step (training/segmented.py): same optimizer
+        # step, chained short executions — the only way depth 48 runs
+        # under the tunneled worker's single-execution time budget.
+        # Timing stays dispatch-proof: grad_norm depends on every
+        # segment's gradients, so fetching it forces the whole chain.
+        from alphafold2_tpu.training import make_segmented_train_step
 
-        return jax.lax.scan(body, state, jax.random.split(rng, steps))
+        seg_step = make_segmented_train_step(ecfg, tcfg, segments)
+        state, metrics = seg_step(state, batch, jax.random.PRNGKey(1))
+        np.asarray(metrics["grad_norm"])  # warmup: compiles + runs chain
+        t0 = time.perf_counter()
+        state, metrics = seg_step(state, batch, jax.random.PRNGKey(2))
+        loss = float(np.asarray(metrics["loss"]))
+        float(np.asarray(metrics["grad_norm"]))
+        dt = time.perf_counter() - t0
+        assert np.isfinite(loss), f"non-finite bench loss: {loss}"
+        steps, steps_per_sec = 1, 1.0 / dt
+        # per-piece cost analysis is not aggregated across the chain;
+        # report honest nulls rather than a partial-program MFU
+        flops_per_step, achieved, mfu = 0.0, 0.0, None
+    else:
+        step = make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn)
 
-    # donate the state: without donation the input AND output copies of
-    # (params + Adam state) are both live — ~8 GB at depth 48 — and the
-    # north-star program does not fit; the warmup's output state feeds the
-    # timed run
-    compiled = (
-        jax.jit(run_steps, donate_argnums=(0,))
-        .lower(state, batch, jax.random.PRNGKey(1))
-        .compile()
-    )
-    # warmup — and fetch, so compilation/dispatch cannot leak into timing
-    state, losses = compiled(state, batch, jax.random.PRNGKey(1))
-    np.asarray(losses)
+        def run_steps(state, batch, rng):
+            def body(s, k):
+                s2, metrics = step(s, batch, k)
+                return s2, metrics["loss"]
 
-    t0 = time.perf_counter()
-    state, losses = compiled(state, batch, jax.random.PRNGKey(2))
-    losses = np.asarray(losses)  # forces execution + download
-    dt = time.perf_counter() - t0
-    assert np.isfinite(losses).all(), f"non-finite bench losses: {losses}"
+            return jax.lax.scan(body, state, jax.random.split(rng, steps))
 
-    steps_per_sec = steps / dt
-    total_flops = _compiled_flops(compiled)
-    flops_per_step = total_flops / steps if total_flops else 0.0
-    achieved = flops_per_step * steps_per_sec
-    mfu = achieved / _peak_flops(dev) if on_tpu and achieved else None
+        # donate the state: without donation the input AND output copies of
+        # (params + Adam state) are both live — ~8 GB at depth 48 — and the
+        # north-star program does not fit; the warmup's output state feeds
+        # the timed run
+        compiled = (
+            jax.jit(run_steps, donate_argnums=(0,))
+            .lower(state, batch, jax.random.PRNGKey(1))
+            .compile()
+        )
+        # warmup — and fetch, so compilation/dispatch cannot leak into timing
+        state, losses = compiled(state, batch, jax.random.PRNGKey(1))
+        np.asarray(losses)
+
+        t0 = time.perf_counter()
+        state, losses = compiled(state, batch, jax.random.PRNGKey(2))
+        losses = np.asarray(losses)  # forces execution + download
+        dt = time.perf_counter() - t0
+        assert np.isfinite(losses).all(), f"non-finite bench losses: {losses}"
+
+        steps_per_sec = steps / dt
+        total_flops = _compiled_flops(compiled)
+        flops_per_step = total_flops / steps if total_flops else 0.0
+        achieved = flops_per_step * steps_per_sec
+        mfu = achieved / _peak_flops(dev) if on_tpu and achieved else None
 
     # inference sec/protein: the predict flow (forward -> distogram -> MDS ->
     # sidechain -> refiner), BASELINE.md's second target metric
@@ -252,7 +294,9 @@ def _run(dev, on_tpu: bool, depth: int) -> dict:
     vs_baseline = round(steps_per_sec / baseline, 4) if on_tpu else 0.0
     return {
         "metric": f"train_end2end_steps_per_sec_crop{crop}_msa{msa_rows}"
-                  f"_depth{depth}_{dev.platform}",
+                  f"_depth{depth}_{dev.platform}"
+                  + (f"_seg{segments}" if segments else ""),
+        **({"segments": segments} if segments else {}),
         "value": round(steps_per_sec, 4),
         "unit": "steps/sec",
         "vs_baseline": vs_baseline,
