@@ -53,6 +53,17 @@ def main():
                     help="shard the trunk sequence-parallel over this many "
                          "devices (3*--len and MSA rows must be multiples "
                          "of it; deterministic path; 0 = replicated)")
+    ap.add_argument("--reversible", action="store_true",
+                    help="reversible trunk: O(1) activation memory in "
+                         "depth (the north-star depth-48 config, "
+                         "BASELINE.md config 5)")
+    ap.add_argument("--trunk-segments", type=int, default=0,
+                    help="run each step as this many reversible-trunk "
+                         "segments in SEPARATE device executions "
+                         "(training/segmented.py) — for runtimes that "
+                         "bound single-execution device time; requires "
+                         "--reversible; identical numerics to the "
+                         "monolithic step; 0 = one jitted step")
     add_train_args(ap)
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     # the reference's FEATURES switch (reference train_end2end.py:20-28):
@@ -106,6 +117,7 @@ def main():
             # other modes keep the default so checkpoints stay resumable
             # regardless of the (unused) --esm-dim flag
             **({"num_embedds": args.esm_dim} if args.features == "esm" else {}),
+            reversible=args.reversible,
             dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         ),
         refiner=RefinerConfig(num_tokens=14, dim=64, depth=args.refiner_depth),
@@ -182,6 +194,13 @@ def main():
         it = with_embedds(it)
 
     batches = stack_microbatches(it, tcfg.grad_accum)
+    if args.sp_shards and args.trunk_segments:
+        raise SystemExit("--sp-shards and --trunk-segments are exclusive: "
+                         "the segmented step is a single-device execution "
+                         "chain")
+    if args.trunk_segments and not args.reversible:
+        raise SystemExit("--trunk-segments requires --reversible (segment "
+                         "backward IS reversible reconstruction)")
     if args.sp_shards:
         from alphafold2_tpu.parallel import make_mesh, make_sp_train_step, sp_e2e_loss_fn
 
@@ -189,6 +208,13 @@ def main():
         train_step = make_sp_train_step(
             ecfg, tcfg, mesh, loss_fn=sp_e2e_loss_fn(mesh)
         )
+    elif args.trunk_segments:
+        # multi-execution step: each piece jits itself; the chain donates
+        # state at the optimizer, same live-footprint win as below
+        from alphafold2_tpu.training import make_segmented_train_step
+
+        train_step = make_segmented_train_step(ecfg, tcfg,
+                                               args.trunk_segments)
     else:
         # donated state: see train_pre.py — halves the live state footprint
         train_step = jax.jit(make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn),
