@@ -86,6 +86,44 @@ def test_segmented_matches_monolithic(accum):
         )
 
 
+@pytest.mark.slow
+def test_segmented_matches_monolithic_mixed_sparse():
+    """Segment boundaries must align with sparse-flag runs; parity over a
+    (True, True, False, False) trunk exercises that path end-to-end."""
+    import dataclasses
+
+    ecfg, tcfg, batch, _ = _setup(depth=4, accum=1)
+    ecfg = dataclasses.replace(
+        ecfg,
+        model=dataclasses.replace(
+            ecfg.model,
+            sparse_self_attn=(True, True, False, False),
+            sparse_block_size=8,
+            max_seq_len=2048,
+        ),
+    )
+    state = e2e_train_state_init(jax.random.PRNGKey(0), ecfg, tcfg)
+    rng = jax.random.PRNGKey(9)
+
+    mono = make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn)
+    seg = make_segmented_train_step(ecfg, tcfg, trunk_segments=3)
+
+    s_mono, m_mono = mono(state, batch, rng)
+    s_seg, m_seg = seg(state, batch, rng)
+    np.testing.assert_allclose(
+        float(m_mono["loss"]), float(m_seg["loss"]), rtol=1e-5
+    )
+    flat_mono = jax.tree_util.tree_leaves_with_path(s_mono["params"])
+    flat_seg = dict(jax.tree_util.tree_leaves_with_path(s_seg["params"]))
+    for path, leaf in flat_mono:
+        np.testing.assert_allclose(
+            np.asarray(leaf, np.float32),
+            np.asarray(flat_seg[path], np.float32),
+            rtol=2e-4, atol=2e-6,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
 def test_segmented_rejects_non_reversible():
     ecfg, _, _ = north_star_e2e_config(2, smoke=True)
     import dataclasses
