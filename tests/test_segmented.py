@@ -124,6 +124,32 @@ def test_segmented_matches_monolithic_mixed_sparse():
         )
 
 
+@pytest.mark.slow
+def test_segmented_checkpoint_interchange(tmp_path):
+    """Segmented and monolithic training are interchangeable mid-run: a
+    state saved from a segmented step restores into the monolithic step
+    (identical pytree structure) and keeps training with a finite loss."""
+    from alphafold2_tpu.training.checkpoint import (
+        CheckpointManager,
+        abstract_like,
+    )
+
+    ecfg, tcfg, batch, state = _setup(depth=2, accum=1)
+    rng = jax.random.PRNGKey(3)
+    seg = make_segmented_train_step(ecfg, tcfg, trunk_segments=2)
+    state, _ = seg(state, batch, rng)
+
+    with CheckpointManager(str(tmp_path / "ckpt")) as mgr:
+        mgr.save(state, force=True)
+        mgr.wait()
+        restored = mgr.restore(abstract_like(state))
+
+    mono = make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn)
+    s2, metrics = mono(restored, batch, jax.random.PRNGKey(4))
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(s2["step"]) == 2
+
+
 def test_segmented_rejects_non_reversible():
     ecfg, _, _ = north_star_e2e_config(2, smoke=True)
     import dataclasses
