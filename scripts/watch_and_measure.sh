@@ -10,9 +10,18 @@
 # timeout — killing a client that is merely waiting on a wedged relay
 # does not worsen the wedge (PERF.md).
 cd "$(dirname "$0")/.."
+# Hard deadline (epoch seconds, optional $1): a watcher that outlives its
+# session could fire measurements concurrently with the round-end driver
+# bench and distort ITS numbers — past the deadline, stop touching the
+# chip entirely.
+DEADLINE="${1:-0}"
 decomp_done=0
 sweep_done=0
 for i in $(seq 1 60); do
+  if [ "$DEADLINE" -gt 0 ] && [ "$(date +%s)" -gt "$DEADLINE" ]; then
+    echo "$(date -u +%H:%M:%S) deadline reached; exiting without measuring"
+    exit 0
+  fi
   if timeout 240 python -c "
 import jax, jax.numpy as jnp
 assert jax.devices()[0].platform == 'tpu', jax.devices()
