@@ -10,6 +10,8 @@ import pytest
 from alphafold2_tpu.models import (
     Alphafold2Config,
     alphafold2_apply,
+    alphafold2_front,
+    alphafold2_head,
     alphafold2_init,
 )
 
@@ -42,6 +44,36 @@ def _run(cfg, seq_len=16, rows=3, cols=8, templates_T=0):
     assert np.isfinite(float(val))
     for leaf in jax.tree_util.tree_leaves(grads):
         assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_front_trunk_head_composition_equals_apply():
+    """alphafold2_front -> trunk -> alphafold2_head IS alphafold2_apply —
+    the decomposition contract the segmented multi-execution step
+    (training/segmented.py) is built on."""
+    from alphafold2_tpu.models.reversible import reversible_trunk_apply
+
+    cfg = Alphafold2Config(
+        dim=32, depth=2, heads=2, dim_head=8, max_seq_len=64,
+        reversible=True, msa_tie_row_attn=True,
+    )
+    params = alphafold2_init(jax.random.PRNGKey(0), cfg)
+    rs = np.random.RandomState(0)
+    seq = jnp.asarray(rs.randint(0, 21, size=(1, 12)))
+    msa = jnp.asarray(rs.randint(0, 21, size=(1, 3, 12)))
+    mask = jnp.ones((1, 12), bool)
+    rng = jax.random.PRNGKey(5)
+
+    whole = alphafold2_apply(params, cfg, seq, msa, mask=mask, rng=rng)
+
+    x, m, x_mask, m_mask, rng_trunk = alphafold2_front(
+        params, cfg, seq, msa, mask=mask, rng=rng
+    )
+    x, m = reversible_trunk_apply(
+        params["trunk"], cfg, x, m, x_mask=x_mask, msa_mask=m_mask,
+        rng=rng_trunk,
+    )
+    composed = alphafold2_head(params, cfg, x)
+    np.testing.assert_array_equal(np.asarray(whole), np.asarray(composed))
 
 
 @pytest.mark.slow
