@@ -128,6 +128,16 @@ def main():
             # its own unrelated "timed out" wording
             return None, f"depth-{depth} hit the {timeout}s timeout", True
         if proc.returncode != 0:
+            # salvage a partial measurement: the worker prints the train
+            # numbers BEFORE the inference leg, so a crash there (a long
+            # single forward execution) must not cost the whole attempt
+            for line in reversed((proc.stdout or "").strip().splitlines()):
+                try:
+                    partial = json.loads(line)
+                except ValueError:
+                    continue
+                partial["worker_crashed_after_train_measurement"] = True
+                return partial, None, False
             err = (proc.stderr or "").strip().splitlines()
             return None, (err[-1] if err else f"rc={proc.returncode}"), False
         for line in reversed(proc.stdout.strip().splitlines()):
@@ -280,19 +290,12 @@ def _run(dev, on_tpu: bool, depth: int, segments: int = 0) -> dict:
             p, ecfg, s, mask=msk, msa=m, msa_mask=mm
         )["refined"]
     )
-    mb = jax.tree_util.tree_map(lambda t: t[0], batch)  # drop microbatch axis
-    args = (state["params"], mb["seq"], mb["msa"], mb["msa_mask"], mb["mask"])
-    np.asarray(infer(*args))  # compile + warmup
-    t0 = time.perf_counter()
-    np.asarray(infer(*args))
-    infer_sec = time.perf_counter() - t0
-
     baseline = 1.0  # driver target: >=1 optimizer step/sec/chip (BASELINE.md)
     # the target is defined ON TPU at the north-star shapes; a CPU smoke
     # fallback must not read as progress against it (bench honesty —
     # VERDICT r1 weakness #3)
     vs_baseline = round(steps_per_sec / baseline, 4) if on_tpu else 0.0
-    return {
+    result = {
         "metric": f"train_end2end_steps_per_sec_crop{crop}_msa{msa_rows}"
                   f"_depth{depth}_{dev.platform}"
                   + (f"_seg{segments}" if segments else ""),
@@ -308,8 +311,23 @@ def _run(dev, on_tpu: bool, depth: int, segments: int = 0) -> dict:
         "tflops_per_step": round(flops_per_step / 1e12, 2),
         "achieved_tflops_per_sec": round(achieved / 1e12, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
-        "inference_sec_per_protein": round(infer_sec, 3),
     }
+    # print the train measurement BEFORE attempting inference: the parent
+    # takes the LAST parseable stdout line, so if the inference forward
+    # (a ~depth x 0.7 s single execution — tens of seconds at depth 48)
+    # crashes the tunneled worker, the train numbers above still land
+    print(json.dumps({**result, "inference_sec_per_protein": None,
+                      "note_inference": "inference leg did not complete"}),
+          flush=True)
+
+    mb = jax.tree_util.tree_map(lambda t: t[0], batch)  # drop microbatch axis
+    args = (state["params"], mb["seq"], mb["msa"], mb["msa_mask"], mb["mask"])
+    np.asarray(infer(*args))  # compile + warmup
+    t0 = time.perf_counter()
+    np.asarray(infer(*args))
+    infer_sec = time.perf_counter() - t0
+    result["inference_sec_per_protein"] = round(infer_sec, 3)
+    return result
 
 
 if __name__ == "__main__":
