@@ -171,17 +171,27 @@ def make_segmented_train_step(
             head_params, refiner_params, state4
         )
 
+    # keys the front never reads — differentiating over them would
+    # materialize a trunk-grad-sized ZERO cotangent buffer alongside the
+    # real trunk grads (at depth 48 that is a whole extra trunk in HBM)
+    _NON_FRONT_KEYS = ("trunk", "head_norm", "head_out")
+
     @jax.jit
     def front_bwd(model_params, seq3, msa, mask3, msa_mask, embedds,
                   rng_model, dx, dm):
-        def front_xm(p):
+        rest = {k: model_params[k] for k in _NON_FRONT_KEYS
+                if k in model_params}
+        front_sub = {k: v for k, v in model_params.items()
+                     if k not in rest}
+
+        def front_xm(p_sub):
             x, m, *_ = alphafold2_front(
-                p, cfg, seq3, msa, mask=mask3, msa_mask=msa_mask,
-                embedds=embedds, rng=rng_model,
+                {**p_sub, **rest}, cfg, seq3, msa, mask=mask3,
+                msa_mask=msa_mask, embedds=embedds, rng=rng_model,
             )
             return x, m
 
-        _, vjp = jax.vjp(front_xm, model_params)
+        _, vjp = jax.vjp(front_xm, front_sub)
         (d_params,) = vjp((dx, dm))
         return d_params
 
@@ -265,8 +275,8 @@ def make_segmented_train_step(
             mp, seq3, msa, mask3, msa_mask, embedds, rng_model,
             accum_grads(dx1, dx2), accum_grads(dm1, dm2)
         )
-        # front_bwd returns the full model-params structure (zeros at
-        # trunk/head, which the front does not read); fill those in
+        # front_bwd returns only the front-read subtree; fill in the
+        # trunk/head grads computed by the segment chain and the tail
         d_model = dict(d_model)
         d_model["trunk"] = jax.tree_util.tree_map(
             lambda *xs: jnp.concatenate(xs, axis=0), *dsegs
