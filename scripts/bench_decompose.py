@@ -354,6 +354,9 @@ else:
 
 def run_leg(leg, depth, timeout, smoke=False):
     spec = {"leg": leg, "depth": depth, "smoke": smoke}
+    # error rows must carry the smoke flag too: a failed CPU smoke run
+    # must never consume the profile leg's single on-chip attempt
+    smoke_kv = {"smoke": True} if smoke else {}
     env = dict(os.environ)
     if smoke:  # never touch the (possibly busy/wedged) TPU for a smoke run
         env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -379,18 +382,20 @@ def run_leg(leg, depth, timeout, smoke=False):
         # chip time spent on completed measurements must reach the record
         out = e.stdout.decode() if isinstance(e.stdout, bytes) else e.stdout
         return (parse_rows(out) + [{"leg": leg, "depth": depth,
-                                    "error": "timeout"}],
+                                    "error": "timeout", **smoke_kv}],
                 time.time() - t0, True)
     if proc.returncode != 0:
         return (
             parse_rows(proc.stdout)
             + [{"leg": leg, "depth": depth,
-                "error": err_tail(proc.stderr, proc.returncode)}],
+                "error": err_tail(proc.stderr, proc.returncode),
+                **smoke_kv}],
             time.time() - t0,
             False,
         )
     rows = parse_rows(proc.stdout)
-    return (rows or [{"leg": leg, "error": "no JSON"}]), time.time() - t0, False
+    return (rows or [{"leg": leg, "depth": depth, "error": "no JSON",
+                      **smoke_kv}]), time.time() - t0, False
 
 
 def main():
@@ -425,7 +430,7 @@ def main():
                     continue
                 if "error" not in e and not e.get("smoke"):
                     done.add((e.get("leg"), e.get("depth")))
-                elif e.get("leg") == "profile":
+                elif e.get("leg") == "profile" and not e.get("smoke"):
                     # the profile leg is an EXPERIMENT (tracing may hang the
                     # relay client): one recorded attempt — success or
                     # failure — is final, or a hang would loop the watcher

@@ -1,0 +1,27 @@
+"""TPU tunnel health probe — the ONE definition of "healthy".
+
+Run as a subprocess under a timeout by bench.py and
+scripts/watch_and_measure.sh (never in-process: a wedged relay can hang
+backend init indefinitely, and `jax.devices()` alone is not proof — a
+wedged relay can enumerate devices yet hang every execution, so the
+probe runs a real matmul and fetches the result).
+
+stdout contract:
+  "platform: <name>"  — backend init succeeded; non-tpu means this host
+                        deterministically has no TPU (callers should NOT
+                        retry)
+  "tpu-healthy"       — the matmul executed and returned; the chip is live
+Exit code 0 only when healthy.
+"""
+
+import jax
+
+d = jax.devices()[0]
+print("platform:", d.platform, flush=True)
+assert d.platform == "tpu", d
+
+import jax.numpy as jnp
+
+x = jnp.ones((256, 256), jnp.bfloat16)
+assert float(jnp.sum((x @ x).astype(jnp.float32))) > 0
+print("tpu-healthy")

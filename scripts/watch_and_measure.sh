@@ -22,13 +22,7 @@ for i in $(seq 1 60); do
     echo "$(date -u +%H:%M:%S) deadline reached; exiting without measuring"
     exit 0
   fi
-  if timeout 240 python -c "
-import jax, jax.numpy as jnp
-assert jax.devices()[0].platform == 'tpu', jax.devices()
-x = jnp.ones((256, 256), jnp.bfloat16)
-assert float(jnp.sum((x @ x).astype(jnp.float32))) > 0
-print('healthy')
-" 2>/dev/null | grep -q healthy; then
+  if timeout 240 python scripts/tpu_probe.py 2>/dev/null | grep -q tpu-healthy; then
     echo "$(date -u +%H:%M:%S) chip healthy on probe $i; measuring"
     if [ "$decomp_done" -eq 0 ]; then
       python scripts/bench_decompose.py --depth 12
