@@ -127,6 +127,26 @@ def axial_attention_init(key, cfg: AttentionConfig):
 # --- apply ------------------------------------------------------------------
 
 
+def _compress_conv(params, cfg: AttentionConfig, t):
+    """The grouped strided conv the compression paths share: stride-`ratio`
+    windows, one feature group per head (torch Conv1d(inner, inner, ratio,
+    stride=ratio, groups=heads), reference alphafold2.py:101). Also used by
+    the sequence-parallel halo-exchange compression
+    (parallel/sp_trunk.py `_compress_kv_sharded`) — the two paths must
+    convolve identically or SP parity breaks."""
+    w = params["compress"]["w"].astype(t.dtype)
+    b = params["compress"]["b"].astype(t.dtype)
+    out = jax.lax.conv_general_dilated(
+        t,
+        w,
+        window_strides=(cfg.compress_ratio,),
+        padding="VALID",
+        dimension_numbers=("NWC", "WIO", "NWC"),
+        feature_group_count=cfg.heads,
+    )
+    return out + b
+
+
 def _compress_kv(params, cfg: AttentionConfig, k, v, context_mask):
     """Downsample keys/values along the sequence with a grouped strided conv.
 
@@ -144,21 +164,8 @@ def _compress_kv(params, cfg: AttentionConfig, k, v, context_mask):
         if context_mask is not None:
             context_mask = jnp.pad(context_mask, ((0, 0), (0, pad)))
 
-    w = params["compress"]["w"].astype(k.dtype)
-    b = params["compress"]["b"].astype(k.dtype)
-
-    def conv(t):
-        out = jax.lax.conv_general_dilated(
-            t,
-            w,
-            window_strides=(ratio,),
-            padding="VALID",
-            dimension_numbers=("NWC", "WIO", "NWC"),
-            feature_group_count=cfg.heads,
-        )
-        return out + b
-
-    k, v = conv(k), conv(v)
+    k = _compress_conv(params, cfg, k)
+    v = _compress_conv(params, cfg, v)
     if context_mask is not None:
         pooled = jnp.sum(
             context_mask.astype(jnp.float32).reshape(context_mask.shape[0], -1, ratio),

@@ -24,8 +24,10 @@ ROW axis sharded over one mesh axis, inside a single `shard_map`:
 Semantics match the replicated sequential trunk (flat OR aligned
 cross-attention, dropout off) to float tolerance; `tests/test_sp_trunk.py` asserts
 full-model parity on the 8-device CPU mesh. KV compression for
-cross-attention applies per-shard and therefore requires the local key
-length to divide the ratio (checked).
+cross-attention applies per shard with a ring halo exchange
+(`_compress_kv_sharded`) that reproduces the global compression window
+grid exactly for any local key length >= ratio-1 — shard counts need not
+divide the compression ratio.
 
 Reference anchor: the axial fold-into-batch pattern this shards is
 reference alphafold2_pytorch/alphafold2.py:240-286; SURVEY.md §2.2 maps it
@@ -132,16 +134,85 @@ def _gathered_cross(params, cfg: Alphafold2Config, q_flat, ctx_local, q_mask, ct
     return out
 
 
+def _compress_kv_sharded(params, cfg, k, v, context_mask, axis_name):
+    """Per-shard KV compression EXACTLY matching the global strided conv.
+
+    The global compression (ops/attention.py `_compress_kv`) convolves
+    windows [0:r], [r:2r], ... of the full key sequence. Shard s holds the
+    contiguous slice [s*L, (s+1)*L); when L is not a multiple of the ratio
+    those windows straddle shard boundaries, which is why the old code
+    required divisibility. Instead: each shard fetches a (ratio-1)-element
+    halo from its right neighbor (`ppermute`; the last shard receives
+    zeros — exactly the global path's zero padding), computes the
+    ceil(L/ratio) candidate windows whose starts land in its slice, and
+    masks off slots it does not own. Window starts within a shard are
+    stride-`ratio` from `(-s*L) mod ratio`, so the owned windows are one
+    dynamic slice + the same grouped conv as the dense path. The union of
+    owned slots over shards is exactly the global window set, so ring
+    attention over the compressed shards reproduces the replicated result
+    to accumulation-order tolerance.
+
+    k, v: (B, L, inner) local shard. Returns (k_c, v_c, slot_mask) with
+    W = ceil(L/ratio) slots; slot_mask combines window ownership with the
+    sum-pooled key mask (reference alphafold2.py:116-136 semantics).
+    """
+    ratio = cfg.compress_ratio
+    B, L, _ = k.shape
+    if L < ratio - 1:
+        raise ValueError(
+            f"sequence-parallel KV compression needs the local key length "
+            f"({L}) >= ratio-1 ({ratio - 1}): a compression window may not "
+            f"span more than two shards"
+        )
+    from alphafold2_tpu.ops.attention import _compress_conv
+
+    num_shards = jax.lax.psum(1, axis_name)
+    s = jax.lax.axis_index(axis_name)
+    W = -(-L // ratio)  # ceil: max windows any shard can own
+    halo_len = ratio - 1
+    # shard s receives shard s+1's head; the LAST shard receives zeros
+    # (ppermute default for unlisted destinations) == global zero padding
+    perm = [(i, i - 1) for i in range(1, num_shards)]
+
+    # ONE fused halo collective: k, v (and the key mask as one extra
+    # feature column when present) ride a single ppermute — the halos are
+    # tiny, so per-collective latency dominates
+    fused = [k, v]
+    if context_mask is not None:
+        fused.append(context_mask.astype(k.dtype)[..., None])
+    t = jnp.concatenate(fused, axis=-1)
+    halo = jax.lax.ppermute(t[:, :halo_len], axis_name, perm)
+    # slack so the W-window slice below always stays in bounds; only
+    # un-owned (masked) slots ever read it, values are irrelevant
+    slack = jnp.zeros((B, ratio + 1, t.shape[-1]), t.dtype)
+    t_ext = jnp.concatenate([t, halo, slack], axis=1)
+
+    # local offset of the first global window start inside this shard;
+    # owned window starts are stride-`ratio` from it
+    offset0 = (-(s * L)) % ratio
+    t_win = jax.lax.dynamic_slice_in_dim(t_ext, offset0, W * ratio, axis=1)
+
+    inner = k.shape[-1]
+    k_c = _compress_conv(params, cfg, t_win[..., :inner])
+    v_c = _compress_conv(params, cfg, t_win[..., inner:2 * inner])
+    owned = (offset0 + jnp.arange(W) * ratio) < L
+    if context_mask is None:
+        # every owned window starts inside the shard, so it contains at
+        # least one real key: ownership alone is the slot mask
+        return k_c, v_c, jnp.broadcast_to(owned[None, :], (B, W))
+    pooled = t_win[..., -1].reshape(B, W, ratio).sum(-1) > 0
+    return k_c, v_c, pooled & owned[None, :]
+
+
 def _ring_cross_tokens(params, cfg: Alphafold2Config, q_tokens, ctx_tokens_local, ctx_mask_local, axis_name):
     """Cross-attention with resident queries and ring-streamed K/V shards.
 
     q_tokens: (B, nq, d) resident queries; ctx_tokens_local: (B, nk_local, d)
     this chip's key/value token shard. K/V (and the key mask) rotate around
     the ring; the full key stream never materializes on one chip. KV
-    compression applies to the LOCAL shard before the ring (requires the
-    local key length to be a multiple of the ratio so per-shard compression
-    tiles the global one — the shard is a contiguous slice of the global
-    key order). Key-side masking only (ops/flash.py contract): query-side
+    compression applies per shard via `_compress_kv_sharded` (halo
+    exchange reproduces the global window grid for ANY local length >=
+    ratio-1). Key-side masking only (ops/flash.py contract): query-side
     masks are intentionally not applied, like the dense path.
     """
     cross_cfg = cfg.cross_attn_config()
@@ -155,16 +226,8 @@ def _ring_cross_tokens(params, cfg: Alphafold2Config, q_tokens, ctx_tokens_local
     k, v = jnp.split(kv, 2, axis=-1)
 
     if cross_cfg.compress_ratio > 1:
-        from alphafold2_tpu.ops.attention import _compress_kv
-
-        if k.shape[1] % cross_cfg.compress_ratio != 0:
-            raise ValueError(
-                f"sequence-parallel KV compression needs the local key "
-                f"length ({k.shape[1]}) divisible by the ratio "
-                f"({cross_cfg.compress_ratio})"
-            )
-        k, v, ctx_mask_local = _compress_kv(
-            params["attn"], cross_cfg, k, v, ctx_mask_local
+        k, v, ctx_mask_local = _compress_kv_sharded(
+            params["attn"], cross_cfg, k, v, ctx_mask_local, axis_name
         )
     k = _split_heads(k, h, dh)
     v = _split_heads(v, h, dh)

@@ -42,6 +42,10 @@ def _setup(cfg, n, rows, cols, seed=0, masked=False):
         pytest.param(False, 1, False, 1, marks=pytest.mark.slow),
         pytest.param(True, 1, False, 2, marks=pytest.mark.slow),
         pytest.param(True, 2, True, 2, marks=pytest.mark.slow),
+        # ratio 3 does NOT divide the local key length (2*16=32): exercises
+        # the halo-exchange compression (_compress_kv_sharded) whose window
+        # grid must still match the global strided conv exactly
+        (False, 3, True, 1),
     ],
 )
 def test_sp_trunk_matches_replicated(tie, compress, masked, depth):
@@ -107,6 +111,9 @@ def test_sp_trunk_rejects_unsupported_modes():
     [
         (False, 1, False),  # cheap fast-tier parity case
         pytest.param(True, 2, True, marks=pytest.mark.slow),
+        # non-divisible compression on the aligned per-column-group ring:
+        # local folded key length 2*2=4, ratio 3 -> halo-exchange windows
+        (False, 3, True),
     ],
 )
 def test_sp_trunk_aligned_matches_replicated(tie, compress, masked):
