@@ -4,32 +4,45 @@ The last absent row of SURVEY.md §2.2 ("optional: stage the depth-48 trunk
 across pods"). GPipe-style schedule, TPU-native mechanics: the depth-stacked
 layer parameters are SHARDED over the "pipe" mesh axis (each device owns
 depth/S consecutive layers), microbatches stream through the stages, and
-the only communication is a neighbor `ppermute` of activations per tick —
+ALL communication is neighbor `ppermute` of one microbatch per tick —
 exactly the collective the hardware's ring likes. Everything runs inside
 one `shard_map` + `lax.scan` over ticks; no host round-trips.
 
 Schedule (S stages, M microbatches, T = M + S - 1 ticks):
 
-  tick t: stage 0 ingests microbatch t (zeros once the real ones run out);
-          every stage applies its layer block to its resident activation;
-          activations ppermute stage s -> s+1; the last stage's result for
-          microbatch t - (S-1) lands in the output buffer.
+  tick t: stage 0 ingests microbatch t; every stage applies its layer
+          block to its resident activation; activations ppermute stage
+          s -> s+1; the last stage finishes microbatch t - (S-1).
+
+Activation memory is O(batch/S) per stage — inputs, outputs, AND
+in-flight state (this is the reason to pipeline depth 48):
+
+  * the input stack is sharded round-robin (microbatch i lives on stage
+    i mod S, slot i//S) and DRIPS to stage 0 through a rotating ring
+    register: during consumption cycle k (ticks kS..kS+S-1) slot k
+    rotates one hop toward stage 0 per tick, so microbatch kS+j — parked
+    j hops away — arrives exactly at tick kS+j. One extra
+    microbatch-sized ppermute per tick, no gathered buffer.
+  * finished microbatches ride a second ring register from the last
+    stage back to their round-robin home (microbatch d enters at stage
+    S-1 on tick d+S-1 and is harvested (d+1) mod S hops later at stage
+    d mod S, slot d//S). The register carries its payload's microbatch
+    index; an index of -1 marks garbage. A payload is overwritten at
+    stage S-1 only after a full ring lap, which is strictly after its
+    harvest hop — no collision.
 
 Bubble fraction is (S-1)/T — the standard GPipe cost; pick M >= 4*S to
-amortize. Parity vs the replicated sequential trunk is tested on the
-8-device CPU mesh (tests/test_pipeline.py).
+amortize. Parity vs the replicated sequential trunk and the O(batch/S)
+buffer bound are tested on the 8-device CPU mesh (tests/test_pipeline.py).
 
 The per-stage body is the REAL trunk layer (models/trunk.py
 `trunk_layer_apply`, deterministic path): pair axial self-attn, MSA axial
 self-attn (tied rows allowed — rows are NOT sharded here, so no psum is
 needed), cross-attention (flat or aligned), feed-forwards.
 
-What this scales — and what it does not (yet): the per-stage PARAMETER and
-optimizer state is 1/S of the trunk (the reason to pipeline depth-48
-across pods). The microbatch input stack and output buffer are currently
-replicated across stages for schedule simplicity, so per-chip ACTIVATION
-memory is bounded by the global batch, not batch/S — compose with smaller
-per-pipeline batches or the SP trunk when activations dominate.
+Per-stage parameter and optimizer state is 1/S of the trunk; compose with
+the SP trunk (parallel/sp_trunk.py) on an inner mesh axis when a single
+microbatch's pair grid itself outgrows a chip.
 """
 
 from __future__ import annotations
@@ -43,6 +56,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 from alphafold2_tpu.models.config import Alphafold2Config
 from alphafold2_tpu.models.reversible import stack_layers
 from alphafold2_tpu.models.trunk import trunk_layer_apply
+
+
+def _round_robin(t, M, S):
+    """(M, mb, ...) -> (S, M/S, mb, ...): microbatch i to [i % S, i // S]."""
+    return jnp.swapaxes(t.reshape((M // S, S) + t.shape[1:]), 0, 1)
+
+
+def _un_round_robin(t, M):
+    """(S, M/S, mb, ...) -> (M, mb, ...), inverse of `_round_robin`."""
+    return jnp.swapaxes(t, 0, 1).reshape((M,) + t.shape[2:])
 
 
 def pipeline_trunk_apply(
@@ -63,7 +86,8 @@ def pipeline_trunk_apply(
       layers: list of trunk_layer_init params (depth % stages == 0);
       x: (b, n, n, d) pair grid; m: (b, rows, cols, d) MSA or None;
       microbatches: how many microbatches to split b into (default =
-        stage count; b % microbatches == 0).
+        stage count; b % microbatches == 0 and microbatches % stages == 0
+        — the round-robin input/output sharding needs whole slots).
 
     Deterministic path only. Masks must be batch-broadcast (shape (1, ...))
     or None: microbatch slicing of per-example masks would need them to
@@ -89,6 +113,11 @@ def pipeline_trunk_apply(
     M = microbatches or stages
     if b % M != 0:
         raise ValueError(f"batch {b} must divide into {M} microbatches")
+    if M % stages != 0:
+        raise ValueError(
+            f"microbatches ({M}) must divide by the stage count ({stages}) "
+            f"for the round-robin input/output sharding"
+        )
     mb = b // M
 
     # materialize broadcast masks at microbatch size so the layer body's
@@ -102,10 +131,15 @@ def pipeline_trunk_apply(
     stacked = stack_layers(list(layers))  # (depth, ...) leaves
     per_stage = depth // stages
     ticks = M + stages - 1
+    slots = M // stages
 
-    # microbatch-leading stacks: (M, mb, ...)
-    xs = x.reshape((M, mb) + x.shape[1:])
-    ms = m.reshape((M, mb) + m.shape[1:]) if has_msa else None
+    # round-robin-sharded microbatch stacks: (S, M/S, mb, ...)
+    xs = _round_robin(x.reshape((M, mb) + x.shape[1:]), M, stages)
+    ms = (
+        _round_robin(m.reshape((M, mb) + m.shape[1:]), M, stages)
+        if has_msa
+        else None
+    )
 
     def reshape_stage(t):
         # (depth, ...) -> (stages, per_stage, ...): shard leading axis
@@ -115,10 +149,10 @@ def pipeline_trunk_apply(
 
     in_specs = (
         jax.tree_util.tree_map(lambda _: P(axis_name), stage_params),
-        P(None),  # xs: every stage sees the full microbatch stack (stage 0 reads it)
-        P(None) if has_msa else None,
+        P(axis_name),  # each stage holds only its M/S input slots
+        P(axis_name) if has_msa else None,
     )
-    out_specs = (P(None), P(None) if has_msa else None)
+    out_specs = (P(axis_name), P(axis_name) if has_msa else None)
 
     @functools.partial(
         jax.shard_map,
@@ -128,12 +162,15 @@ def pipeline_trunk_apply(
         check_vma=False,
     )
     def run(sp, xs, ms):
-        # sp leaves: (1, per_stage, ...) — this device's layer block
+        # sp leaves: (1, per_stage, ...); xs: (1, M/S, mb, ...)
         my_layers = jax.tree_util.tree_map(lambda t: t[0], sp)
+        xs = xs[0]
+        ms = ms[0] if has_msa else None
         stage = jax.lax.axis_index(axis_name)
         is_first = stage == 0
         is_last = stage == stages - 1
-        fwd_perm = [(s, s + 1) for s in range(stages - 1)]
+        fwd_perm = [(s, (s + 1) % stages) for s in range(stages)]
+        back_perm = [(s, (s - 1) % stages) for s in range(stages)]
 
         def apply_block(x_act, m_act):
             def body(carry, lp):
@@ -148,56 +185,121 @@ def pipeline_trunk_apply(
             )
             return x_act, m_act
 
-        x0 = jnp.zeros((mb,) + xs.shape[2:], xs.dtype)
-        m0 = jnp.zeros((mb,) + ms.shape[2:], ms.dtype) if has_msa else None
+        def zeros_like_mb(t):
+            return jnp.zeros((mb,) + t.shape[2:], t.dtype)
+
+        x0, m0 = zeros_like_mb(xs), zeros_like_mb(ms) if has_msa else None
         out_x = jnp.zeros_like(xs)
         out_m = jnp.zeros_like(ms) if has_msa else None
+        # return-ring register: payload + the microbatch index it carries
+        # (-1 = garbage). Starts empty.
+        reg_idx0 = jnp.int32(-1)
 
-        def tick(carry, t):
-            x_act, m_act, out_x, out_m = carry
-            # stage 0 ingests microbatch t (or zeros past the end)
-            feed_idx = jnp.minimum(t, M - 1)
-            x_in = jnp.where(is_first, xs[feed_idx], x_act)
-            m_in = jnp.where(is_first, ms[feed_idx], m_act) if has_msa else None
-
-            x_act, m_act = apply_block(x_in, m_in)
-
-            # the last stage finished microbatch t-(S-1) this tick
-            done = t - (stages - 1)
-            write = is_last & (done >= 0)
-            widx = jnp.maximum(done, 0)
+        def harvest(out_x, out_m, reg_x, reg_m, reg_idx):
+            """Write the return ring's payload if it reached its home
+            stage (idempotent re-writes are harmless)."""
+            write = (reg_idx >= 0) & (reg_idx % stages == stage)
+            wslot = jnp.maximum(reg_idx // stages, 0)
             out_x = jnp.where(
                 write,
-                jax.lax.dynamic_update_index_in_dim(out_x, x_act, widx, 0),
+                jax.lax.dynamic_update_index_in_dim(out_x, reg_x, wslot, 0),
                 out_x,
             )
             if has_msa:
                 out_m = jnp.where(
                     write,
-                    jax.lax.dynamic_update_index_in_dim(out_m, m_act, widx, 0),
+                    jax.lax.dynamic_update_index_in_dim(
+                        out_m, reg_m, wslot, 0
+                    ),
                     out_m,
                 )
+            return out_x, out_m
 
-            # hand activations to the next stage (last stage's output is
-            # dropped by the permute — nothing maps to stage 0's input)
-            x_act = jax.lax.ppermute(x_act, axis_name, fwd_perm)
+        def rotate_reg(reg_x, reg_m, reg_idx):
+            reg_x = jax.lax.ppermute(reg_x, axis_name, fwd_perm)
             if has_msa:
-                m_act = jax.lax.ppermute(m_act, axis_name, fwd_perm)
-            return (x_act, m_act, out_x, out_m), None
+                reg_m = jax.lax.ppermute(reg_m, axis_name, fwd_perm)
+            reg_idx = jax.lax.ppermute(reg_idx, axis_name, fwd_perm)
+            return reg_x, reg_m, reg_idx
 
-        (x_act, m_act, out_x, out_m), _ = jax.lax.scan(
-            tick, (x0, m0, out_x, out_m), jnp.arange(ticks)
+        def tick(carry, t):
+            (x_act, m_act, out_x, out_m, xs, ms, reg_x, reg_m,
+             reg_idx) = carry
+
+            # --- feed: stage 0 consumes the drip register's current slot.
+            # During cycle k = t//S, slot k has rotated (t mod S) hops, so
+            # it now holds the stage-(t mod S) original = microbatch t.
+            slot = jnp.minimum(t // stages, slots - 1)
+            x_in = jnp.where(is_first, xs[slot], x_act)
+            m_in = jnp.where(is_first, ms[slot], m_act) if has_msa else None
+
+            x_act, m_act = apply_block(x_in, m_in)
+
+            # --- the last stage's finished microbatch enters the return
+            # ring (overwriting a payload that must already be harvested —
+            # a full ring lap is longer than any harvest path), then every
+            # stage harvests
+            done = t - (stages - 1)
+            fresh = jnp.where(is_last & (done >= 0) & (done < M), done, -1)
+            reg_idx = jnp.where(is_last, fresh, reg_idx)
+            reg_x = jnp.where(is_last, x_act, reg_x)
+            if has_msa:
+                reg_m = jnp.where(is_last, m_act, reg_m)
+            out_x, out_m = harvest(out_x, out_m, reg_x, reg_m, reg_idx)
+
+            # --- rotate all three rings.
+            # activations: stage s -> s+1 (stage 0 ignores the wrapped
+            # S-1 -> 0 handoff — it reads the feed register instead);
+            # fused with the return ring, which shares the direction
+            both = jax.lax.ppermute(
+                jnp.stack([x_act, reg_x]), axis_name, fwd_perm
+            )
+            x_act, reg_x = both[0], both[1]
+            if has_msa:
+                both = jax.lax.ppermute(
+                    jnp.stack([m_act, reg_m]), axis_name, fwd_perm
+                )
+                m_act, reg_m = both[0], both[1]
+            reg_idx = jax.lax.ppermute(reg_idx, axis_name, fwd_perm)
+            # feed drip: the consumption-cycle slot moves one hop toward
+            # stage 0 (data past stage 0 becomes garbage, never re-read)
+            xs = xs.at[slot].set(
+                jax.lax.ppermute(xs[slot], axis_name, back_perm)
+            )
+            if has_msa:
+                ms = ms.at[slot].set(
+                    jax.lax.ppermute(ms[slot], axis_name, back_perm)
+                )
+            return (x_act, m_act, out_x, out_m, xs, ms, reg_x, reg_m,
+                    reg_idx), None
+
+        def drain(carry, _):
+            """Return-ring rides can outlast the compute schedule by up to
+            S-2 hops (microbatch M-2's home is S-1 hops from the last
+            stage); rotate + harvest only, no compute."""
+            out_x, out_m, reg_x, reg_m, reg_idx = carry
+            out_x, out_m = harvest(out_x, out_m, reg_x, reg_m, reg_idx)
+            reg_x, reg_m, reg_idx = rotate_reg(reg_x, reg_m, reg_idx)
+            return (out_x, out_m, reg_x, reg_m, reg_idx), None
+
+        carry0 = (x0, m0, out_x, out_m, xs, ms, x0, m0, reg_idx0)
+        (x_act, m_act, out_x, out_m, xs, ms, reg_x, reg_m, reg_idx), _ = (
+            jax.lax.scan(tick, carry0, jnp.arange(ticks))
         )
-        # only the last stage holds real outputs; psum with zero
-        # contributions elsewhere replicates them to every shard (a
-        # one-to-all ppermute is not a permutation)
-        out_x = jax.lax.psum(jnp.where(is_last, out_x, 0), axis_name)
-        if has_msa:
-            out_m = jax.lax.psum(jnp.where(is_last, out_m, 0), axis_name)
+        drain_ticks = max(0, stages - 2)
+        if drain_ticks:
+            (out_x, out_m, reg_x, reg_m, reg_idx), _ = jax.lax.scan(
+                drain,
+                (out_x, out_m, reg_x, reg_m, reg_idx),
+                None,
+                length=drain_ticks,
+            )
+        out_x = out_x[None]  # restore the sharded leading stage axis
+        out_m = out_m[None] if has_msa else None
         return out_x, out_m
 
     out_x, out_m = run(stage_params, xs, ms)
-    out_x = out_x.reshape((b,) + x.shape[1:])
+    out_x = _un_round_robin(out_x, M).reshape((b,) + x.shape[1:])
     if has_msa:
-        out_m = out_m.reshape((b,) + m.shape[1:])
+        out_m = _un_round_robin(out_m, M).reshape((b,) + m.shape[1:])
     return out_x, out_m
