@@ -9,9 +9,13 @@ scripts/bench_sweep.py, scripts/bench_decompose.py) and their numbers are
 only comparable if they run the SAME program, so the config lives here
 and the scripts import it instead of hand-copying kwargs.
 
-`smoke=True` swaps in tiny CPU-safe shapes (the driver-validated fallback
-bench.py has always run off-TPU); numbers from smoke configs are
-meaningless and exist only to prove the code path end-to-end.
+Three tiers exist: "north_star" (the real target), "smoke" (tiny
+CPU-safe shapes — the driver-validated fallback bench.py runs off-TPU;
+numbers are meaningless and exist only to prove the code path
+end-to-end), and "proportional" (1/8-crop shapes preserving the north
+star's structural ratios — what the multichip dryrun's scaled leg and
+MULTICHIP_r0N.json measure). `smoke=True` is the legacy spelling of
+tier="smoke".
 """
 
 from __future__ import annotations
@@ -27,12 +31,20 @@ NORTH_STAR_CROP = 384
 NORTH_STAR_MSA_ROWS = 128
 SMOKE_CROP = 16
 SMOKE_MSA_ROWS = 4
+# the PROPORTIONAL tier keeps the north star's structural ratios —
+# crop : MSA rows = 3:1, compress ratio 4, aligned cross, reversible
+# tied-row trunk — at 1/8 the crop so an 8-device CPU mesh can
+# compile AND execute it in minutes (the multichip dryrun's scaled
+# config, VERDICT r2 weak #5)
+PROPORTIONAL_CROP = 48
+PROPORTIONAL_MSA_ROWS = 16
 
 
 def north_star_e2e_config(
     depth: int,
     *,
     smoke: bool = False,
+    tier: str | None = None,
     model_overrides: dict | None = None,
     e2e_overrides: dict | None = None,
 ):
@@ -41,12 +53,24 @@ def north_star_e2e_config(
     Returns (ecfg, crop, msa_rows). model_overrides / e2e_overrides are
     dataclasses.replace patches on the model / e2e config respectively —
     the sweep's tuning knobs go through here so a knob rename breaks
-    loudly in every script at once.
+    loudly in every script at once. `tier` selects "north_star"
+    (default), "smoke" (tiny CPU validation shapes), or "proportional"
+    (scaled-down-but-ratio-preserving, for the multichip dryrun);
+    smoke=True is the legacy spelling of tier="smoke".
     """
-    crop = SMOKE_CROP if smoke else NORTH_STAR_CROP
-    msa_rows = SMOKE_MSA_ROWS if smoke else NORTH_STAR_MSA_ROWS
-    dim, dim_head = (32, 16) if smoke else (256, 64)
-    dtype = jnp.float32 if smoke else jnp.bfloat16
+    if smoke and tier not in (None, "smoke"):
+        raise ValueError(f"smoke=True conflicts with tier={tier!r}")
+    tier = tier or ("smoke" if smoke else "north_star")
+    smoke = tier == "smoke"
+    # one row per tier: crop, msa_rows, dim, dim_head, compress, rdim, mds
+    crop, msa_rows, dim, dim_head, compress, rdim, mds_iters = {
+        "north_star": (NORTH_STAR_CROP, NORTH_STAR_MSA_ROWS, 256, 64, 4, 64,
+                       200),  # mds: reference train_end2end.py:157
+        "smoke": (SMOKE_CROP, SMOKE_MSA_ROWS, 32, 16, 1, 16, 5),
+        "proportional": (PROPORTIONAL_CROP, PROPORTIONAL_MSA_ROWS, 64, 16, 4,
+                         32, 25),
+    }[tier]
+    dtype = jnp.bfloat16 if tier == "north_star" else jnp.float32
 
     model = Alphafold2Config(
         dim=dim,
@@ -59,29 +83,30 @@ def north_star_e2e_config(
         # O(1) trunk activation memory in depth — mandatory at depth 48
         reversible=True,
         msa_tie_row_attn=True,
-        cross_attn_compress_ratio=1 if smoke else 4,
+        cross_attn_compress_ratio=compress,
         # column-aligned cross-attention: the O(n^2 * r) redesign that makes
         # this workload tractable (flat mode is O(n^2 * r*c) — ~100x more)
         cross_attn_mode="aligned",
         attn_flash="auto",
         # chunk attention ops over the folded-batch axis so QKV/out
-        # projections never materialize over all 1.3M pair tokens
-        attn_batch_chunk=0 if smoke else 32,
+        # projections never materialize over all 1.3M pair tokens (only
+        # needed at north-star scale; chunking tiny shapes just adds
+        # lax.map dispatch)
+        attn_batch_chunk=32 if tier == "north_star" else 0,
         # bound the 2048-wide GEGLU intermediate on the pair stream
-        ff_chunk_size=0 if smoke else 32768,
+        ff_chunk_size=32768 if tier == "north_star" else 0,
     )
     if model_overrides:
         model = dataclasses.replace(model, **model_overrides)
 
-    rdim = 16 if smoke else 64
     ecfg = E2EConfig(
         model=model,
         refiner=RefinerConfig(
             num_tokens=14, dim=rdim, depth=2, msg_dim=rdim, dtype=dtype,
             # bound the (A, A, msg) pair-message tensor at 5376 atoms
-            atom_chunk=0 if smoke else 256,
+            atom_chunk=256 if tier == "north_star" else 0,
         ),
-        mds_iters=5 if smoke else 200,  # reference train_end2end.py:157
+        mds_iters=mds_iters,
     )
     if e2e_overrides:
         ecfg = dataclasses.replace(ecfg, **e2e_overrides)
