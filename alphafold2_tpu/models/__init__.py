@@ -28,6 +28,7 @@ from alphafold2_tpu.models.refiner import (
 from alphafold2_tpu.models.embedder import (
     EmbedderConfig,
     convert_esm_state_dict,
+    convert_hf_esm_state_dict,
     embed_sequences,
     embedder_apply,
     embedder_init,
@@ -37,6 +38,7 @@ from alphafold2_tpu.models.embedder import (
 __all__ = [
     "EmbedderConfig",
     "convert_esm_state_dict",
+    "convert_hf_esm_state_dict",
     "embed_sequences",
     "embedder_apply",
     "embedder_init",

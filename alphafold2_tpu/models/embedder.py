@@ -13,7 +13,11 @@ for that contract:
   * `convert_esm_state_dict` maps a torch ESM-1b `state_dict()` (host-side
     numpy) onto the pytree, so the real 650M-param weights drop in when
     available — the architecture hyperparameters default to ESM-1b's
-    (33 layers, 1280 dim, 20 heads);
+    (33 layers, 1280 dim, 20 heads); `convert_hf_esm_state_dict` accepts
+    the same weights in HuggingFace `EsmModel` layout, and numerical
+    parity of the whole path is pinned against transformers' independent
+    torch implementation (tests/test_embedder.py), the strongest oracle
+    available without the 30 GB hub download;
   * `esm_tokenize` converts our amino-acid vocabulary (constants.AA_ORDER)
     to the ESM alphabet with BOS/EOS framing, and `embed_sequences` strips
     the framing back off so the output aligns 1:1 with residues.
@@ -35,11 +39,22 @@ from alphafold2_tpu.constants import AA_ORDER
 from alphafold2_tpu.ops.core import (
     embedding,
     embedding_init,
-    layer_norm,
+    layer_norm as _layer_norm,
     layer_norm_init,
     linear,
     linear_init,
 )
+
+# ESM-1b LayerNorm runs at eps=1e-12 (fair-esm ESM1bLayerNorm, mirrored by
+# HF EsmConfig.layer_norm_eps) — NOT our model-wide 1e-5 default. With the
+# real 650M weights the wrong eps shifts representations by ~1e-3
+# (measured against the transformers EsmModel oracle,
+# tests/test_embedder.py).
+_ESM_LN_EPS = 1e-12
+
+
+def layer_norm(params, x):
+    return _layer_norm(params, x, eps=_ESM_LN_EPS)
 
 # the ESM alphabet (fair-esm constants): specials + amino acids in ESM order
 ESM_TOKENS = (
@@ -245,3 +260,49 @@ def convert_esm_state_dict(state_dict, cfg: EmbedderConfig):
             }
         )
     return jax.tree_util.tree_map(jnp.asarray, params)
+
+
+# HuggingFace transformers EsmModel key -> fair-esm ProteinBertModel key.
+# transformers ships an independently validated torch port of ESM
+# (facebook/esm1b_t33_650M_UR50S is published in this format too), so
+# accepting its state dicts both widens the real-weight loading path and
+# gives the test suite a third-party numerical oracle for the
+# architecture (tests/test_embedder.py).
+_HF_STATIC = {
+    "embeddings.word_embeddings.weight": "embed_tokens.weight",
+    "embeddings.position_embeddings.weight": "embed_positions.weight",
+    "embeddings.layer_norm.weight": "emb_layer_norm_before.weight",
+    "embeddings.layer_norm.bias": "emb_layer_norm_before.bias",
+    "encoder.emb_layer_norm_after.weight": "emb_layer_norm_after.weight",
+    "encoder.emb_layer_norm_after.bias": "emb_layer_norm_after.bias",
+}
+_HF_LAYER = {
+    "attention.self.query": "self_attn.q_proj",
+    "attention.self.key": "self_attn.k_proj",
+    "attention.self.value": "self_attn.v_proj",
+    "attention.output.dense": "self_attn.out_proj",
+    "attention.LayerNorm": "self_attn_layer_norm",
+    "intermediate.dense": "fc1",
+    "output.dense": "fc2",
+    "LayerNorm": "final_layer_norm",
+}
+
+
+def convert_hf_esm_state_dict(state_dict, cfg: EmbedderConfig):
+    """Map a HuggingFace `EsmModel` state dict (absolute-position / ESM-1b
+    family, e.g. facebook/esm1b_t33_650M_UR50S in transformers format)
+    onto the embedder pytree, via the fair-esm key layout."""
+    sd = {}
+    for key, val in state_dict.items():
+        key = key.removeprefix("esm.")
+        if key in _HF_STATIC:
+            sd[_HF_STATIC[key]] = val
+            continue
+        if key.startswith("encoder.layer."):
+            _, _, idx, rest = key.split(".", 3)
+            stem, leaf = rest.rsplit(".", 1)
+            if stem in _HF_LAYER:
+                sd[f"layers.{idx}.{_HF_LAYER[stem]}.{leaf}"] = val
+        # anything else (pooler, contact head, rotary buffers) is not part
+        # of the representation path and is ignored
+    return convert_esm_state_dict(sd, cfg)

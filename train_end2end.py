@@ -164,6 +164,7 @@ def main():
         from alphafold2_tpu.models.embedder import (
             EmbedderConfig,
             convert_esm_state_dict,
+            convert_hf_esm_state_dict,
             embed_sequences,
             embedder_init,
         )
@@ -174,8 +175,16 @@ def main():
         )
         if args.esm_ckpt:
             sd = dict(np.load(args.esm_ckpt, allow_pickle=True))
-            e_params = convert_esm_state_dict(sd, e_cfg)
-            print(f"loaded converted ESM weights from {args.esm_ckpt}")
+            # both published formats load: fair-esm torch.hub state dicts
+            # and transformers EsmModel state dicts (detected by key style)
+            hf_style = any(
+                k.startswith(("esm.", "encoder.layer.", "embeddings."))
+                for k in sd
+            )
+            convert = convert_hf_esm_state_dict if hf_style else convert_esm_state_dict
+            e_params = convert(sd, e_cfg)
+            print(f"loaded converted ESM weights from {args.esm_ckpt} "
+                  f"({'transformers' if hf_style else 'fair-esm'} layout)")
         else:
             e_params = embedder_init(jax.random.PRNGKey(42), e_cfg)
             print("esm features with RANDOM embedder weights (pass "
