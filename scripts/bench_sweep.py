@@ -52,6 +52,8 @@ ecfg, crop, msa_rows = north_star_e2e_config(
         attn_flash_tile_elems=spec["tile_elems"],
         attn_flash_qb_target=spec.get("qb_target"),
         **({"ff_chunk_size": spec["ff_chunk"]} if "ff_chunk" in spec else {}),
+        **({"heads": spec["heads"], "dim_head": spec["dim_head"]}
+           if "heads" in spec or "dim_head" in spec else {}),
     ),
     e2e_overrides=dict(
         mds_bwd_iters=spec["mds_bwd_iters"],
@@ -230,6 +232,12 @@ def main():
             # leaves shorter axes unpadded): collapses the (BH, nqb) grid
             # 3x — the per-grid-step-overhead lever (PERF.md finding 3)
             ("e2e_qbt1152", {**base, "kernel": "force", "qb_target": 1152}),
+            # heads 4 x dh 128 keeps inner width 512 but fills the
+            # 128-lane tile that bf16 dh=64 pads 2x (session-3 finding 1)
+            # on EVERY attention q/k/v/out tile — candidate biggest
+            # single-chip lever; BASELINE config 5 pins dim/depth, not
+            # the head split
+            ("e2e_h4dh128", {**base, "heads": 4, "dim_head": 128}),
             ("e2e_mdsbwd25", {**base, "mds_bwd_iters": 25}),
             # MDS scan unroll: amortizes the 200 sequential small-kernel
             # iterations' dispatch overhead (PERF.md "MDS latency")
