@@ -44,6 +44,7 @@ def test_model_forward_bf16_close_to_f32():
     assert np.abs(p16 - p32).max() < 0.02
 
 
+@pytest.mark.slow
 def test_reversible_bf16_forward_and_grad_finite():
     cfg = _toy(jnp.bfloat16, reversible=True, msa_tie_row_attn=True)
     params = alphafold2_init(jax.random.PRNGKey(0), cfg)

@@ -82,6 +82,7 @@ def test_config1_readme_toy():
     _run(Alphafold2Config(dim=32, depth=2, heads=2, dim_head=8, max_seq_len=32))
 
 
+@pytest.mark.slow
 def test_config2_reversible_dense():
     # BASELINE config 2: reversible trunk, dense self+cross
     _run(Alphafold2Config(
@@ -89,6 +90,7 @@ def test_config2_reversible_dense():
     ))
 
 
+@pytest.mark.slow
 def test_config3_sparse_interleaved():
     # BASELINE config 3: interleaved block-sparse self-attention
     _run(Alphafold2Config(
@@ -112,6 +114,7 @@ def test_config4_templates_compress_tied():
     )
 
 
+@pytest.mark.slow
 def test_config5_e2e_miniature():
     # BASELINE config 5 in miniature: the full structure pipeline — covered
     # in depth by tests/test_e2e.py and the multichip dryrun; here the
@@ -152,6 +155,7 @@ def test_scan_layers_matches_unrolled():
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_raw_distance_templates_match_prebinned():
     """Float templates (raw Angstrom distances) are binned internally with
     the library thresholds — the model output must equal passing the same

@@ -107,6 +107,7 @@ def test_convert_torch_state_dict():
     np.testing.assert_allclose(q_ours, q_torch, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_embedder_feeds_model_embedds_path():
     """End-to-end: embedder output drives Alphafold2's embedds input
     (reference train_end2end.py:149 -> alphafold2.py:469-472)."""

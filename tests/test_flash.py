@@ -60,6 +60,7 @@ def test_blockwise_matches_dense(B, i, j, tile_elems, kv_block):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_blockwise_gradients_match_dense():
     B, i, j, h, dh = 4, 24, 40, 2, 8
     ks = jax.random.split(jax.random.PRNGKey(1), 4)

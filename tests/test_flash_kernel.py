@@ -87,7 +87,10 @@ def test_kernel_matches_dense(B, i, j, qb, kb, dtype):
     _check_matches_dense(B, i, j, qb, kb, dtype)
 
 
-@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize(
+    "dtype",
+    [jnp.float32, pytest.param(jnp.bfloat16, marks=pytest.mark.slow)],
+)
 def test_kernel_gradients_match_dense(dtype):
     # bf16 exercises the backward's ds/p operand-dtype casts in the
     # dq/dkv kernels (identity under f32); the f32 oracle bounds rounding

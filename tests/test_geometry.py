@@ -132,6 +132,7 @@ def test_mds_differentiable():
     assert float(jnp.abs(g).sum()) > 0
 
 
+@pytest.mark.slow
 def test_mds_truncated_backprop():
     key = jax.random.PRNGKey(5)
     n = 16

@@ -150,6 +150,7 @@ def test_embedds_path():
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow
 def test_own_init_jit_forward():
     # init + jitted forward with dropout rng on our own params
     cfg = Alphafold2Config(

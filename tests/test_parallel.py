@@ -71,6 +71,7 @@ def test_dp_tp_matches_single_device():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_dp_only_mesh():
     mesh = make_mesh({"data": 8})
     batch = _batch(batch_size=8)
@@ -120,6 +121,7 @@ def test_reversible_sharded_step():
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_sp_train_step_matches_single_device():
     """Sequence-parallel TRAINING: the distogram train step with the trunk
     sharded over all 8 devices (make_sp_train_step) must track the

@@ -55,6 +55,11 @@ def _setup(depth, accum, seed=0):
     return ecfg, tcfg, batch, state
 
 
+# slow tier: the segmented chain jits ~7 separate e2e-sized programs
+# (front/seg fwd/tail vjp/seg bwd/front bwd/opt), ~50 s cold regardless of
+# depth — the fast tier keeps the structural tests below, and the chain's
+# execution parity is pinned here plus exercised on-chip by bench.py
+@pytest.mark.slow
 @pytest.mark.parametrize("accum", [1, 2])
 def test_segmented_matches_monolithic(accum):
     ecfg, tcfg, batch, state = _setup(depth=4, accum=accum)

@@ -119,7 +119,10 @@ def test_model_interleaved_sparse():
         assert np.isfinite(np.asarray(leaf)).all()
 
 
-@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize(
+    "dtype",
+    [jnp.float32, pytest.param(jnp.bfloat16, marks=pytest.mark.slow)],
+)
 def test_pallas_kernel_matches_xla_path(dtype):
     """Pallas flash-style kernel (interpret mode on CPU) == XLA block-gather
     path, forward and gradients. The bf16 case exercises the kernel's
@@ -167,6 +170,7 @@ def test_pallas_kernel_matches_xla_path(dtype):
         )
 
 
+@pytest.mark.slow
 def test_sparse_coexists_with_tied_rows():
     cfg = Alphafold2Config(
         dim=32, depth=1, heads=2, dim_head=8, max_seq_len=64,

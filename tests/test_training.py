@@ -139,6 +139,7 @@ def test_length_bucketing_static_shapes():
         assert g["coords"].shape == (3, 2, bl, 3)
 
 
+@pytest.mark.slow
 def test_bucketed_training_steps_run_per_shape():
     """A jitted train step consumes bucketed groups — one compile per
     bucket, numerically fine across shapes."""
