@@ -52,8 +52,7 @@ ecfg, crop, msa_rows = north_star_e2e_config(
         attn_flash_tile_elems=spec["tile_elems"],
         attn_flash_qb_target=spec.get("qb_target"),
         **({"ff_chunk_size": spec["ff_chunk"]} if "ff_chunk" in spec else {}),
-        **({"heads": spec["heads"], "dim_head": spec["dim_head"]}
-           if "heads" in spec or "dim_head" in spec else {}),
+        **{k: spec[k] for k in ("heads", "dim_head") if k in spec},
     ),
     e2e_overrides=dict(
         mds_bwd_iters=spec["mds_bwd_iters"],
