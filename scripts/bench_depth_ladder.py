@@ -1,0 +1,128 @@
+"""North-star depth ladder: depth-24 monolithic + depth-48 segmented.
+
+BASELINE.md's north star is >=1 optimizer step/sec/chip at depth 48,
+crop 384, MSA 128 — and depth 48 has never been timed on chip (rounds
+1-3). bench.py measures the ladder at round end under the driver's
+~20 min budget; this script is the SAME measurement armed for the
+recovery watcher, so the numbers land the moment the chip returns
+instead of gambling on the tunnel being healthy at round end.
+
+Each leg is one `bench.py --single-depth` subprocess (bench.py's
+isolation pattern: a crashed TPU worker must not take the orchestrator
+down). depth 24 runs monolithic (fits the tunneled worker's ~60 s
+single-execution budget); depth 48 runs SEGMENTED
+(training/segmented.py, 4 segments — the monolithic ~96 s execution
+CRASHES the worker and wedges the relay, reference workload
+/root/reference/train_end2end.py:104-183 at config-5 depth).
+
+Rows append to PERF_LADDER.jsonl (committed). Legs with a successful
+record are skipped (recovered-tunnel time is scarce; the watcher
+restarts this script on every recovery). Exit 3 on a wedge signature
+(timeout with nothing salvaged) so the watcher goes back to probing.
+
+Usage: python scripts/bench_depth_ladder.py [--force-all]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+from bench_sweep import err_tail  # noqa: E402  (shared failure summarizer)
+
+OUT = os.path.join(REPO, "PERF_LADDER.jsonl")
+BENCH = os.path.join(REPO, "bench.py")
+
+# (depth, segments, subprocess timeout). Timeouts are hung-tunnel
+# backstops sized at generous multiples of expected compile+run wall —
+# NOT budget devices: killing an in-flight device execution wedges the
+# relay (PERF.md), so these only fire when the tunnel is already hung.
+LEGS = ((24, 0, 2400), (48, 4, 3000))
+
+
+def run_leg(depth, segments, timeout):
+    cmd = [sys.executable, BENCH, "--single-depth", str(depth)]
+    if segments:
+        cmd += ["--segments", str(segments)]
+
+    def parse_last(stdout):
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode("utf-8", "replace")
+        for line in reversed((stdout or "").strip().splitlines()):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+        return None
+
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout, cwd=REPO)
+    except subprocess.TimeoutExpired as e:
+        # salvage the train row if the worker printed it before hanging
+        # (bench.py prints it before the inference leg)
+        row = parse_last(e.stdout)
+        if row is not None:
+            row["worker_timed_out"] = True
+            return row, time.time() - t0, True
+        return ({"depth": depth, "segments": segments, "error": "timeout"},
+                time.time() - t0, True)
+    row = parse_last(proc.stdout)
+    if proc.returncode != 0:
+        if row is not None:
+            row["worker_crashed_after_measurement"] = True
+            return row, time.time() - t0, False
+        return ({"depth": depth, "segments": segments,
+                 "error": err_tail(proc.stderr, proc.returncode)},
+                time.time() - t0, False)
+    if row is None:
+        return ({"depth": depth, "segments": segments,
+                 "error": "no JSON"}, time.time() - t0, False)
+    return row, time.time() - t0, False
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--force-all", action="store_true",
+                    help="re-run legs already recorded in PERF_LADDER.jsonl")
+    args = ap.parse_args()
+
+    done = set()
+    if not args.force_all and os.path.exists(OUT):
+        with open(OUT) as f:
+            for line in f:
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                if "error" not in e and "_tpu" in e.get("metric", ""):
+                    done.add((e.get("depth"), e.get("segments", 0)))
+
+    for depth, segments, timeout in LEGS:
+        if (depth, segments) in done:
+            print(f"skip depth {depth} seg {segments}: already in {OUT}",
+                  flush=True)
+            continue
+        row, wall, timed_out = run_leg(depth, segments, timeout)
+        row.setdefault("depth", depth)
+        row.setdefault("segments", segments)
+        row["wall"] = round(wall, 1)
+        with open(OUT, "a") as f:
+            f.write(json.dumps(row) + "\n")
+        print(json.dumps(row), flush=True)
+        if timed_out:
+            print(json.dumps({"bench": "depth_ladder",
+                              "error": "tunnel wedged; stopping"}),
+                  flush=True)
+            sys.exit(3)  # wedged-tunnel code: watchers retry later
+
+
+if __name__ == "__main__":
+    main()

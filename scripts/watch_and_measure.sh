@@ -2,11 +2,12 @@
 # Probe the TPU tunnel every 8 minutes; on a healthy probe, run the
 # remaining measurements in information-value order: the e2e decomposition
 # (where-the-time-goes — the sweep showed the knobs are all noise, so the
-# decomposition is what identifies the real sink), then the sweep's
-# remaining micro legs (already-recorded legs are skipped by both). Both
-# scripts exit 3 when they detect a wedged tunnel — the watcher goes back
-# to probing instead of hammering a dead relay; any other exit code counts
-# as done. The probe is a tiny subprocess matmul under a generous
+# decomposition is what identifies the real sink), then the north-star
+# depth ladder (depth-24 monolithic MFU + depth-48 segmented, never timed
+# on chip in rounds 1-3), then the sweep's remaining micro legs
+# (already-recorded legs are skipped by all three). Each script exits 3
+# when it detects a wedged tunnel — the watcher goes back to probing
+# instead of hammering a dead relay; any other exit code counts as done. The probe is a tiny subprocess matmul under a generous
 # timeout — killing a client that is merely waiting on a wedged relay
 # does not worsen the wedge (PERF.md).
 cd "$(dirname "$0")/.."
@@ -15,23 +16,42 @@ cd "$(dirname "$0")/.."
 # bench and distort ITS numbers — past the deadline, stop touching the
 # chip entirely.
 DEADLINE="${1:-0}"
+past_deadline() {
+  [ "$DEADLINE" -gt 0 ] && [ "$(date +%s)" -gt "$DEADLINE" ]
+}
 decomp_done=0
+ladder_done=0
 sweep_done=0
 for i in $(seq 1 60); do
-  if [ "$DEADLINE" -gt 0 ] && [ "$(date +%s)" -gt "$DEADLINE" ]; then
+  if past_deadline; then
     echo "$(date -u +%H:%M:%S) deadline reached; exiting without measuring"
     exit 0
   fi
   if timeout 240 python scripts/tpu_probe.py 2>/dev/null | grep -q tpu-healthy; then
     echo "$(date -u +%H:%M:%S) chip healthy on probe $i; measuring"
     if [ "$decomp_done" -eq 0 ]; then
+      # re-check before EACH stage: a probe that lands just before the
+      # deadline must not start an hours-long stage that would overlap
+      # the round-end driver bench and distort its numbers
+      if past_deadline; then echo "deadline; skipping decompose"; exit 0; fi
       python scripts/bench_decompose.py --depth 12
       rc=$?
       echo "$(date -u +%H:%M:%S) decompose finished rc=$rc"
       if [ "$rc" -eq 3 ]; then sleep 480; continue; fi
       decomp_done=1
     fi
+    if [ "$ladder_done" -eq 0 ]; then
+      if past_deadline; then echo "deadline; skipping ladder"; exit 0; fi
+      # round-4 priority #3: depth-24 monolithic MFU + depth-48 segmented
+      # steps/sec (never timed on chip in rounds 1-3)
+      python scripts/bench_depth_ladder.py
+      rc=$?
+      echo "$(date -u +%H:%M:%S) depth ladder finished rc=$rc"
+      if [ "$rc" -eq 3 ]; then sleep 480; continue; fi
+      ladder_done=1
+    fi
     if [ "$sweep_done" -eq 0 ]; then
+      if past_deadline; then echo "deadline; skipping sweep"; exit 0; fi
       python scripts/bench_sweep.py
       rc=$?
       echo "$(date -u +%H:%M:%S) sweep finished rc=$rc"
