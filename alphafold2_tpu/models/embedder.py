@@ -65,6 +65,7 @@ ESM_TOKENS = (
 )
 ESM_IDX = {t: i for i, t in enumerate(ESM_TOKENS)}
 _CLS, _PAD, _EOS = ESM_IDX["<cls>"], ESM_IDX["<pad>"], ESM_IDX["<eos>"]
+_MASK = ESM_IDX["<mask>"]
 
 # our token id (0..19 = AA_ORDER, 20 = pad) -> ESM alphabet id
 _OURS_TO_ESM = np.array(
@@ -84,6 +85,15 @@ class EmbedderConfig:
     # max_len + padding_idx, so the table holds max_len + padding_idx + 1
     # rows — (1026, 1280) for real ESM-1b, matching its state dict
     max_len: int = 1024
+    # ESM "mask-dropout": real ESM-1b INFERENCE zeroes <mask>-token
+    # embeddings and rescales every token embedding by
+    # (1 - 0.15*0.8) / (1 - observed <mask> fraction) — a flat 0.88x when
+    # no <mask> tokens are present. The reference's torch.hub ESM-1b
+    # applies this (fair-esm esm1.py token_dropout; HF EsmEmbeddings
+    # mirrors it), so faithfully reproducing the layer-33 representations
+    # the reference feeds (train_end2end.py:54-59) requires it ON. Parity
+    # with HF is pinned BOTH ways (tests/test_embedder.py).
+    token_dropout: bool = True
     dtype: Any = jnp.float32
 
     @property
@@ -119,6 +129,29 @@ def embedder_init(key, cfg: EmbedderConfig):
     return params
 
 
+def apply_token_dropout(h, tokens, mask):
+    """ESM mask-dropout on token embeddings h (b, n, d), BEFORE positional
+    embeddings are added (HF EsmEmbeddings order): zero <mask> positions,
+    rescale every row by (1 - 0.15*0.8) / (1 - observed <mask> fraction)
+    — a flat 0.88x when no <mask> tokens are present.
+
+    Denominator = NON-PAD count, fair-esm semantics (esm1.py src_lengths
+    = (~padding_mask).sum()) — the torch.hub ESM-1b the reference runs.
+    NB: HF's EsmModel.forward drops the attention mask on the way into
+    EsmEmbeddings, so for PADDED batches with <mask> present HF divides
+    by the padded length instead; we follow fair-esm
+    (tests/test_embedder.py pins it via padding invariance).
+    """
+    is_masked = tokens == _MASK
+    h = jnp.where(is_masked[..., None], 0.0, h)
+    mask_ratio_train = 0.15 * 0.8  # the ratio all ESM runs trained with
+    src_lengths = jnp.maximum(  # guard the degenerate all-pad row
+        jnp.sum(mask.astype(jnp.float32), axis=1), 1.0)
+    ratio_obs = jnp.sum(is_masked.astype(jnp.float32), axis=1) / src_lengths
+    return (h * ((1.0 - mask_ratio_train)
+                 / (1.0 - ratio_obs))[:, None, None]).astype(h.dtype)
+
+
 def embedder_apply(params, cfg: EmbedderConfig, tokens, mask=None):
     """Forward over ESM-alphabet tokens. tokens: (b, n) int; mask: (b, n).
 
@@ -137,6 +170,8 @@ def embedder_apply(params, cfg: EmbedderConfig, tokens, mask=None):
         mask = tokens != _PAD
 
     h = embedding(params["token_emb"], tokens, dtype=dtype)
+    if cfg.token_dropout:
+        h = apply_token_dropout(h, tokens, mask)
     # fairseq LearnedPositionalEmbedding semantics (what ESM-1b trained
     # with): position = cumulative count of non-pad tokens + padding_idx,
     # pads pinned at padding_idx — NOT a plain arange
@@ -305,4 +340,35 @@ def convert_hf_esm_state_dict(state_dict, cfg: EmbedderConfig):
                 sd[f"layers.{idx}.{_HF_LAYER[stem]}.{leaf}"] = val
         # anything else (pooler, contact head, rotary buffers) is not part
         # of the representation path and is ignored
+
+    # Validate the mapped layout BEFORE handing off: silently dropping
+    # unmapped keys means an ESM-2/rotary-family checkpoint (no absolute
+    # position table, no emb_layer_norm_before, different norm layout)
+    # would fail later with an opaque KeyError deep in
+    # convert_esm_state_dict. Name the unsupported layout instead.
+    missing = [k for k in
+               ("embed_tokens.weight", "embed_positions.weight",
+                "emb_layer_norm_before.weight", "emb_layer_norm_after.weight")
+               if k not in sd]
+    missing += [f"layers.{i}.self_attn.q_proj.weight"
+                for i in range(cfg.num_layers)
+                if f"layers.{i}.self_attn.q_proj.weight" not in sd]
+    if missing:
+        raise ValueError(
+            "state dict does not look like an absolute-position ESM-1b "
+            f"family EsmModel (missing after mapping: {missing[:4]}"
+            f"{'...' if len(missing) > 4 else ''}). ESM-2/rotary "
+            "checkpoints (no position table, no emb_layer_norm_before) "
+            "are not supported by this converter; check cfg.num_layers "
+            "matches the checkpoint depth."
+        )
+    # a checkpoint DEEPER than cfg.num_layers would otherwise truncate
+    # silently — wrong representations with no error
+    extra = f"layers.{cfg.num_layers}.self_attn.q_proj.weight"
+    if extra in sd:
+        raise ValueError(
+            f"checkpoint has more encoder layers than cfg.num_layers="
+            f"{cfg.num_layers} (found {extra}); refusing to silently "
+            "truncate — set cfg.num_layers to the checkpoint depth"
+        )
     return convert_esm_state_dict(sd, cfg)

@@ -165,22 +165,8 @@ def test_near_max_length_positions_in_table():
     assert np.isfinite(np.asarray(out)).all()
 
 
-def test_embedder_matches_transformers_esm():
-    """Numerical parity against HuggingFace's EsmModel — an INDEPENDENT,
-    HF-validated torch implementation of the ESM architecture (the same
-    family transformers publishes facebook/esm1b_t33_650M_UR50S in).
-    fair-esm's hub download is unavailable in this environment, so this is
-    the strongest available oracle for 'the real weights would drop in and
-    produce the same embeddings': same ids in, same representations out,
-    through convert_hf_esm_state_dict -> convert_esm_state_dict.
-    """
-    torch = pytest.importorskip("torch")
-    tfm = pytest.importorskip("transformers")
-
-    from alphafold2_tpu.models.embedder import convert_hf_esm_state_dict
-
-    cfg = EmbedderConfig(num_layers=2, dim=64, heads=4, max_len=30)
-    hf_cfg = tfm.EsmConfig(
+def _hf_oracle_cfg(tfm, cfg):
+    return tfm.EsmConfig(
         vocab_size=cfg.vocab,
         hidden_size=cfg.dim,
         num_hidden_layers=cfg.num_layers,
@@ -189,19 +175,52 @@ def test_embedder_matches_transformers_esm():
         position_embedding_type="absolute",  # ESM-1b (ESM-2 is rotary)
         max_position_embeddings=cfg.pos_table_rows,
         pad_token_id=ESM_IDX["<pad>"],
+        mask_token_id=ESM_IDX["<mask>"],
         emb_layer_norm_before=True,  # ESM-1b has it (ESM-2 dropped it)
-        token_dropout=False,
+        token_dropout=cfg.token_dropout,
         hidden_dropout_prob=0.0,
         attention_probs_dropout_prob=0.0,
     )
+
+
+def _hf_parity_case(cfg, inject_mask_tokens=False, atol=2e-5, seq_len=11):
+    """Shared oracle run: build an HF EsmModel at cfg's shape, convert its
+    random weights, compare representations at valid positions.
+
+    inject_mask_tokens uses UNPADDED rows only: for padded batches with
+    <mask> present, HF's EsmModel.forward calls EsmEmbeddings without the
+    attention mask, so its observed-mask-ratio denominator is the padded
+    length — while fair-esm (the torch.hub ESM-1b the reference actually
+    runs, esm1.py) divides by the NON-PAD count. Our embedder follows
+    fair-esm, the reference's contract; on unpadded rows the two torch
+    implementations agree and HF remains a valid oracle."""
+    torch = pytest.importorskip("torch")
+    tfm = pytest.importorskip("transformers")
+
+    from alphafold2_tpu.models.embedder import (
+        convert_hf_esm_state_dict,
+        embedder_apply,
+    )
+
     torch.manual_seed(0)
-    model = tfm.EsmModel(hf_cfg, add_pooling_layer=False).eval()
+    model = tfm.EsmModel(
+        _hf_oracle_cfg(tfm, cfg), add_pooling_layer=False
+    ).eval()
     params = convert_hf_esm_state_dict(model.state_dict(), cfg)
 
     rs = np.random.RandomState(1)
-    ours = jnp.asarray(rs.randint(0, 20, size=(2, 11)))
-    our_mask = jnp.asarray(np.arange(11)[None, :] < np.array([[11], [7]]))
+    ours = jnp.asarray(rs.randint(0, 20, size=(2, seq_len)))
+    row2_len = seq_len if inject_mask_tokens else seq_len - 4
+    our_mask = jnp.asarray(
+        np.arange(seq_len)[None, :] < np.array([[seq_len], [row2_len]])
+    )
     tokens, mask = esm_tokenize(ours, our_mask)
+    if inject_mask_tokens:
+        # a realistic MLM-style input: some residues replaced by <mask> —
+        # exercises both the zeroing and the per-row observed-ratio rescale
+        tokens = tokens.at[0, 3].set(ESM_IDX["<mask>"])
+        tokens = tokens.at[0, 5].set(ESM_IDX["<mask>"])
+        tokens = tokens.at[1, 2].set(ESM_IDX["<mask>"])
 
     with torch.no_grad():
         want = model(
@@ -209,10 +228,144 @@ def test_embedder_matches_transformers_esm():
             attention_mask=torch.from_numpy(np.asarray(mask)).long(),
         ).last_hidden_state.numpy()
 
-    from alphafold2_tpu.models.embedder import embedder_apply
-
     got = np.asarray(embedder_apply(params, cfg, tokens, mask))
     # compare at VALID positions only (HF zeroes pad embeddings; pads are
     # attention-masked so valid positions are unaffected)
     sel = np.asarray(mask)
-    np.testing.assert_allclose(got[sel], want[sel], atol=2e-5)
+    np.testing.assert_allclose(got[sel], want[sel], atol=atol)
+
+
+@pytest.mark.parametrize("token_dropout", [False, True])
+def test_embedder_matches_transformers_esm(token_dropout):
+    """Numerical parity against HuggingFace's EsmModel — an INDEPENDENT,
+    HF-validated torch implementation of the ESM architecture (the same
+    family transformers publishes facebook/esm1b_t33_650M_UR50S in).
+    fair-esm's hub download is unavailable in this environment, so this is
+    the strongest available oracle for 'the real weights would drop in and
+    produce the same embeddings': same ids in, same representations out,
+    through convert_hf_esm_state_dict -> convert_esm_state_dict.
+
+    token_dropout=True is the real ESM-1b inference semantics (flat 0.88x
+    embedding rescale with no <mask> present — fair-esm esm1.py, mirrored
+    by HF EsmEmbeddings); False pins the plain path stays correct too.
+    """
+    cfg = EmbedderConfig(num_layers=2, dim=64, heads=4, max_len=30,
+                         token_dropout=token_dropout)
+    _hf_parity_case(cfg)
+
+
+def test_embedder_token_dropout_with_mask_tokens():
+    """<mask> tokens in the input: embeddings zeroed and the per-row
+    observed-mask-ratio rescale applied, matching HF exactly (unpadded
+    rows — see _hf_parity_case on the HF/fair-esm padded divergence)."""
+    cfg = EmbedderConfig(num_layers=2, dim=64, heads=4, max_len=30,
+                         token_dropout=True)
+    _hf_parity_case(cfg, inject_mask_tokens=True)
+
+
+def test_token_dropout_ratio_uses_nonpad_count():
+    """fair-esm semantics for the observed-mask-ratio denominator: the
+    NON-PAD token count, not the padded length (esm1.py src_lengths =
+    (~padding_mask).sum). Pinned via padding invariance: a row with a
+    <mask> token embedded amid padding must equal the same row embedded
+    without padding — true only if the denominator ignores pads (HF's
+    full-model path divides by padded length here and would fail this)."""
+    from alphafold2_tpu.models.embedder import ESM_IDX as IDX, embedder_apply
+
+    cfg = EmbedderConfig(num_layers=1, dim=16, heads=2, max_len=16,
+                         token_dropout=True)
+    params = embedder_init(jax.random.PRNGKey(0), cfg)
+    seq = jnp.asarray([[0, 1, 2, 3, 4]])
+    tokens, mask = esm_tokenize(seq)
+    tokens = tokens.at[0, 2].set(IDX["<mask>"])
+    alone = np.asarray(embedder_apply(params, cfg, tokens, mask))
+
+    pad = jnp.full((1, 3), IDX["<pad>"], tokens.dtype)
+    tokens_p = jnp.concatenate([tokens, pad], axis=1)
+    mask_p = jnp.concatenate([mask, jnp.zeros((1, 3), bool)], axis=1)
+    padded = np.asarray(embedder_apply(params, cfg, tokens_p, mask_p))
+    np.testing.assert_allclose(padded[:, :7], alone, atol=1e-5)
+
+
+def test_token_dropout_flat_rescale_when_unmasked():
+    """With no <mask> tokens, token_dropout must be EXACTLY a flat 0.88x
+    (= 1 - 0.15*0.8) rescale of the token embeddings (the documented
+    ESM-1b behavior); with k of L non-pad tokens masked, zeroed <mask>
+    rows and a (1-0.12)/(1-k/L) row rescale."""
+    from alphafold2_tpu.models.embedder import ESM_IDX as IDX, apply_token_dropout
+
+    assert EmbedderConfig().token_dropout  # the faithful default is ON
+    rs = np.random.RandomState(0)
+    h = jnp.asarray(rs.randn(2, 6, 8).astype(np.float32))
+    tokens = jnp.asarray([[5, 6, 7, 8, 9, IDX["<pad>"]],
+                          [5, IDX["<mask>"], 7, 8, 9, IDX["<pad>"]]])
+    mask = jnp.asarray([[True] * 5 + [False]] * 2)
+    out = np.asarray(apply_token_dropout(h, tokens, mask))
+    # row 0: no <mask> -> flat 0.88x
+    np.testing.assert_allclose(out[0], 0.88 * np.asarray(h)[0], rtol=1e-6)
+    # row 1: <mask> at position 1 zeroed; others scaled by .88/(1-1/5)
+    np.testing.assert_allclose(out[1, 1], 0.0)
+    keep = [0, 2, 3, 4, 5]
+    np.testing.assert_allclose(
+        out[1, keep], (0.88 / (1 - 1 / 5)) * np.asarray(h)[1, keep],
+        rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_embedder_matches_transformers_esm_real_dims():
+    """HF-oracle parity at REAL ESM-1b dimensions — 33 layers, dim 1280,
+    20 heads, 1026-row position table, token_dropout on (random weights;
+    the actual 650M download is unreachable in-env). Catches
+    scale-dependent conversion bugs (head splitting at 20 heads, the
+    full-depth qkv concat, position-table rows) that the tiny-config
+    parity cannot. ~2.6 GB torch + conversion; CPU wall ~2-4 min.
+    """
+    cfg = EmbedderConfig()  # the real esm1b_t33_650M_UR50S shape defaults
+    assert (cfg.num_layers, cfg.dim, cfg.heads, cfg.pos_table_rows) == \
+        (33, 1280, 20, 1026)
+    # f32 accumulation over 33 layers at dim 1280 is noisier than the toy
+    # config; 33x depth and 20x width over the 2e-5 toy bound motivates
+    # the looser-but-still-tight 2e-4
+    _hf_parity_case(cfg, atol=2e-4, seq_len=17)
+
+
+def test_hf_converter_rejects_esm2_layout():
+    """An ESM-2/rotary-style state dict (no absolute position table, no
+    emb_layer_norm_before) must fail with a descriptive layout error, not
+    an opaque KeyError (ADVICE r3)."""
+    cfg = EmbedderConfig(num_layers=2, dim=32, heads=4, max_len=16)
+    rs = np.random.RandomState(0)
+    sd = {
+        "embeddings.word_embeddings.weight":
+            rs.randn(cfg.vocab, cfg.dim).astype(np.float32),
+        # rotary family: inv_freq buffers instead of a position table
+        "encoder.layer.0.attention.self.rotary_embeddings.inv_freq":
+            rs.randn(4).astype(np.float32),
+    }
+    from alphafold2_tpu.models.embedder import convert_hf_esm_state_dict
+
+    with pytest.raises(ValueError, match="ESM-2/rotary"):
+        convert_hf_esm_state_dict(sd, cfg)
+
+
+def test_hf_converter_rejects_deeper_checkpoint():
+    """cfg.num_layers smaller than the checkpoint depth must refuse (the
+    silent-truncation failure mode), not build a shallower model."""
+    from alphafold2_tpu.models.embedder import _HF_LAYER, convert_hf_esm_state_dict
+
+    cfg = EmbedderConfig(num_layers=1, dim=8, heads=2, max_len=16)
+    z = np.zeros((1,), np.float32)
+    sd = {
+        "embeddings.word_embeddings.weight": z,
+        "embeddings.position_embeddings.weight": z,
+        "embeddings.layer_norm.weight": z,
+        "embeddings.layer_norm.bias": z,
+        "encoder.emb_layer_norm_after.weight": z,
+        "encoder.emb_layer_norm_after.bias": z,
+    }
+    for i in range(2):  # two layers vs cfg.num_layers=1
+        for stem in _HF_LAYER:
+            sd[f"encoder.layer.{i}.{stem}.weight"] = z
+            sd[f"encoder.layer.{i}.{stem}.bias"] = z
+    with pytest.raises(ValueError, match="silently truncate"):
+        convert_hf_esm_state_dict(sd, cfg)
