@@ -40,9 +40,22 @@ The per-stage body is the REAL trunk layer (models/trunk.py
 self-attn (tied rows allowed — rows are NOT sharded here, so no psum is
 needed), cross-attention (flat or aligned), feed-forwards.
 
-Per-stage parameter and optimizer state is 1/S of the trunk; compose with
-the SP trunk (parallel/sp_trunk.py) on an inner mesh axis when a single
-microbatch's pair grid itself outgrows a chip.
+Per-stage parameter and optimizer state is 1/S of the trunk; pass
+`seq_axis` to compose with the SP trunk (parallel/sp_trunk.py) on an
+inner mesh axis when a single microbatch's pair grid itself outgrows a
+chip: the stage body becomes the sequence-parallel layer (row-sharded
+activations, all_to_all/psum/ring collectives over `seq_axis`) while the
+three pipe rings keep ppermuting over `axis_name` — one shard_map over
+both axes, no host coordination (tests/test_pipeline.py pins parity on a
+2x4 pipe x seq CPU mesh).
+
+Masks: batch-broadcast masks (shape (1, ...)) are tiled once and closed
+over — zero ring cost. PER-EXAMPLE masks (shape (b, ...) — what padded
+variable-length batches produce, reference alphafold2.py:156-161) travel
+WITH their microbatches: round-robin sharded like the inputs, dripped to
+stage 0 on the feed ring, and ppermuted stage-to-stage alongside the
+activations they mask (they skip the return ring — masks are not
+outputs).
 """
 
 from __future__ import annotations
@@ -56,6 +69,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from alphafold2_tpu.models.config import Alphafold2Config
 from alphafold2_tpu.models.reversible import stack_layers
 from alphafold2_tpu.models.trunk import trunk_layer_apply
+from alphafold2_tpu.parallel.sp_trunk import sp_layer_apply
 
 
 def _round_robin(t, M, S):
@@ -79,6 +93,7 @@ def pipeline_trunk_apply(
     microbatches: int = None,
     x_mask=None,
     msa_mask=None,
+    seq_axis: str = None,
 ):
     """Run the sequential trunk pipelined over `mesh[axis_name]`.
 
@@ -87,11 +102,16 @@ def pipeline_trunk_apply(
       x: (b, n, n, d) pair grid; m: (b, rows, cols, d) MSA or None;
       microbatches: how many microbatches to split b into (default =
         stage count; b % microbatches == 0 and microbatches % stages == 0
-        — the round-robin input/output sharding needs whole slots).
+        — the round-robin input/output sharding needs whole slots);
+      seq_axis: optional second mesh axis for PP x SP composition — the
+        stage body becomes the sequence-parallel layer (sp_trunk.py
+        sp_layer_apply) with the pair-grid row axis and MSA row axis
+        sharded over it.
 
-    Deterministic path only. Masks must be batch-broadcast (shape (1, ...))
-    or None: microbatch slicing of per-example masks would need them to
-    travel with the activations (not implemented).
+    Deterministic path only. Masks may be batch-broadcast (shape (1, ...),
+    tiled once, zero ring cost) or PER-EXAMPLE (shape (b, ...), as padded
+    variable-length batches produce): per-example masks travel with their
+    microbatches on the feed/forward rings.
 
     Returns (x, m) in global layouts, numerically identical to
     sequential_trunk_apply with the same layers.
@@ -105,9 +125,24 @@ def pipeline_trunk_apply(
             "sparse layers are not supported in the pipeline trunk (the "
             "scanned stage body is uniform); use the sequential trunk"
         )
-    for mask in (x_mask, msa_mask):
-        if mask is not None and mask.shape[0] != 1:
-            raise ValueError("pipeline masks must be batch-broadcast (b=1)")
+    seq_shards = mesh.shape[seq_axis] if seq_axis else 1
+    if seq_axis:
+        # same contracts as sp_trunk_apply, checked at the global layouts
+        if cfg.cross_attn_mode == "aligned" and x.shape[1] != x.shape[2]:
+            raise ValueError(
+                f"aligned cross-attention needs a square pair grid; got "
+                f"({x.shape[1]}, {x.shape[2]})"
+            )
+        if x.shape[1] % seq_shards != 0:
+            raise ValueError(
+                f"pair-grid rows ({x.shape[1]}) must divide by the "
+                f"'{seq_axis}' mesh axis ({seq_shards})"
+            )
+        if m is not None and m.shape[1] % seq_shards != 0:
+            raise ValueError(
+                f"MSA rows ({m.shape[1]}) must divide by the "
+                f"'{seq_axis}' mesh axis ({seq_shards})"
+            )
 
     b = x.shape[0]
     M = microbatches or stages
@@ -120,12 +155,25 @@ def pipeline_trunk_apply(
         )
     mb = b // M
 
-    # materialize broadcast masks at microbatch size so the layer body's
-    # fold-into-batch reshapes line up
-    if x_mask is not None:
-        x_mask = jnp.tile(x_mask, (mb,) + (1,) * (x_mask.ndim - 1))
-    if msa_mask is not None:
-        msa_mask = jnp.tile(msa_mask, (mb,) + (1,) * (msa_mask.ndim - 1))
+    def classify_mask(mask, what):
+        """-> (value, mode): 'none' | 'static' (tiled to mb once) |
+        'travel' (round-robin stack riding the rings)."""
+        if mask is None:
+            return None, "none"
+        if mask.shape[0] == 1:
+            return jnp.tile(mask, (mb,) + (1,) * (mask.ndim - 1)), "static"
+        if mask.shape[0] != b:
+            raise ValueError(
+                f"{what} batch dim {mask.shape[0]} must be 1 (broadcast) "
+                f"or {b} (per-example)"
+            )
+        return (
+            _round_robin(mask.reshape((M, mb) + mask.shape[1:]), M, stages),
+            "travel",
+        )
+
+    x_mask_v, x_mask_mode = classify_mask(x_mask, "x_mask")
+    msa_mask_v, msa_mask_mode = classify_mask(msa_mask, "msa_mask")
 
     has_msa = m is not None
     stacked = stack_layers(list(layers))  # (depth, ...) leaves
@@ -147,12 +195,39 @@ def pipeline_trunk_apply(
 
     stage_params = jax.tree_util.tree_map(reshape_stage, stacked)
 
+    def seq_sharded(spec_prefix, row_axis_pos):
+        """PartitionSpec with the row axis additionally sharded over
+        seq_axis (activation/mask row axes live after the stack dims)."""
+        if not seq_axis:
+            return P(*spec_prefix)
+        pad = (None,) * (row_axis_pos - len(spec_prefix))
+        return P(*spec_prefix, *pad, seq_axis)
+
+    # activation stacks (S, M/S, mb, ROWS, ...): rows at index 3
+    act_spec = seq_sharded((axis_name,), 3)
+    # static masks (mb, ROWS, ...): rows at index 1; travel stacks like acts
+    mask_spec = {
+        "none": None,
+        "static": seq_sharded((), 1) if seq_axis else None,
+        "travel": act_spec,
+    }
+
+    # static masks WITHOUT seq sharding are closed over (replicated);
+    # everything else enters as a shard_map arg with a real spec
+    def mask_arg(value, mode):
+        return value if mask_spec[mode] is not None else None
+
+    x_mask_static = x_mask_v if x_mask_mode == "static" else None
+    msa_mask_static = msa_mask_v if msa_mask_mode == "static" else None
+
     in_specs = (
         jax.tree_util.tree_map(lambda _: P(axis_name), stage_params),
-        P(axis_name),  # each stage holds only its M/S input slots
-        P(axis_name) if has_msa else None,
+        act_spec,  # each stage holds only its M/S input slots
+        act_spec if has_msa else None,
+        mask_spec[x_mask_mode],
+        mask_spec[msa_mask_mode],
     )
-    out_specs = (P(axis_name), P(axis_name) if has_msa else None)
+    out_specs = (act_spec, act_spec if has_msa else None)
 
     @functools.partial(
         jax.shard_map,
@@ -161,23 +236,43 @@ def pipeline_trunk_apply(
         out_specs=out_specs,
         check_vma=False,
     )
-    def run(sp, xs, ms):
+    def run(sp, xs, ms, xmk, mmk):
         # sp leaves: (1, per_stage, ...); xs: (1, M/S, mb, ...)
         my_layers = jax.tree_util.tree_map(lambda t: t[0], sp)
         xs = xs[0]
         ms = ms[0] if has_msa else None
+        # mask shard_map args: travel stacks carry the sharded stage axis;
+        # static-with-seq args arrive at local row shards, ready to use
+        xmk = xmk[0] if x_mask_mode == "travel" else xmk
+        mmk = mmk[0] if msa_mask_mode == "travel" else mmk
         stage = jax.lax.axis_index(axis_name)
         is_first = stage == 0
         is_last = stage == stages - 1
         fwd_perm = [(s, (s + 1) % stages) for s in range(stages)]
         back_perm = [(s, (s - 1) % stages) for s in range(stages)]
 
-        def apply_block(x_act, m_act):
+        def static_mask(arg, closure, mode):
+            if mode == "static":
+                return arg if arg is not None else closure
+            return None  # 'none', or 'travel' (threaded per tick)
+
+        x_mask_const = static_mask(xmk, x_mask_static, x_mask_mode)
+        msa_mask_const = static_mask(mmk, msa_mask_static, msa_mask_mode)
+
+        def apply_block(x_act, m_act, x_mk, m_mk):
+            xm = x_mk if x_mask_mode == "travel" else x_mask_const
+            mm = m_mk if msa_mask_mode == "travel" else msa_mask_const
+
             def body(carry, lp):
                 cx, cm = carry
-                cx, cm = trunk_layer_apply(
-                    lp, cfg, cx, cm, x_mask=x_mask, msa_mask=msa_mask
-                )
+                if seq_axis:
+                    cx, cm = sp_layer_apply(
+                        lp, cfg, cx, cm, xm, mm, seq_axis
+                    )
+                else:
+                    cx, cm = trunk_layer_apply(
+                        lp, cfg, cx, cm, x_mask=xm, msa_mask=mm
+                    )
                 return (cx, cm), None
 
             (x_act, m_act), _ = jax.lax.scan(
@@ -189,6 +284,11 @@ def pipeline_trunk_apply(
             return jnp.zeros((mb,) + t.shape[2:], t.dtype)
 
         x0, m0 = zeros_like_mb(xs), zeros_like_mb(ms) if has_msa else None
+        # traveling-mask ring registers (garbage until the first real
+        # microbatch's mask arrives — garbage ticks' outputs are never
+        # harvested, so an all-False mask is harmless)
+        xmk0 = zeros_like_mb(xmk) if x_mask_mode == "travel" else None
+        mmk0 = zeros_like_mb(mmk) if msa_mask_mode == "travel" else None
         out_x = jnp.zeros_like(xs)
         out_m = jnp.zeros_like(ms) if has_msa else None
         # return-ring register: payload + the microbatch index it carries
@@ -224,7 +324,7 @@ def pipeline_trunk_apply(
 
         def tick(carry, t):
             (x_act, m_act, out_x, out_m, xs, ms, reg_x, reg_m,
-             reg_idx) = carry
+             reg_idx, xmk_act, mmk_act, xmk_s, mmk_s) = carry
 
             # --- feed: stage 0 consumes the drip register's current slot.
             # During cycle k = t//S, slot k has rotated (t mod S) hops, so
@@ -232,8 +332,13 @@ def pipeline_trunk_apply(
             slot = jnp.minimum(t // stages, slots - 1)
             x_in = jnp.where(is_first, xs[slot], x_act)
             m_in = jnp.where(is_first, ms[slot], m_act) if has_msa else None
+            # traveling masks feed exactly like their activations
+            xmk_in = (jnp.where(is_first, xmk_s[slot], xmk_act)
+                      if x_mask_mode == "travel" else None)
+            mmk_in = (jnp.where(is_first, mmk_s[slot], mmk_act)
+                      if msa_mask_mode == "travel" else None)
 
-            x_act, m_act = apply_block(x_in, m_in)
+            x_act, m_act = apply_block(x_in, m_in, xmk_in, mmk_in)
 
             # --- the last stage's finished microbatch enters the return
             # ring (overwriting a payload that must already be harvested —
@@ -261,6 +366,13 @@ def pipeline_trunk_apply(
                 )
                 m_act, reg_m = both[0], both[1]
             reg_idx = jax.lax.ppermute(reg_idx, axis_name, fwd_perm)
+            # traveling masks follow their activations forward: the mask
+            # THIS stage just used (xmk_in) is what the next stage needs
+            # for the same microbatch
+            if x_mask_mode == "travel":
+                xmk_act = jax.lax.ppermute(xmk_in, axis_name, fwd_perm)
+            if msa_mask_mode == "travel":
+                mmk_act = jax.lax.ppermute(mmk_in, axis_name, fwd_perm)
             # feed drip: the consumption-cycle slot moves one hop toward
             # stage 0 (data past stage 0 becomes garbage, never re-read)
             xs = xs.at[slot].set(
@@ -270,8 +382,16 @@ def pipeline_trunk_apply(
                 ms = ms.at[slot].set(
                     jax.lax.ppermute(ms[slot], axis_name, back_perm)
                 )
+            if x_mask_mode == "travel":
+                xmk_s = xmk_s.at[slot].set(
+                    jax.lax.ppermute(xmk_s[slot], axis_name, back_perm)
+                )
+            if msa_mask_mode == "travel":
+                mmk_s = mmk_s.at[slot].set(
+                    jax.lax.ppermute(mmk_s[slot], axis_name, back_perm)
+                )
             return (x_act, m_act, out_x, out_m, xs, ms, reg_x, reg_m,
-                    reg_idx), None
+                    reg_idx, xmk_act, mmk_act, xmk_s, mmk_s), None
 
         def drain(carry, _):
             """Return-ring rides can outlast the compute schedule by up to
@@ -282,8 +402,12 @@ def pipeline_trunk_apply(
             reg_x, reg_m, reg_idx = rotate_reg(reg_x, reg_m, reg_idx)
             return (out_x, out_m, reg_x, reg_m, reg_idx), None
 
-        carry0 = (x0, m0, out_x, out_m, xs, ms, x0, m0, reg_idx0)
-        (x_act, m_act, out_x, out_m, xs, ms, reg_x, reg_m, reg_idx), _ = (
+        carry0 = (x0, m0, out_x, out_m, xs, ms, x0, m0, reg_idx0,
+                  xmk0, mmk0,
+                  xmk if x_mask_mode == "travel" else None,
+                  mmk if msa_mask_mode == "travel" else None)
+        (x_act, m_act, out_x, out_m, xs, ms, reg_x, reg_m, reg_idx,
+         *_mask_state), _ = (
             jax.lax.scan(tick, carry0, jnp.arange(ticks))
         )
         drain_ticks = max(0, stages - 2)
@@ -298,7 +422,11 @@ def pipeline_trunk_apply(
         out_m = out_m[None] if has_msa else None
         return out_x, out_m
 
-    out_x, out_m = run(stage_params, xs, ms)
+    out_x, out_m = run(
+        stage_params, xs, ms,
+        mask_arg(x_mask_v, x_mask_mode),
+        mask_arg(msa_mask_v, msa_mask_mode),
+    )
     out_x = _un_round_robin(out_x, M).reshape((b,) + x.shape[1:])
     if has_msa:
         out_m = _un_round_robin(out_m, M).reshape((b,) + m.shape[1:])

@@ -331,8 +331,11 @@ def _aligned_ring_cross(params, cfg: Alphafold2Config, m_local, x_local, msa_mas
     return jnp.swapaxes(out.reshape(b, c, r_loc, d), 1, 2)
 
 
-def _sp_layer(layer, cfg: Alphafold2Config, x, m, x_mask, msa_mask, axis_name):
+def sp_layer_apply(layer, cfg: Alphafold2Config, x, m, x_mask, msa_mask, axis_name):
     """One trunk layer on resident shards (deterministic path).
+
+    Public within the package: the pipeline trunk (parallel/pipeline.py)
+    uses it as the per-stage body when composing PP x SP.
 
     x: (b, n_local, n, d) pair rows; m: (b, r_local, c, d) MSA rows.
     Mirrors models/trunk.py sequential order: pair self -> msa self ->
@@ -456,7 +459,7 @@ def sp_trunk_apply(
     )
     def run(x, m, x_mask, msa_mask):
         for layer in layers:
-            x, m = _sp_layer(layer, cfg, x, m, x_mask, msa_mask, axis_name)
+            x, m = sp_layer_apply(layer, cfg, x, m, x_mask, msa_mask, axis_name)
         return x, m
 
     return run(x, m, x_mask, msa_mask)
