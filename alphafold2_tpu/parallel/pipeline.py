@@ -205,20 +205,13 @@ def pipeline_trunk_apply(
 
     # activation stacks (S, M/S, mb, ROWS, ...): rows at index 3
     act_spec = seq_sharded((axis_name,), 3)
-    # static masks (mb, ROWS, ...): rows at index 1; travel stacks like acts
+    # static masks (mb, ROWS, ...): rows at index 1 — P() (replicated)
+    # without seq_axis, row-sharded with it; travel stacks ride like acts
     mask_spec = {
         "none": None,
-        "static": seq_sharded((), 1) if seq_axis else None,
+        "static": seq_sharded((), 1),
         "travel": act_spec,
     }
-
-    # static masks WITHOUT seq sharding are closed over (replicated);
-    # everything else enters as a shard_map arg with a real spec
-    def mask_arg(value, mode):
-        return value if mask_spec[mode] is not None else None
-
-    x_mask_static = x_mask_v if x_mask_mode == "static" else None
-    msa_mask_static = msa_mask_v if msa_mask_mode == "static" else None
 
     in_specs = (
         jax.tree_util.tree_map(lambda _: P(axis_name), stage_params),
@@ -242,7 +235,8 @@ def pipeline_trunk_apply(
         xs = xs[0]
         ms = ms[0] if has_msa else None
         # mask shard_map args: travel stacks carry the sharded stage axis;
-        # static-with-seq args arrive at local row shards, ready to use
+        # static args arrive replicated (or at local row shards under
+        # seq_axis), ready to use
         xmk = xmk[0] if x_mask_mode == "travel" else xmk
         mmk = mmk[0] if msa_mask_mode == "travel" else mmk
         stage = jax.lax.axis_index(axis_name)
@@ -251,13 +245,8 @@ def pipeline_trunk_apply(
         fwd_perm = [(s, (s + 1) % stages) for s in range(stages)]
         back_perm = [(s, (s - 1) % stages) for s in range(stages)]
 
-        def static_mask(arg, closure, mode):
-            if mode == "static":
-                return arg if arg is not None else closure
-            return None  # 'none', or 'travel' (threaded per tick)
-
-        x_mask_const = static_mask(xmk, x_mask_static, x_mask_mode)
-        msa_mask_const = static_mask(mmk, msa_mask_static, msa_mask_mode)
+        x_mask_const = xmk if x_mask_mode == "static" else None
+        msa_mask_const = mmk if msa_mask_mode == "static" else None
 
         def apply_block(x_act, m_act, x_mk, m_mk):
             xm = x_mk if x_mask_mode == "travel" else x_mask_const
@@ -422,11 +411,7 @@ def pipeline_trunk_apply(
         out_m = out_m[None] if has_msa else None
         return out_x, out_m
 
-    out_x, out_m = run(
-        stage_params, xs, ms,
-        mask_arg(x_mask_v, x_mask_mode),
-        mask_arg(msa_mask_v, msa_mask_mode),
-    )
+    out_x, out_m = run(stage_params, xs, ms, x_mask_v, msa_mask_v)
     out_x = _un_round_robin(out_x, M).reshape((b,) + x.shape[1:])
     if has_msa:
         out_m = _un_round_robin(out_m, M).reshape((b,) + m.shape[1:])

@@ -96,22 +96,31 @@ def make_batches(proteins, steps, crop=CROP, seed=42):
     return batches
 
 
-HELDOUT_START = 200  # window the training stream never uses
+# Fixed eval window at residues [200, 328) of proteins[0] (1h22). NOTE:
+# this is NOT a held-out window — training crops start uniformly in
+# [0, len-crop] of the same protein, so pairs inside it are trained on
+# constantly; the metric is train-set recall (the model memorizing real
+# structure it saw), not generalization. Round 3 mislabeled it; the
+# honest zero-overlap eval (train on 4k77 only, evaluate on 1h22, a
+# different protein) lives in scripts/generalization_run.py.
+HELDOUT_START = 200
 
 
 def heldout_distance_eval(params, cfg, proteins, crop=CROP,
-                          start=HELDOUT_START):
-    """Held-out distance-map metrics on proteins[0]: (corr, mae, true_d,
-    pred_d) over the distogram's expressible 2-20 A range. ONE definition
-    shared by the artifact renderer and the extended-training eval trace
-    so they measure the same quantity."""
+                          start=HELDOUT_START, protein_index=0):
+    """Distance-map metrics on proteins[protein_index]: (corr, mae,
+    true_d, pred_d) over the distogram's expressible 2-20 A range. ONE
+    definition shared by the artifact renderer, the extended-training
+    eval trace, and the generalization run so they measure the same
+    quantity. Whether the window is held out depends on the TRAINING
+    stream the caller used — see the HELDOUT_START note above."""
     import jax
     import jax.numpy as jnp
 
     from alphafold2_tpu.geometry import center_distogram
     from alphafold2_tpu.models import alphafold2_apply
 
-    name, tokens, coords = proteins[0]
+    name, tokens, coords = proteins[protein_index]
     seq = tokens[None, start:start + crop].astype(np.int32)
     true_d = np.linalg.norm(
         coords[start:start + crop, None] - coords[None, start:start + crop],
