@@ -1,0 +1,23 @@
+"""Shared chart style for the committed artifacts (dataviz method).
+
+One palette + one axis-styling helper so the loss-curve and
+generalization artifacts stay one visual system: categorical slots 1/2
+(blue/orange) in fixed order, neutral text/grid grays, no rainbow.
+Slot meaning is per-chart (documented at each call site); the COLORS are
+the shared contract.
+"""
+
+SERIES_1 = "#2a78d6"  # categorical slot 1
+SERIES_2 = "#eb6834"  # categorical slot 2
+TEXT = "#40403e"
+GRID = "#e8e8e4"
+
+
+def style_axes(ax):
+    """The shared spine/grid/tick treatment every artifact chart uses."""
+    ax.grid(color=GRID, lw=0.6)
+    for s in ("top", "right"):
+        ax.spines[s].set_visible(False)
+    for s in ("left", "bottom"):
+        ax.spines[s].set_color(GRID)
+    ax.tick_params(colors=TEXT)

@@ -24,10 +24,11 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "docs", "losscurve")
 
-SERIES_1 = "#2a78d6"  # categorical slot 1: held-in (train-set recall)
-SERIES_2 = "#eb6834"  # categorical slot 2: held-out (generalization)
-TEXT = "#40403e"
-GRID = "#e8e8e4"
+# slot 1 = held-in (train-set recall), slot 2 = held-out
+# (generalization) (shared palette: scripts/chartstyle.py)
+import sys as _sys
+_sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from chartstyle import GRID, SERIES_1, SERIES_2, TEXT, style_axes
 
 
 def main():
@@ -68,12 +69,7 @@ def main():
         "(the model never sees any 1h22 residue at any step)",
         color=TEXT, fontsize=10,
     )
-    ax.grid(color=GRID, lw=0.6)
-    for s in ("top", "right"):
-        ax.spines[s].set_visible(False)
-    for s in ("left", "bottom"):
-        ax.spines[s].set_color(GRID)
-    ax.tick_params(colors=TEXT)
+    style_axes(ax)
     ax.legend(frameon=False, fontsize=8, labelcolor=TEXT, loc="lower right")
     fig.tight_layout()
     fig.savefig(os.path.join(OUT, "generalization.png"))

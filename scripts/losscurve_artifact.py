@@ -34,10 +34,9 @@ sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 OUT = os.path.join(REPO, "docs", "losscurve")
 
-SERIES_1 = "#2a78d6"  # categorical slot 1: the reference
-SERIES_2 = "#eb6834"  # categorical slot 2: alphafold2_tpu
-TEXT = "#40403e"
-GRID = "#e8e8e4"
+# slot 1 = the reference, slot 2 = alphafold2_tpu (shared palette:
+# scripts/chartstyle.py)
+from chartstyle import GRID, SERIES_1, SERIES_2, TEXT, style_axes
 
 
 def main(steps=200):
@@ -71,12 +70,7 @@ def main(steps=200):
         "identical init, data, and Adam(3e-4)",
         color=TEXT, fontsize=10,
     )
-    ax.grid(color=GRID, lw=0.6)
-    for s in ("top", "right"):
-        ax.spines[s].set_visible(False)
-    for s in ("left", "bottom"):
-        ax.spines[s].set_color(GRID)
-    ax.tick_params(colors=TEXT)
+    style_axes(ax)
     ax.legend(frameon=False, fontsize=8, labelcolor=TEXT)
     fig.tight_layout()
     fig.savefig(os.path.join(OUT, "losscurve.png"))
@@ -199,12 +193,7 @@ def main(steps=200):
                      "(2-20 Å; training crops overlap it — recall, not "
                      "generalization)",
                      color=TEXT, fontsize=10)
-        ax.grid(color=GRID, lw=0.6)
-        for s in ("top", "right"):
-            ax.spines[s].set_visible(False)
-        for s in ("left", "bottom"):
-            ax.spines[s].set_color(GRID)
-        ax.tick_params(colors=TEXT)
+        style_axes(ax)
         fig.tight_layout()
         fig.savefig(os.path.join(OUT, "heldout_signal.png"))
         plt.close(fig)
