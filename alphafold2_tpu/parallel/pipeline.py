@@ -35,6 +35,12 @@ Bubble fraction is (S-1)/T — the standard GPipe cost; pick M >= 4*S to
 amortize. Parity vs the replicated sequential trunk and the O(batch/S)
 buffer bound are tested on the 8-device CPU mesh (tests/test_pipeline.py).
 
+TRAINABLE: autodiff through the schedule is exact — ppermute transposes
+to the reverse permutation and the tick scan to the reverse-order scan,
+so jax.grad yields a correctly pipelined BACKWARD schedule for free
+(gradient parity vs the sequential trunk pinned in tests, both plain
+and composed with SP).
+
 The per-stage body is the REAL trunk layer (models/trunk.py
 `trunk_layer_apply`, deterministic path): pair axial self-attn, MSA axial
 self-attn (tied rows allowed — rows are NOT sharded here, so no psum is

@@ -207,6 +207,62 @@ def test_pipeline_sp_with_masks():
         np.testing.assert_allclose(g[mk], w[mk], atol=1e-5)
 
 
+def test_pipeline_gradient_matches_sequential():
+    """Training through the pipeline: autodiff of the shard_map ring
+    schedule (ppermute transposes to the reverse permutation, scan to the
+    reverse-order scan) must reproduce the sequential trunk's gradients —
+    the backward is itself a pipelined schedule, for free."""
+    if len(jax.devices()) < N_DEV:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = Alphafold2Config(dim=16, depth=2, heads=2, dim_head=8,
+                           max_seq_len=32)
+    layers, x, m = _setup(cfg, b=2, n=8, rows=3, cols=8)
+    mesh = make_mesh({"pipe": 2})
+
+    def loss(apply_fn):
+        def f(ls):
+            ox, om = apply_fn(ls)
+            return jnp.mean(jnp.square(ox)) + jnp.mean(jnp.square(om))
+        return f
+
+    gp = jax.jit(jax.grad(loss(
+        lambda ls: pipeline_trunk_apply(ls, cfg, x, m, mesh,
+                                        microbatches=2))))(layers)
+    gs = jax.jit(jax.grad(loss(
+        lambda ls: sequential_trunk_apply(ls, cfg, x, m))))(layers)
+    for a, b in zip(jax.tree_util.tree_leaves(gp),
+                    jax.tree_util.tree_leaves(gs)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@pytest.mark.slow
+def test_pipeline_sp_gradient_matches_sequential():
+    """PP x SP gradients: the composed shard_map (pipe rings + seq
+    collectives) differentiates to the sequential trunk's gradients."""
+    if len(jax.devices()) < N_DEV:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = Alphafold2Config(dim=16, depth=2, heads=2, dim_head=8,
+                           max_seq_len=32)
+    layers, x, m = _setup(cfg, b=2, n=8, rows=4, cols=8)
+    mesh = make_mesh({"pipe": 2, "seq": 4})
+
+    def loss(apply_fn):
+        def f(ls):
+            ox, om = apply_fn(ls)
+            return jnp.mean(jnp.square(ox)) + jnp.mean(jnp.square(om))
+        return f
+
+    gp = jax.jit(jax.grad(loss(
+        lambda ls: pipeline_trunk_apply(ls, cfg, x, m, mesh,
+                                        microbatches=2,
+                                        seq_axis="seq"))))(layers)
+    gs = jax.jit(jax.grad(loss(
+        lambda ls: sequential_trunk_apply(ls, cfg, x, m))))(layers)
+    for a, b in zip(jax.tree_util.tree_leaves(gp),
+                    jax.tree_util.tree_leaves(gs)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_pipeline_validates_shapes():
     if len(jax.devices()) < N_DEV:
         pytest.skip("needs the 8-device CPU mesh")
