@@ -29,7 +29,10 @@ from alphafold2_tpu.parallel.sequence import (
     ulysses_attention,
 )
 from alphafold2_tpu.parallel.sp_trunk import alphafold2_apply_sp, sp_trunk_apply
-from alphafold2_tpu.parallel.pipeline import pipeline_trunk_apply
+from alphafold2_tpu.parallel.pipeline import (
+    alphafold2_apply_pp,
+    pipeline_trunk_apply,
+)
 from alphafold2_tpu.parallel.distributed import (
     global_mesh,
     initialize_from_env,
@@ -39,6 +42,7 @@ from alphafold2_tpu.parallel.distributed import (
 __all__ = [
     "sp_trunk_apply",
     "alphafold2_apply_sp",
+    "alphafold2_apply_pp",
     "pipeline_trunk_apply",
     "initialize_from_env",
     "global_mesh",

@@ -422,3 +422,63 @@ def pipeline_trunk_apply(
     if has_msa:
         out_m = _un_round_robin(out_m, M).reshape((b,) + m.shape[1:])
     return out_x, out_m
+
+
+def alphafold2_apply_pp(
+    params,
+    cfg: Alphafold2Config,
+    seq,
+    msa,
+    mesh: Mesh,
+    *,
+    axis_name: str = "pipe",
+    seq_axis: str = None,
+    microbatches: int = None,
+    mask=None,
+    msa_mask=None,
+    templates=None,
+    templates_mask=None,
+    embedds=None,
+):
+    """FULL-model forward with the trunk pipelined over `mesh[axis_name]`
+    (optionally composed with sequence parallelism over `seq_axis`).
+
+    Embeddings, the (optional) template tower, and the distogram head run
+    replicated — a negligible share of the FLOPs; the trunk stages over
+    the pipe axis via the models/alphafold2.py `trunk_fn` hook. The
+    front's masks are PER-EXAMPLE, so this integration rides the
+    traveling-mask rings. Deterministic path (pipeline contract); parity
+    with the replicated `alphafold2_apply` is pinned full-model on the
+    8-device mesh (tests/test_pipeline.py).
+    """
+    from alphafold2_tpu.models.alphafold2 import alphafold2_apply
+
+    if cfg.reversible:
+        raise ValueError(
+            "the pipeline trunk uses the sequential layer list; set "
+            "reversible=False (activation memory scales O(batch/S) via "
+            "the schedule instead)"
+        )
+    if embedds is not None and seq_axis is not None:
+        # same contract as alphafold2_apply_sp: the embedds-substitute
+        # stream has no row axis to shard, so the SP layer body cannot
+        # run on it — plain PP (seq_axis=None) handles embedds fine
+        raise ValueError(
+            "embedds is not supported with seq_axis (the substitute MSA "
+            "stream has no row axis to shard); use seq_axis=None"
+        )
+
+    def trunk_fn(layers, cfg_, x, m, x_mask, m_mask, rng):
+        del rng  # deterministic path (pipeline_trunk_apply contract)
+        return pipeline_trunk_apply(
+            layers, cfg_, x, m, mesh,
+            axis_name=axis_name, microbatches=microbatches,
+            x_mask=x_mask, msa_mask=m_mask, seq_axis=seq_axis,
+        )
+
+    return alphafold2_apply(
+        params, cfg, seq, msa,
+        mask=mask, msa_mask=msa_mask,
+        templates=templates, templates_mask=templates_mask,
+        embedds=embedds, trunk_fn=trunk_fn,
+    )
