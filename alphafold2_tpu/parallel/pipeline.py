@@ -44,7 +44,11 @@ and composed with SP).
 The per-stage body is the REAL trunk layer (models/trunk.py
 `trunk_layer_apply`, deterministic path): pair axial self-attn, MSA axial
 self-attn (tied rows allowed — rows are NOT sharded here, so no psum is
-needed), cross-attention (flat or aligned), feed-forwards.
+needed), cross-attention (flat or aligned), feed-forwards. Interleaved
+block-sparse layers (reference BASELINE config 3) are supported: the
+sparse flag rides as per-stage DATA (an SPMD stage program cannot branch
+on the stage index in Python), with `lax.cond` selecting the sparse or
+dense pair self-attention body per scanned layer.
 
 Per-stage parameter and optimizer state is 1/S of the trunk; pass
 `seq_axis` to compose with the SP trunk (parallel/sp_trunk.py) on an
@@ -126,10 +130,25 @@ def pipeline_trunk_apply(
     depth = len(layers)
     if depth % stages != 0:
         raise ValueError(f"depth {depth} must divide into {stages} stages")
-    if any(cfg.layer_sparse):
+    # interleaved block-sparse layers (reference BASELINE config 3): the
+    # SPMD stage body must be one program for every stage, so the sparse
+    # flag becomes DATA — a per-stage flag vector scanned with the layer
+    # params, lax.cond selecting the sparse or dense pair self-attention
+    # body per layer. SP composition keeps the rejection (the sparse
+    # layout is defined over the full row axis; sp_trunk_apply has the
+    # same contract).
+    sparse_flags = tuple(cfg.layer_sparse)
+    has_sparse = any(sparse_flags)
+    if has_sparse and len(sparse_flags) != depth:
+        # validate BEFORE any use: a silent [:depth] slice could flip
+        # which layers are sparse (or reject/dual-compile spuriously)
         raise ValueError(
-            "sparse layers are not supported in the pipeline trunk (the "
-            "scanned stage body is uniform); use the sequential trunk"
+            f"layer_sparse length {len(sparse_flags)} != depth {depth}"
+        )
+    if has_sparse and seq_axis:
+        raise ValueError(
+            "sparse layers are not sequence-parallel (the block layout "
+            "spans the full row axis); use seq_axis=None"
         )
     seq_shards = mesh.shape[seq_axis] if seq_axis else 1
     if seq_axis:
@@ -200,6 +219,14 @@ def pipeline_trunk_apply(
         return t.reshape((stages, per_stage) + t.shape[1:])
 
     stage_params = jax.tree_util.tree_map(reshape_stage, stacked)
+    sparse_fn = None
+    stage_flags = None
+    if has_sparse:
+        from alphafold2_tpu.models.trunk import make_sparse_axial_fn
+
+        sparse_fn = make_sparse_axial_fn(cfg)
+        stage_flags = jnp.asarray(sparse_flags, bool).reshape(
+            stages, per_stage)
 
     def seq_sharded(spec_prefix, row_axis_pos):
         """PartitionSpec with the row axis additionally sharded over
@@ -225,6 +252,7 @@ def pipeline_trunk_apply(
         act_spec if has_msa else None,
         mask_spec[x_mask_mode],
         mask_spec[msa_mask_mode],
+        P(axis_name) if has_sparse else None,
     )
     out_specs = (act_spec, act_spec if has_msa else None)
 
@@ -235,11 +263,12 @@ def pipeline_trunk_apply(
         out_specs=out_specs,
         check_vma=False,
     )
-    def run(sp, xs, ms, xmk, mmk):
+    def run(sp, xs, ms, xmk, mmk, sflags):
         # sp leaves: (1, per_stage, ...); xs: (1, M/S, mb, ...)
         my_layers = jax.tree_util.tree_map(lambda t: t[0], sp)
         xs = xs[0]
         ms = ms[0] if has_msa else None
+        my_flags = sflags[0] if has_sparse else None  # (per_stage,)
         # mask shard_map args: travel stacks carry the sharded stage axis;
         # static args arrive replicated (or at local row shards under
         # seq_axis), ready to use
@@ -258,20 +287,35 @@ def pipeline_trunk_apply(
             xm = x_mk if x_mask_mode == "travel" else x_mask_const
             mm = m_mk if msa_mask_mode == "travel" else msa_mask_const
 
-            def body(carry, lp):
+            def body(carry, scanned):
                 cx, cm = carry
-                if seq_axis:
+                if has_sparse:
+                    lp, flag = scanned
+                    # the flag is data (stages differ), so both bodies
+                    # compile and lax.cond selects per layer at runtime
+                    cx, cm = jax.lax.cond(
+                        flag,
+                        lambda: trunk_layer_apply(
+                            lp, cfg, cx, cm, x_mask=xm, msa_mask=mm,
+                            sparse_fn=sparse_fn,
+                        ),
+                        lambda: trunk_layer_apply(
+                            lp, cfg, cx, cm, x_mask=xm, msa_mask=mm
+                        ),
+                    )
+                elif seq_axis:
                     cx, cm = sp_layer_apply(
-                        lp, cfg, cx, cm, xm, mm, seq_axis
+                        scanned, cfg, cx, cm, xm, mm, seq_axis
                     )
                 else:
                     cx, cm = trunk_layer_apply(
-                        lp, cfg, cx, cm, x_mask=xm, msa_mask=mm
+                        scanned, cfg, cx, cm, x_mask=xm, msa_mask=mm
                     )
                 return (cx, cm), None
 
             (x_act, m_act), _ = jax.lax.scan(
-                body, (x_act, m_act), my_layers
+                body, (x_act, m_act),
+                (my_layers, my_flags) if has_sparse else my_layers,
             )
             return x_act, m_act
 
@@ -417,7 +461,8 @@ def pipeline_trunk_apply(
         out_m = out_m[None] if has_msa else None
         return out_x, out_m
 
-    out_x, out_m = run(stage_params, xs, ms, x_mask_v, msa_mask_v)
+    out_x, out_m = run(stage_params, xs, ms, x_mask_v, msa_mask_v,
+                       stage_flags)
     out_x = _un_round_robin(out_x, M).reshape((b,) + x.shape[1:])
     if has_msa:
         out_m = _un_round_robin(out_m, M).reshape((b,) + m.shape[1:])

@@ -110,13 +110,13 @@ step:
 What transfers from a single 280-residue training structure is generic
 protein geometry — sequence-separation-dependent distance priors,
 secondary-structure-scale contact patterns — which is exactly what a
-depth-1 model can express. Notably the held-in and held-out curves
-track each other closely ({'no memorization gap: the model underfits '
- 'its single training protein and everything it learns is portable'
+depth-1 model can express. {'Notably the held-in and held-out curves '
+ 'track each other closely — no memorization gap: the model underfits '
+ 'its single training protein and everything it learns is portable.'
  if last['gen_1h22_mean_corr'] >= last['heldin_4k77_corr'] - 0.05
- else 'the held-in curve above the held-out one is the memorization '
- 'gap'}). The number is reported as measured, whatever it is
-(VERDICT r3 next #4).
+ else 'The held-in curve sitting above the held-out one is the '
+ 'memorization gap.'} The number is reported as measured, whatever it
+is (VERDICT r3 next #4).
 
 Regenerate: `python scripts/generalization_run.py --steps
 {last['step']}`, then `python scripts/generalization_artifact.py`.
