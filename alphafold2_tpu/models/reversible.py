@@ -69,6 +69,17 @@ def stack_layers(layers):
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
 
 
+def unstack_layers(stacked):
+    """(depth, ...) stacked pytree -> per-layer params list, the inverse
+    of `stack_layers` (e.g. to predict with a pipeline-sharded train
+    state's trunk through the sequential apply)."""
+    depth = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    return [
+        jax.tree_util.tree_map(lambda t, i=i: t[i], stacked)
+        for i in range(depth)
+    ]
+
+
 # --- the four block functions, parameter-explicit for jax.vjp ---------------
 
 

@@ -16,6 +16,11 @@ from alphafold2_tpu.parallel.sharding import (
 from alphafold2_tpu.parallel.train import (
     make_sharded_train_step,
     make_sp_train_step,
+    make_pp_train_step,
+    pp_distogram_loss_fn,
+    pp_e2e_loss_fn,
+    pp_model_apply,
+    pp_train_state_init,
     sp_e2e_loss_fn,
     sp_model_apply,
     sp_distogram_loss_fn,
@@ -61,6 +66,11 @@ __all__ = [
     "replicated",
     "make_sharded_train_step",
     "make_sp_train_step",
+    "make_pp_train_step",
+    "pp_distogram_loss_fn",
+    "pp_e2e_loss_fn",
+    "pp_model_apply",
+    "pp_train_state_init",
     "sp_e2e_loss_fn",
     "sp_model_apply",
     "sp_distogram_loss_fn",

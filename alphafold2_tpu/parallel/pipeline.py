@@ -127,7 +127,16 @@ def pipeline_trunk_apply(
     sequential_trunk_apply with the same layers.
     """
     stages = mesh.shape[axis_name]
-    depth = len(layers)
+    if isinstance(layers, (list, tuple)):
+        depth = len(layers)
+        stacked = stack_layers(list(layers))  # (depth, ...) leaves
+    else:
+        # pre-stacked (depth, ...) pytree — the layout
+        # pp_train_state_init stores so the persistent params/optimizer
+        # state live sharded 1/S over the pipe axis (a per-step
+        # jnp.stack of replicated layer lists would defeat that)
+        stacked = layers
+        depth = jax.tree_util.tree_leaves(stacked)[0].shape[0]
     if depth % stages != 0:
         raise ValueError(f"depth {depth} must divide into {stages} stages")
     # interleaved block-sparse layers (reference BASELINE config 3): the
@@ -201,7 +210,6 @@ def pipeline_trunk_apply(
     msa_mask_v, msa_mask_mode = classify_mask(msa_mask, "msa_mask")
 
     has_msa = m is not None
-    stacked = stack_layers(list(layers))  # (depth, ...) leaves
     per_stage = depth // stages
     ticks = M + stages - 1
     slots = M // stages

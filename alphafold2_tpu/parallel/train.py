@@ -150,6 +150,184 @@ def sp_e2e_loss_fn(mesh: Mesh, axis_name: str = "seq"):
     return make_e2e_loss_fn(sp_model_apply(mesh, axis_name))
 
 
+def pp_model_apply(mesh: Mesh, axis_name: str = "pipe", *,
+                   seq_axis: str = None, microbatches: int = None):
+    """alphafold2_apply-signature adapter over the PIPELINED trunk — the
+    public hook for running any alphafold2_apply consumer with the trunk
+    staged over `mesh[axis_name]` (optionally composed with sequence
+    parallelism over `seq_axis`). The batch must divide into the
+    microbatch count (the pipeline schedules over batch microbatches, so
+    per-step batch >= stage count; contrast sp_model_apply, which shards
+    the GRID and serves batch 1)."""
+    from alphafold2_tpu.parallel.pipeline import alphafold2_apply_pp
+
+    def apply_fn(params, cfg, seq, msa, *, mask=None, msa_mask=None,
+                 embedds=None, rng=None):
+        if cfg.attn_dropout > 0.0 or cfg.ff_dropout > 0.0:
+            # rng is dropped below (pipeline_trunk_apply is
+            # deterministic); with dropout configured that would train a
+            # silently-different model than the replicated path
+            raise ValueError(
+                "the pipelined trunk is deterministic; set "
+                "attn_dropout=0 and ff_dropout=0 (or train replicated)"
+            )
+        del rng  # deterministic path (pipeline_trunk_apply contract)
+        return alphafold2_apply_pp(
+            params, cfg, seq, msa, mesh,
+            axis_name=axis_name, seq_axis=seq_axis,
+            microbatches=microbatches, mask=mask, msa_mask=msa_mask,
+            embedds=embedds,
+        )
+
+    return apply_fn
+
+
+def pp_distogram_loss_fn(mesh: Mesh, axis_name: str = "pipe", *,
+                         seq_axis: str = None, microbatches: int = None):
+    """Distogram loss with the trunk PIPELINED over `mesh[axis_name]` —
+    the depth-48 single-step alternative to the reversible trunk:
+    activations stay O(batch/S) in flight and autodiff of the ring
+    schedule yields the pipelined backward (gradient parity in
+    tests/test_pipeline.py). For params + optimizer state at 1/S per
+    stage, init with pp_train_state_init and pass its shardings to
+    make_pp_train_step."""
+    from alphafold2_tpu.training.harness import make_distogram_loss_fn
+
+    return make_distogram_loss_fn(pp_model_apply(
+        mesh, axis_name, seq_axis=seq_axis, microbatches=microbatches))
+
+
+def pp_e2e_loss_fn(mesh: Mesh, axis_name: str = "pipe", *,
+                   seq_axis: str = None, microbatches: int = None):
+    """The FULL structure loss (distogram -> MDS -> sidechain -> refiner
+    -> Kabsch RMSD) with the trunk pipelined (optionally PP x SP). The
+    geometry pipeline and refiner run replicated (negligible share);
+    requires reversible=False (the pipeline IS the memory strategy)."""
+    from alphafold2_tpu.training.e2e import make_e2e_loss_fn
+
+    return make_e2e_loss_fn(pp_model_apply(
+        mesh, axis_name, seq_axis=seq_axis, microbatches=microbatches))
+
+
+def pp_train_state_init(
+    key,
+    cfg,
+    tcfg: TrainConfig,
+    mesh: Mesh,
+    *,
+    axis_name: str = "pipe",
+    state_init: Callable = train_state_init,
+):
+    """Init the train state with the trunk DEPTH-STACKED and sharded 1/S
+    over the pipe axis — the layout that actually delivers the
+    pipeline's persistent-memory promise.
+
+    A plain `train_state_init` stores the trunk as a per-layer list,
+    which replicates all params + Adam moments on every device (GSPMD
+    cannot propagate the pipe sharding backward through the per-step
+    jnp.stack). Here the trunk params restack to (depth, ...) leaves
+    sharded `P(axis_name)` — each stage holds depth/S layers of params
+    AND optimizer state — and `pipeline_trunk_apply` consumes the
+    stacked layout directly, so no gather ever materializes. Returns
+    (state, state_shardings); pass both to make_pp_train_step. Works
+    for any `state_init` whose params tree keeps the trunk under a
+    "trunk" key (distogram pretrain and the e2e state both do).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from alphafold2_tpu.models.reversible import stack_layers
+    from alphafold2_tpu.training.harness import make_optimizer
+
+    model_cfg = getattr(cfg, "model", cfg)
+    if getattr(model_cfg, "reversible", False):
+        # a reversible init stores the trunk ALREADY depth-stacked, so
+        # restack below would iterate dict keys and die deep inside
+        # jnp.stack — raise the same clear error as the apply path
+        raise ValueError(
+            "the pipeline trunk uses the sequential layer list; set "
+            "reversible=False (activation memory scales O(batch/S) via "
+            "the schedule instead)"
+        )
+
+    def init(k):
+        state = state_init(k, cfg, tcfg)
+
+        def restack(node):
+            if isinstance(node, dict):
+                return {
+                    kk: (stack_layers(list(v)) if kk == "trunk"
+                         else restack(v))
+                    for kk, v in node.items()
+                }
+            return node
+
+        params = restack(state["params"])
+        opt = make_optimizer(tcfg)
+        return {
+            "params": params,
+            "opt_state": opt.init(params),  # moments mirror the layout
+            "step": state["step"],
+        }
+
+    shape = jax.eval_shape(init, key)
+
+    def spec(path, leaf):
+        in_trunk = any(getattr(p, "key", None) == "trunk" for p in path)
+        if in_trunk and leaf.ndim >= 1:
+            return NamedSharding(mesh, P(axis_name))
+        return NamedSharding(mesh, P())
+
+    shardings = jax.tree_util.tree_map_with_path(spec, shape)
+    return jax.jit(init, out_shardings=shardings)(key), shardings
+
+
+def make_pp_train_step(
+    cfg,
+    tcfg: TrainConfig,
+    mesh: Mesh,
+    *,
+    axis_name: str = "pipe",
+    seq_axis: str = None,
+    microbatches: int = None,
+    donate_state: bool = True,
+    loss_fn: Optional[Callable] = None,
+    state_shardings=None,
+):
+    """Jitted train step with the trunk pipelined over `mesh[axis_name]`.
+
+    loss_fn defaults to the distogram pretraining loss; pass
+    `pp_e2e_loss_fn(mesh, ...)` (with cfg=E2EConfig) for the full
+    structure workload. Step signature matches make_train_step:
+    (state, batch, rng) -> (state, metrics); the per-step batch must
+    divide into `microbatches` (default: the stage count).
+
+    Pass the shardings from pp_train_state_init to pin the stacked
+    trunk state 1/S per stage end to end (without them the state — and
+    Adam moments — stay replicated; the pipeline then shards only the
+    in-flight compute)."""
+    step = make_train_step(
+        cfg, tcfg,
+        loss_fn or pp_distogram_loss_fn(
+            mesh, axis_name, seq_axis=seq_axis, microbatches=microbatches),
+    )
+    if loss_fn is not None and (seq_axis is not None
+                                or microbatches is not None):
+        # the schedule kwargs only feed the DEFAULT loss; silently
+        # ignoring them alongside a custom loss_fn would train a
+        # different pipeline schedule than the caller asked for
+        raise ValueError(
+            "seq_axis/microbatches only apply to the default loss_fn; "
+            "build the custom loss with pp_e2e_loss_fn(mesh, "
+            "seq_axis=..., microbatches=...) instead"
+        )
+    kwargs = {"donate_argnums": (0,) if donate_state else ()}
+    if state_shardings is not None:
+        kwargs["in_shardings"] = (state_shardings, replicated(mesh),
+                                  replicated(mesh))
+        kwargs["out_shardings"] = (state_shardings, replicated(mesh))
+    return jax.jit(step, **kwargs)
+
+
 def make_sp_train_step(
     cfg,
     tcfg: TrainConfig,

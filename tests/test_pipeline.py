@@ -324,14 +324,25 @@ def test_pipeline_interleaved_sparse_matches_sequential():
     the stage index in Python). Parity vs the sequential trunk."""
     if len(jax.devices()) < N_DEV:
         pytest.skip("needs the 8-device CPU mesh")
+    # n=16 with block 4 -> 4 blocks: local 2 + global 1 + random 1 leaves
+    # the layout GENUINELY sparse (at 2 blocks it degenerates to all-True
+    # and sparse==dense, which would let a mis-routed flag pass parity)
     cfg = Alphafold2Config(
         dim=16, depth=2, heads=2, dim_head=8, max_seq_len=32,
         sparse_self_attn=(True, False), sparse_block_size=4,
         sparse_num_random_blocks=1, sparse_num_local_blocks=2,
         sparse_use_kernel=False,
     )
-    layers, x, m = _setup(cfg, b=2, n=8, rows=3, cols=8)
+    layers, x, m = _setup(cfg, b=2, n=16, rows=3, cols=8)
     mesh = make_mesh({"pipe": 2})
+    # guard the guard: dense output must DIFFER, else this parity test
+    # cannot catch flag-routing bugs
+    dense_cfg = Alphafold2Config(
+        dim=16, depth=2, heads=2, dim_head=8, max_seq_len=32,
+    )
+    dense = jax.jit(
+        lambda ls, a, b: sequential_trunk_apply(ls, dense_cfg, a, b)
+    )(layers, x, m)
 
     want = jax.jit(
         lambda ls, a, b: sequential_trunk_apply(ls, cfg, a, b)
@@ -341,6 +352,8 @@ def test_pipeline_interleaved_sparse_matches_sequential():
             ls, cfg, a, b, mesh, microbatches=2
         )
     )(layers, x, m)
+    assert not np.allclose(np.asarray(want[0]), np.asarray(dense[0]),
+                           atol=1e-5), "sparse degenerated to dense"
     for g, w in zip(got, want):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-5)
 
@@ -350,6 +363,183 @@ def test_pipeline_interleaved_sparse_matches_sequential():
         pipeline_trunk_apply(layers, cfg, x, m,
                              make_mesh({"pipe": 2, "seq": 4}),
                              microbatches=2, seq_axis="seq")
+
+
+def test_pp_train_step_matches_replicated():
+    """One distogram-pretrain optimizer step with the trunk pipelined
+    (make_pp_train_step) must match the replicated step — loss and
+    updated params equal. The pipeline is the depth-48 single-step
+    alternative to the reversible trunk: params/optimizer state shard
+    1/S per stage, activations stay O(batch/S)."""
+    from alphafold2_tpu.parallel import make_pp_train_step
+    from alphafold2_tpu.training import (
+        DataConfig,
+        TrainConfig,
+        make_train_step,
+        stack_microbatches,
+        synthetic_batches,
+        train_state_init,
+    )
+
+    if len(jax.devices()) < N_DEV:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = Alphafold2Config(dim=16, depth=2, heads=2, dim_head=8,
+                           max_seq_len=32)
+    tcfg = TrainConfig(learning_rate=1e-3, grad_accum=1)
+    dcfg = DataConfig(batch_size=2, max_len=8, seed=0)
+    batch = next(stack_microbatches(synthetic_batches(dcfg), 1))
+    mesh = make_mesh({"pipe": 2})
+
+    state = train_state_init(jax.random.PRNGKey(0), cfg, tcfg)
+    step = jax.jit(make_train_step(cfg, tcfg))
+    pp_state = train_state_init(jax.random.PRNGKey(0), cfg, tcfg)
+    pp_step = make_pp_train_step(cfg, tcfg, mesh, donate_state=False)
+
+    rng = jax.random.PRNGKey(3)
+    state, m1 = step(state, batch, rng)
+    pp_state, m2 = pp_step(pp_state, batch, rng)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(state["params"]),
+                    jax.tree_util.tree_leaves(pp_state["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+def test_pp_sharded_state_train_step():
+    """pp_train_state_init delivers the pipeline's PERSISTENT-memory
+    promise: trunk params AND Adam moments live depth-stacked, sharded
+    1/S over the pipe axis (each device holds depth/S layers), and one
+    step through make_pp_train_step with those shardings matches the
+    replicated step."""
+    from alphafold2_tpu.models.reversible import stack_layers
+    from alphafold2_tpu.parallel import make_pp_train_step, pp_train_state_init
+    from alphafold2_tpu.training import (
+        DataConfig,
+        TrainConfig,
+        make_train_step,
+        stack_microbatches,
+        synthetic_batches,
+        train_state_init,
+    )
+
+    if len(jax.devices()) < N_DEV:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = Alphafold2Config(dim=16, depth=8, heads=2, dim_head=8,
+                           max_seq_len=32)
+    tcfg = TrainConfig(learning_rate=1e-3, grad_accum=1)
+    dcfg = DataConfig(batch_size=8, max_len=8, seed=0)
+    batch = next(stack_microbatches(synthetic_batches(dcfg), 1))
+    mesh = make_mesh({"pipe": N_DEV})
+
+    pp_state, shardings = pp_train_state_init(
+        jax.random.PRNGKey(0), cfg, tcfg, mesh)
+    # 1/S for real: every stacked trunk leaf is sharded over pipe, and
+    # each device's addressable shard holds depth/S layers
+    for leaf in jax.tree_util.tree_leaves(pp_state["params"]["trunk"]):
+        assert leaf.shape[0] == cfg.depth
+        shard = leaf.addressable_shards[0]
+        assert shard.data.shape[0] == cfg.depth // N_DEV, (
+            leaf.shape, shard.data.shape)
+    # Adam moments mirror the layout
+    mu = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda t: t, pp_state["opt_state"]))
+    assert any(
+        getattr(l, "addressable_shards", None)
+        and l.ndim >= 1 and l.addressable_shards[0].data.shape != l.shape
+        for l in mu
+    ), "no optimizer leaf is actually sharded"
+
+    pp_step = make_pp_train_step(cfg, tcfg, mesh, donate_state=False,
+                                 state_shardings=shardings)
+    state = train_state_init(jax.random.PRNGKey(0), cfg, tcfg)
+    step = jax.jit(make_train_step(cfg, tcfg))
+
+    rng = jax.random.PRNGKey(3)
+    state, m1 = step(state, batch, rng)
+    pp_state, m2 = pp_step(pp_state, batch, rng)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=2e-5)
+    # compare the stacked trunk against the replicated list stacked
+    want_trunk = stack_layers(list(state["params"]["trunk"]))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(pp_state["params"]["trunk"]),
+        jax.tree_util.tree_leaves(want_trunk),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+    # unstack_layers: the bridge back to the sequential apply (e.g. to
+    # predict with a pipeline-sharded train state) — layer-list roundtrip
+    from alphafold2_tpu.models.reversible import unstack_layers
+
+    back = unstack_layers(pp_state["params"]["trunk"])
+    assert len(back) == cfg.depth
+    for a, b in zip(jax.tree_util.tree_leaves(back[3]),
+                    jax.tree_util.tree_leaves(state["params"]["trunk"][3])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+    # reversible configs must be rejected with the clear contract error,
+    # not a cryptic stack failure
+    rcfg = Alphafold2Config(dim=16, depth=2, heads=2, dim_head=8,
+                            max_seq_len=32, reversible=True)
+    with pytest.raises(ValueError, match="reversible=False"):
+        pp_train_state_init(jax.random.PRNGKey(0), rcfg, tcfg, mesh)
+    # schedule kwargs alongside a custom loss_fn are a silent-mismatch
+    # trap — rejected
+    with pytest.raises(ValueError, match="only apply to the default"):
+        make_pp_train_step(cfg, tcfg, mesh, microbatches=4,
+                           loss_fn=lambda *a: 0.0)
+
+
+@pytest.mark.slow
+def test_pp_e2e_train_step_matches_replicated():
+    """The FULL structure workload (distogram -> MDS -> sidechain ->
+    refiner -> Kabsch loss) trained with the trunk pipelined: one step of
+    make_pp_train_step(loss_fn=pp_e2e_loss_fn) matches the replicated e2e
+    step."""
+    from alphafold2_tpu.models import RefinerConfig
+    from alphafold2_tpu.parallel import make_pp_train_step, pp_e2e_loss_fn
+    from alphafold2_tpu.training import (
+        DataConfig,
+        E2EConfig,
+        TrainConfig,
+        e2e_loss_fn,
+        e2e_train_state_init,
+        make_train_step,
+        stack_microbatches,
+        synthetic_structure_batches,
+    )
+
+    if len(jax.devices()) < N_DEV:
+        pytest.skip("needs the 8-device CPU mesh")
+    ecfg = E2EConfig(
+        model=Alphafold2Config(
+            dim=16, depth=2, heads=2, dim_head=8, max_seq_len=64,
+            msa_tie_row_attn=True, cross_attn_mode="aligned",
+        ),
+        refiner=RefinerConfig(num_tokens=14, dim=16, depth=1, msg_dim=16),
+        mds_iters=3,
+    )
+    tcfg = TrainConfig(learning_rate=1e-3, grad_accum=1)
+    # batch 2: the pipeline schedules over batch microbatches (>= stages)
+    dcfg = DataConfig(batch_size=2, max_len=8, msa_rows=4, seed=0)
+    batch = next(stack_microbatches(synthetic_structure_batches(dcfg), 1))
+    mesh = make_mesh({"pipe": 2})
+
+    state = e2e_train_state_init(jax.random.PRNGKey(0), ecfg, tcfg)
+    step = jax.jit(make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn))
+    pp_state = e2e_train_state_init(jax.random.PRNGKey(0), ecfg, tcfg)
+    pp_step = make_pp_train_step(
+        ecfg, tcfg, mesh, donate_state=False, loss_fn=pp_e2e_loss_fn(mesh)
+    )
+
+    rng = jax.random.PRNGKey(3)
+    state, m1 = step(state, batch, rng)
+    pp_state, m2 = pp_step(pp_state, batch, rng)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(state["params"]),
+                    jax.tree_util.tree_leaves(pp_state["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
 
 
 def test_pipeline_validates_shapes():
