@@ -80,6 +80,11 @@ def main():
     ap.add_argument("--esm-ckpt", default=None,
                     help="npz of a torch ESM state dict to convert+load "
                          "(random init otherwise)")
+    ap.add_argument("--esm-token-dropout", type=int, default=1,
+                    help="1 = real ESM-1b inference semantics (mask-"
+                         "dropout rescale; the reference's hub model "
+                         "applies it); 0 reproduces pre-round-4 "
+                         "embeddings")
     ap.add_argument("--data", choices=["synthetic", "sidechainnet"],
                     default="synthetic")
     ap.add_argument("--ckpt-dir", default=None, help="checkpoint/resume directory")
@@ -172,6 +177,10 @@ def main():
         e_cfg = EmbedderConfig(
             num_layers=args.esm_layers, dim=args.esm_dim, heads=args.esm_heads,
             max_len=max(1024, args.max_len + 2),
+            # default ON = the torch.hub ESM-1b inference semantics the
+            # reference feeds (0.88x mask-dropout rescale); the flag
+            # exists to reproduce embeddings from runs predating it
+            token_dropout=bool(args.esm_token_dropout),
         )
         if args.esm_ckpt:
             sd = dict(np.load(args.esm_ckpt, allow_pickle=True))
