@@ -35,6 +35,7 @@ Usage: python scripts/bench_decompose.py [--depth 12] [--legs trunk_fwd,...]
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import subprocess
@@ -43,7 +44,8 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "scripts"))
-from bench_sweep import err_tail  # noqa: E402  (shared failure summarizer)
+from bench_sweep import LOCK_BUSY, err_tail  # noqa: E402  (shared helpers)
+from tpu_lock import tpu_lock  # noqa: E402  (single-client tunnel lock)
 
 OUT = os.path.join(REPO, "PERF_DECOMP.jsonl")
 
@@ -129,7 +131,59 @@ n3 = crop * 3
 seq3 = elongate(batch["seq"])
 mask3 = elongate(batch["mask"])
 
-if leg in ("trunk_fwd", "trunk_vg"):
+
+def sq_total(tree):
+    # on-device scalar that depends on every leaf: fetching it is
+    # dispatch-proof WITHOUT paying the tunnel transfer of the full tree
+    # (the fetch-heavy legs measured compute + hundreds of MB of
+    # device->host transfer in one number; see the *_s legs' rationale)
+    leaves = jax.tree_util.tree_leaves(tree)
+    return sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+
+
+# Scalarized twins (trunk_vg_s / geom_vg_s / ops_s) share the fetch-heavy
+# legs' bodies below: same traced program plus an on-device grad reduction,
+# so the twins can never drift apart. The _s numbers are the component
+# compute cost; the fetch-heavy twins are the transfer-inclusive record.
+scalarized = leg.endswith("_s")
+base_leg = leg[:-2] if scalarized else leg
+leg_suffix = "_s" if scalarized else ""
+
+
+def scalarize(vg):
+    def scalar_vg(*a):
+        v, g = vg(*a)
+        return v, sq_total(g)
+
+    return scalar_vg
+
+
+def maybe_scalarize(vg):
+    return scalarize(vg) if scalarized else vg
+
+
+if leg == "fetch_bw":
+    # direct tunnel device->host bandwidth + latency probe: converts the
+    # (fetch-heavy leg) - (scalarized leg) deltas into MB/s, and sizes
+    # how much any grad-fetching measurement overstates compute.
+    # jax.Array caches its host copy after the first np.asarray, so each
+    # probe times the FIRST fetch of a fresh array; a small throwaway
+    # fetch warms the transfer path beforehand.
+    jnp.ones((1024,), jnp.bfloat16).block_until_ready()
+    np.asarray(jnp.zeros((1024,), jnp.bfloat16))  # warm the D2H path
+    for name, elems in (("lat_4B", 2), ("bw_64MB", 32 << 20),
+                        ("bw_256MB", 128 << 20)):
+        x = jnp.ones((elems,), jnp.bfloat16)
+        x.block_until_ready()  # timed section must be transfer-only
+        t0 = time.perf_counter()
+        np.asarray(x)
+        dt = time.perf_counter() - t0
+        mb = elems * 2 / 1e6
+        report(leg=f"fetch_{name}", depth=depth, sec=round(dt, 6),
+               mb=round(mb, 1),
+               mb_per_s=round(mb / dt, 1) if dt > 1e-6 else None)
+
+elif base_leg in ("trunk_fwd", "trunk_vg"):
     state = e2e_train_state_init(key, ecfg, tcfg)
     params = state["params"]["model"]
 
@@ -141,12 +195,13 @@ if leg in ("trunk_fwd", "trunk_vg"):
         # scalar pull so the backward has a cotangent; f32 to match e2e
         return jnp.mean(jnp.square(logits.astype(jnp.float32)))
 
-    fn = fwd if leg == "trunk_fwd" else jax.value_and_grad(fwd)
+    fn = (fwd if base_leg == "trunk_fwd"
+          else maybe_scalarize(jax.value_and_grad(fwd)))
     compiled = jax.jit(fn).lower(params).compile()
     dt = timed(compiled, params)
     report(leg=leg, depth=depth, **perf_fields(compiled, dt))
 
-elif leg == "geom_vg":
+elif base_leg == "geom_vg":
     state = e2e_train_state_init(key, ecfg, tcfg)
     # fixed logits standing in for the trunk output; differentiate the
     # geometry tail wrt logits AND refiner params (what training does)
@@ -162,12 +217,12 @@ elif leg == "geom_vg":
         params = {"model": {}, "refiner": refiner_params}
         return lf(params, ecfg, mb, key)
 
-    fn = jax.value_and_grad(tail_loss, argnums=(0, 1))
+    fn = maybe_scalarize(jax.value_and_grad(tail_loss, argnums=(0, 1)))
     compiled = jax.jit(fn).lower(logits, state["params"]["refiner"]).compile()
     dt = timed(compiled, logits, state["params"]["refiner"])
     report(leg=leg, depth=depth, **perf_fields(compiled, dt))
 
-elif leg == "ops":
+elif base_leg == "ops":
     # one REVERSIBLE trunk layer's pieces, each fwd+bwd in isolation at
     # model shapes — 8 blocks: reversible layers carry TWO feed-forwards
     # per stream (models/trunk.py trunk_layer_init; an identity over only
@@ -187,10 +242,12 @@ elif leg == "ops":
     def bench_op(name, f, *args):
         def loss(*a):
             return jnp.mean(jnp.square(f(*a).astype(jnp.float32)))
-        vg = jax.value_and_grad(loss, argnums=tuple(range(len(args))))
+        vg = maybe_scalarize(
+            jax.value_and_grad(loss, argnums=tuple(range(len(args)))))
         compiled = jax.jit(vg).lower(*args).compile()
         dt = timed(compiled, *args)
-        report(leg=f"op_{name}", depth=depth, **perf_fields(compiled, dt))
+        report(leg=f"op{leg_suffix}_{name}", depth=depth,
+               **perf_fields(compiled, dt))
 
     bench_op(
         "pair_axial",
@@ -253,7 +310,12 @@ elif leg == "ops_detail":
     def bench_fn(name, f, *args):
         def loss(*a):
             return jnp.mean(jnp.square(f(*a).astype(jnp.float32)))
-        vg = jax.value_and_grad(loss, argnums=tuple(range(len(args))))
+        # grads reduced on device (scalarize): a (1,1152,1152,256) bf16 arg
+        # grad is ~680 MB — fetching it over the tunnel would swamp the
+        # measurement (and giant fetches are implicated in a relay stall,
+        # PERF.md round-4 session)
+        vg = scalarize(
+            jax.value_and_grad(loss, argnums=tuple(range(len(args)))))
         compiled = jax.jit(vg).lower(*args).compile()
         dt = timed(compiled, *args)
         report(leg=f"detail_{name}", depth=depth, **perf_fields(compiled, dt))
@@ -372,11 +434,20 @@ def run_leg(leg, depth, timeout, smoke=False):
 
     t0 = time.time()
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", WORKER, json.dumps(spec)],
-            capture_output=True, text=True, timeout=timeout, cwd=REPO,
-            env=env,
-        )
+        with contextlib.ExitStack() as stack:
+            if not smoke:  # one tunnel client at a time, repo-wide
+                stack.enter_context(tpu_lock(timeout=120))
+            proc = subprocess.run(
+                [sys.executable, "-c", WORKER, json.dumps(spec)],
+                capture_output=True, text=True, timeout=timeout, cwd=REPO,
+                env=env,
+            )
+    except TimeoutError:
+        # structured sentinel (not message text): callers must distinguish
+        # lock contention from worker crashes without substring sniffing
+        return ([{"leg": leg, "depth": depth, "error": LOCK_BUSY,
+                  **smoke_kv}],
+                time.time() - t0, False)
     except subprocess.TimeoutExpired as e:
         # salvage rows the worker already printed (it flushes per row):
         # chip time spent on completed measurements must reach the record
@@ -402,8 +473,15 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--depth", type=int, default=12)
     ap.add_argument("--legs",
-                    default="trunk_fwd,trunk_vg,geom_vg,ops,ops_detail,"
-                            "profile")
+                    # scalarized legs by default: the fetch-heavy trunk_vg
+                    # measured compute + ~35 s of gradient-tree transfer in
+                    # one number (49.7 s vs the 24.4 s e2e step that
+                    # CONTAINS the trunk), and its ~2x440 MB fetches are
+                    # implicated in a relay stall. trunk_vg/geom_vg/ops
+                    # remain available explicitly as transfer-inclusive
+                    # twins.
+                    default="trunk_fwd,trunk_vg_s,geom_vg_s,ops_s,fetch_bw,"
+                            "ops_detail,profile")
     ap.add_argument("--timeout", type=int, default=1800)
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CPU shapes: validates the worker end-to-end "
@@ -418,7 +496,9 @@ def main():
     # rows are salvaged from failed runs), so its done-marker is the LAST
     # row — a partially-measured ops leg re-runs until every op lands.
     marker = {"ops": "op_ff_msa2",
+              "ops_s": "op_s_ff_msa2",
               "ops_detail": "detail_pair_attn_rowpass",
+              "fetch_bw": "fetch_bw_256MB",
               "profile": "profile_total"}
     done = set()
     if not args.force_all and os.path.exists(OUT):
@@ -456,6 +536,13 @@ def main():
             print(json.dumps({"bench": "decompose",
                               "error": "tunnel wedged; stopping"}), flush=True)
             sys.exit(3)  # wedged-tunnel code: watchers retry later
+        if any(r.get("error") == LOCK_BUSY for r in rows):
+            # another client (e.g. the round-end driver bench) owns the
+            # tunnel: stop instead of burning a lock-timeout per leg
+            print(json.dumps({"bench": "decompose",
+                              "error": "TPU lock busy; stopping"}),
+                  flush=True)
+            sys.exit(3)
 
 
 if __name__ == "__main__":

@@ -34,7 +34,8 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "scripts"))
-from bench_sweep import err_tail  # noqa: E402  (shared failure summarizer)
+from bench_sweep import LOCK_BUSY, err_tail  # noqa: E402  (shared helpers)
+from tpu_lock import tpu_lock  # noqa: E402  (single-client tunnel lock)
 
 OUT = os.path.join(REPO, "PERF_LADDER.jsonl")
 BENCH = os.path.join(REPO, "bench.py")
@@ -63,8 +64,12 @@ def run_leg(depth, segments, timeout):
 
     t0 = time.time()
     try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=timeout, cwd=REPO)
+        with tpu_lock(timeout=120):  # one tunnel client at a time
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout, cwd=REPO)
+    except TimeoutError:
+        return ({"depth": depth, "segments": segments, "error": LOCK_BUSY},
+                time.time() - t0, False)
     except subprocess.TimeoutExpired as e:
         # salvage the train row if the worker printed it before hanging
         # (bench.py prints it before the inference leg)
@@ -122,6 +127,13 @@ def main():
                               "error": "tunnel wedged; stopping"}),
                   flush=True)
             sys.exit(3)  # wedged-tunnel code: watchers retry later
+        if row.get("error") == LOCK_BUSY:
+            # another client (e.g. the round-end driver bench) owns the
+            # tunnel: stop instead of burning a lock-timeout per leg
+            print(json.dumps({"bench": "depth_ladder",
+                              "error": "TPU lock busy; stopping"}),
+                  flush=True)
+            sys.exit(3)
 
 
 if __name__ == "__main__":

@@ -28,6 +28,13 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "PERF_SWEEP.jsonl")
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+from tpu_lock import tpu_lock  # noqa: E402  (single-client tunnel lock)
+
+# structured error sentinel for "another local client holds the tunnel
+# lock" — compared by equality, never by substring (a worker crash whose
+# stderr mentions the lock must not read as contention)
+LOCK_BUSY = "tpu-lock-busy"
 
 E2E_WORKER = r"""
 import json, sys, time
@@ -133,9 +140,13 @@ def run_sub(code_or_path, argv, timeout):
     else:
         cmd = [sys.executable, "-c", code_or_path, *argv]
     try:
-        proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO,
-        )
+        with tpu_lock(timeout=120):  # one tunnel client at a time
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=timeout,
+                cwd=REPO,
+            )
+    except TimeoutError:
+        return None, LOCK_BUSY, time.time() - t0
     except subprocess.TimeoutExpired:
         return None, "timeout", time.time() - t0
     if proc.returncode != 0:
@@ -165,6 +176,11 @@ def run_and_record(name, code_or_path, argv, timeout, extra=None):
             "wall": round(dt, 1)})
     if err == "timeout":
         record({"bench": "sweep", "error": "tunnel wedged; stopping"})
+        return False
+    if err == LOCK_BUSY:
+        # another client (e.g. the round-end driver bench) owns the tunnel:
+        # stop instead of burning a lock-timeout per leg
+        record({"bench": "sweep", "error": "TPU lock busy; stopping"})
         return False
     return True
 

@@ -1,0 +1,94 @@
+"""Single-client TPU-tunnel lock.
+
+The axon terminal serves ONE session; a second local client racing the
+first deadlocks both and can wedge the relay for hours (observed
+2026-08-01: a stray CPU-intended script initialized the axon backend
+while a measurement worker was mid-leg — both blocked, the tunnel
+wedged). Every process that may touch the tunnel must hold this lock for
+its whole lifetime:
+
+  python scripts/tpu_lock.py [--timeout SEC] -- CMD ARG...   # CLI wrapper
+  with tpu_lock(timeout=...):                                # in-process
+
+The lock is a plain flock(2) on .tpu.lock at the repo root — kernel-owned,
+so it cannot leak: a killed or crashed holder releases it instantly
+(no stale-pidfile failure mode). Holding it does NOT make killing a
+mid-execution client safe (that still wedges the relay); it only prevents
+the two-client collision.
+
+CPU-only subprocesses must instead drop the tunnel env entirely:
+`env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python ...` plus
+`jax.config.update("jax_platforms", "cpu")` before any jax import user
+code runs (the env var alone does not always win over the axon pin).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import fcntl
+import os
+import sys
+import time
+
+LOCK_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".tpu.lock"
+)
+
+
+@contextlib.contextmanager
+def tpu_lock(timeout: float = 0.0, poll: float = 2.0):
+    """Hold the exclusive tunnel lock; raise TimeoutError if unavailable.
+
+    timeout=0 means try once and fail immediately — right for probes,
+    which must never queue behind a long measurement (the watcher retries
+    on its own schedule anyway).
+    """
+    fd = os.open(LOCK_PATH, os.O_CREAT | os.O_RDWR, 0o644)
+    deadline = time.monotonic() + timeout
+    try:
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError as e:
+                if e.errno not in (errno.EAGAIN, errno.EACCES):
+                    raise
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"TPU lock {LOCK_PATH} held by another client"
+                    ) from None
+                time.sleep(poll)
+        try:
+            os.ftruncate(fd, 0)
+            os.write(fd, f"pid={os.getpid()}\n".encode())
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+    finally:
+        os.close(fd)
+
+
+def main(argv: list[str]) -> int:
+    timeout = 0.0
+    if argv and argv[0] == "--timeout":
+        timeout = float(argv[1])
+        argv = argv[2:]
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    if not argv:
+        print("usage: tpu_lock.py [--timeout SEC] -- CMD ARG...",
+              file=sys.stderr)
+        return 2
+    import subprocess
+
+    try:
+        with tpu_lock(timeout=timeout):
+            return subprocess.run(argv).returncode
+    except TimeoutError as e:
+        print(f"tpu_lock: {e}", file=sys.stderr)
+        return 75  # EX_TEMPFAIL: caller should retry later
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

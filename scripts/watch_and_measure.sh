@@ -27,7 +27,9 @@ for i in $(seq 1 60); do
     echo "$(date -u +%H:%M:%S) deadline reached; exiting without measuring"
     exit 0
   fi
-  if timeout 240 python scripts/tpu_probe.py 2>/dev/null | grep -q tpu-healthy; then
+  # lock: a probe must never open a second tunnel client beside a running
+  # measurement (two clients deadlock + wedge the relay; scripts/tpu_lock.py)
+  if python scripts/tpu_lock.py -- timeout 240 python scripts/tpu_probe.py 2>/dev/null | grep -q tpu-healthy; then
     echo "$(date -u +%H:%M:%S) chip healthy on probe $i; measuring"
     if [ "$decomp_done" -eq 0 ]; then
       # re-check before EACH stage: a probe that lands just before the
