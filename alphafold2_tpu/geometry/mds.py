@@ -27,7 +27,36 @@ def _pairwise_dist(coords, eps=1e-12):
     return jnp.sqrt(d2 + eps)
 
 
-@partial(jax.jit, static_argnames=("iters", "bwd_iters", "unroll"))
+def _classical_init(pre_dist_mat):
+    """Torgerson classical-MDS embedding as a Guttman warm start.
+
+    Double-center the squared distances (B = -1/2 J D^2 J) and embed with
+    the top-3 eigenpairs. For near-Euclidean inputs this lands within
+    quantization noise of the global optimum, so the iterative tail
+    refines instead of traveling from a random cloud — the same stress is
+    reached in far fewer Guttman iterations (the reference's 200,
+    utils.py:306, is sized for random init). One (N, N) eigh per
+    structure — O(N^3) once, ~1.5 GFLOPs at N=1152, amortized against
+    hundreds of sequential (N, N) matmul iterations it replaces.
+
+    Detached: eigh's backward is unstable under (near-)degenerate
+    eigenvalues, and the init is a starting POINT, not part of the
+    differentiable pipeline (random init carries no gradient either);
+    gradients flow through the Guttman iterations only.
+    """
+    d2 = jnp.square(pre_dist_mat)
+    row = jnp.mean(d2, axis=-1, keepdims=True)
+    col = jnp.mean(d2, axis=-2, keepdims=True)
+    tot = jnp.mean(d2, axis=(-1, -2), keepdims=True)
+    b_mat = -0.5 * (d2 - row - col + tot)
+    evals, evecs = jnp.linalg.eigh(b_mat)  # ascending
+    top_vals = jnp.clip(evals[..., -3:], 0.0)  # (b, 3)
+    top_vecs = evecs[..., -3:]  # (b, N, 3)
+    coords = top_vecs * jnp.sqrt(top_vals)[..., None, :]
+    return jax.lax.stop_gradient(coords)
+
+
+@partial(jax.jit, static_argnames=("iters", "bwd_iters", "unroll", "init"))
 def mds(
     pre_dist_mat,
     weights=None,
@@ -36,6 +65,7 @@ def mds(
     key=None,
     bwd_iters: int | None = None,
     unroll: int = 1,
+    init: str = "random",
 ):
     """Stress-majorization MDS.
 
@@ -69,6 +99,11 @@ def mds(
         overhead at the cost of compile time. Same math and trip count;
         results differ from the rolled scan only by XLA
         fusion/reassociation float noise.
+      init: "random" (reference parity, utils.py:326) or "classical" —
+        Torgerson double-centering eigendecomposition warm start
+        (_classical_init), which reaches the random-init stress floor in
+        a fraction of the iterations and is the lever for cutting
+        `iters` below the reference's 200.
 
     Returns:
       coords: (batch, 3, N)
@@ -85,7 +120,15 @@ def mds(
     if key is None:
         key = jax.random.PRNGKey(0)
 
-    init_coords = 2.0 * jax.random.uniform(key, (batch, n, 3), pre_dist_mat.dtype) - 1.0
+    if init == "classical":
+        init_coords = _classical_init(pre_dist_mat)
+    elif init == "random":
+        init_coords = (
+            2.0 * jax.random.uniform(key, (batch, n, 3), pre_dist_mat.dtype)
+            - 1.0
+        )
+    else:
+        raise ValueError(f"unknown mds init {init!r}")
     eye = jnp.eye(n, dtype=pre_dist_mat.dtype)
 
     def make_step(allow_freeze: bool):
@@ -168,6 +211,7 @@ def mdscaling(
     key=None,
     bwd_iters: int | None = None,
     unroll: int = 1,
+    init: str = "random",
 ):
     """MDS + chirality (mirror-image) correction.
 
@@ -180,7 +224,7 @@ def mdscaling(
     """
     preds, stresses = mds(
         pre_dist_mat, weights=weights, iters=iters, tol=tol, key=key,
-        bwd_iters=bwd_iters, unroll=unroll,
+        bwd_iters=bwd_iters, unroll=unroll, init=init,
     )
     if not fix_mirror:
         return preds, stresses

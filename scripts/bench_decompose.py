@@ -44,8 +44,8 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "scripts"))
-from bench_sweep import LOCK_BUSY, err_tail  # noqa: E402  (shared helpers)
-from tpu_lock import tpu_lock  # noqa: E402  (single-client tunnel lock)
+from bench_sweep import err_tail  # noqa: E402  (shared failure summarizer)
+from tpu_lock import LOCK_BUSY, tpu_lock  # noqa: E402  (tunnel lock)
 
 OUT = os.path.join(REPO, "PERF_DECOMP.jsonl")
 
@@ -77,12 +77,6 @@ cfg = ecfg.model
 dim, dt_model = cfg.dim, cfg.dtype
 tcfg = TrainConfig(learning_rate=3e-4, grad_accum=1)
 dcfg = DataConfig(batch_size=1, max_len=crop, msa_rows=msa_rows, seed=0)
-batch = jax.device_put(
-    jax.tree_util.tree_map(
-        lambda t: t[0],
-        next(stack_microbatches(synthetic_structure_batches(dcfg), 1)),
-    )
-)
 key = jax.random.PRNGKey(0)
 
 
@@ -127,6 +121,37 @@ def report(**kv):
     print(json.dumps(kv), flush=True)
 
 
+if leg == "fetch_bw":
+    # direct tunnel device->host bandwidth + latency probe: converts the
+    # (fetch-heavy leg) - (scalarized leg) deltas into MB/s, and sizes
+    # how much any grad-fetching measurement overstates compute.
+    # Runs BEFORE any model-batch setup: this leg measures the tunnel, so
+    # it must not push a model batch through it first. jax.Array caches
+    # its host copy after the first np.asarray, so each probe times the
+    # FIRST fetch of a fresh array; a small throwaway fetch warms the
+    # transfer path beforehand.
+    jnp.ones((1024,), jnp.bfloat16).block_until_ready()
+    np.asarray(jnp.zeros((1024,), jnp.bfloat16))  # warm the D2H path
+    for name, elems in (("lat_4B", 2), ("bw_64MB", 32 << 20),
+                        ("bw_256MB", 128 << 20)):
+        x = jnp.ones((elems,), jnp.bfloat16)
+        x.block_until_ready()  # timed section must be transfer-only
+        t0 = time.perf_counter()
+        np.asarray(x)
+        dt = time.perf_counter() - t0
+        mb = elems * 2 / 1e6
+        report(leg=f"fetch_{name}", depth=depth, sec=round(dt, 6),
+               mb=round(mb, 1),
+               mb_per_s=round(mb / dt, 1) if dt > 1e-6 else None)
+    raise SystemExit(0)
+
+
+batch = jax.device_put(
+    jax.tree_util.tree_map(
+        lambda t: t[0],
+        next(stack_microbatches(synthetic_structure_batches(dcfg), 1)),
+    )
+)
 n3 = crop * 3
 seq3 = elongate(batch["seq"])
 mask3 = elongate(batch["mask"])
@@ -162,28 +187,7 @@ def maybe_scalarize(vg):
     return scalarize(vg) if scalarized else vg
 
 
-if leg == "fetch_bw":
-    # direct tunnel device->host bandwidth + latency probe: converts the
-    # (fetch-heavy leg) - (scalarized leg) deltas into MB/s, and sizes
-    # how much any grad-fetching measurement overstates compute.
-    # jax.Array caches its host copy after the first np.asarray, so each
-    # probe times the FIRST fetch of a fresh array; a small throwaway
-    # fetch warms the transfer path beforehand.
-    jnp.ones((1024,), jnp.bfloat16).block_until_ready()
-    np.asarray(jnp.zeros((1024,), jnp.bfloat16))  # warm the D2H path
-    for name, elems in (("lat_4B", 2), ("bw_64MB", 32 << 20),
-                        ("bw_256MB", 128 << 20)):
-        x = jnp.ones((elems,), jnp.bfloat16)
-        x.block_until_ready()  # timed section must be transfer-only
-        t0 = time.perf_counter()
-        np.asarray(x)
-        dt = time.perf_counter() - t0
-        mb = elems * 2 / 1e6
-        report(leg=f"fetch_{name}", depth=depth, sec=round(dt, 6),
-               mb=round(mb, 1),
-               mb_per_s=round(mb / dt, 1) if dt > 1e-6 else None)
-
-elif base_leg in ("trunk_fwd", "trunk_vg"):
+if base_leg in ("trunk_fwd", "trunk_vg"):
     state = e2e_train_state_init(key, ecfg, tcfg)
     params = state["params"]["model"]
 

@@ -34,8 +34,8 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "scripts"))
-from bench_sweep import LOCK_BUSY, err_tail  # noqa: E402  (shared helpers)
-from tpu_lock import tpu_lock  # noqa: E402  (single-client tunnel lock)
+from bench_sweep import err_tail  # noqa: E402  (shared failure summarizer)
+from tpu_lock import LOCK_BUSY, tpu_lock  # noqa: E402  (tunnel lock)
 
 OUT = os.path.join(REPO, "PERF_LADDER.jsonl")
 BENCH = os.path.join(REPO, "bench.py")

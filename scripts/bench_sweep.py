@@ -29,12 +29,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "PERF_SWEEP.jsonl")
 sys.path.insert(0, os.path.join(REPO, "scripts"))
-from tpu_lock import tpu_lock  # noqa: E402  (single-client tunnel lock)
-
-# structured error sentinel for "another local client holds the tunnel
-# lock" — compared by equality, never by substring (a worker crash whose
-# stderr mentions the lock must not read as contention)
-LOCK_BUSY = "tpu-lock-busy"
+from tpu_lock import LOCK_BUSY, tpu_lock  # noqa: E402  (tunnel lock)
 
 E2E_WORKER = r"""
 import json, sys, time
@@ -64,6 +59,8 @@ ecfg, crop, msa_rows = north_star_e2e_config(
     e2e_overrides=dict(
         mds_bwd_iters=spec["mds_bwd_iters"],
         mds_unroll=spec.get("mds_unroll", 1),
+        mds_init=spec.get("mds_init", "random"),
+        **({"mds_iters": spec["mds_iters"]} if "mds_iters" in spec else {}),
     ),
 )
 # Kernel policy (spec["kernel"]):
@@ -254,6 +251,13 @@ def main():
             # the head split
             ("e2e_h4dh128", {**base, "heads": 4, "dim_head": 128}),
             ("e2e_mdsbwd25", {**base, "mds_bwd_iters": 25}),
+            # Torgerson warm start + 25-iteration tail: classical init
+            # reaches the random-init-200 stress floor in ~1 iteration on
+            # exact AND distogram-censored real inputs (geometry/mds.py,
+            # tests/test_geometry.py) — this leg measures the step-time
+            # win of dropping the 200-iteration sequential Guttman tail
+            ("e2e_mds25classical",
+             {**base, "mds_iters": 25, "mds_init": "classical"}),
             # MDS scan unroll: amortizes the 200 sequential small-kernel
             # iterations' dispatch overhead (PERF.md "MDS latency")
             ("e2e_mdsunroll8", {**base, "mds_unroll": 8}),

@@ -35,6 +35,11 @@ LOCK_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".tpu.lock"
 )
 
+# structured error sentinel for "another local client holds the tunnel
+# lock" — compared by equality, never by substring (a worker crash whose
+# stderr mentions the lock must not read as contention)
+LOCK_BUSY = "tpu-lock-busy"
+
 
 @contextlib.contextmanager
 def tpu_lock(timeout: float = 0.0, poll: float = 2.0):

@@ -29,7 +29,17 @@ for i in $(seq 1 60); do
   fi
   # lock: a probe must never open a second tunnel client beside a running
   # measurement (two clients deadlock + wedge the relay; scripts/tpu_lock.py)
-  if python scripts/tpu_lock.py -- timeout 240 python scripts/tpu_probe.py 2>/dev/null | grep -q tpu-healthy; then
+  python scripts/tpu_lock.py -- timeout 240 python scripts/tpu_probe.py \
+    > /tmp/af2_probe_out.$$ 2>/dev/null
+  probe_rc=$?
+  if [ "$probe_rc" -eq 75 ]; then
+    # fail-fast lock wrapper: another client owns the tunnel — contention,
+    # NOT a wedge; keep the log honest and retry on schedule
+    echo "$(date -u +%H:%M:%S) probe $i: lock busy (another client measuring)"
+    sleep 480
+    continue
+  fi
+  if grep -q tpu-healthy /tmp/af2_probe_out.$$; then
     echo "$(date -u +%H:%M:%S) chip healthy on probe $i; measuring"
     if [ "$decomp_done" -eq 0 ]; then
       # re-check before EACH stage: a probe that lands just before the

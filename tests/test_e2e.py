@@ -69,6 +69,28 @@ def test_e2e_loss_and_grads(ecfg, batch):
     assert model_norm > 0 and refiner_norm > 0
 
 
+def test_e2e_loss_and_grads_classical_mds_init(ecfg, batch):
+    # the Torgerson warm start (E2EConfig.mds_init="classical") must stay
+    # trainable: the eigh init is stop_gradient'd (geometry/mds.py), so
+    # grads flow through the Guttman tail only — finite and nonzero
+    import dataclasses
+
+    ccfg = dataclasses.replace(ecfg, mds_init="classical", mds_iters=2)
+    state = e2e_train_state_init(jax.random.PRNGKey(0), ccfg, TrainConfig())
+
+    @jax.jit
+    def loss(params):
+        return e2e_loss_fn(params, ccfg, batch, jax.random.PRNGKey(2))
+
+    val, grads = jax.value_and_grad(loss)(state["params"])
+    assert np.isfinite(float(val))
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in leaves)
+    model_norm = sum(float(jnp.sum(jnp.abs(g)))
+                     for g in jax.tree_util.tree_leaves(grads["model"]))
+    assert model_norm > 0
+
+
 @pytest.mark.slow
 def test_e2e_train_step_improves(ecfg):
     """A few steps on a fixed batch decrease the loss."""

@@ -97,6 +97,54 @@ def test_mds_reconstructs_known_structure():
     assert min(float(err[0]), float(err_m[0])) < 0.1
 
 
+def test_mds_classical_init_converges_in_few_iters():
+    # Torgerson warm start: on exact distances the embedding is already the
+    # solution, so 2 Guttman iterations beat random init's 500 (above).
+    # This pins the basis for the mds_iters cut (E2EConfig.mds_init).
+    key = jax.random.PRNGKey(1)
+    n = 24
+    truth = jax.random.normal(key, (1, n, 3)) * 4.0
+    dist = jnp.sqrt(
+        jnp.sum((truth[:, :, None] - truth[:, None]) ** 2, axis=-1) + 1e-12
+    )
+    coords, history = mds(dist, iters=2, tol=1e-9, init="classical")
+    assert coords.shape == (1, 3, n)
+    errs = []
+    for flip in (1.0, -1.0):
+        X, Y = Kabsch(coords[0] * jnp.array([[1.0], [1.0], [flip]]),
+                      jnp.transpose(truth[0]))
+        errs.append(float(RMSD(X, Y)[0]))
+    assert min(errs) < 0.01, errs
+
+
+def test_mds_classical_init_dominates_on_censored_input():
+    # On a weighted, distogram-censored matrix (zero-weight far pairs +
+    # bucket quantization — the e2e pipeline's actual input), classical
+    # init at 5 iterations must reach at-most the stress random init
+    # reaches at 40: the warm start removes the long Guttman tail the
+    # reference's iters=200 (train_end2end.py:157) is sized for.
+    key = jax.random.PRNGKey(3)
+    n = 48
+    truth = jax.random.normal(key, (1, n, 3)) * 5.0
+    d = jnp.sqrt(
+        jnp.sum((truth[:, :, None] - truth[:, None]) ** 2, axis=-1) + 1e-12
+    )
+    bins = jnp.searchsorted(jnp.asarray(DISTANCE_THRESHOLDS),
+                            jnp.clip(d, 0.0, 19.99))
+    probs = jax.nn.one_hot(bins, 37)
+    dist, weights = center_distogram(probs, center="median")
+
+    def final_stress(init, iters):
+        _, hist = mds(dist, weights=weights, iters=iters, tol=1e-9,
+                      key=jax.random.PRNGKey(0), init=init)
+        return float(np.ravel(np.asarray(hist))[-1])
+
+    assert final_stress("classical", 5) <= final_stress("random", 40) + 1e-4
+
+    with pytest.raises(ValueError):
+        mds(dist, iters=2, init="not-an-init")
+
+
 def test_mds_and_mirror_shapes():
     # reference tests/test_utils.py:18-35
     key = jax.random.PRNGKey(0)
