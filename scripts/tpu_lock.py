@@ -31,7 +31,9 @@ import os
 import sys
 import time
 
-LOCK_PATH = os.path.join(
+# AF2_TPU_LOCK_PATH override: tests isolate themselves from the real lock
+# (a suite run during a live measurement must neither block it nor fail on it)
+LOCK_PATH = os.environ.get("AF2_TPU_LOCK_PATH") or os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".tpu.lock"
 )
 

@@ -1,0 +1,76 @@
+"""Single-client tunnel lock (scripts/tpu_lock.py).
+
+The lock is pure host-side flock plumbing — no jax — but it guards every
+on-chip measurement, so its semantics (mutual exclusion, fail-fast
+timeout=0, kernel-owned release) get pinned here.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts")
+sys.path.insert(0, SCRIPTS)
+
+# isolate from the real .tpu.lock BEFORE importing: a test must neither
+# block a live measurement nor fail because one is running
+os.environ["AF2_TPU_LOCK_PATH"] = os.path.join(
+    tempfile.mkdtemp(prefix="af2locktest"), "test.lock"
+)
+
+from tpu_lock import LOCK_BUSY, tpu_lock  # noqa: E402
+
+
+def test_exclusion_and_release():
+    with tpu_lock():
+        # a second holder in another process must fail fast with EX_TEMPFAIL
+        rc = subprocess.run(
+            [sys.executable, os.path.join(SCRIPTS, "tpu_lock.py"),
+             "--", "true"],
+            capture_output=True,
+        ).returncode
+        assert rc == 75
+        # and an in-process try-once acquire raises
+        with pytest.raises(TimeoutError):
+            with tpu_lock():
+                pass
+    # released: both styles acquire immediately
+    with tpu_lock(timeout=0):
+        pass
+    rc = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "tpu_lock.py"), "--", "true"],
+        capture_output=True,
+    ).returncode
+    assert rc == 0
+
+
+def test_cli_passes_through_exit_code():
+    rc = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "tpu_lock.py"),
+         "--", sys.executable, "-c", "raise SystemExit(7)"],
+        capture_output=True,
+    ).returncode
+    assert rc == 7
+
+
+def test_crashed_holder_releases():
+    # kernel-owned: a SIGKILLed holder releases instantly (no stale pidfile)
+    holder = subprocess.Popen(
+        [sys.executable, os.path.join(SCRIPTS, "tpu_lock.py"),
+         "--", sys.executable, "-c",
+         "import sys, time; print('held', flush=True); time.sleep(60)"],
+        stdout=subprocess.PIPE, text=True,
+    )
+    assert holder.stdout.readline().strip() == "held"
+    holder.kill()
+    holder.wait()
+    with tpu_lock(timeout=5, poll=0.2):
+        pass
+
+
+def test_lock_busy_sentinel_is_stable():
+    # orchestrators compare by equality; a rename breaks their back-off path
+    assert LOCK_BUSY == "tpu-lock-busy"
