@@ -250,19 +250,21 @@ def main():
             # single-chip lever; BASELINE config 5 pins dim/depth, not
             # the head split
             ("e2e_h4dh128", {**base, "heads": 4, "dim_head": 128}),
-            ("e2e_mdsbwd25", {**base, "mds_bwd_iters": 25}),
             # Torgerson warm start + 25-iteration tail: classical init
-            # reaches the random-init-200 stress floor in ~1 iteration on
+            # reaches the random-init stress floor in ~1 iteration on
             # exact AND distogram-censored real inputs (geometry/mds.py,
             # tests/test_geometry.py) — this leg measures the step-time
             # win of dropping the 200-iteration sequential Guttman tail
             ("e2e_mds25classical",
              {**base, "mds_iters": 25, "mds_init": "classical"}),
+            ("e2e_mdsbwd25", {**base, "mds_bwd_iters": 25}),
             # MDS scan unroll: amortizes the 200 sequential small-kernel
             # iterations' dispatch overhead (PERF.md "MDS latency")
             ("e2e_mdsunroll8", {**base, "mds_unroll": 8}),
             ("e2e_tile26", {**base, "tile_elems": 1 << 26}),
-            ("e2e_chunk0", {**base, "batch_chunk": 0}),
+            # e2e_chunk0 is RETIRED: measured OOM at compile (session 5,
+            # PERF.md) — re-attempting a known-dead config risks a worker
+            # crash for zero information
             ("e2e_chunk96", {**base, "batch_chunk": 96}),
         ]
     for name, spec in variants:

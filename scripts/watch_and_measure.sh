@@ -2,12 +2,15 @@
 # Probe the TPU tunnel every 8 minutes; on a healthy probe, run the
 # remaining measurements in information-value order: the e2e decomposition
 # (where-the-time-goes — the sweep showed the knobs are all noise, so the
-# decomposition is what identifies the real sink), then the north-star
-# depth ladder (depth-24 monolithic MFU + depth-48 segmented, never timed
-# on chip in rounds 1-3), then the sweep's remaining micro legs
+# decomposition is what identifies the real sink), then the sweep (the new
+# e2e legs — ff-chunk, qbt1152, h4dh128, mds25classical — plus the kernel
+# micro grid; measured nowhere else), then the depth ladder LAST: the
+# round-end driver bench re-measures depth 24 + depth 48 regardless, so
+# under a short recovery window the ladder is the redundant stage
 # (already-recorded legs are skipped by all three). Each script exits 3
 # when it detects a wedged tunnel — the watcher goes back to probing
-# instead of hammering a dead relay; any other exit code counts as done. The probe is a tiny subprocess matmul under a generous
+# instead of hammering a dead relay; any other exit code counts as done.
+# The probe is a tiny subprocess matmul under a generous
 # timeout — killing a client that is merely waiting on a wedged relay
 # does not worsen the wedge (PERF.md).
 cd "$(dirname "$0")/.."
@@ -52,16 +55,6 @@ for i in $(seq 1 60); do
       if [ "$rc" -eq 3 ]; then sleep 480; continue; fi
       decomp_done=1
     fi
-    if [ "$ladder_done" -eq 0 ]; then
-      if past_deadline; then echo "deadline; skipping ladder"; exit 0; fi
-      # round-4 priority #3: depth-24 monolithic MFU + depth-48 segmented
-      # steps/sec (never timed on chip in rounds 1-3)
-      python scripts/bench_depth_ladder.py
-      rc=$?
-      echo "$(date -u +%H:%M:%S) depth ladder finished rc=$rc"
-      if [ "$rc" -eq 3 ]; then sleep 480; continue; fi
-      ladder_done=1
-    fi
     if [ "$sweep_done" -eq 0 ]; then
       if past_deadline; then echo "deadline; skipping sweep"; exit 0; fi
       python scripts/bench_sweep.py
@@ -69,6 +62,16 @@ for i in $(seq 1 60); do
       echo "$(date -u +%H:%M:%S) sweep finished rc=$rc"
       if [ "$rc" -eq 3 ]; then sleep 480; continue; fi
       sweep_done=1
+    fi
+    if [ "$ladder_done" -eq 0 ]; then
+      if past_deadline; then echo "deadline; skipping ladder"; exit 0; fi
+      # round-4 priority #3: depth-24 monolithic MFU + depth-48 segmented
+      # steps/sec — ALSO measured by the round-end driver bench, hence last
+      python scripts/bench_depth_ladder.py
+      rc=$?
+      echo "$(date -u +%H:%M:%S) depth ladder finished rc=$rc"
+      if [ "$rc" -eq 3 ]; then sleep 480; continue; fi
+      ladder_done=1
     fi
     echo "$(date -u +%H:%M:%S) all measurements recorded"
     exit 0
