@@ -10,7 +10,8 @@ Unlike the reference there is no torch/numpy dual-backend dispatch layer
 vmaps, and differentiates; numpy arrays are accepted and converted on entry.
 """
 
-from alphafold2_tpu.geometry.distogram import center_distogram
+from alphafold2_tpu.geometry.distogram import (center_distogram,
+                                               distogram_confidence)
 from alphafold2_tpu.geometry.mds import mds, mdscaling, MDScaling
 from alphafold2_tpu.geometry.dihedral import get_dihedral, calc_phis
 from alphafold2_tpu.geometry.kabsch import kabsch, Kabsch
@@ -20,6 +21,7 @@ from alphafold2_tpu.geometry.sidechain import nerf, sidechain_container
 
 __all__ = [
     "center_distogram",
+    "distogram_confidence",
     "mds",
     "mdscaling",
     "MDScaling",

@@ -93,6 +93,45 @@ def center_distogram(
     return central, weights
 
 
+def distogram_confidence(distogram, mask=None):
+    """Per-residue confidence in [0, 1] from distogram entropy.
+
+    The reference exposes no confidence signal at all; structure-prediction
+    users expect one (AlphaFold's pLDDT convention). This is the natural
+    distogram analog: residue i's confidence is the mean over partners j of
+    the model's CERTAINTY about the (i, j) distance, where certainty is one
+    minus the normalized entropy of the bucket distribution —
+    1 - H(p_ij)/ln(B). A uniform distogram scores 0, a one-hot distogram 1.
+    Written into PDB B-factors (scaled x100, pLDDT-style) by predict.py.
+
+    Args:
+      distogram: (batch, N, N, B) probabilities (softmax the logits first).
+      mask: (batch, N) bool residue validity; masked partners are excluded
+        from every mean and masked residues score 0.
+
+    Returns: (batch, N) float32.
+    """
+    distogram = jnp.asarray(distogram)
+    if distogram.ndim == 3:
+        distogram = distogram[None]
+    p = distogram.astype(jnp.float32)
+    n, nb = p.shape[-2], p.shape[-1]
+    ent = -jnp.sum(p * jnp.log(jnp.clip(p, 1e-12)), axis=-1)  # (b, N, N)
+    certainty = 1.0 - ent / jnp.log(float(nb))
+
+    off_diag = ~jnp.eye(n, dtype=bool)[None]
+    if mask is not None:
+        mask = jnp.asarray(mask, dtype=bool)
+        pair_valid = off_diag & mask[:, :, None] & mask[:, None, :]
+    else:
+        pair_valid = jnp.broadcast_to(off_diag, certainty.shape)
+    denom = jnp.maximum(jnp.sum(pair_valid, axis=-1), 1)
+    conf = jnp.sum(jnp.where(pair_valid, certainty, 0.0), axis=-1) / denom
+    if mask is not None:
+        conf = jnp.where(mask, conf, 0.0)
+    return jnp.clip(conf, 0.0, 1.0)
+
+
 def bucketize_distances(coords, mask=None, bins=None, ignore_index: int = -100):
     """Ground-truth bucketized distance labels for distogram training.
 

@@ -38,6 +38,7 @@ class PdbAtom:
     res_seq: int
     xyz: np.ndarray
     element: str = ""
+    bfactor: float = 0.0  # carries per-residue confidence (pLDDT-style)
 
 
 @dataclass
@@ -91,6 +92,7 @@ def parse_pdb(path: str) -> PdbStructure:
                         [float(line[30:38]), float(line[38:46]), float(line[46:54])]
                     ),
                     element=line[76:78].strip(),
+                    bfactor=float(line[60:66]) if line[60:66].strip() else 0.0,
                 )
             )
     return PdbStructure(atoms)
@@ -105,7 +107,7 @@ def write_pdb(path: str, structure: PdbStructure) -> str:
                 f"ATOM  {a.serial:5d} {name}{'':1s}{a.res_name:>3s} "
                 f"{a.chain_id:1s}{a.res_seq:4d}    "
                 f"{a.xyz[0]:8.3f}{a.xyz[1]:8.3f}{a.xyz[2]:8.3f}"
-                f"{1.00:6.2f}{0.00:6.2f}          {a.element:>2s}\n"
+                f"{1.00:6.2f}{a.bfactor:6.2f}          {a.element:>2s}\n"
             )
         fh.write("END\n")
     return path
@@ -116,17 +118,27 @@ def coords_to_structure(
     sequence: Optional[str] = None,
     atom_names=BACKBONE_ATOM_NAMES[:3],
     chain_id: str = "A",
+    bfactors=None,
 ) -> PdbStructure:
     """Build a PdbStructure from (L, A, 3) or (L*A, 3) coordinates.
 
     Each residue gets `len(atom_names)` atoms; `sequence` is a one-letter
-    string (defaults to poly-alanine).
+    string (defaults to poly-alanine). `bfactors`: optional per-residue
+    values written to every atom of that residue (confidence convention:
+    `distogram_confidence` x 100, pLDDT-style).
     """
     coords = np.asarray(coords, dtype=np.float64).reshape(-1, 3)
     n_per_res = len(atom_names)
     length = coords.shape[0] // n_per_res
     if sequence is None:
         sequence = "A" * length
+    if bfactors is not None:
+        bfactors = np.asarray(bfactors, dtype=np.float64).reshape(-1)
+        if bfactors.shape[0] != length:
+            raise ValueError(
+                f"bfactors has {bfactors.shape[0]} entries for {length} "
+                f"residues"
+            )
     atoms = []
     serial = 1
     for i in range(length):
@@ -141,6 +153,7 @@ def coords_to_structure(
                     res_seq=i + 1,
                     xyz=coords[i * n_per_res + j],
                     element=an[0],
+                    bfactor=float(bfactors[i]) if bfactors is not None else 0.0,
                 )
             )
             serial += 1

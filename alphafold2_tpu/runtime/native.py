@@ -73,12 +73,13 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.af2_parse_pdb.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
-            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_char_p,
         ]
         lib.af2_write_pdb.restype = ctypes.c_int64
         lib.af2_write_pdb.argtypes = [
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int64,
         ]
         _lib = lib
         return _lib
@@ -273,11 +274,13 @@ def parse_pdb_fast(path: str):
     max_atoms = max(1, text.count(b"\nATOM") + (1 if text.startswith(b"ATOM") else 0))
     xyz = np.empty((max_atoms, 3), np.float32)
     res_seq = np.empty(max_atoms, np.int32)
+    bfac = np.empty(max_atoms, np.float32)
     names = ctypes.create_string_buffer(8 * max_atoms)
     n = lib.af2_parse_pdb(
         text, len(text), max_atoms,
         xyz.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         res_seq.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        bfac.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         names,
     )
     atoms = []
@@ -292,6 +295,7 @@ def parse_pdb_fast(path: str):
                 chain_id=(rec[7:8].decode().strip() or "A"),
                 res_seq=int(res_seq[i]),
                 xyz=xyz[i].astype(np.float64),
+                bfactor=float(bfac[i]),
             )
         )
     return PdbStructure(atoms)
@@ -308,6 +312,7 @@ def write_pdb_fast(path: str, structure) -> str:
     n = len(structure.atoms)
     xyz = np.asarray([a.xyz for a in structure.atoms], np.float32).reshape(n, 3)
     res_seq = np.asarray([a.res_seq for a in structure.atoms], np.int32)
+    bfac = np.asarray([a.bfactor for a in structure.atoms], np.float32)
     names = bytearray(8 * n)
     for i, a in enumerate(structure.atoms):
         nm = a.name if len(a.name) == 4 else f" {a.name:<3s}"
@@ -319,6 +324,7 @@ def write_pdb_fast(path: str, structure) -> str:
     written = lib.af2_write_pdb(
         xyz.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         res_seq.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        bfac.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         bytes(names), n, out, cap,
     )
     if written < 0:

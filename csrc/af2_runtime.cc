@@ -234,11 +234,13 @@ static inline int field_i(const char* line, int beg, int len) {
 }
 
 // Parse ATOM records (first model). Per atom writes: xyz (3 floats),
-// res_seq (int32), and 4-char atom name + 3-char residue name + 1-char
-// chain into the names buffer (8 bytes/atom: name[4], res3[3], chain[1]).
-// Returns number of atoms parsed (capped at max_atoms).
+// res_seq (int32), B-factor (1 float — carries per-residue confidence,
+// geometry/pdb.py convention), and 4-char atom name + 3-char residue name +
+// 1-char chain into the names buffer (8 bytes/atom: name[4], res3[3],
+// chain[1]). Returns number of atoms parsed (capped at max_atoms).
 int af2_parse_pdb(const char* text, int64_t text_len, int max_atoms,
-                  float* xyz_out, int32_t* res_seq_out, char* names_out) {
+                  float* xyz_out, int32_t* res_seq_out, float* bfactor_out,
+                  char* names_out) {
   int n = 0;
   const char* p = text;
   const char* end = text + text_len;
@@ -252,6 +254,7 @@ int af2_parse_pdb(const char* text, int64_t text_len, int max_atoms,
       xyz_out[n * 3 + 1] = field_f(p, 38, 8);
       xyz_out[n * 3 + 2] = field_f(p, 46, 8);
       res_seq_out[n] = field_i(p, 22, 4);
+      bfactor_out[n] = linelen >= 66 ? field_f(p, 60, 6) : 0.0f;
       std::memcpy(names_out + n * 8 + 0, p + 12, 4);  // atom name
       std::memcpy(names_out + n * 8 + 4, p + 17, 3);  // res name
       names_out[n * 8 + 7] = p[21];                   // chain id
@@ -264,10 +267,11 @@ int af2_parse_pdb(const char* text, int64_t text_len, int max_atoms,
 }
 
 // Write ATOM records into `out` (caller sizes it at >= 82*(n_atoms+1)).
-// names layout as af2_parse_pdb. Returns bytes written.
+// names layout as af2_parse_pdb; bfactor may be null (writes 0.00).
+// Returns bytes written.
 int64_t af2_write_pdb(const float* xyz, const int32_t* res_seq,
-                      const char* names, int n_atoms, char* out,
-                      int64_t out_cap) {
+                      const float* bfactor, const char* names, int n_atoms,
+                      char* out, int64_t out_cap) {
   int64_t w = 0;
   for (int i = 0; i < n_atoms; ++i) {
     if (w + 82 > out_cap) return -1;
@@ -282,7 +286,8 @@ int64_t af2_write_pdb(const float* xyz, const int32_t* res_seq,
         out + w, out_cap - w,
         "ATOM  %5d %-4s %3s %c%4d    %8.3f%8.3f%8.3f%6.2f%6.2f\n",
         i + 1, name, res3, chain ? chain : 'A', res_seq[i],
-        xyz[i * 3 + 0], xyz[i * 3 + 1], xyz[i * 3 + 2], 1.0, 0.0);
+        xyz[i * 3 + 0], xyz[i * 3 + 1], xyz[i * 3 + 2], 1.0,
+        bfactor ? bfactor[i] : 0.0f);
   }
   if (w + 4 <= out_cap) w += std::snprintf(out + w, out_cap - w, "END\n");
   return w;

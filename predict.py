@@ -61,7 +61,8 @@ def main():
     import jax.numpy as jnp
 
     from alphafold2_tpu.constants import aa_to_tokens
-    from alphafold2_tpu.geometry import MDScaling, center_distogram
+    from alphafold2_tpu.geometry import (MDScaling, center_distogram,
+                                         distogram_confidence)
     from alphafold2_tpu.geometry.pdb import coords_to_pdb
     from alphafold2_tpu.models import Alphafold2Config, alphafold2_apply, alphafold2_init
     from alphafold2_tpu.training import TrainConfig, train_state_init
@@ -128,9 +129,15 @@ def main():
     trace = np.asarray(jnp.transpose(coords, (0, 2, 1))[0])  # (L, 3)
     print(f"MDS final stress: {float(stresses[-1][0]):.4f}")
 
+    # per-residue confidence from distogram entropy, written as B-factors
+    # (x100, pLDDT-style; the reference exposes no confidence signal)
+    conf = np.asarray(distogram_confidence(probs))[0]
+    print(f"mean confidence: {100 * conf.mean():.1f}/100")
+
     # NOTE: geometric relaxation (scripts/refinement.py) operates on full
     # N/CA/C backbones; a CA-only trace has no bond structure to relax
-    coords_to_pdb(args.out, trace, sequence=seq_str, atom_names=("CA",))
+    coords_to_pdb(args.out, trace, sequence=seq_str, atom_names=("CA",),
+                  bfactors=100.0 * conf)
     print(f"wrote {args.out} ({L} residues)")
 
 
@@ -188,12 +195,25 @@ def _predict_full_atom(args, cfg, tokens, seq_str):
         lambda p, t: predict_structure(
             p, ecfg, t, rng=jax.random.PRNGKey(args.seed),
             model_apply_fn=model_apply_fn,
-        )["refined"]
-    )(params, tokens)  # (1, L, 14, 3)
-    backbone = np.asarray(out)[0, :, :4]  # N, CA, C, O slots
+        )
+    )(params, tokens)
+    backbone = np.asarray(out["refined"])[0, :, :4]  # N, CA, C, O slots
+
+    # per-residue confidence from distogram entropy -> B-factors (x100,
+    # pLDDT-style). The distogram is over the 3x-elongated backbone-atom
+    # axis (one token per N/CA/C atom); average the three atoms per residue.
+    from alphafold2_tpu.geometry import distogram_confidence
+
+    probs = jax.nn.softmax(
+        jnp.asarray(out["distogram_logits"]).astype(jnp.float32), axis=-1
+    )
+    conf3 = np.asarray(distogram_confidence(probs))[0]  # (3L,)
+    conf = conf3.reshape(-1, 3).mean(axis=1)
+    print(f"mean confidence: {100 * conf.mean():.1f}/100")
+
     coords_to_pdb(
         args.out, backbone.reshape(-1, 3), sequence=seq_str,
-        atom_names=("N", "CA", "C", "O"),
+        atom_names=("N", "CA", "C", "O"), bfactors=100.0 * conf,
     )
     print(f"wrote {args.out} ({tokens.shape[1]} residues, full pipeline)")
 

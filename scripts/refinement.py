@@ -66,7 +66,11 @@ def main():
     relaxed = run_fast_relax(
         np.asarray(coords), seq, iters=args.iters, peptide_mask=peptide_mask
     )
-    coords_to_pdb(args.output, relaxed, sequence=seq)
+    # carry per-residue confidence (B-factors, predict.py convention)
+    # through relaxation — relaxation moves atoms, not confidence
+    bfactors = np.asarray([by_res[k]["CA"].bfactor for k in complete])
+    coords_to_pdb(args.output, relaxed, sequence=seq,
+                  bfactors=bfactors if bfactors.any() else None)
     print(f"wrote {args.output}")
 
 

@@ -97,6 +97,41 @@ def test_mds_reconstructs_known_structure():
     assert min(float(err[0]), float(err_m[0])) < 0.1
 
 
+def test_distogram_confidence_bounds_and_mask():
+    from alphafold2_tpu.geometry import distogram_confidence
+
+    n, nb = 12, 37
+    uniform = jnp.full((1, n, n, nb), 1.0 / nb)
+    onehot = jax.nn.one_hot(jnp.zeros((1, n, n), jnp.int32), nb)
+    np.testing.assert_allclose(
+        np.asarray(distogram_confidence(uniform)), 0.0, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(distogram_confidence(onehot)), 1.0, atol=1e-5
+    )
+    # masked residues score 0 and are excluded from partners' means
+    mask = jnp.arange(n)[None] < n - 4
+    conf = np.asarray(distogram_confidence(onehot, mask=mask))
+    assert np.all(conf[0, -4:] == 0.0)
+    np.testing.assert_allclose(conf[0, : n - 4], 1.0, atol=1e-5)
+
+
+def test_pdb_bfactor_roundtrip(tmp_path):
+    from alphafold2_tpu.geometry.pdb import coords_to_pdb, parse_pdb
+
+    L = 5
+    coords = np.arange(L * 3, dtype=np.float64).reshape(L, 1, 3)
+    conf = np.linspace(10.0, 97.5, L)
+    out = str(tmp_path / "conf.pdb")
+    coords_to_pdb(out, coords, sequence="AC" + "G" * 3, atom_names=("CA",),
+                  bfactors=conf)
+    back = parse_pdb(out)
+    got = np.array([a.bfactor for a in back.atoms])
+    np.testing.assert_allclose(got, conf, atol=5e-3)  # PDB %6.2f precision
+    with pytest.raises(ValueError):
+        coords_to_pdb(out, coords, atom_names=("CA",), bfactors=conf[:-1])
+
+
 def test_mds_classical_init_converges_in_few_iters():
     # Torgerson warm start: on exact distances the embedding is already the
     # solution, so 2 Guttman iterations beat random init's 500 (above).

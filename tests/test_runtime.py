@@ -99,12 +99,17 @@ def test_pdb_codec_roundtrip(tmp_path):
     """C++ writer/parser round-trips against the pure-Python implementation."""
     rs = np.random.RandomState(5)
     coords = rs.randn(7, 3, 3).astype(np.float64) * 10
-    structure = coords_to_structure(coords, sequence="ACDEFGH")
+    # per-residue B-factors (confidence convention) must survive BOTH codecs
+    structure = coords_to_structure(
+        coords, sequence="ACDEFGH", bfactors=np.linspace(5.0, 95.0, 7)
+    )
 
     py_path = str(tmp_path / "py.pdb")
     cc_path = str(tmp_path / "cc.pdb")
     write_pdb(py_path, structure)
     write_pdb_fast(cc_path, structure)
+
+    want_b = np.array([a.bfactor for a in structure.atoms])
 
     # C++ written file parses identically with BOTH parsers
     for parse in (parse_pdb, parse_pdb_fast):
@@ -113,11 +118,16 @@ def test_pdb_codec_roundtrip(tmp_path):
         np.testing.assert_allclose(got.coords(), structure.coords(), atol=2e-3)
         assert got.sequence() == "ACDEFGH"
         assert [a.name for a in got.atoms] == [a.name for a in structure.atoms]
+        np.testing.assert_allclose(
+            [a.bfactor for a in got.atoms], want_b, atol=5e-3
+        )
 
     # and the Python-written file parses identically with the C++ parser
     got = parse_pdb_fast(py_path)
     np.testing.assert_allclose(got.coords(), structure.coords(), atol=2e-3)
     assert got.sequence() == "ACDEFGH"
+    np.testing.assert_allclose([a.bfactor for a in got.atoms], want_b,
+                               atol=5e-3)
 
 
 def _fallback_loader(ds, batch, max_len, buckets=None, seed=0):
