@@ -72,6 +72,16 @@ class PdbStructure:
         return "".join(seq)
 
 
+def _parse_bfactor(line: str) -> float:
+    # tolerant: files in the wild carry blanks or overflow markers ('******'
+    # for B > 999.99) in cols 61-66 — junk must not abort the whole parse
+    # (and the C++ fast parser's field_f likewise returns 0 on junk)
+    try:
+        return float(line[60:66])
+    except (ValueError, IndexError):
+        return 0.0
+
+
 def parse_pdb(path: str) -> PdbStructure:
     """Parse ATOM records from a PDB file (first model only)."""
     atoms: List[PdbAtom] = []
@@ -92,7 +102,7 @@ def parse_pdb(path: str) -> PdbStructure:
                         [float(line[30:38]), float(line[38:46]), float(line[46:54])]
                     ),
                     element=line[76:78].strip(),
-                    bfactor=float(line[60:66]) if line[60:66].strip() else 0.0,
+                    bfactor=_parse_bfactor(line),
                 )
             )
     return PdbStructure(atoms)

@@ -41,6 +41,17 @@ def main():
                          "random-init stress floor in ~1 Guttman iteration "
                          "— pair with a small --mds-iters for fast "
                          "inference; 'random' is reference parity")
+    ap.add_argument("--msa-file", default=None,
+                    help="FASTA/A3M alignment for the MSA track (first "
+                         "record = query; lowercase a3m insertions are "
+                         "stripped; rows capped at --max-msa-rows)")
+    ap.add_argument("--max-msa-rows", type=int, default=20,
+                    help="MSA row cap (reference MAX_NUM_MSA)")
+    ap.add_argument("--max-num-msa", type=int, default=None,
+                    help="MSA row-position-table size; MUST match the "
+                         "training config when restoring a checkpoint "
+                         "(default: derived from the loaded MSA, min 20 — "
+                         "like --max-seq-len for sequence positions)")
     ap.add_argument("--ckpt-dir", default=None, help="restore trained params")
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument("--seed", type=int, default=0)
@@ -71,6 +82,18 @@ def main():
     tokens = jnp.asarray(aa_to_tokens(seq_str))[None]  # (1, L)
     L = tokens.shape[1]
 
+    msa_tokens = msa_mask = None
+    if args.msa_file is not None:
+        from alphafold2_tpu.utils.msa import load_msa
+
+        msa_np, msa_mask_np = load_msa(
+            args.msa_file, query=seq_str, max_rows=args.max_msa_rows
+        )
+        msa_tokens = jnp.asarray(msa_np)
+        msa_mask = jnp.asarray(msa_mask_np)
+        print(f"MSA: {msa_tokens.shape[1]} rows x {msa_tokens.shape[2]} "
+              f"cols from {args.msa_file}")
+
     cfg = Alphafold2Config(
         dim=args.dim,
         depth=args.depth,
@@ -80,11 +103,13 @@ def main():
         # --max-seq-len pins the table to the training value for restore
         max_seq_len=args.max_seq_len
         or max(64, 3 * L if args.full_atom else L),
+        max_num_msa=args.max_num_msa
+        or max(20, msa_tokens.shape[1] if msa_tokens is not None else 0),
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
     )
 
     if args.full_atom:
-        _predict_full_atom(args, cfg, tokens, seq_str)
+        _predict_full_atom(args, cfg, tokens, seq_str, msa_tokens, msa_mask)
         return
 
     if args.ckpt_dir is not None:
@@ -109,12 +134,13 @@ def main():
 
         mesh = make_mesh({"seq": args.sp_shards})
         logits = jax.jit(
-            lambda p, t: alphafold2_apply_sp(p, cfg, t, None, mesh)
-        )(params, tokens)  # (1, L, L, 37)
+            lambda p, t, m, mm: alphafold2_apply_sp(
+                p, cfg, t, m, mesh, msa_mask=mm)
+        )(params, tokens, msa_tokens, msa_mask)  # (1, L, L, 37)
     else:
         logits = jax.jit(
-            lambda p, t: alphafold2_apply(p, cfg, t, None)
-        )(params, tokens)  # (1, L, L, 37)
+            lambda p, t, m, mm: alphafold2_apply(p, cfg, t, m, msa_mask=mm)
+        )(params, tokens, msa_tokens, msa_mask)  # (1, L, L, 37)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     distances, weights = center_distogram(probs)
 
@@ -141,7 +167,8 @@ def main():
     print(f"wrote {args.out} ({L} residues)")
 
 
-def _predict_full_atom(args, cfg, tokens, seq_str):
+def _predict_full_atom(args, cfg, tokens, seq_str, msa_tokens=None,
+                       msa_mask=None):
     """sequence -> refined 14-atom cloud -> N/CA/C/O backbone PDB."""
     import jax.numpy as jnp
 
@@ -192,11 +219,11 @@ def _predict_full_atom(args, cfg, tokens, seq_str):
         model_apply_fn = sp_model_apply(make_mesh({"seq": args.sp_shards}))
 
     out = jax.jit(
-        lambda p, t: predict_structure(
+        lambda p, t, m, mm: predict_structure(
             p, ecfg, t, rng=jax.random.PRNGKey(args.seed),
-            model_apply_fn=model_apply_fn,
+            msa=m, msa_mask=mm, model_apply_fn=model_apply_fn,
         )
-    )(params, tokens)
+    )(params, tokens, msa_tokens, msa_mask)
     backbone = np.asarray(out["refined"])[0, :, :4]  # N, CA, C, O slots
 
     # per-residue confidence from distogram entropy -> B-factors (x100,

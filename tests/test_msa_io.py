@@ -1,0 +1,77 @@
+"""FASTA/A3M alignment parsing (alphafold2_tpu/utils/msa.py)."""
+
+import numpy as np
+import pytest
+
+from alphafold2_tpu.constants import PAD_TOKEN_ID, aa_to_tokens
+from alphafold2_tpu.utils.msa import load_msa, parse_alignment
+
+A3M = """>query
+ACDEFG
+>hit1 some description
+AC-EFG
+>hit2 with lowercase insertions
+ACdefDEFG
+>hit3
+.CDEFG
+"""
+
+
+def test_parse_alignment_a3m_conventions(tmp_path):
+    p = tmp_path / "msa.a3m"
+    p.write_text(A3M)
+    records = parse_alignment(str(p))
+    assert [h.split()[0] if h else h for h, _ in records] == [
+        "query", "hit1", "hit2", "hit3"
+    ]
+    # lowercase insertions stripped, '.' normalized to '-'
+    assert [s for _, s in records] == ["ACDEFG", "AC-EFG", "ACDEFG", "-CDEFG"]
+
+
+def test_load_msa_tokens_and_mask(tmp_path):
+    p = tmp_path / "msa.a3m"
+    p.write_text(A3M)
+    tokens, mask = load_msa(str(p), query="ACDEFG")
+    assert tokens.shape == (1, 4, 6) and mask.shape == (1, 4, 6)
+    np.testing.assert_array_equal(tokens[0, 0], aa_to_tokens("ACDEFG"))
+    # gaps: pad token + masked out
+    assert tokens[0, 1, 2] == PAD_TOKEN_ID and not mask[0, 1, 2]
+    assert not mask[0, 3, 0]
+    assert mask[0, 0].all()
+
+    # row cap drops from the end
+    tokens2, _ = load_msa(str(p), max_rows=2)
+    assert tokens2.shape == (1, 2, 6)
+
+
+def test_load_msa_gapped_query_maps_to_query_coordinates(tmp_path):
+    # Clustal/MUSCLE-style: the query row itself is gapped; columns where
+    # the query is gapped must be dropped so column i = query residue i
+    p = tmp_path / "clustal.fasta"
+    p.write_text(">q\nAC-DEF\n>h\nACWDE-\n")
+    tokens, mask = load_msa(str(p), query="ACDEF")
+    assert tokens.shape == (1, 2, 5)
+    np.testing.assert_array_equal(tokens[0, 0], aa_to_tokens("ACDEF"))
+    np.testing.assert_array_equal(tokens[0, 1], aa_to_tokens("ACDE-"))
+    assert not mask[0, 1, 4]
+
+
+def test_load_msa_query_mismatch_raises(tmp_path):
+    p = tmp_path / "msa.a3m"
+    p.write_text(A3M)
+    with pytest.raises(ValueError, match="does not match"):
+        load_msa(str(p), query="ACDEFGHIK")
+
+
+def test_parse_alignment_rejects_ragged(tmp_path):
+    p = tmp_path / "bad.fasta"
+    p.write_text(">a\nACDEF\n>b\nACD\n")
+    with pytest.raises(ValueError, match="differ in length"):
+        parse_alignment(str(p))
+
+
+def test_parse_alignment_empty_raises(tmp_path):
+    p = tmp_path / "empty.fasta"
+    p.write_text("\n")
+    with pytest.raises(ValueError, match="no sequences"):
+        parse_alignment(str(p))
