@@ -114,7 +114,8 @@ def sp_model_apply(mesh: Mesh, axis_name: str = "seq"):
     from alphafold2_tpu.parallel.sp_trunk import alphafold2_apply_sp
 
     def apply_fn(params, cfg, seq, msa, *, mask=None, msa_mask=None,
-                 embedds=None, rng=None):
+                 embedds=None, templates=None, templates_mask=None,
+                 rng=None):
         if embedds is not None:
             raise ValueError(
                 "the embedds path has no row axis to shard; use the "
@@ -132,6 +133,7 @@ def sp_model_apply(mesh: Mesh, axis_name: str = "seq"):
         return alphafold2_apply_sp(
             params, cfg, seq, msa, mesh,
             axis_name=axis_name, mask=mask, msa_mask=msa_mask,
+            templates=templates, templates_mask=templates_mask,
         )
 
     return apply_fn

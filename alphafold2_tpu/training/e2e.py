@@ -84,7 +84,7 @@ def elongate(seq, factor: int = 3):
     return jnp.repeat(seq, factor, axis=-1)
 
 
-def predict_structure(params, ecfg: E2EConfig, seq, mask=None, rng=None, msa=None, msa_mask=None, embedds=None, model_apply_fn=None):
+def predict_structure(params, ecfg: E2EConfig, seq, mask=None, rng=None, msa=None, msa_mask=None, embedds=None, templates=None, templates_mask=None, model_apply_fn=None):
     """Full forward: sequence -> refined (b, L, 14, 3) atom cloud.
 
     params: {"model": ..., "refiner": ...}.
@@ -107,9 +107,17 @@ def predict_structure(params, ecfg: E2EConfig, seq, mask=None, rng=None, msa=Non
     else:
         rng_model, rng_mds = None, jax.random.PRNGKey(0)
 
+    # templates are over the ELONGATED (3L, 3L) pair grid — the trunk's
+    # pair axes after the x3 backbone-atom expansion (one token per N/CA/C)
+    tmpl_kwargs = (
+        {"templates": templates, "templates_mask": templates_mask}
+        if templates is not None
+        else {}
+    )
     logits = apply_fn(
         params["model"], ecfg.model, seq3, msa,
         mask=mask3, msa_mask=msa_mask, embedds=embedds, rng=rng_model,
+        **tmpl_kwargs,
     )  # (b, 3L, 3L, buckets)
     # geometry runs in float32 regardless of the trunk's compute dtype:
     # the distogram -> MDS pipeline divides by pairwise distances (Guttman

@@ -52,6 +52,20 @@ def main():
                          "training config when restoring a checkpoint "
                          "(default: derived from the loaded MSA, min 20 — "
                          "like --max-seq-len for sequence positions)")
+    ap.add_argument("--embedds-file", default=None,
+                    help=".npz with 'embedds' (1, L, 1280) or (L, 1280): "
+                         "precomputed ESM-1b residue embeddings as the MSA "
+                         "substitute (reference train_end2end.py:54-59). "
+                         "For --full-atom the L axis is the RESIDUE axis; "
+                         "it is elongated x3 internally. Unsupported with "
+                         "--sp-shards")
+    ap.add_argument("--templates-file", default=None,
+                    help=".npz with 'templates' (1, T, N, N) int distogram "
+                         "buckets in [0, 37) and optional 'templates_mask' "
+                         "(1, T, N, N) bool: template conditioning "
+                         "(reference README.md:118-150). N must equal the "
+                         "model's pair-grid length (L, or 3L for "
+                         "--full-atom)")
     ap.add_argument("--ckpt-dir", default=None, help="restore trained params")
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument("--seed", type=int, default=0)
@@ -94,6 +108,57 @@ def main():
         print(f"MSA: {msa_tokens.shape[1]} rows x {msa_tokens.shape[2]} "
               f"cols from {args.msa_file}")
 
+    embedds = None
+    if args.embedds_file is not None:
+        if args.msa_file is not None:
+            ap.error("--embedds-file and --msa-file are exclusive (the "
+                     "embedds path is the MSA substitute)")
+        if args.sp_shards:
+            ap.error("--embedds-file is unsupported with --sp-shards (the "
+                     "substitute stream has no row axis to shard)")
+        raw = np.load(args.embedds_file)
+        arr = raw["embedds"] if hasattr(raw, "files") else raw
+        if arr.ndim == 2:
+            arr = arr[None]
+        if arr.shape[1] != L:
+            ap.error(f"--embedds-file has {arr.shape[1]} residues; --seq "
+                     f"has {L}")
+        embedds = np.asarray(arr, np.float32)
+        print(f"embedds: {embedds.shape[1]} residues x {embedds.shape[2]} "
+              f"dims from {args.embedds_file}")
+
+    templates = templates_mask = None
+    if args.templates_file is not None:
+        raw = np.load(args.templates_file)
+        tarr = np.asarray(raw["templates"])
+        # preserve dtype: int arrays are distogram BUCKETS, float arrays are
+        # raw Angstrom distances binned by the model itself
+        # (models/alphafold2.py templates path) — an unconditional int cast
+        # would silently truncate distances into nonsense bucket ids
+        if np.issubdtype(tarr.dtype, np.integer):
+            if tarr.min() < 0 or tarr.max() >= 37:
+                ap.error(f"--templates-file int buckets must be in [0, 37); "
+                         f"got range [{tarr.min()}, {tarr.max()}] — pass "
+                         f"float distances to have the model bin them")
+            templates = jnp.asarray(tarr.astype(np.int32))
+        else:
+            templates = jnp.asarray(tarr.astype(np.float32))
+        if templates.ndim == 3:
+            templates = templates[None]
+        templates_mask = (
+            jnp.asarray(np.asarray(raw["templates_mask"], bool))
+            if "templates_mask" in getattr(raw, "files", ())
+            else jnp.ones(templates.shape, bool)  # (b, T, N, N) per-position
+        )
+        grid = 3 * L if args.full_atom else L
+        if templates.shape[-2:] != (grid, grid):
+            ap.error(f"--templates-file pair grid is "
+                     f"{templates.shape[-2]}x{templates.shape[-1]}; the "
+                     f"model's is {grid}x{grid} "
+                     f"({'3L, elongated' if args.full_atom else 'L'})")
+        print(f"templates: {templates.shape[1]} x {templates.shape[-1]}^2 "
+              f"grids from {args.templates_file}")
+
     cfg = Alphafold2Config(
         dim=args.dim,
         depth=args.depth,
@@ -105,11 +170,13 @@ def main():
         or max(64, 3 * L if args.full_atom else L),
         max_num_msa=args.max_num_msa
         or max(20, msa_tokens.shape[1] if msa_tokens is not None else 0),
+        **({"num_embedds": embedds.shape[-1]} if embedds is not None else {}),
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
     )
 
     if args.full_atom:
-        _predict_full_atom(args, cfg, tokens, seq_str, msa_tokens, msa_mask)
+        _predict_full_atom(args, cfg, tokens, seq_str, msa_tokens, msa_mask,
+                           embedds, templates, templates_mask)
         return
 
     if args.ckpt_dir is not None:
@@ -134,13 +201,18 @@ def main():
 
         mesh = make_mesh({"seq": args.sp_shards})
         logits = jax.jit(
-            lambda p, t, m, mm: alphafold2_apply_sp(
-                p, cfg, t, m, mesh, msa_mask=mm)
-        )(params, tokens, msa_tokens, msa_mask)  # (1, L, L, 37)
+            lambda p, t, m, mm, tp, tpm: alphafold2_apply_sp(
+                p, cfg, t, m, mesh, msa_mask=mm,
+                templates=tp, templates_mask=tpm)
+        )(params, tokens, msa_tokens, msa_mask, templates,
+          templates_mask)  # (1, L, L, 37)
     else:
         logits = jax.jit(
-            lambda p, t, m, mm: alphafold2_apply(p, cfg, t, m, msa_mask=mm)
-        )(params, tokens, msa_tokens, msa_mask)  # (1, L, L, 37)
+            lambda p, t, m, mm, e, tp, tpm: alphafold2_apply(
+                p, cfg, t, m, msa_mask=mm, embedds=e,
+                templates=tp, templates_mask=tpm)
+        )(params, tokens, msa_tokens, msa_mask, embedds, templates,
+          templates_mask)  # (1, L, L, 37)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     distances, weights = center_distogram(probs)
 
@@ -168,7 +240,8 @@ def main():
 
 
 def _predict_full_atom(args, cfg, tokens, seq_str, msa_tokens=None,
-                       msa_mask=None):
+                       msa_mask=None, embedds=None, templates=None,
+                       templates_mask=None):
     """sequence -> refined 14-atom cloud -> N/CA/C/O backbone PDB."""
     import jax.numpy as jnp
 
@@ -218,12 +291,19 @@ def _predict_full_atom(args, cfg, tokens, seq_str, msa_tokens=None,
 
         model_apply_fn = sp_model_apply(make_mesh({"seq": args.sp_shards}))
 
+    if embedds is not None:
+        # per-RESIDUE embeddings -> per-backbone-atom (x3 elongation), the
+        # same host-side repeat training applies (train_end2end.py)
+        embedds = np.repeat(np.asarray(embedds), 3, axis=1)
+
     out = jax.jit(
-        lambda p, t, m, mm: predict_structure(
+        lambda p, t, m, mm, e, tp, tpm: predict_structure(
             p, ecfg, t, rng=jax.random.PRNGKey(args.seed),
-            msa=m, msa_mask=mm, model_apply_fn=model_apply_fn,
+            msa=m, msa_mask=mm, embedds=e, templates=tp, templates_mask=tpm,
+            model_apply_fn=model_apply_fn,
         )
-    )(params, tokens, msa_tokens, msa_mask)
+    )(params, tokens, msa_tokens, msa_mask, embedds, templates,
+      templates_mask)
     backbone = np.asarray(out["refined"])[0, :, :4]  # N, CA, C, O slots
 
     # per-residue confidence from distogram entropy -> B-factors (x100,
