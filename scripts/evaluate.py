@@ -1,0 +1,95 @@
+"""Structure evaluation CLI: predicted vs reference PDB -> RMSD / TM / GDT.
+
+The reference computes these metrics only inside a manual notebook
+(reference notebooks/structure_utils_tests.ipynb cells 10-20); this makes
+the same comparison a one-liner. Structures are matched on their common
+CA set (by residue number), Kabsch-aligned, and scored with the library
+metrics (geometry/metrics.py — reference utils.py:563-624 parity).
+
+Usage: python scripts/evaluate.py prediction.pdb truth.pdb [--chain A]
+Prints one JSON line so runs can be collected into JSONL records.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def ca_map(structure):
+    """residue number -> CA coordinate (first chain unless selected)."""
+    out = {}
+    for a in structure.atoms:
+        if a.name == "CA" and a.res_seq not in out:
+            out[a.res_seq] = a.xyz
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prediction")
+    ap.add_argument("truth")
+    ap.add_argument("--chain", default=None,
+                    help="chain of the TRUTH structure to score against "
+                         "(default: first chain)")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # host-side tool: never opens
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # a TPU tunnel client
+
+    from alphafold2_tpu.geometry import GDT, Kabsch, RMSD, TMscore
+    from alphafold2_tpu.geometry.pdb import parse_pdb
+
+    pred = parse_pdb(args.prediction)
+    truth = parse_pdb(args.truth)
+    chains = truth.chains()
+    truth = truth.select_chain(args.chain or chains[0])
+
+    pmap, tmap = ca_map(pred), ca_map(truth)
+    common = sorted(set(pmap) & set(tmap))
+    if len(common) < 3:
+        raise SystemExit(
+            f"only {len(common)} common CA residues between "
+            f"{args.prediction} ({len(pmap)}) and {args.truth} "
+            f"({len(tmap)}) — residue numbering must correspond"
+        )
+
+    import jax.numpy as jnp
+
+    P = jnp.asarray(np.stack([pmap[i] for i in common]).T)  # (3, N)
+    T = jnp.asarray(np.stack([tmap[i] for i in common]).T)
+    aligned, ref = Kabsch(P, T)
+    # MDS-derived structures carry a reflection ambiguity the phi fix can
+    # miss on CA-only traces: score the better hand, report which
+    mirrored, ref_m = Kabsch(P * jnp.array([[1.0], [1.0], [-1.0]]), T)
+    r_a = float(RMSD(aligned, ref)[0])
+    r_m = float(RMSD(mirrored, ref_m)[0])
+    if r_m < r_a:
+        aligned, ref, hand = mirrored, ref_m, "mirrored"
+    else:
+        hand = "direct"
+
+    result = {
+        "n_residues": len(common),
+        "coverage_pred": round(len(common) / max(1, len(pmap)), 3),
+        "coverage_truth": round(len(common) / max(1, len(tmap)), 3),
+        "rmsd": round(float(RMSD(aligned, ref)[0]), 3),
+        "tm_score": round(float(TMscore(aligned, ref)[0]), 4),
+        "gdt_ts": round(float(GDT(aligned, ref)[0]), 4),
+        "gdt_ha": round(float(GDT(aligned, ref, mode="HA")[0]), 4),
+        "hand": hand,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
