@@ -44,9 +44,14 @@ def rmsd(X, Y, mask=None):
     return jnp.sqrt(jnp.sum(sq * w, axis=-1) / (3.0 * n))
 
 
-def gdt(X, Y, cutoffs=GDT_TS_CUTOFFS, weights=None, mask=None):
+def gdt(X, Y, cutoffs=GDT_TS_CUTOFFS, weights=None, mask=None,
+        norm_len=None):
     """Global distance test. X, Y: (batch, 3, N) -> (batch,).
-    `weights`: per-cutoff weights; `mask` (batch, N): per-point validity."""
+    `weights`: per-cutoff weights; `mask` (batch, N): per-point validity.
+    `norm_len`: normalize fractions by this reference length instead of the
+    provided point count — CASP convention when scoring a prediction that
+    covers only part of the reference (uncovered residues count as outside
+    every cutoff)."""
     X, Y = _batchify(X, Y)
     cutoffs = jnp.asarray(cutoffs, dtype=X.dtype)
     if weights is None:
@@ -54,6 +59,8 @@ def gdt(X, Y, cutoffs=GDT_TS_CUTOFFS, weights=None, mask=None):
     else:
         weights = jnp.broadcast_to(jnp.asarray(weights, dtype=X.dtype), cutoffs.shape)
     pw, n = _point_weights(mask, X)
+    if norm_len is not None:
+        n = jnp.asarray(float(norm_len), X.dtype)
     dist = jnp.sqrt(jnp.sum((X - Y) ** 2, axis=-2))  # (batch, N)
     # fraction of valid residues within each cutoff, weighted mean over cutoffs
     within = (dist[..., None, :] <= cutoffs[:, None]).astype(X.dtype)
@@ -61,17 +68,27 @@ def gdt(X, Y, cutoffs=GDT_TS_CUTOFFS, weights=None, mask=None):
     return jnp.mean(frac * weights, axis=-1)
 
 
-def tmscore(X, Y, mask=None):
+def tmscore(X, Y, mask=None, norm_len=None):
     """Template-modeling score. X, Y: (batch, 3, N) -> (batch,).
 
     Deviation from the reference (`utils.py:608-615`): d0 is clamped to
     >= 0.5 as in standard TM-score implementations — the unclamped formula
     goes negative near L=18 and collapses the score for short chains.
-    With `mask`, L is the per-structure count of valid points.
+    With `mask`, L is the per-structure count of valid points. `norm_len`:
+    use this reference length for BOTH d0 and the 1/L normalization
+    (standard TM-score convention when the prediction covers only part of
+    the reference — uncovered residues contribute zero terms).
     """
     X, Y = _batchify(X, Y)
     w, n = _point_weights(mask, X)
-    if mask is None:
+    if norm_len is not None:
+        n = jnp.asarray(float(norm_len), X.dtype)
+        d0 = jnp.asarray(
+            max(1.24 * np.cbrt(norm_len - 15) - 1.8, 0.5)
+            if norm_len > 15 else 0.5,
+            X.dtype,
+        )
+    elif mask is None:
         L = X.shape[-1]
         d0 = max(1.24 * np.cbrt(L - 15) - 1.8, 0.5) if L > 15 else 0.5
         d0 = jnp.asarray(d0, X.dtype)
@@ -88,10 +105,11 @@ def RMSD(A, B, *, mask=None):
     return rmsd(A, B, mask=mask)
 
 
-def GDT(A, B, *, mode: str = "TS", weights=None, mask=None):
+def GDT(A, B, *, mode: str = "TS", weights=None, mask=None, norm_len=None):
     cutoffs = GDT_HA_CUTOFFS if str(mode).upper() == "HA" else GDT_TS_CUTOFFS
-    return gdt(A, B, cutoffs=cutoffs, weights=weights, mask=mask)
+    return gdt(A, B, cutoffs=cutoffs, weights=weights, mask=mask,
+               norm_len=norm_len)
 
 
-def TMscore(A, B, *, mask=None):
-    return tmscore(A, B, mask=mask)
+def TMscore(A, B, *, mask=None, norm_len=None):
+    return tmscore(A, B, mask=mask, norm_len=norm_len)

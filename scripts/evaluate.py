@@ -24,12 +24,26 @@ sys.path.insert(0, REPO)
 
 
 def ca_map(structure):
-    """residue number -> CA coordinate (first chain unless selected)."""
+    """residue number -> CA coordinate (filter chains BEFORE calling)."""
     out = {}
     for a in structure.atoms:
         if a.name == "CA" and a.res_seq not in out:
             out[a.res_seq] = a.xyz
     return out
+
+
+def pick_chain(structure, wanted, label, path):
+    chains = structure.chains()
+    if not chains:
+        raise SystemExit(f"no ATOM records in {label} file {path}")
+    if wanted is None:
+        return structure.select_chain(chains[0]), chains[0]
+    if wanted not in chains:
+        raise SystemExit(
+            f"{label} file {path} has no chain {wanted!r} "
+            f"(available: {', '.join(chains)})"
+        )
+    return structure.select_chain(wanted), wanted
 
 
 def main():
@@ -38,6 +52,9 @@ def main():
     ap.add_argument("truth")
     ap.add_argument("--chain", default=None,
                     help="chain of the TRUTH structure to score against "
+                         "(default: first chain)")
+    ap.add_argument("--pred-chain", default=None,
+                    help="chain of the PREDICTION to score "
                          "(default: first chain)")
     args = ap.parse_args()
 
@@ -49,10 +66,13 @@ def main():
     from alphafold2_tpu.geometry import GDT, Kabsch, RMSD, TMscore
     from alphafold2_tpu.geometry.pdb import parse_pdb
 
-    pred = parse_pdb(args.prediction)
-    truth = parse_pdb(args.truth)
-    chains = truth.chains()
-    truth = truth.select_chain(args.chain or chains[0])
+    pred, pred_chain = pick_chain(
+        parse_pdb(args.prediction), args.pred_chain, "prediction",
+        args.prediction,
+    )
+    truth, truth_chain = pick_chain(
+        parse_pdb(args.truth), args.chain, "truth", args.truth,
+    )
 
     pmap, tmap = ca_map(pred), ca_map(truth)
     common = sorted(set(pmap) & set(tmap))
@@ -78,14 +98,21 @@ def main():
     else:
         hand = "direct"
 
+    # TM/GDT normalized by the TRUTH chain length (standard convention:
+    # residues the prediction does not cover count as failures), so partial
+    # predictions cannot score inflated headline numbers; RMSD is over the
+    # aligned common set as usual
+    n_truth = len(tmap)
     result = {
+        "chains": f"{pred_chain}->{truth_chain}",
         "n_residues": len(common),
         "coverage_pred": round(len(common) / max(1, len(pmap)), 3),
-        "coverage_truth": round(len(common) / max(1, len(tmap)), 3),
+        "coverage_truth": round(len(common) / max(1, n_truth), 3),
         "rmsd": round(float(RMSD(aligned, ref)[0]), 3),
-        "tm_score": round(float(TMscore(aligned, ref)[0]), 4),
-        "gdt_ts": round(float(GDT(aligned, ref)[0]), 4),
-        "gdt_ha": round(float(GDT(aligned, ref, mode="HA")[0]), 4),
+        "tm_score": round(float(TMscore(aligned, ref, norm_len=n_truth)[0]), 4),
+        "gdt_ts": round(float(GDT(aligned, ref, norm_len=n_truth)[0]), 4),
+        "gdt_ha": round(
+            float(GDT(aligned, ref, mode="HA", norm_len=n_truth)[0]), 4),
         "hand": hand,
     }
     print(json.dumps(result))

@@ -60,5 +60,30 @@ def test_mirror_scored_on_better_hand(tmp_path):
     write_pdb(mirrored, s)
 
     out = run_cli(mirrored, TRUTH)
+    assert out.returncode == 0, out.stderr[-400:]
     r = json.loads(out.stdout)
     assert r["hand"] == "mirrored" and r["rmsd"] < 0.01, r
+
+
+def test_partial_coverage_normalized_by_truth_length(tmp_path):
+    # a perfect prediction of only the first 100 residues must NOT score
+    # TM/GDT ~1.0: headline numbers normalize by the truth chain length
+    from alphafold2_tpu.geometry.pdb import PdbStructure, parse_pdb, write_pdb
+
+    s = parse_pdb(TRUTH)
+    partial = PdbStructure([a for a in s.atoms if a.res_seq <= 100])
+    moved = str(tmp_path / "partial.pdb")
+    write_pdb(moved, partial)
+
+    out = run_cli(moved, TRUTH)
+    assert out.returncode == 0, out.stderr[-400:]
+    r = json.loads(out.stdout)
+    assert r["rmsd"] < 0.01  # the covered part is exact
+    assert r["coverage_truth"] < 0.25
+    assert r["tm_score"] < 0.3 and r["gdt_ts"] < 0.3, r
+
+
+def test_bad_chain_fails_loudly():
+    out = run_cli(TRUTH, TRUTH, "--chain", "Z")
+    assert out.returncode != 0
+    assert "no chain 'Z'" in out.stderr
