@@ -95,9 +95,11 @@ def main():
 
     lad = latest_rows(os.path.join(REPO, "PERF_LADDER.jsonl"))
     if lad:
-        print("\n= depth ladder =")
+        print("\n= depth ladder (on-chip rows only) =")
         for (metric, depth), e in sorted(lad.items(), key=lambda kv: str(kv[0])):
-            if "steps_per_sec" in str(metric):
+            m = str(metric)
+            # _cpu rows are smoke-shape validation runs, not measurements
+            if "steps_per_sec" in m and "_cpu" not in m:
                 print(f"  {metric}: {e.get('value')} steps/s "
                       f"(sec/step {e.get('sec_per_step')}, "
                       f"mfu {e.get('mfu')})")
