@@ -78,6 +78,7 @@ def main():
 
     last = rows[-1]
     peak = max(gen_mean)
+    peak_step = steps[int(np.argmax(gen_mean))]
     win_md = "\n".join(
         f"| {k} | {last['gen_1h22_windows'][k]['corr']} | "
         f"{last['gen_1h22_windows'][k]['mae']} |"
@@ -115,8 +116,12 @@ depth-1 model can express. {'Notably the held-in and held-out curves '
  'its single training protein and everything it learns is portable.'
  if last['gen_1h22_mean_corr'] >= last['heldin_4k77_corr'] - 0.05
  else 'The held-in curve sitting above the held-out one is the '
- 'memorization gap.'} The number is reported as measured, whatever it
-is (VERDICT r3 next #4).
+ 'memorization gap.'}{f''' Training past the held-out peak (step
+{peak_step}) trades transfer for memorization: held-out declines from
+{peak} while held-in keeps climbing — the expected single-structure
+overfitting turn, visible end to end in the curve.'''
+ if last['gen_1h22_mean_corr'] < peak - 0.03 else ''} The number is
+reported as measured, whatever it is (VERDICT r3 next #4).
 
 Regenerate: `python scripts/generalization_run.py --steps
 {last['step']}`, then `python scripts/generalization_artifact.py`.
