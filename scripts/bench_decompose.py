@@ -483,9 +483,12 @@ def main():
                     # CONTAINS the trunk), and its ~2x440 MB fetches are
                     # implicated in a relay stall. trunk_vg/geom_vg/ops
                     # remain available explicitly as transfer-inclusive
-                    # twins.
-                    default="trunk_fwd,trunk_vg_s,geom_vg_s,ops_s,fetch_bw,"
-                            "ops_detail,profile")
+                    # twins. Order = information value per minute of a
+                    # possibly-short recovery window: fetch_bw (~1 min,
+                    # prices the tunnel), ops_s (the decisive per-op
+                    # split of the 378 ms/layer forward), then the rest.
+                    default="trunk_fwd,fetch_bw,ops_s,ops_detail,"
+                            "trunk_vg_s,geom_vg_s,profile")
     ap.add_argument("--timeout", type=int, default=1800)
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CPU shapes: validates the worker end-to-end "
