@@ -117,7 +117,13 @@ def distogram_confidence(distogram, mask=None):
     p = distogram.astype(jnp.float32)
     n, nb = p.shape[-2], p.shape[-1]
     ent = -jnp.sum(p * jnp.log(jnp.clip(p, 1e-12)), axis=-1)  # (b, N, N)
-    certainty = 1.0 - ent / jnp.log(float(nb))
+    # nb=1 is degenerate (ent=0, ln(1)=0 -> 0/0): a single-bucket distogram
+    # carries no distance information, so certainty is defined as 1 (the
+    # distribution is exactly known) rather than NaN
+    if nb == 1:
+        certainty = jnp.ones_like(ent)
+    else:
+        certainty = 1.0 - ent / jnp.log(float(nb))
 
     off_diag = ~jnp.eye(n, dtype=bool)[None]
     if mask is not None:

@@ -35,6 +35,25 @@ def _point_weights(mask, X):
     return w, jnp.maximum(jnp.sum(w, axis=-1), 1.0)
 
 
+def _check_norm_len(norm_len, mask, X):
+    """Precondition: norm_len covers every scored point (it is the FULL
+    reference length). A smaller value silently inflates GDT/TM-score
+    above 1.0. Enforced when inputs are concrete; under a jit trace the
+    mask sum is unavailable and the precondition is documented-only."""
+    if mask is None:
+        valid = X.shape[-1]
+    else:
+        try:
+            valid = int(np.max(np.sum(np.asarray(mask, np.float64), axis=-1)))
+        except Exception:  # traced mask: cannot inspect values
+            return
+    if norm_len < valid:
+        raise ValueError(
+            f"norm_len={norm_len} is smaller than the scored point count "
+            f"{valid}; the score would exceed 1.0. norm_len is the full "
+            f"reference length and must cover every valid point.")
+
+
 def rmsd(X, Y, mask=None):
     """Root-mean-square deviation. X, Y: (batch, 3, N) -> (batch,).
     `mask` (batch, N): points excluded from the average when False."""
@@ -60,6 +79,7 @@ def gdt(X, Y, cutoffs=GDT_TS_CUTOFFS, weights=None, mask=None,
         weights = jnp.broadcast_to(jnp.asarray(weights, dtype=X.dtype), cutoffs.shape)
     pw, n = _point_weights(mask, X)
     if norm_len is not None:
+        _check_norm_len(norm_len, mask, X)
         n = jnp.asarray(float(norm_len), X.dtype)
     dist = jnp.sqrt(jnp.sum((X - Y) ** 2, axis=-2))  # (batch, N)
     # fraction of valid residues within each cutoff, weighted mean over cutoffs
@@ -82,6 +102,7 @@ def tmscore(X, Y, mask=None, norm_len=None):
     X, Y = _batchify(X, Y)
     w, n = _point_weights(mask, X)
     if norm_len is not None:
+        _check_norm_len(norm_len, mask, X)
         n = jnp.asarray(float(norm_len), X.dtype)
         d0 = jnp.asarray(
             max(1.24 * np.cbrt(norm_len - 15) - 1.8, 0.5)

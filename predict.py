@@ -20,9 +20,14 @@ N/CA/C/O backbone PDB that scripts/refinement.py can relax.
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 
-import jax
-import numpy as np
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "scripts"))
+import hostenv  # noqa: E402
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
 
 
 def main():
@@ -82,6 +87,12 @@ def main():
                          "devices (sequence length must be a multiple of "
                          "it; 0 = single-device)")
     args = ap.parse_args()
+
+    # single-client tunnel discipline AFTER argparse (--help must not
+    # block on the lock): a prediction queues behind, never races, a
+    # running measurement — two concurrent clients wedge the relay for
+    # hours (scripts/tpu_lock.py). Held for the process lifetime.
+    hostenv.tunnel_guard()
 
     import jax.numpy as jnp
 
@@ -150,6 +161,12 @@ def main():
             if "templates_mask" in getattr(raw, "files", ())
             else jnp.ones(templates.shape, bool)  # (b, T, N, N) per-position
         )
+        if templates_mask.ndim == 3:
+            templates_mask = templates_mask[None]
+        if templates_mask.shape != templates.shape:
+            ap.error(f"--templates-file 'templates_mask' shape "
+                     f"{tuple(templates_mask.shape)} does not match "
+                     f"'templates' shape {tuple(templates.shape)}")
         grid = 3 * L if args.full_atom else L
         if templates.shape[-2:] != (grid, grid):
             ap.error(f"--templates-file pair grid is "

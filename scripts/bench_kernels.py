@@ -22,9 +22,16 @@ import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax
-import jax.numpy as jnp
+import hostenv  # noqa: E402
+
+# single-client tunnel discipline; reentrant when bench_sweep already
+# holds the lock around this subprocess (scripts/tpu_lock.py)
+hostenv.tunnel_guard()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 import numpy as np
 
 

@@ -58,10 +58,10 @@ def main():
                          "(default: first chain)")
     args = ap.parse_args()
 
-    import jax
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import hostenv
 
-    jax.config.update("jax_platforms", "cpu")  # host-side tool: never opens
-    os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # a TPU tunnel client
+    hostenv.force_cpu()  # host-side tool: never opens a tunnel client
 
     from alphafold2_tpu.geometry import GDT, Kabsch, RMSD, TMscore
     from alphafold2_tpu.geometry.pdb import parse_pdb

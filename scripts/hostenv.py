@@ -1,0 +1,98 @@
+"""One place for the two host-environment disciplines every entry point
+needs (VERDICT r4 next #6 — these lived in per-script memory and the one
+time a script forgot, the tunnel wedged for hours, PERF.md):
+
+  * force_cpu() — the FULL CPU pin for CPU-intended processes. The env
+    var alone loses to the axon sitecustomize platform pin, silently
+    opening a tunnel client beside a running measurement (the round-4
+    wedge); the pin must clear the pool env AND update jax.config before
+    any jax-importing code runs.
+
+  * tunnel_guard() — for processes that MAY touch the tunnel: hold the
+    single-client flock (scripts/tpu_lock.py) for the process's whole
+    lifetime. Reentrant across process boundaries: a parent already
+    holding the lock (tpu_lock CLI wrapper, or a `with tpu_lock()` body
+    spawning measurement subprocesses) marks the environment, and the
+    child's guard becomes a no-op instead of deadlocking against its
+    parent.
+
+Import from a script via the usual sys.path.insert(scripts/) pattern:
+
+    import hostenv
+    hostenv.force_cpu()          # CPU-intended scripts, OR
+    hostenv.tunnel_guard()       # tunnel-using entry points
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from tpu_lock import LOCK_HELD_ENV, LOCK_PATH, tpu_lock  # noqa: E402,F401
+
+_guard_stack: contextlib.ExitStack | None = None
+
+
+def force_cpu() -> None:
+    """Pin this process to the CPU backend — completely.
+
+    Must run before any code imports jax (callers put it at the top of
+    main, right after argparse). Safe to call when jax is already
+    imported ONLY if no computation ran yet.
+    """
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def tunnel_guard(timeout: float | None = None) -> bool:
+    """Hold the single-client tunnel lock until this process exits.
+
+    Returns True when the lock is (now or already) held, False when the
+    process is CPU-pinned and cannot touch the tunnel anyway. Raises
+    TimeoutError (with a how-to message) when another client holds it.
+
+    timeout: seconds to wait for a busy lock; default from
+    AF2_TPU_LOCK_TIMEOUT, else 600 (a user prediction should queue
+    behind a measurement leg, not corrupt it).
+    """
+    global _guard_stack
+    if os.environ.get(LOCK_HELD_ENV):
+        return True  # parent holds it; our subprocess-tree is one client
+    if _guard_stack is not None:
+        return True
+    if (
+        os.environ.get("JAX_PLATFORMS") == "cpu"
+        and not os.environ.get("PALLAS_AXON_POOL_IPS")
+    ):
+        return False  # CPU-pinned: no tunnel client possible
+    if timeout is None:
+        timeout = float(os.environ.get("AF2_TPU_LOCK_TIMEOUT", 600))
+
+    # one acquire implementation: tpu_lock() does the flock/retry/pid
+    # bookkeeping; the ExitStack is deliberately never closed, so the
+    # lock (and the held-marker env) lives until process exit
+    stack = contextlib.ExitStack()
+    try:
+        stack.enter_context(tpu_lock(timeout=0))
+    except TimeoutError:
+        print(
+            "waiting for the TPU tunnel lock (another client is using "
+            "the tunnel; single-client discipline, scripts/tpu_lock.py)",
+            file=sys.stderr,
+            flush=True,
+        )
+        try:
+            stack.enter_context(tpu_lock(timeout=timeout))
+        except TimeoutError:
+            raise TimeoutError(
+                f"TPU tunnel lock {LOCK_PATH} held by another client "
+                f"after {timeout:.0f}s — a measurement is likely "
+                "running; retry later or raise AF2_TPU_LOCK_TIMEOUT"
+            ) from None
+    _guard_stack = stack
+    return True

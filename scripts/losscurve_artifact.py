@@ -32,6 +32,10 @@ sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tests"))
 sys.path.insert(0, os.path.join(REPO, "scripts"))
 
+import hostenv  # noqa: E402
+
+hostenv.force_cpu()  # CPU-intended: must never open a tunnel client
+
 OUT = os.path.join(REPO, "docs", "losscurve")
 
 # slot 1 = the reference, slot 2 = alphafold2_tpu (shared palette:

@@ -41,6 +41,14 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tests"))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import hostenv  # noqa: E402
+
+# CPU-intended (torch-reference parity + evals): the FULL pin, so this
+# can never silently open a tunnel client beside a measurement — the
+# env var alone loses to the axon platform pin (scripts/hostenv.py)
+hostenv.force_cpu()
 
 CROP = 128
 REF_4K77 = "/root/reference/notebooks/data/4k77_protein.pdb"

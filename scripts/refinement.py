@@ -15,6 +15,11 @@ import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import hostenv  # noqa: E402
+
+hostenv.force_cpu()  # CPU-intended: must never open a tunnel client
 
 import numpy as np  # noqa: E402
 

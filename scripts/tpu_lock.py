@@ -42,6 +42,12 @@ LOCK_PATH = os.environ.get("AF2_TPU_LOCK_PATH") or os.path.join(
 # stderr mentions the lock must not read as contention)
 LOCK_BUSY = "tpu-lock-busy"
 
+# set in the environment while the lock is held so measurement
+# subprocesses spawned UNDER the lock don't deadlock re-acquiring it
+# (the whole subprocess tree is one tunnel client); hostenv.tunnel_guard
+# checks it
+LOCK_HELD_ENV = "AF2_TPU_LOCK_HELD"
+
 
 @contextlib.contextmanager
 def tpu_lock(timeout: float = 0.0, poll: float = 2.0):
@@ -51,6 +57,11 @@ def tpu_lock(timeout: float = 0.0, poll: float = 2.0):
     which must never queue behind a long measurement (the watcher retries
     on its own schedule anyway).
     """
+    if os.environ.get(LOCK_HELD_ENV):
+        # this process tree already holds the lock (hostenv.tunnel_guard
+        # or an enclosing tpu_lock CLI/with-body): one client, reentrant
+        yield
+        return
     fd = os.open(LOCK_PATH, os.O_CREAT | os.O_RDWR, 0o644)
     deadline = time.monotonic() + timeout
     try:
@@ -69,7 +80,13 @@ def tpu_lock(timeout: float = 0.0, poll: float = 2.0):
         try:
             os.ftruncate(fd, 0)
             os.write(fd, f"pid={os.getpid()}\n".encode())
-            yield
+            had = os.environ.get(LOCK_HELD_ENV)
+            os.environ[LOCK_HELD_ENV] = "1"
+            try:
+                yield
+            finally:
+                if had is None:
+                    os.environ.pop(LOCK_HELD_ENV, None)
         finally:
             fcntl.flock(fd, fcntl.LOCK_UN)
     finally:

@@ -14,6 +14,17 @@ stdout contract:
 Exit code 0 only when healthy.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import hostenv
+
+# fail-fast single-client discipline for AD-HOC invocations (the watcher
+# and bench wrap this in the tpu_lock CLI, which the guard detects and
+# no-ops): a probe must never queue behind a measurement, so timeout=0
+hostenv.tunnel_guard(timeout=0)
+
 import jax
 
 d = jax.devices()[0]

@@ -58,7 +58,9 @@ for i in $(seq 1 60); do
       # depth 12 = 4.54 s; slope vs intercept decides whether the 378
       # ms/layer is in the layers at all)
       python scripts/bench_decompose.py --depth 2 --legs trunk_fwd
-      echo "$(date -u +%H:%M:%S) depth-2 fwd point finished rc=$?"
+      rc=$?
+      echo "$(date -u +%H:%M:%S) depth-2 fwd point finished rc=$rc"
+      if [ "$rc" -eq 3 ]; then sleep 480; continue; fi
       decomp_done=1
     fi
     if [ "$sweep_done" -eq 0 ]; then

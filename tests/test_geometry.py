@@ -115,6 +115,32 @@ def test_distogram_confidence_bounds_and_mask():
     assert np.all(conf[0, -4:] == 0.0)
     np.testing.assert_allclose(conf[0, : n - 4], 1.0, atol=1e-5)
 
+    # degenerate single-bucket distogram: defined as certainty 1, not 0/0 NaN
+    one_bucket = jnp.ones((1, n, n, 1))
+    conf1 = np.asarray(distogram_confidence(one_bucket))
+    assert np.isfinite(conf1).all()
+    np.testing.assert_allclose(conf1, 1.0, atol=1e-6)
+
+
+def test_metrics_norm_len_guard():
+    import pytest
+
+    from alphafold2_tpu.geometry import gdt, tmscore
+
+    rs = np.random.RandomState(0)
+    X = jnp.asarray(rs.randn(1, 3, 20))
+    # norm_len below the scored point count must fail loudly, not return >1
+    with pytest.raises(ValueError, match="norm_len"):
+        tmscore(X, X, norm_len=10)
+    with pytest.raises(ValueError, match="norm_len"):
+        gdt(X, X, norm_len=10)
+    mask = jnp.arange(20)[None] < 15
+    with pytest.raises(ValueError, match="norm_len"):
+        tmscore(X, X, mask=mask, norm_len=10)
+    # covering norm_len stays valid and bounded
+    assert float(tmscore(X, X, mask=mask, norm_len=15)[0]) <= 1.0 + 1e-6
+    assert float(gdt(X, X, norm_len=20)[0]) <= 1.0 + 1e-6
+
 
 def test_pdb_bfactor_roundtrip(tmp_path):
     from alphafold2_tpu.geometry.pdb import coords_to_pdb, parse_pdb

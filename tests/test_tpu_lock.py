@@ -21,22 +21,47 @@ os.environ["AF2_TPU_LOCK_PATH"] = os.path.join(
     tempfile.mkdtemp(prefix="af2locktest"), "test.lock"
 )
 
-from tpu_lock import LOCK_BUSY, tpu_lock  # noqa: E402
+from tpu_lock import LOCK_BUSY, LOCK_HELD_ENV, tpu_lock  # noqa: E402
+
+
+def _independent_env():
+    """Env for a client that is NOT part of this process's subprocess
+    tree: holding the lock marks the environment so legitimate children
+    are one client; an independent client must not carry the marker."""
+    env = dict(os.environ)
+    env.pop(LOCK_HELD_ENV, None)
+    return env
 
 
 def test_exclusion_and_release():
     with tpu_lock():
-        # a second holder in another process must fail fast with EX_TEMPFAIL
+        # an INDEPENDENT second client must fail fast with EX_TEMPFAIL
+        rc = subprocess.run(
+            [sys.executable, os.path.join(SCRIPTS, "tpu_lock.py"),
+             "--", "true"],
+            capture_output=True, env=_independent_env(),
+        ).returncode
+        assert rc == 75
+        # while a subprocess SPAWNED UNDER the lock (inherits the held
+        # marker) is the same client and must pass straight through —
+        # a measurement leg re-wrapping itself must not deadlock
         rc = subprocess.run(
             [sys.executable, os.path.join(SCRIPTS, "tpu_lock.py"),
              "--", "true"],
             capture_output=True,
         ).returncode
-        assert rc == 75
-        # and an in-process try-once acquire raises
-        with pytest.raises(TimeoutError):
-            with tpu_lock():
-                pass
+        assert rc == 0
+        # in-process re-entry under the held marker is also a no-op
+        with tpu_lock(timeout=0):
+            pass
+        # an in-process try-once acquire WITHOUT the marker raises
+        os.environ.pop(LOCK_HELD_ENV, None)
+        try:
+            with pytest.raises(TimeoutError):
+                with tpu_lock():
+                    pass
+        finally:
+            os.environ[LOCK_HELD_ENV] = "1"  # restore for the outer exit
     # released: both styles acquire immediately
     with tpu_lock(timeout=0):
         pass

@@ -14,8 +14,13 @@ Usage: python train_end2end.py [--steps N] [--dim 64] [--depth 2] [--len 16]
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 
-import jax
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "scripts"))
+import hostenv  # noqa: E402
+import jax  # noqa: E402
 
 from alphafold2_tpu.models import Alphafold2Config, RefinerConfig
 from alphafold2_tpu.training import (
@@ -97,6 +102,11 @@ def main():
         help="trace this many steps (starting after compile at step start+1)",
     )
     args = ap.parse_args()
+
+    # single-client tunnel discipline AFTER argparse (--help must not
+    # block on the lock): the run holds the lock for its lifetime so it
+    # can never race a measurement (scripts/tpu_lock.py)
+    hostenv.tunnel_guard()
 
     # multi-host entry: no-op unless AF2_COORDINATOR/AF2_NUM_PROCESSES/
     # AF2_PROCESS_ID (or AF2_AUTO_INIT=1 on TPU pods) are set — one command

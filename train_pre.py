@@ -11,9 +11,14 @@ Usage: python train_pre.py [--steps N] [--dim 256] [--depth 1] [--len 128]
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 import time
 
-import jax
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "scripts"))
+import hostenv  # noqa: E402
+import jax  # noqa: E402
 
 from alphafold2_tpu.models import Alphafold2Config
 from alphafold2_tpu.utils import MetricsLogger
@@ -66,6 +71,11 @@ def main():
                          "(sequence-parallel trunk; --len must be a "
                          "multiple of it; 0 = replicated)")
     args = ap.parse_args()
+
+    # single-client tunnel discipline AFTER argparse (--help must not
+    # block on the lock): the run holds the lock for its lifetime so it
+    # can never race a measurement (scripts/tpu_lock.py)
+    hostenv.tunnel_guard()
 
     # multi-host entry: no-op unless AF2_COORDINATOR/AF2_NUM_PROCESSES/
     # AF2_PROCESS_ID (or AF2_AUTO_INIT=1 on TPU pods) are set — one command
