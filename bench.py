@@ -12,9 +12,10 @@ the artifact must be null-proof by construction). Total wall is clamped
 to AF2_BENCH_BUDGET_SEC (default 1140 s).
 The reference publishes no numbers (BASELINE.md), so vs_baseline is against
 the driver-defined operational target of 1.0 optimizer step/sec/chip.
-Extras: achieved TFLOP/s and MFU (model FLOPs from the compiled
-executable's cost analysis over the chip's peak), and inference
-sec/protein for the predict flow.
+Extras: achieved TFLOP/s and MFU (analytic model-FLOP count from
+utils/flops.py over the chip's peak — XLA cost analysis counts scan
+bodies once and underreports the reversible/streamed trunk ~100x), and
+inference sec/protein for the predict flow.
 
 Methodology: K optimizer steps run INSIDE one jitted `lax.scan`, and the
 per-step losses are fetched to the host before stopping the clock. This is
@@ -49,17 +50,6 @@ def _peak_flops(device) -> float:
         if key in kind:
             return peak
     return 197e12  # default to v5e
-
-
-def _compiled_flops(compiled) -> float:
-    """Model FLOPs of one executable from XLA cost analysis (0 if opaque)."""
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        return float(ca.get("flops", 0.0))
-    except Exception:
-        return 0.0
 
 
 def main():
@@ -415,9 +405,6 @@ def _run(dev, on_tpu: bool, depth: int, segments: int = 0) -> dict:
         dt = time.perf_counter() - t0
         assert np.isfinite(loss), f"non-finite bench loss: {loss}"
         steps, steps_per_sec = 1, 1.0 / dt
-        # per-piece cost analysis is not aggregated across the chain;
-        # report honest nulls rather than a partial-program MFU
-        flops_per_step, achieved, mfu = 0.0, 0.0, None
     else:
         step = make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn)
 
@@ -448,10 +435,18 @@ def _run(dev, on_tpu: bool, depth: int, segments: int = 0) -> dict:
         assert np.isfinite(losses).all(), f"non-finite bench losses: {losses}"
 
         steps_per_sec = steps / dt
-        total_flops = _compiled_flops(compiled)
-        flops_per_step = total_flops / steps if total_flops else 0.0
-        achieved = flops_per_step * steps_per_sec
-        mfu = achieved / _peak_flops(dev) if on_tpu and achieved else None
+
+    # analytic model-FLOP count, shared by both branches (utils/flops.py):
+    # XLA cost analysis counts scan bodies once — on the reversible/
+    # streamed trunk it underreports ~100x and every MFU derived from it
+    # is garbage — and never could aggregate the segmented chain at all
+    from alphafold2_tpu.utils.flops import train_step_flops
+
+    flops_per_step = train_step_flops(
+        ecfg.model, 3 * crop, msa_rows, crop, grad_accum=tcfg.grad_accum,
+    )
+    achieved = flops_per_step * steps_per_sec
+    mfu = achieved / _peak_flops(dev) if on_tpu else None
 
     # inference sec/protein: the predict flow (forward -> distogram -> MDS ->
     # sidechain -> refiner), BASELINE.md's second target metric

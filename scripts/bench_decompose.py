@@ -90,8 +90,10 @@ def timed(compiled, *args):
 
 
 def compiled_tflop(compiled):
-    # Model TFLOPs of the compiled program per XLA cost analysis (0 if
-    # opaque) -- turns measured seconds into roofline-relative TF/s.
+    # TFLOPs per XLA cost analysis (0 if opaque). CAUTION: counts
+    # scan/map bodies ONCE, so on the reversible/streamed trunk it is
+    # ~100x low (utils/flops.py docstring) -- kept for reference only;
+    # tf_per_s uses the analytic model count when one is supplied.
     # (comment, not docstring: this code lives inside the WORKER
     # triple-quoted string, which a nested triple-quote would terminate)
     try:
@@ -103,11 +105,17 @@ def compiled_tflop(compiled):
         return 0.0
 
 
-def perf_fields(compiled, dt):
+def perf_fields(compiled, dt, model_tflop=None):
+    # model_tflop: analytic matmul count (utils/flops.py), the honest
+    # numerator for roofline-relative TF/s on scanned programs
     tf = compiled_tflop(compiled)
     out = {"sec": round(dt, 3)}
     if tf:
-        out["tflop"] = round(tf, 3)
+        out["tflop_xla"] = round(tf, 3)
+    if model_tflop:
+        out["tflop_model"] = round(model_tflop, 3)
+        out["tf_per_s"] = round(model_tflop / dt, 1)
+    elif tf:
         out["tf_per_s"] = round(tf / dt, 1)
     return out
 
@@ -203,7 +211,10 @@ if base_leg in ("trunk_fwd", "trunk_vg"):
           else maybe_scalarize(jax.value_and_grad(fwd)))
     compiled = jax.jit(fn).lower(params).compile()
     dt = timed(compiled, params)
-    report(leg=leg, depth=depth, **perf_fields(compiled, dt))
+    from alphafold2_tpu.utils.flops import model_fwd_flops, train_step_flops
+    mt = (model_fwd_flops(cfg, n3, msa_rows, crop) if base_leg == "trunk_fwd"
+          else train_step_flops(cfg, n3, msa_rows, crop)) / 1e12
+    report(leg=leg, depth=depth, **perf_fields(compiled, dt, model_tflop=mt))
 
 elif base_leg == "geom_vg":
     state = e2e_train_state_init(key, ecfg, tcfg)

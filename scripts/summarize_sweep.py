@@ -117,8 +117,10 @@ def summarize_decomp(path):
     print("| leg | depth | sec | TFLOP | TF/s | error |")
     print("|---|---|---|---|---|---|")
     for (leg, depth), r in sorted(latest.items(), key=lambda kv: str(kv[0])):
+        # tflop_model (analytic, scan-proof) > tflop_xla > legacy tflop
+        tf = r.get("tflop_model", r.get("tflop_xla", r.get("tflop", "-")))
         print(f"| {leg} | {depth} | {r.get('sec', '-')} "
-              f"| {r.get('tflop', '-')} | {r.get('tf_per_s', '-')} "
+              f"| {tf} | {r.get('tf_per_s', '-')} "
               f"| {(r.get('error') or '')[:60]} |")
     if profile_ops:
         print("\n### top ops by device time (perfetto trace, one step)\n")

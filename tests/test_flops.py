@@ -1,0 +1,111 @@
+"""Analytic FLOP accounting (utils/flops.py) vs XLA's own count.
+
+On a fully-unrolled DENSE configuration — sequential trunk (Python-loop
+layers), flash off, no batch/ff chunking — `compiled.cost_analysis()`
+counts every op exactly once, so it is a trustworthy oracle there. The
+analytic count excludes elementwise/softmax/norm work, so it must land
+BELOW the XLA number but within a modest band. (On scan/map-tiled
+programs — reversible trunk, flash streaming — XLA counts loop bodies
+once and underreports ~100x; that regime is exactly why the analytic
+counter exists, and is pinned by the last test.)
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu.models import (
+    Alphafold2Config,
+    alphafold2_apply,
+    alphafold2_init,
+)
+from alphafold2_tpu.utils.flops import (
+    model_fwd_flops,
+    train_step_flops,
+    trunk_layer_flops,
+)
+
+
+def _xla_fwd_flops(cfg, n_seq, r, c):
+    params = alphafold2_init(jax.random.PRNGKey(0), cfg)
+    rs = np.random.RandomState(0)
+    seq = jnp.asarray(rs.randint(0, 21, (1, n_seq)))
+    msa = jnp.asarray(rs.randint(0, 21, (1, r, c))) if r else None
+
+    def fwd(p):
+        return alphafold2_apply(p, cfg, seq, msa)
+
+    compiled = jax.jit(fwd).lower(params).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    return float(ca["flops"])
+
+
+def _dense_cfg(**kw):
+    base = dict(
+        dim=64, depth=2, heads=4, dim_head=16, max_seq_len=256,
+        reversible=False, attn_flash=False, attn_batch_chunk=0,
+        ff_chunk_size=0,
+    )
+    base.update(kw)
+    return Alphafold2Config(**base)
+
+
+@pytest.mark.parametrize(
+    "kw,r,c",
+    [
+        (dict(), 4, 24),  # plain flat cross
+        (dict(msa_tie_row_attn=True), 4, 24),  # tied rows
+        (dict(cross_attn_compress_ratio=2), 4, 24),  # KV compression
+        (dict(cross_attn_mode="aligned"), 4, 24),  # column-aligned cross
+        (dict(), 0, 0),  # no MSA stream at all
+    ],
+)
+def test_analytic_matches_xla_on_unrolled_dense(kw, r, c):
+    n = 48
+    cfg = _dense_cfg(**kw)
+    analytic = model_fwd_flops(cfg, n, r, c)
+    xla = _xla_fwd_flops(cfg, n, r, c)
+    ratio = analytic / xla
+    # analytic counts matmuls only -> strictly below XLA's total, but it
+    # must capture the bulk of it (measured 0.90-0.99 across variants)
+    assert 0.80 < ratio <= 1.02, (analytic, xla, ratio)
+
+
+def test_layer_and_step_scaling():
+    cfg = _dense_cfg(depth=5)
+    n, r, c = 48, 4, 24
+    lf = trunk_layer_flops(cfg, n, r, c)
+    assert lf > 0
+    # model = depth * layer + head (head is the small remainder)
+    head = model_fwd_flops(cfg, n, r, c) - cfg.depth * lf
+    assert 0 < head < lf
+    # sequential train step ~ 3x fwd per accum microbatch
+    fwd = model_fwd_flops(cfg, n, r, c)
+    assert train_step_flops(cfg, n, r, c, grad_accum=4) == 4 * 3.0 * fwd
+    # reversible pays the recompute
+    rcfg = dataclasses.replace(cfg, reversible=True)
+    assert train_step_flops(rcfg, n, r, c) == 4.0 * model_fwd_flops(
+        rcfg, n, r, c
+    )
+    # reversible layers carry two extra feed-forwards
+    assert trunk_layer_flops(rcfg, n, r, c) > lf
+
+
+def test_xla_undercounts_scanned_programs():
+    """The reason this module exists: under scan-based execution XLA's
+    flops are a gross undercount, while the analytic number is
+    execution-strategy-invariant."""
+    n, r, c = 48, 4, 24
+    dense = _dense_cfg()
+    scanned = dataclasses.replace(dense, reversible=True)
+    xla_scanned = _xla_fwd_flops(scanned, n, r, c)
+    analytic_scanned = model_fwd_flops(scanned, n, r, c)
+    # XLA reports the scanned program far below the dense oracle even
+    # though the reversible forward does MORE work (extra FFs)
+    assert xla_scanned < 0.8 * _xla_fwd_flops(dense, n, r, c)
+    assert analytic_scanned > model_fwd_flops(dense, n, r, c)
