@@ -5,7 +5,7 @@ a `lax.scan` / `lax.while_loop` ONCE, not times the trip count. The
 north-star forward is a scan over reversible layers whose attention is
 itself `lax.map`-tiled, so the reported number is ~2 orders of magnitude
 low (measured: 0.607 TFLOP reported for a depth-12 forward whose matmul
-arithmetic is ~150 TFLOP). Every MFU computed from it is garbage. These
+arithmetic is 186 TFLOP). Every MFU computed from it is garbage. These
 formulas count the matmul FLOPs (2*M*N*K per dot) of the model as
 configured — the ~(1-3)% of elementwise/softmax/norm work is
 deliberately excluded, so the count is a slight UNDERestimate and MFU
@@ -24,7 +24,7 @@ from __future__ import annotations
 from alphafold2_tpu.models.config import Alphafold2Config
 
 
-def _attention_flops(
+def attention_flops(
     tokens_q: float,
     tokens_kv: float,
     j_eff: float,
@@ -42,58 +42,73 @@ def _attention_flops(
     return proj_q_out + proj_kv + attn
 
 
-def _ff_flops(tokens: float, dim: int, mult: int = 4) -> float:
+def ff_flops(tokens: float, dim: int, mult: int = 4) -> float:
     """GEGLU feed-forward (ops/feedforward.py): d -> 2*mult*d -> ... ->
     mult*d -> d."""
     return tokens * (4.0 * mult * dim * dim + 2.0 * mult * dim * dim)
 
 
-def trunk_layer_flops(cfg: Alphafold2Config, n: int, r: int, c: int) -> float:
-    """Matmul FLOPs of ONE trunk layer at pair side n, MSA r x c.
+def trunk_layer_op_flops(
+    cfg: Alphafold2Config, n: int, r: int, c: int
+) -> dict:
+    """Per-op matmul FLOPs of ONE trunk layer at pair side n, MSA r x c.
 
     Mirrors models/trunk.py trunk_layer_apply: pair axial self-attention
     (row+col), MSA axial self-attention (row+col, tied rows cost the
     same contraction count), cross-attention both directions
-    (mode-dependent), and the feed-forwards (2 sequential / 4
-    reversible, models/reversible.py seq_ff2/msa_ff2).
+    (mode-dependent, each including its k+v compression conv), and the
+    feed-forwards (2 sequential / 4 reversible,
+    models/reversible.py seq_ff2/msa_ff2). The decomposition bench
+    (scripts/bench_decompose.py ops leg) consumes these keys directly —
+    one formula source, so the per-op table always sums to
+    trunk_layer_flops.
     """
     d, w = cfg.dim, cfg.heads * cfg.dim_head
     rho = max(1, cfg.cross_attn_compress_ratio)
-    fl = 0.0
+    # grouped strided KV-compression conv (ops/attention.py
+    # _compress_conv: inner->inner, kernel rho, groups=heads), applied
+    # to k AND v: 4*j_kv*w^2/heads per cross direction
+    conv = (lambda j_kv: 4.0 * j_kv * w * w / cfg.heads) if rho > 1 else (
+        lambda j_kv: 0.0)
 
-    # pair axial self-attention: two passes (rows then cols), each a full
-    # QKVO over the n^2 grid and n-token attention within each line
-    fl += 2 * _attention_flops(n * n, n * n, n, d, w)
-
+    ops = {
+        # two passes (rows then cols), each a full QKVO over the n^2
+        # grid and n-token attention within each line
+        "pair_axial": 2 * attention_flops(n * n, n * n, n, d, w),
+    }
     if r and c:
-        # MSA axial self-attention over the (r, c) grid
-        fl += _attention_flops(r * c, r * c, c, d, w)  # along rows
-        fl += _attention_flops(r * c, r * c, r, d, w)  # along cols
-
+        ops["msa_axial"] = (
+            attention_flops(r * c, r * c, c, d, w)  # along rows
+            + attention_flops(r * c, r * c, r, d, w)  # along cols
+        )
         if cfg.cross_attn_mode == "aligned":
             f = max(1, n // c)  # elongation factor (column fold)
-            # pair<-msa: every pair token attends its column's r MSA
-            # rows (compressed rho-fold)
-            fl += _attention_flops(n * n, r * c, max(1.0, r * f / rho),
-                                   d, w)
+            # pair<-msa: the context folds to (b*c, r) — every pair
+            # token attends its column's r MSA rows, compressed rho-fold
+            ops["cross_pair_from_msa"] = attention_flops(
+                n * n, r * c, max(1.0, r / rho), d, w
+            ) + conv(r * c)
             # msa<-pair: every MSA token attends its column's n*f pair
             # tokens (compressed)
-            fl += _attention_flops(r * c, n * n, max(1.0, n * f / rho),
-                                   d, w)
+            ops["cross_msa_from_pair"] = attention_flops(
+                r * c, n * n, max(1.0, n * f / rho), d, w
+            ) + conv(n * n)
         else:  # flat: all-to-all between the flattened streams
-            fl += _attention_flops(n * n, r * c, r * c / rho, d, w)
-            fl += _attention_flops(r * c, n * n, n * n / rho, d, w)
-        if rho > 1:
-            # grouped strided KV-compression conv (ops/attention.py
-            # _compress_conv: inner->inner, kernel rho, groups=heads,
-            # applied to k AND v of both cross directions)
-            fl += 4.0 * (r * c + n * n) * w * w / cfg.heads
+            ops["cross_pair_from_msa"] = attention_flops(
+                n * n, r * c, r * c / rho, d, w) + conv(r * c)
+            ops["cross_msa_from_pair"] = attention_flops(
+                r * c, n * n, n * n / rho, d, w) + conv(n * n)
 
     ffs_per_stream = 2 if cfg.reversible else 1
-    fl += ffs_per_stream * _ff_flops(n * n, d)
+    ops["ff_pair"] = ffs_per_stream * ff_flops(n * n, d)
     if r and c:
-        fl += ffs_per_stream * _ff_flops(r * c, d)
-    return fl
+        ops["ff_msa"] = ffs_per_stream * ff_flops(r * c, d)
+    return ops
+
+
+def trunk_layer_flops(cfg: Alphafold2Config, n: int, r: int, c: int) -> float:
+    """Matmul FLOPs of ONE trunk layer (sum of trunk_layer_op_flops)."""
+    return sum(trunk_layer_op_flops(cfg, n, r, c).values())
 
 
 def model_fwd_flops(cfg: Alphafold2Config, n: int, r: int, c: int) -> float:

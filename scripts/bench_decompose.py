@@ -254,6 +254,26 @@ elif base_leg == "ops":
                               (1, n3, n3))
     msa_mask = batch["msa_mask"]
 
+    # per-op analytic matmul counts, from the SAME source as the layer
+    # total (utils/flops.py trunk_layer_op_flops) so the per-op table
+    # always sums to trunk_layer_flops: each row's TF/s is
+    # roofline-relative, localizing not just WHERE the time goes but
+    # which op is furthest off peak. The benched ops split each
+    # ff entry (seq_ff/seq_ff2 share one dict key covering both).
+    from alphafold2_tpu.utils.flops import trunk_layer_op_flops
+    layer_ops = trunk_layer_op_flops(cfg, n3, msa_rows, crop)
+    n_ffs = 2 if cfg.reversible else 1  # dict ff entries cover all passes
+    op_fwd_tf = {
+        "pair_axial": layer_ops["pair_axial"] / 1e12,
+        "msa_axial_tied": layer_ops["msa_axial"] / 1e12,
+        "cross_pair_from_msa": layer_ops["cross_pair_from_msa"] / 1e12,
+        "cross_msa_from_pair": layer_ops["cross_msa_from_pair"] / 1e12,
+        "ff_pair": layer_ops["ff_pair"] / n_ffs / 1e12,
+        "ff_pair2": layer_ops["ff_pair"] / n_ffs / 1e12,
+        "ff_msa": layer_ops["ff_msa"] / n_ffs / 1e12,
+        "ff_msa2": layer_ops["ff_msa"] / n_ffs / 1e12,
+    }
+
     def bench_op(name, f, *args):
         def loss(*a):
             return jnp.mean(jnp.square(f(*a).astype(jnp.float32)))
@@ -261,8 +281,12 @@ elif base_leg == "ops":
             jax.value_and_grad(loss, argnums=tuple(range(len(args)))))
         compiled = jax.jit(vg).lower(*args).compile()
         dt = timed(compiled, *args)
+        # vg multiplier: attention ops remat their tiles (fwd +
+        # recompute + bwd = 4x fwd); the FFs are chunked, not remat'd (3x)
+        vg_mult = 4.0 if "ff" not in name else 3.0
+        mt = vg_mult * op_fwd_tf[name] if name in op_fwd_tf else None
         report(leg=f"op{leg_suffix}_{name}", depth=depth,
-               **perf_fields(compiled, dt))
+               **perf_fields(compiled, dt, model_tflop=mt))
 
     bench_op(
         "pair_axial",
