@@ -75,6 +75,13 @@ class AttentionConfig:
     # (a (1152, 384) f32 logit tile fits VMEM headroom; qb=kb=1152 would
     # not). Surfaced up to Alphafold2Config for the e2e sweep.
     flash_qb_target: Optional[int] = None
+    # materialize the XLA streaming path's score/probability tiles in the
+    # COMPUTE dtype instead of f32 (ops/flash.py stream_block): those
+    # tiles dominate the path's HBM traffic, and the AV dot consumes p in
+    # the compute dtype anyway — bf16 halves the dominant traffic at
+    # ~0.5% probability error (running max/sum stats stay f32). Off by
+    # default pending the on-chip A/B (sweep leg e2e_logit_bf16).
+    flash_compute_dtype_logits: bool = False
     # process the (folded) batch axis in chunks of this many elements under
     # jax.checkpoint (0 = off). Flash tiling bounds the LOGITS, but the
     # QKV/output projections still materialize over the whole folded batch —
@@ -262,6 +269,7 @@ def attention_apply(
             q, k, v, key_bias, scale=scale,
             tile_elems=cfg.flash_tile_elems, kv_block=cfg.flash_kv_block,
             kernel_qb=qb,
+            logit_dtype=dtype if cfg.flash_compute_dtype_logits else None,
         )
         out = out.reshape(out.shape[0], i, h * dh)
         return linear(params["to_out"], out, dtype=dtype)

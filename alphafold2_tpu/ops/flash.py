@@ -25,16 +25,24 @@ import jax.numpy as jnp
 _NEG_INF = float("-inf")
 
 
-def stream_block(q, k_blk, v_blk, bias_blk, m, l, acc, scale):
+def stream_block(q, k_blk, v_blk, bias_blk, m, l, acc, scale,
+                 logit_dtype=jnp.float32):
     """One flash-attention accumulation step against a K/V block.
 
     q: (b, nq, h, d); k_blk/v_blk: (b, nk, h, d); bias_blk: (b, nk) additive
     (-inf for masked keys). Running stats m, l: (b, h, nq); acc: (b, h, nq, d).
-    """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
-    s = s + bias_blk[:, None, None, :]
 
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    logit_dtype: dtype the (b, h, nq, nk) score/probability tiles are
+    MATERIALIZED in. These tiles dominate the path's HBM traffic (the
+    running stats and accumulator are f32 regardless, and the AV dot
+    casts p to v's dtype anyway) — bf16 halves the dominant traffic at
+    ~0.5% probability error, the same order as the bf16 activation
+    quantization the model already carries. Running max/sum stay f32.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(logit_dtype) * scale
+    s = s + bias_blk[:, None, None, :].astype(logit_dtype)
+
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1).astype(jnp.float32))
     # alpha/p guards: -inf - -inf = nan. The exp ARGUMENT must be sanitized
     # too, not just the result: exp(nan) in the unselected where-branch has a
     # nan primal, and exp's vjp multiplies even a zero cotangent by it
@@ -45,10 +53,12 @@ def stream_block(q, k_blk, v_blk, bias_blk, m, l, acc, scale):
     )
     p = jnp.where(
         jnp.isneginf(s),
-        0.0,
-        jnp.exp(jnp.where(jnp.isneginf(s), 0.0, s) - m_safe[..., None]),
+        jnp.zeros((), logit_dtype),
+        jnp.exp(jnp.where(jnp.isneginf(s), jnp.zeros((), logit_dtype), s)
+                - m_safe[..., None].astype(logit_dtype)),
     )
-    l_new = l * alpha + jnp.sum(p, axis=-1)
+    # f32 ACCUMULATION without materializing an f32 copy of p
+    l_new = l * alpha + jnp.sum(p, axis=-1, dtype=jnp.float32)
     acc_new = acc * alpha[..., None] + jnp.einsum(
         "bhqk,bkhd->bhqd", p.astype(v_blk.dtype), v_blk
     ).astype(jnp.float32)
@@ -63,7 +73,7 @@ def _largest_divisor_leq(n: int, cap: int) -> int:
     return 1
 
 
-def _tile_attention(q, k, v, bias, scale, kv_block):
+def _tile_attention(q, k, v, bias, scale, kv_block, logit_dtype=jnp.float32):
     """Exact attention for one query tile, streaming K/V blocks."""
     b, nq, h, dh = q.shape
     j = k.shape[1]
@@ -72,7 +82,8 @@ def _tile_attention(q, k, v, bias, scale, kv_block):
     acc0 = jnp.zeros((b, h, nq, dh), jnp.float32)
 
     if kv_block is None or j <= kv_block:
-        m, l, acc = stream_block(q, k, v, bias, m0, l0, acc0, scale)
+        m, l, acc = stream_block(q, k, v, bias, m0, l0, acc0, scale,
+                                 logit_dtype)
     else:
         pad = (-j) % kv_block
         if pad:
@@ -87,7 +98,8 @@ def _tile_attention(q, k, v, bias, scale, kv_block):
         def body(carry, blk):
             mm, ll, aa = carry
             kb, vb, bb = blk
-            return stream_block(q, kb, vb, bb, mm, ll, aa, scale), None
+            return stream_block(q, kb, vb, bb, mm, ll, aa, scale,
+                                logit_dtype), None
 
         (m, l, acc), _ = jax.lax.scan(body, (m0, l0, acc0), (ks, vs, bs))
 
@@ -105,6 +117,7 @@ def blockwise_attention(
     tile_elems: int = 1 << 25,
     kv_block: int = 2048,
     remat: bool = True,
+    logit_dtype=None,
 ):
     """Exact softmax(QK^T * scale + bias)V with bounded-memory tiling.
 
@@ -123,12 +136,16 @@ def blockwise_attention(
       kv_block: stream K/V in blocks of this length when j exceeds it.
       remat: jax.checkpoint each tile so backward recomputes instead of
         storing tile activations.
+      logit_dtype: dtype the score/probability tiles are materialized in
+        (None = float32). These tiles dominate HBM traffic; bf16 halves
+        it at ~0.5% probability error (see stream_block).
 
     Returns: (B, i, h, dh) in q.dtype. Fully-masked query rows return zeros.
     """
     B, i, h, dh = q.shape
     j = k.shape[1]
     scale = dh ** -0.5 if scale is None else scale
+    logit_dtype = jnp.float32 if logit_dtype is None else logit_dtype
     if key_bias is None:
         key_bias = jnp.zeros((B, j), jnp.float32)
 
@@ -139,7 +156,7 @@ def blockwise_attention(
     kvb = kv_block if (kv_block and j > kv_block) else None
 
     def tile(qt, kt, vt, bt):
-        return _tile_attention(qt, kt, vt, bt, scale, kvb)
+        return _tile_attention(qt, kt, vt, bt, scale, kvb, logit_dtype)
 
     if remat:
         tile = jax.checkpoint(tile)
@@ -265,6 +282,19 @@ def flash_attention(q, k, v, key_bias=None, *, scale=None, use_kernel="auto",
     scale = dh ** -0.5 if scale is None else scale
 
     if kernel_dispatch(i, j, dh, use_kernel):
+        ldt = blockwise_kwargs.get("logit_dtype")
+        if ldt is not None and ldt != jnp.float32:
+            # the Pallas kernel keeps its logit tiles in VMEM (no HBM
+            # materialization to halve) and computes them f32: recording
+            # a "bf16-logits" measurement that actually ran the kernel
+            # would misattribute the A/B — fail loudly instead
+            raise ValueError(
+                "logit_dtype (flash_compute_dtype_logits) applies only "
+                "to the XLA streaming path, but the Pallas kernel "
+                f"dispatched here (i={i}, j={j}, use_kernel="
+                f"{use_kernel!r}); disable the kernel for this A/B"
+            )
+
         def fold(t):
             return t.transpose(0, 2, 1, 3).reshape(B * h, t.shape[1], dh)
 

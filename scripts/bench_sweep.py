@@ -54,6 +54,8 @@ ecfg, crop, msa_rows = north_star_e2e_config(
         attn_flash_tile_elems=spec["tile_elems"],
         attn_flash_qb_target=spec.get("qb_target"),
         **({"ff_chunk_size": spec["ff_chunk"]} if "ff_chunk" in spec else {}),
+        **({"attn_flash_compute_dtype_logits": spec["logit_bf16"]}
+           if "logit_bf16" in spec else {}),
         **{k: spec[k] for k in ("heads", "dim_head") if k in spec},
     ),
     e2e_overrides=dict(
@@ -257,6 +259,14 @@ def main():
             # win of dropping the 200-iteration sequential Guttman tail
             ("e2e_mds25classical",
              {**base, "mds_iters": 25, "mds_init": "classical"}),
+            # bf16 score/probability tiles in the XLA streaming path:
+            # halves the attention passes' dominant HBM traffic (the f32
+            # logit materialization — PERF.md round-5 traffic budget) at
+            # bf16-rounding probability error (tests/test_flash.py). If
+            # the traffic theory is right this is a direct ~2x on the
+            # ~60%-of-layer pair attention; if it is noise, the sink is
+            # elsewhere — decisive either way.
+            ("e2e_logit_bf16", {**base, "logit_bf16": True}),
             ("e2e_mdsbwd25", {**base, "mds_bwd_iters": 25}),
             # MDS scan unroll: amortizes the 200 sequential small-kernel
             # iterations' dispatch overhead (PERF.md "MDS latency")

@@ -60,6 +60,53 @@ def test_blockwise_matches_dense(B, i, j, tile_elems, kv_block):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
+@pytest.mark.parametrize("kv_block", [2048, 16])  # single-shot + streamed
+def test_blockwise_compute_dtype_logits(kv_block):
+    """bf16 score/probability materialization (the streaming path's HBM
+    traffic halver): same math within bf16 rounding, masked keys still
+    exactly excluded, fully-masked rows still zero."""
+    B, i, j, h, dh = 4, 32, 48, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(1), 4)
+    q = jax.random.normal(ks[0], (B, i, h, dh), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, j, h, dh), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, j, h, dh), jnp.bfloat16)
+    mask = jax.random.bernoulli(ks[3], 0.7, (B, j))
+    mask = mask.at[:, 0].set(True)
+    mask = mask.at[0].set(False)  # one fully-masked batch row
+    bias = jnp.where(mask, 0.0, float("-inf")).astype(jnp.float32)
+
+    run = lambda ldt: jax.jit(
+        lambda q, k, v, b: blockwise_attention(
+            q, k, v, b, scale=dh**-0.5, kv_block=kv_block,
+            logit_dtype=ldt,
+        )
+    )(q, k, v, bias)
+    f32 = np.asarray(run(None), np.float32)
+    b16 = np.asarray(run(jnp.bfloat16), np.float32)
+    assert np.isfinite(b16).all()
+    # fully-masked row exact zeros in both
+    assert (b16[0] == 0).all() and (f32[0] == 0).all()
+    # bf16-rounding-level agreement on the rest
+    np.testing.assert_allclose(b16[1:], f32[1:], atol=0.04, rtol=0.04)
+
+    # gradients flow and agree to the same order
+    def loss(ldt):
+        def f(q, k, v):
+            return jnp.sum(
+                blockwise_attention(
+                    q, k, v, bias, scale=dh**-0.5, kv_block=kv_block,
+                    logit_dtype=ldt,
+                ).astype(jnp.float32) ** 2
+            )
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gf = loss(None)
+    gb = loss(jnp.bfloat16)
+    for a, b in zip(gf, gb):
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        assert np.isfinite(b).all()
+        np.testing.assert_allclose(b, a, atol=0.12, rtol=0.12)
+
+
 @pytest.mark.slow
 def test_blockwise_gradients_match_dense():
     B, i, j, h, dh = 4, 24, 40, 2, 8
