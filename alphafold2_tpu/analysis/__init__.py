@@ -1,0 +1,89 @@
+"""af2lint: in-repo static analysis for a JAX codebase that cannot afford
+runtime discovery of statically detectable breakage.
+
+Four passes, each a module in this package:
+
+  * ``compat``   — AST linter: no `jax.experimental.*` access and no
+                   drift-table symbol outside `alphafold2_tpu/compat.py`
+                   (compat_lint.py, drift.py);
+  * ``trace``    — trace-safety: walks functions reachable from
+                   jit/pjit/shard_map entry points and flags Python side
+                   effects, host-numpy calls on traced values, and
+                   data-dependent Python branching (trace_safety.py);
+  * ``sharding`` — PartitionSpec rank vs annotated rank, unknown /
+                   duplicate mesh axis names against
+                   parallel/mesh.py KNOWN_AXES (sharding_lint.py);
+  * ``smoke``    — abstract interpretation: `jax.eval_shape` every public
+                   op and training preset under abstract inputs — import-
+                   and trace-time errors surface in seconds, zero FLOPs
+                   (abstract_smoke.py).
+
+CLI: ``python -m alphafold2_tpu.analysis --strict`` (docs/STATIC_ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+from alphafold2_tpu.analysis.common import Finding, iter_py_files, suppressed
+
+__all__ = [
+    "Finding",
+    "PASSES",
+    "iter_py_files",
+    "run_passes",
+    "suppressed",
+]
+
+
+def _run_compat(root, files=None, **_):
+    from alphafold2_tpu.analysis.compat_lint import run
+
+    return run(root, files=files)
+
+
+def _run_trace(root, files=None, **_):
+    from alphafold2_tpu.analysis.trace_safety import run
+
+    return run(root, files=files)
+
+
+def _run_sharding(root, files=None, axes=None, **_):
+    from alphafold2_tpu.analysis.sharding_lint import run
+
+    return run(root, files=files, axes=axes)
+
+
+def _run_smoke(root, **_):
+    from alphafold2_tpu.analysis.abstract_smoke import run
+
+    return run()
+
+
+# name -> runner(root, files=..., axes=...) -> list[Finding]
+PASSES = {
+    "compat": _run_compat,
+    "trace": _run_trace,
+    "sharding": _run_sharding,
+    "smoke": _run_smoke,
+}
+
+
+def run_passes(root, select=None, files=None, axes=None):
+    """Run the selected passes (all by default) over `root`; returns the
+    combined finding list, stable-sorted by (path, line, code).
+
+    With an explicit `files` list and no explicit `select`, the smoke pass
+    is dropped: it traces the whole public surface regardless of files, so
+    a "lint this one file" invocation would pay the full model-tracing cost
+    and could fail on findings unrelated to the requested file. Selecting
+    it explicitly (select=... including "smoke") still runs it."""
+    if select is None:
+        names = [
+            n for n in PASSES if not (files is not None and n == "smoke")
+        ]
+    else:
+        names = list(select)
+    findings = []
+    for name in names:
+        findings.extend(PASSES[name](root, files=files, axes=axes))
+    findings.sort(key=lambda f: (str(f.path), f.line, f.code))
+    return findings

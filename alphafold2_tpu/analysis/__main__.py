@@ -1,0 +1,92 @@
+"""CLI: ``python -m alphafold2_tpu.analysis [--strict] [--select ...]``.
+
+Exit status: 0 when clean (always, without --strict); with --strict, 1
+when any finding survives. CI runs ``--strict`` as a build gate and
+``--select smoke`` as the fast pre-test gate (.github/workflows/test.yml).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from alphafold2_tpu.analysis import PASSES, run_passes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m alphafold2_tpu.analysis",
+        description="af2lint: JAX-aware static analysis "
+        "(compat / trace / sharding / smoke)",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help="files to analyze (default: the whole tree under --root). "
+        "With explicit paths the repo-wide smoke pass is skipped unless "
+        "selected via --select",
+    )
+    ap.add_argument(
+        "--root",
+        default=".",
+        help="repo root for file discovery and relative paths (default: cwd)",
+    )
+    ap.add_argument(
+        "--select",
+        default=None,
+        help=f"comma-separated pass names (default: all of {','.join(PASSES)})",
+    )
+    ap.add_argument(
+        "--strict",
+        action="store_true",
+        help="exit 1 on any finding (CI gate mode)",
+    )
+    ap.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format",
+    )
+    ap.add_argument(
+        "--axes",
+        default=None,
+        help="comma-separated mesh-axis allowlist for the sharding pass "
+        "(default: parallel/mesh.py KNOWN_AXES)",
+    )
+    args = ap.parse_args(argv)
+
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+        unknown = [s for s in select if s not in PASSES]
+        if unknown:
+            ap.error(f"unknown pass(es) {unknown}; available: {list(PASSES)}")
+    axes = (
+        {a.strip() for a in args.axes.split(",") if a.strip()}
+        if args.axes
+        else None
+    )
+    files = args.paths or None
+
+    findings = run_passes(args.root, select=select, files=files, axes=axes)
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                [f.__dict__ for f in findings], indent=2, sort_keys=True
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        names = select or list(PASSES)
+        print(
+            f"af2lint: {len(findings)} finding(s) from passes "
+            f"[{', '.join(names)}]"
+        )
+    return 1 if (args.strict and findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,238 @@
+"""Pass 4 — abstract-interpretation smoke.
+
+`jax.eval_shape` every public op, the full model, and every
+`training/presets.py` tier under abstract inputs. eval_shape runs the
+whole trace — imports, shape arithmetic, dtype promotion, custom-VJP
+wiring, Pallas kernel construction — without compiling or executing a
+single FLOP, so an import-time or trace-time regression (exactly the
+class that had the seed suite red) surfaces in seconds on a laptop
+instead of minutes into a paid TPU reservation.
+
+Each target is a named thunk; a target that raises becomes one SMOKE001
+finding carrying the exception head. Registered targets:
+
+  ops.*       flash / blockwise / dense / axial attention, feed-forward
+  model.*     alphafold2 init+apply at smoke shapes
+  presets.*   e2e train-state init for every tier; full e2e loss (fwd +
+              structure module) at smoke shapes
+
+Add a target when adding a public op: append to `_targets()`.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Callable, Dict, List
+
+from alphafold2_tpu.analysis.common import Finding
+
+PASS = "smoke"
+
+
+def _targets() -> Dict[str, Callable[[], None]]:
+    """name -> thunk that eval_shapes one surface (raises on breakage)."""
+    import jax
+    import jax.numpy as jnp
+
+    import numpy as np
+
+    key = jax.random.PRNGKey(0)
+    f32 = jnp.float32
+
+    def abstract(shape, dtype=f32):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    targets: Dict[str, Callable[[], None]] = {}
+
+    def register(name):
+        def deco(fn):
+            targets[name] = fn
+            return fn
+
+        return deco
+
+    # --- ops ---------------------------------------------------------------
+    @register("ops.flash_attention_tpu")
+    def _flash():
+        from alphafold2_tpu.ops.flash_kernel import flash_attention_tpu
+
+        jax.eval_shape(
+            lambda q, k, v, b: flash_attention_tpu(q, k, v, b, 0.35, qb=128, kb=128),
+            abstract((2, 16, 8)), abstract((2, 24, 8)), abstract((2, 24, 8)),
+            abstract((2, 24)),
+        )
+
+    @register("ops.flash_attention_lse")
+    def _flash_lse():
+        from alphafold2_tpu.ops.flash_kernel import flash_attention_lse
+
+        jax.eval_shape(
+            lambda q, k, v, b: flash_attention_lse(q, k, v, b, 0.35, qb=128, kb=128),
+            abstract((2, 16, 8)), abstract((2, 16, 8)), abstract((2, 16, 8)),
+            abstract((2, 16)),
+        )
+
+    @register("ops.flash_attention_grad")
+    def _flash_grad():
+        from alphafold2_tpu.ops.flash_kernel import flash_attention_tpu
+
+        jax.eval_shape(
+            jax.grad(
+                lambda q, k, v, b: flash_attention_tpu(
+                    q, k, v, b, 0.35, qb=128, kb=128
+                ).sum(),
+                argnums=(0, 1, 2),
+            ),
+            abstract((2, 16, 8)), abstract((2, 16, 8)), abstract((2, 16, 8)),
+            abstract((2, 16)),
+        )
+
+    @register("ops.blockwise_attention")
+    def _blockwise():
+        from alphafold2_tpu.ops.flash import blockwise_attention
+
+        jax.eval_shape(
+            lambda q, k, v: blockwise_attention(q, k, v),
+            abstract((2, 32, 4, 8)), abstract((2, 32, 4, 8)),
+            abstract((2, 32, 4, 8)),
+        )
+
+    @register("ops.attention")
+    def _attention():
+        from alphafold2_tpu.ops import AttentionConfig, attention_apply, attention_init
+
+        cfg = AttentionConfig(dim=32, heads=4, dim_head=8)
+        params = jax.eval_shape(lambda k: attention_init(k, cfg), key)
+        jax.eval_shape(
+            lambda p, x: attention_apply(p, cfg, x), params, abstract((2, 12, 32))
+        )
+
+    @register("ops.axial_attention")
+    def _axial():
+        from alphafold2_tpu.ops import (
+            AttentionConfig,
+            axial_attention_apply,
+            axial_attention_init,
+        )
+
+        cfg = AttentionConfig(dim=32, heads=4, dim_head=8)
+        params = jax.eval_shape(lambda k: axial_attention_init(k, cfg), key)
+        jax.eval_shape(
+            lambda p, x: axial_attention_apply(p, cfg, x),
+            params,
+            abstract((1, 8, 8, 32)),
+        )
+
+    @register("ops.feed_forward")
+    def _ff():
+        from alphafold2_tpu.ops import feed_forward_apply, feed_forward_init
+
+        params = jax.eval_shape(lambda k: feed_forward_init(k, 32), key)
+        jax.eval_shape(
+            lambda p, x: feed_forward_apply(p, x), params, abstract((2, 12, 32))
+        )
+
+    @register("ops.block_sparse_attention")
+    def _sparse():
+        from alphafold2_tpu.ops.sparse import SparseConfig, block_sparse_attention
+
+        scfg = SparseConfig(block_size=16)
+        jax.eval_shape(
+            lambda q, k, v: block_sparse_attention(q, k, v, scfg=scfg),
+            abstract((1, 64, 4, 8)), abstract((1, 64, 4, 8)),
+            abstract((1, 64, 4, 8)),
+        )
+
+    # --- model -------------------------------------------------------------
+    @register("model.alphafold2")
+    def _model():
+        from alphafold2_tpu.models import (
+            Alphafold2Config,
+            alphafold2_apply,
+            alphafold2_init,
+        )
+
+        cfg = Alphafold2Config(
+            dim=32, depth=1, heads=4, dim_head=8, max_seq_len=64
+        )
+        params = jax.eval_shape(lambda k: alphafold2_init(k, cfg), key)
+        seq = abstract((1, 12), jnp.int32)
+        jax.eval_shape(lambda p, s: alphafold2_apply(p, cfg, s), params, seq)
+
+    # --- training presets ---------------------------------------------------
+    def _preset_init(tier):
+        def thunk():
+            from alphafold2_tpu.training.e2e import e2e_train_state_init
+            from alphafold2_tpu.training.harness import TrainConfig
+            from alphafold2_tpu.training.presets import north_star_e2e_config
+
+            ecfg, _, _ = north_star_e2e_config(depth=2, tier=tier)
+            tcfg = TrainConfig()
+            jax.eval_shape(lambda k: e2e_train_state_init(k, ecfg, tcfg), key)
+
+        return thunk
+
+    for tier in ("smoke", "proportional", "north_star"):
+        targets[f"presets.{tier}.init"] = _preset_init(tier)
+
+    @register("presets.smoke.e2e_loss")
+    def _e2e_loss():
+        from alphafold2_tpu.training.e2e import (
+            e2e_train_state_init,
+            make_e2e_loss_fn,
+        )
+        from alphafold2_tpu.training.harness import TrainConfig
+        from alphafold2_tpu.training.presets import north_star_e2e_config
+
+        ecfg, crop, msa_rows = north_star_e2e_config(depth=2, tier="smoke")
+        state = jax.eval_shape(
+            lambda k: e2e_train_state_init(k, ecfg, TrainConfig()), key
+        )
+        loss_fn = make_e2e_loss_fn()
+        batch = {
+            "seq": abstract((1, crop), jnp.int32),
+            "mask": abstract((1, crop), jnp.bool_),
+            "coords": abstract((1, crop, 14, 3)),
+            # the reversible trunk requires an MSA stream
+            "msa": abstract((1, msa_rows, crop), jnp.int32),
+            "msa_mask": abstract((1, msa_rows, crop), jnp.bool_),
+        }
+        jax.eval_shape(
+            lambda p, b, k: loss_fn(p, ecfg, b, k), state["params"], batch, key
+        )
+
+    del np  # imported to fail fast when the env lacks it
+    return targets
+
+
+def run() -> List[Finding]:
+    findings: List[Finding] = []
+    try:
+        targets = _targets()
+    except Exception as e:  # registry construction itself failing is a finding
+        findings.append(
+            Finding(
+                PASS,
+                "SMOKE000",
+                "alphafold2_tpu/analysis/abstract_smoke.py",
+                1,
+                f"smoke registry failed to build: {type(e).__name__}: {e}",
+            )
+        )
+        return findings
+    for name, thunk in targets.items():
+        try:
+            thunk()
+        except Exception as e:
+            tb = traceback.format_exc(limit=3).strip().splitlines()
+            head = f"{type(e).__name__}: {e}".splitlines()[0][:300]
+            findings.append(
+                Finding(
+                    PASS,
+                    "SMOKE001",
+                    name,
+                    0,
+                    f"eval_shape failed — {head} (tail: {tb[-1][:160]})",
+                )
+            )
+    return findings

@@ -1,0 +1,227 @@
+"""Pass 3 — sharding annotations.
+
+Checks every `PartitionSpec(...)` / `P(...)` construction (plus
+`compat.shard_map` in/out specs) statically:
+
+  SHARD001  spec rank disagrees with the annotated array rank. Annotate
+            the line with `# af2lint: rank=N` where N counts the array's
+            dimensions; the spec may have FEWER entries (trailing dims
+            replicate by JAX convention) but never more.
+  SHARD002  axis name not in `parallel/mesh.py` KNOWN_AXES — a typo'd
+            axis ("dat", "sq") otherwise survives until a mesh lookup
+            KeyErrors mid-trace on real chips. Names bound from an
+            `axis_name`-style parameter are invisible to this check (it
+            only sees string literals), which is exactly right: those are
+            validated against the live mesh at call time.
+  SHARD003  the same axis named twice in one spec — JAX rejects this at
+            trace time; the static check moves it to CI.
+  SHARD004  `shard_map(f, in_specs=(...))` where the literal in_specs
+            tuple arity disagrees with f's parameter count (f a lambda or
+            a local def) — today this dies deep in shard_map's pytree
+            mismatch error; the static message names the actual problem.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import List, Optional, Sequence, Set
+
+from alphafold2_tpu.analysis.common import (
+    Finding,
+    dotted_name,
+    filter_suppressed,
+    iter_py_files,
+    parse_file,
+    rel,
+    suppressed_lines,
+)
+
+PASS = "sharding"
+
+_RANK_RE = re.compile(r"#\s*af2lint:\s*rank=(\d+)")
+
+_SPEC_NAMES = {"P", "PartitionSpec"}
+_SHARD_MAP_NAMES = {"shard_map", "compat.shard_map", "jax.shard_map"}
+
+
+def _default_axes(root) -> Optional[Set[str]]:
+    """KNOWN_AXES from the live package; falls back to statically parsing
+    `<root>/alphafold2_tpu/parallel/mesh.py` (the registry must stay
+    checkable even when the package fails to import — that broken state is
+    exactly when lint matters). Returns None when neither source yields a
+    registry; the caller reports that as its own finding rather than
+    silently disabling SHARD002."""
+    try:
+        from alphafold2_tpu.parallel.mesh import KNOWN_AXES
+
+        return set(KNOWN_AXES)
+    except Exception:
+        pass
+    return _parse_axes_registry(
+        Path(root) / "alphafold2_tpu" / "parallel" / "mesh.py"
+    )
+
+
+def _parse_axes_registry(mesh_py: Path) -> Optional[Set[str]]:
+    """Static read of `KNOWN_AXES = frozenset({...})` out of mesh.py."""
+    try:
+        tree = ast.parse(Path(mesh_py).read_text())
+    except Exception:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "KNOWN_AXES"
+            for t in node.targets
+        ):
+            names = {
+                c.value
+                for c in ast.walk(node.value)
+                if isinstance(c, ast.Constant) and isinstance(c.value, str)
+            }
+            if names:
+                return names
+    return None
+
+
+def _spec_axes(call: ast.Call):
+    """Flatten a P(...) call's dims: each positional arg is one dim; a
+    tuple arg is one dim sharded over several axes. Yields (dim_count,
+    [axis string literals])."""
+    axes: List[str] = []
+    rank = 0
+    for a in call.args:
+        if isinstance(a, ast.Starred):
+            return None  # dynamic — not statically checkable
+        rank += 1
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            axes.append(a.value)
+        elif isinstance(a, ast.Tuple):
+            for el in a.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    axes.append(el.value)
+    return rank, axes
+
+
+def _rank_annotation(src_lines: List[str], lineno: int) -> Optional[int]:
+    m = _RANK_RE.search(src_lines[lineno - 1]) if lineno <= len(src_lines) else None
+    return int(m.group(1)) if m else None
+
+
+def _fn_arity(fn) -> Optional[int]:
+    a = fn.args
+    if a.vararg or a.kwarg:
+        return None
+    return len(a.posonlyargs) + len(a.args)
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, src: str, axes: Set[str], defs):
+        self.path = path
+        self.src_lines = src.splitlines()
+        self.axes = axes
+        self.defs = defs
+        self.findings: List[Finding] = []
+
+    def _emit(self, code, line, msg):
+        self.findings.append(Finding(PASS, code, self.path, line, msg))
+
+    def visit_Call(self, node: ast.Call):
+        name = dotted_name(node.func)
+        if name in _SPEC_NAMES:
+            self._check_spec(node)
+        if name in _SHARD_MAP_NAMES:
+            self._check_shard_map(node)
+        self.generic_visit(node)
+
+    def _check_spec(self, node: ast.Call):
+        flat = _spec_axes(node)
+        if flat is None:
+            return
+        rank, axes = flat
+        annotated = _rank_annotation(self.src_lines, node.lineno)
+        if annotated is not None and rank > annotated:
+            self._emit(
+                "SHARD001",
+                node.lineno,
+                f"PartitionSpec has {rank} entries but the annotated array "
+                f"rank is {annotated} (af2lint: rank={annotated}); a spec "
+                "longer than the array rank fails at trace time",
+            )
+        if self.axes:
+            for ax in axes:
+                if ax not in self.axes:
+                    self._emit(
+                        "SHARD002",
+                        node.lineno,
+                        f"mesh axis {ax!r} is not in parallel/mesh.py "
+                        f"KNOWN_AXES {sorted(self.axes)} — typo, or a new "
+                        "axis missing its registry entry",
+                    )
+        dup = {a for a in axes if axes.count(a) > 1}
+        if dup:
+            self._emit(
+                "SHARD003",
+                node.lineno,
+                f"axis {sorted(dup)} appears more than once in one "
+                "PartitionSpec — JAX rejects this at trace time",
+            )
+
+    def _check_shard_map(self, node: ast.Call):
+        fn = None
+        if node.args:
+            a0 = node.args[0]
+            if isinstance(a0, ast.Lambda):
+                fn = a0
+            elif isinstance(a0, ast.Name) and a0.id in self.defs:
+                fn = self.defs[a0.id]
+        if fn is None:
+            return
+        in_specs = next(
+            (kw.value for kw in node.keywords if kw.arg == "in_specs"), None
+        )
+        if not isinstance(in_specs, ast.Tuple):
+            return
+        arity = _fn_arity(fn)
+        if arity is not None and len(in_specs.elts) != arity:
+            self._emit(
+                "SHARD004",
+                node.lineno,
+                f"shard_map in_specs has {len(in_specs.elts)} entries but "
+                f"the mapped function takes {arity} arguments",
+            )
+
+
+def run(root, files: Optional[Sequence] = None, axes=None) -> List[Finding]:
+    axes = set(axes) if axes is not None else _default_axes(root)
+    findings: List[Finding] = []
+    if axes is None:
+        # no registry found anywhere: say so loudly instead of silently
+        # running with SHARD002 disabled (an importable-but-broken parallel
+        # package is exactly the state the linter exists to catch)
+        findings.append(
+            Finding(
+                PASS,
+                "SHARD000",
+                "alphafold2_tpu/parallel/mesh.py",
+                1,
+                "mesh-axis registry unavailable (package import failed and "
+                "KNOWN_AXES could not be parsed statically) — SHARD002 "
+                "cannot run; fix mesh.py or pass --axes",
+            )
+        )
+        axes = set()
+    for path in iter_py_files(root, files):
+        src, tree = parse_file(path)
+        if tree is None:
+            continue
+        defs = {
+            n.name: n
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        v = _Visitor(rel(path, root), src, axes, defs)
+        v.visit(tree)
+        findings.extend(filter_suppressed(v.findings, suppressed_lines(src)))
+    return findings

@@ -1,0 +1,369 @@
+"""Pass 2 — trace safety.
+
+Finds the functions that run under a JAX trace — anything decorated with
+or passed to `jax.jit` / `pjit` / `compat.shard_map` (and their
+functools.partial forms) — walks every function reachable from them
+through the module's local call graph, and flags Python-level operations
+that are wrong on traced values:
+
+  TRACE001  `print(...)` under trace: runs once at trace time, never on
+            device — use `jax.debug.print`.
+  TRACE002  host-numpy call (`np.*` / `numpy.*`) on a traced value:
+            either crashes (TracerArrayConversionError) or silently
+            constant-folds at trace time.
+  TRACE003  data-dependent Python branch: `if x > 0:` on a traced value
+            is a TracerBoolConversionError at trace time — use
+            `jax.lax.cond` / `jnp.where`.
+  TRACE004  Python concretization of a traced value (`float(x)`,
+            `int(x)`, `bool(x)`, `x.item()`, `x.tolist()`).
+
+Taint model (deliberately first-order): the parameters of a traced
+function are traced; values assigned from expressions mentioning traced
+names are traced. Metadata access is exempt — `.shape`, `.ndim`,
+`.dtype`, `.size`, `len(x)`, `isinstance(x, ...)`, and `x is None`
+checks are all static under tracing and legitimately drive Python
+control flow. Config-like parameters (annotated or named `cfg`/`config`/
+`*_config`, `self`, string/bool/int-annotated args) are not traced —
+they are static argnums in practice; the pass errs on the side of NOT
+flagging so `--strict` stays clean on legitimate code. Suppress a
+deliberate trace-time effect with `# af2lint: disable=TRACE00x`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from alphafold2_tpu.analysis.common import (
+    Finding,
+    dotted_name,
+    filter_suppressed,
+    iter_py_files,
+    parse_file,
+    rel,
+    suppressed_lines,
+)
+
+PASS = "trace"
+
+# callables whose function argument is traced
+_TRACE_WRAPPERS = {
+    "jax.jit",
+    "jit",
+    "jax.pjit",
+    "pjit",
+    "jax.experimental.pjit.pjit",
+    "compat.shard_map",
+    "shard_map",
+    "jax.shard_map",
+}
+
+# attributes that read static metadata off a tracer (never concretize)
+_STATIC_ATTRS = {
+    "shape", "ndim", "dtype", "size", "aval", "sharding", "itemsize",
+}
+
+# parameter names that are configuration/static by convention, never arrays
+_STATIC_PARAM_NAMES = {"self", "cls", "cfg", "config", "ecfg", "tcfg", "mesh"}
+
+_NUMPY_ALIASES_DEFAULT = {"numpy"}
+
+_CONCRETIZERS = {"float", "int", "bool", "complex"}
+_CONCRETIZER_METHODS = {"item", "tolist", "__index__"}
+
+
+def _func_name_of(call_func: ast.AST) -> Optional[str]:
+    return dotted_name(call_func)
+
+
+def _is_trace_wrapper(node: ast.AST) -> bool:
+    """True if `node` (a decorator or call func) denotes jit/pjit/shard_map,
+    directly or through functools.partial(jit, ...)."""
+    if isinstance(node, ast.Call):
+        name = _func_name_of(node.func)
+        if name in _TRACE_WRAPPERS:
+            return True
+        if name in ("functools.partial", "partial") and node.args:
+            return _is_trace_wrapper(node.args[0])
+        return False
+    return _func_name_of(node) in _TRACE_WRAPPERS
+
+
+class _FunctionIndex(ast.NodeVisitor):
+    """module-level (and class-method) def name -> node."""
+
+    def __init__(self):
+        self.defs: Dict[str, ast.AST] = {}
+
+    def visit_FunctionDef(self, node):
+        self.defs.setdefault(node.name, node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _entry_points(tree: ast.Module, defs: Dict[str, ast.AST]) -> List[ast.AST]:
+    """Functions that run under trace: decorated with a trace wrapper, or
+    passed (as the first argument) to a trace-wrapper call anywhere."""
+    entries: List[ast.AST] = []
+    seen: Set[int] = set()
+
+    def add(fn):
+        if fn is not None and id(fn) not in seen:
+            seen.add(id(fn))
+            entries.append(fn)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_trace_wrapper(d) for d in node.decorator_list):
+                add(node)
+        elif isinstance(node, ast.Call) and _is_trace_wrapper(node):
+            for a in node.args[:1]:
+                if isinstance(a, ast.Lambda):
+                    add(a)
+                elif isinstance(a, ast.Name) and a.id in defs:
+                    add(defs[a.id])
+    return entries
+
+
+def _reachable(entries: List[ast.AST], defs: Dict[str, ast.AST]) -> List[ast.AST]:
+    """Transitive closure over same-module calls by bare name."""
+    out: List[ast.AST] = []
+    seen: Set[int] = set()
+    work = list(entries)
+    while work:
+        fn = work.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        out.append(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                callee = defs.get(node.func.id)
+                if callee is not None and id(callee) not in seen:
+                    work.append(callee)
+    return out
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+    return names
+
+
+def _static_param(p: ast.arg, name: str) -> bool:
+    if name in _STATIC_PARAM_NAMES or name.endswith(("_config", "_cfg", "_name", "_fn")):
+        return True
+    ann = getattr(p, "annotation", None)
+    if ann is not None:
+        ann_name = dotted_name(ann) or (
+            ast.unparse(ann) if hasattr(ast, "unparse") else ""
+        )
+        # scalar/static annotations -> static argnums by convention
+        for s in ("int", "bool", "str", "float", "Config", "Mesh", "Optional[int]",
+                  "Optional[str]", "Optional[bool]"):
+            if ann_name == s or ann_name.endswith("." + s) or s in ann_name:
+                return True
+    return False
+
+
+def _tainted_params(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    out = set()
+    for p in a.posonlyargs + a.args + a.kwonlyargs:
+        if not _static_param(p, p.arg):
+            out.add(p.arg)
+    return out
+
+
+class _TaintChecker(ast.NodeVisitor):
+    """One pass over a traced function's body with first-order taint."""
+
+    def __init__(self, path: str, numpy_aliases: Set[str], fn: ast.AST):
+        self.path = path
+        self.np_aliases = numpy_aliases
+        self.fn = fn
+        self.tainted: Set[str] = _tainted_params(fn)
+        self.findings: List[Finding] = []
+
+    # -- helpers ----------------------------------------------------------
+    def _names_in(self, node: ast.AST) -> Set[str]:
+        return {
+            n.id
+            for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+        }
+
+    def _is_tainted_expr(self, node: ast.AST) -> bool:
+        """A bare tainted name used as an ARRAY (not via static metadata)."""
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Name)
+                and isinstance(sub.ctx, ast.Load)
+                and sub.id in self.tainted
+                and not self._only_static_use(node, sub)
+            ):
+                return True
+        return False
+
+    def _only_static_use(self, root: ast.AST, name_node: ast.Name) -> bool:
+        """True when `name_node` appears only under a static-metadata
+        context inside `root`: x.shape/..., len(x), isinstance(x, ...),
+        `x is None` / `x is not None`."""
+        parents = _parent_map(root)
+        node = name_node
+        parent = parents.get(id(node))
+        while parent is not None:
+            if isinstance(parent, ast.Attribute) and parent.attr in _STATIC_ATTRS:
+                return True
+            if isinstance(parent, ast.Call):
+                fname = _func_name_of(parent.func)
+                if fname in ("len", "isinstance", "type", "getattr", "hasattr"):
+                    return True
+            if isinstance(parent, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops
+            ):
+                return True
+            node = parent
+            parent = parents.get(id(node))
+        return False
+
+    def _emit(self, code: str, line: int, msg: str):
+        self.findings.append(Finding(PASS, code, self.path, line, msg))
+
+    # -- taint propagation -------------------------------------------------
+    def visit_Assign(self, node: ast.Assign):
+        self.generic_visit(node)
+        if self._is_tainted_expr(node.value):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        self.tainted.add(n.id)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self.generic_visit(node)
+        if self._is_tainted_expr(node.value) and isinstance(node.target, ast.Name):
+            self.tainted.add(node.target.id)
+
+    # -- checks ------------------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        fname = _func_name_of(node.func) or ""
+        root = fname.split(".", 1)[0] if fname else ""
+
+        if fname == "print":
+            self._emit(
+                "TRACE001",
+                node.lineno,
+                "print() inside a traced function runs at trace time only; "
+                "use jax.debug.print",
+            )
+        elif root in self.np_aliases and any(
+            self._is_tainted_expr(a) for a in list(node.args) + [
+                kw.value for kw in node.keywords
+            ]
+        ):
+            self._emit(
+                "TRACE002",
+                node.lineno,
+                f"host-numpy call {fname}() on a traced value — crashes or "
+                "constant-folds at trace time; use jnp",
+            )
+        elif fname in _CONCRETIZERS and node.args and self._is_tainted_expr(node.args[0]):
+            self._emit(
+                "TRACE004",
+                node.lineno,
+                f"{fname}() concretizes a traced value "
+                "(TracerBoolConversionError under jit)",
+            )
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _CONCRETIZER_METHODS
+            and self._is_tainted_expr(node.func.value)
+        ):
+            self._emit(
+                "TRACE004",
+                node.lineno,
+                f".{node.func.attr}() concretizes a traced value",
+            )
+        self.generic_visit(node)
+
+    def _check_branch(self, test: ast.AST, kind: str, line: int):
+        if self._is_tainted_expr(test):
+            self._emit(
+                "TRACE003",
+                line,
+                f"data-dependent Python {kind} on a traced value — use "
+                "jax.lax.cond / jnp.where (trace-time "
+                "TracerBoolConversionError)",
+            )
+
+    def visit_If(self, node: ast.If):
+        # `if bad: raise ...` is a legitimate trace-time validation guard:
+        # on static quantities it runs at trace time; on a genuine tracer
+        # it crashes loudly at trace time either way — no silent wrongness
+        guard_only = all(
+            isinstance(s, ast.Raise) for s in node.body
+        ) and all(isinstance(s, ast.Raise) for s in node.orelse)
+        if not guard_only:
+            self._check_branch(node.test, "if", node.lineno)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While):
+        self._check_branch(node.test, "while", node.lineno)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert):
+        self._check_branch(node.test, "assert", node.lineno)
+        self.generic_visit(node)
+
+    # nested defs get their own checker via reachability; don't double-walk
+    def visit_FunctionDef(self, node):
+        if node is self.fn:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        if node is self.fn:
+            self.generic_visit(node)
+
+
+def _parent_map(root: ast.AST) -> Dict[int, ast.AST]:
+    out: Dict[int, ast.AST] = {}
+    for parent in ast.walk(root):
+        for child in ast.iter_child_nodes(parent):
+            out[id(child)] = parent
+    return out
+
+
+def _numpy_aliases(tree: ast.Module) -> Set[str]:
+    aliases = set(_NUMPY_ALIASES_DEFAULT)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    aliases.add(a.asname or "numpy")
+    return aliases
+
+
+def run(root, files: Optional[Sequence] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_py_files(root, files):
+        src, tree = parse_file(path)
+        rpath = rel(path, root)
+        if tree is None:
+            continue  # compat pass reports the parse failure
+        idx = _FunctionIndex()
+        idx.visit(tree)
+        entries = _entry_points(tree, idx.defs)
+        if not entries:
+            continue
+        supp = suppressed_lines(src)
+        np_aliases = _numpy_aliases(tree)
+        for fn in _reachable(entries, idx.defs):
+            checker = _TaintChecker(rpath, np_aliases, fn)
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                checker.visit(stmt)
+            findings.extend(filter_suppressed(checker.findings, supp))
+    return findings

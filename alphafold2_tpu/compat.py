@@ -1,0 +1,163 @@
+"""Version-compat shim: every version-dependent JAX name resolves HERE, once.
+
+The seed's tier-1 suite went red on exactly the failure mode this module
+exists to prevent: `jax.experimental.pallas.tpu.CompilerParams` (JAX >=
+0.6) vs `TPUCompilerParams` (<= 0.5), `jax.shard_map(check_vma=...)` vs
+`jax.experimental.shard_map.shard_map(check_rep=...)`, and
+`jax.typeof`/`ShapeDtypeStruct(vma=...)` — all renamed between the JAX the
+code was written against and the JAX in the image, each one crashing at
+import or trace time after chip time was already scheduled. FastFold
+(arxiv 2203.00854) and ScaleFold (arxiv 2404.11068) both make the point
+that AlphaFold-scale iterations are too expensive to burn on avoidable
+crashes; API drift is the most avoidable of all.
+
+Contract, enforced statically by `alphafold2_tpu.analysis` (the `compat`
+pass): no module outside this file touches `jax.experimental.*` or any
+symbol in the drift table (analysis/drift.py). When JAX renames something,
+the resolution moves here, the drift table gains a row, and every call
+site keeps working on both sides of the rename.
+
+Import idiom:
+
+    from alphafold2_tpu import compat
+    from alphafold2_tpu.compat import pallas as pl, pallas_tpu as pltpu
+
+    compat.CompilerParams(dimension_semantics=...)
+    compat.shard_map(f, mesh=mesh, in_specs=..., out_specs=..., check_vma=False)
+    compat.out_struct(shape, dtype, q, k, v)   # vma-aware ShapeDtypeStruct
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+
+__all__ = [
+    "JAX_VERSION",
+    "CompilerParams",
+    "create_hybrid_device_mesh",
+    "out_struct",
+    "pallas",
+    "pallas_tpu",
+    "pcast",
+    "shard_map",
+    "typeof_vma",
+]
+
+
+def _version_tuple(v: str) -> tuple:
+    parts = []
+    for p in v.split(".")[:3]:
+        digits = "".join(ch for ch in p if ch.isdigit())
+        parts.append(int(digits) if digits else 0)
+    return tuple(parts)
+
+
+JAX_VERSION: tuple = _version_tuple(jax.__version__)
+
+# --- pallas ----------------------------------------------------------------
+# The pallas modules themselves live under jax.experimental on every JAX
+# this repo supports; re-exported so kernel files never spell the
+# experimental path (the compat linter forbids it outside this module).
+# Resolved LAZILY (PEP 562 module __getattr__): most consumers of this
+# module (parallel/mesh, sequence, pipeline, sp_trunk) only want
+# shard_map/pcast, and the eager Pallas import costs ~0.26 s on top of
+# jax's own import on every process start.
+#
+# `CompilerParams` (lazy too, it needs pallas_tpu): JAX >= 0.6 renamed
+# TPUCompilerParams -> CompilerParams (drift table row
+# `pltpu.CompilerParams`). Same kwargs (dimension_semantics, ...).
+
+
+def __getattr__(name: str):
+    if name == "pallas":
+        from jax.experimental import pallas
+
+        globals()["pallas"] = pallas
+        return pallas
+    if name == "pallas_tpu":
+        from jax.experimental.pallas import tpu as pallas_tpu
+
+        globals()["pallas_tpu"] = pallas_tpu
+        return pallas_tpu
+    if name == "CompilerParams":
+        ptpu = __getattr__("pallas_tpu")
+        cp = getattr(ptpu, "CompilerParams", None)
+        if cp is None:  # JAX <= 0.5 (e.g. 0.4.37): only the old spelling
+            cp = ptpu.TPUCompilerParams
+        globals()["CompilerParams"] = cp
+        return cp
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+# --- shard_map -------------------------------------------------------------
+# JAX >= 0.6: jax.shard_map(..., check_vma=...). Older: the experimental
+# module with the kwarg spelled check_rep. Semantics are the same knob
+# (disable the replication/varying-across-mesh-axes checker).
+_NEW_SHARD_MAP = hasattr(jax, "shard_map")
+if not _NEW_SHARD_MAP:
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma: Optional[bool] = None):
+    """`jax.shard_map` across JAX versions; `check_vma` maps to the era's
+    checker kwarg (`check_rep` before the rename). Usable directly or as a
+    decorator factory (``f=None``), matching both eras' calling styles."""
+    if f is None:
+        return functools.partial(
+            shard_map, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    kwargs: dict = {}
+    if check_vma is not None:
+        kwargs["check_vma" if _NEW_SHARD_MAP else "check_rep"] = check_vma
+    impl = jax.shard_map if _NEW_SHARD_MAP else _old_shard_map
+    return impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+
+# --- vma-aware ShapeDtypeStruct -------------------------------------------
+# JAX >= 0.7 tracks a `vma` (varying-across-mesh-axes) set on abstract
+# values and requires pallas_call out_shapes under shard_map to declare
+# theirs. Older JAX has neither jax.typeof nor the vma kwarg — there the
+# plain struct is exactly right.
+_HAS_VMA = hasattr(jax, "typeof") and "vma" in getattr(
+    getattr(jax.ShapeDtypeStruct.__init__, "__code__", None), "co_varnames", ()
+)
+
+
+def typeof_vma(x: Any) -> frozenset:
+    """The value's varying-across-mesh-axes set (empty set pre-vma JAX)."""
+    if _HAS_VMA:
+        return frozenset(jax.typeof(x).vma)
+    return frozenset()
+
+
+def out_struct(shape, dtype, *operands) -> jax.ShapeDtypeStruct:
+    """ShapeDtypeStruct whose `vma` is the union of the operands' — required
+    for pallas_call under shard_map with vma checking (e.g. ring-attention
+    hops) on new JAX; collapses to a plain struct on old JAX."""
+    if _HAS_VMA:
+        vma = frozenset().union(*(typeof_vma(o) for o in operands))
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def pcast(x, axis_names, *, to: str = "varying"):
+    """`jax.lax.pcast` (vma-era JAX): mark a value varying/invariant over
+    mesh axes so shard_map carry types line up after collectives. Pre-vma
+    JAX tracks no such set — the identity is the exact semantic there."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_names, to=to)
+    return x
+
+
+# --- device mesh helpers ---------------------------------------------------
+
+def create_hybrid_device_mesh(**kwargs):
+    """jax.experimental.mesh_utils.create_hybrid_device_mesh, resolved here
+    so parallel/mesh.py stays free of experimental imports."""
+    from jax.experimental import mesh_utils
+
+    return mesh_utils.create_hybrid_device_mesh(**kwargs)

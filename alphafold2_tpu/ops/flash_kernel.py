@@ -36,9 +36,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
+from alphafold2_tpu import compat
+from alphafold2_tpu.compat import pallas as pl, pallas_tpu as pltpu
 from alphafold2_tpu.ops.core import pallas_interpret as _interpret
 
 _NEG = float("-inf")
@@ -90,12 +90,10 @@ def _block_target(dh: int) -> int:
     return max(128, min(512, (4 << 20) // (24 * dh) // 128 * 128))
 
 
-def _out_struct(shape, dtype, *operands):
-    """ShapeDtypeStruct whose `vma` (varying-across-mesh-axes set) is the
-    union of the operands' — required for pallas_call under shard_map with
-    vma checking (e.g. the ring-attention hops)."""
-    vma = frozenset().union(*(jax.typeof(o).vma for o in operands))
-    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+# vma-aware ShapeDtypeStruct (union of the operands' varying-across-mesh-
+# axes sets) — required for pallas_call under shard_map with vma checking
+# (e.g. the ring-attention hops); plain struct on pre-vma JAX.
+_out_struct = compat.out_struct
 
 
 def _pad_args(q, k, v, bias, qb, kb):
@@ -115,14 +113,14 @@ def _pad_args(q, k, v, bias, qb, kb):
 
 # Backward kernels: first two grid dims parallel (their output windows are
 # private per (b, block) pair), streamed contraction dim sequential.
-_BWD_PARAMS = pltpu.CompilerParams(
+_BWD_PARAMS = compat.CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary")
 )
 # Forward: the lse output window (1, nqb, qb) is SHARED across qi, so qi
 # must not be split across megacore TPU cores (each core's private copy of
 # the whole window would clobber the other's rows on write-back) — qi runs
 # sequentially; the (batch*head) dim carries all the parallelism.
-_FWD_PARAMS = pltpu.CompilerParams(
+_FWD_PARAMS = compat.CompilerParams(
     dimension_semantics=("parallel", "arbitrary", "arbitrary")
 )
 

@@ -38,9 +38,9 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
+from alphafold2_tpu import compat
+from alphafold2_tpu.compat import pallas as pl, pallas_tpu as pltpu
 from alphafold2_tpu.ops.core import pallas_interpret as _interpret
 from alphafold2_tpu.ops.sparse import (
     SparseConfig,
@@ -56,13 +56,13 @@ _M0 = -1e30
 
 # Backward kernels: outputs are private per (row, block) pair — first two
 # grid dims parallel, streamed slot dim sequential.
-_BWD_PARAMS = pltpu.CompilerParams(
+_BWD_PARAMS = compat.CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary")
 )
 # Forward: the lse output window (1, B, bs) is SHARED across the
 # query-block dim, so it must not split across megacore cores (see
 # ops/flash_kernel.py _FWD_PARAMS).
-_FWD_PARAMS = pltpu.CompilerParams(
+_FWD_PARAMS = compat.CompilerParams(
     dimension_semantics=("parallel", "arbitrary", "arbitrary")
 )
 

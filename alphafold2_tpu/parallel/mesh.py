@@ -9,7 +9,10 @@ hand-written transport layer to build.
 
 Mesh axes used across the framework:
   * "data"  — batch data parallelism (gradient psum rides ICI);
-  * "model" — tensor parallelism over attention heads / FF inner dim.
+  * "model" — tensor parallelism over attention heads / FF inner dim;
+  * "seq"   — sequence/context parallelism (ring / Ulysses attention);
+  * "sp"    — the sequence-parallel trunk's row axis (tests' short name);
+  * "pipe"  — pipeline parallelism over trunk layers.
 """
 
 from __future__ import annotations
@@ -19,6 +22,16 @@ from typing import Mapping, Optional, Sequence
 import numpy as np
 import jax
 from jax.sharding import Mesh
+
+from alphafold2_tpu import compat
+
+# Canonical mesh-axis names. The static analyzer's sharding pass
+# (alphafold2_tpu/analysis/sharding_lint.py) checks every string-literal
+# axis appearing in a PartitionSpec under parallel/ against this registry —
+# a typo'd axis name ("dat", "sq") otherwise survives until a mesh lookup
+# KeyErrors mid-trace on real chips. Add the name HERE when introducing a
+# new mesh axis.
+KNOWN_AXES = frozenset({"data", "model", "seq", "sp", "pipe"})
 
 
 def make_mesh(
@@ -125,11 +138,9 @@ def hybrid_mesh(
         )
     selected = [d for g in groups for d in g]
 
-    from jax.experimental import mesh_utils
-
     # same-rank contract: per-slice shape padded with 1s on the DCN dims,
     # across-slice shape padded with 1s on the ICI dims
-    grid = mesh_utils.create_hybrid_device_mesh(
+    grid = compat.create_hybrid_device_mesh(
         mesh_shape=(1,) * len(dcn_sizes) + ici_sizes,
         dcn_mesh_shape=dcn_sizes + (1,) * len(ici_sizes),
         devices=selected,

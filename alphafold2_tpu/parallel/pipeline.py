@@ -76,6 +76,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from alphafold2_tpu import compat
 from alphafold2_tpu.models.config import Alphafold2Config
 from alphafold2_tpu.models.reversible import stack_layers
 from alphafold2_tpu.models.trunk import trunk_layer_apply
@@ -265,7 +266,7 @@ def pipeline_trunk_apply(
     out_specs = (act_spec, act_spec if has_msa else None)
 
     @functools.partial(
-        jax.shard_map,
+        compat.shard_map,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=out_specs,

@@ -34,6 +34,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from alphafold2_tpu import compat
 from alphafold2_tpu.ops.flash import (
     flash_attention as _flash_attention,
     kernel_dispatch as _kernel_dispatch,
@@ -73,7 +74,7 @@ def ring_attention(q, k, v, axis_name: str, mask=None, use_kernel="auto"):
     # mark constant-built carries as device-varying over the ring axis so
     # the fori_loop carry types match after the first ppermute
     def varying(x):
-        return jax.lax.pcast(x, (axis_name,), to="varying")
+        return compat.pcast(x, (axis_name,), to="varying")
 
     bias = (
         varying(jnp.zeros((b, nk_local), jnp.float32))

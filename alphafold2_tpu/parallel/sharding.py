@@ -43,15 +43,15 @@ def _tp_spec(names: tuple, leaf) -> P:
     parent = names[-2] if len(names) >= 2 else ""
     if leaf_name == "w":
         if parent in ("to_q", "to_kv", "proj_in"):
-            return P(None, "model")  # column parallel: shard output dim
+            return P(None, "model")  # af2lint: rank=2 — column parallel: shard output dim
         if parent in ("to_out", "proj_out"):
-            return P("model", None)  # row parallel: shard input dim
+            return P("model", None)  # af2lint: rank=2 — row parallel: shard input dim
     if leaf_name == "b" and parent in ("to_q", "to_kv", "proj_in"):
         return P("model")
     if parent == "compress":
         # conv kernel (k, in_per_group, out) / bias (out,): shard out
         if leaf_name == "w":
-            return P(None, None, "model")
+            return P(None, None, "model")  # af2lint: rank=3 — (k, in_per_group, out)
         if leaf_name == "b":
             return P("model")
     return P()

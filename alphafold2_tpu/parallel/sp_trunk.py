@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from alphafold2_tpu import compat
 from alphafold2_tpu.models.config import Alphafold2Config
 from alphafold2_tpu.ops.attention import attention_apply
 from alphafold2_tpu.ops.core import layer_norm, linear
@@ -451,7 +452,7 @@ def sp_trunk_apply(
     )
 
     @functools.partial(
-        jax.shard_map,
+        compat.shard_map,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=(spec_x, spec_m if m is not None else None),

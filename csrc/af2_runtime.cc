@@ -222,7 +222,24 @@ static inline float field_f(const char* line, int beg, int len) {
   const char* e = b + len;
   while (b < e && *b == ' ') ++b;
   float v = 0.0f;
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
   std::from_chars(b, e, v);
+#else
+  // GCC 10's libstdc++ ships integer from_chars only (float overloads
+  // landed in GCC 11). PDB float fields are plain fixed-point ("%8.3f",
+  // no exponent, no locale formatting), so a hand-rolled parse is exact
+  // enough and stays locale-independent.
+  bool neg = false;
+  if (b < e && (*b == '-' || *b == '+')) { neg = (*b == '-'); ++b; }
+  double acc = 0.0;
+  while (b < e && *b >= '0' && *b <= '9') { acc = acc * 10.0 + (*b - '0'); ++b; }
+  if (b < e && *b == '.') {
+    ++b;
+    double scale = 0.1;
+    while (b < e && *b >= '0' && *b <= '9') { acc += (*b - '0') * scale; scale *= 0.1; ++b; }
+  }
+  v = static_cast<float>(neg ? -acc : acc);
+#endif
   return v;
 }
 

@@ -8,12 +8,25 @@ reference code is used at runtime by alphafold2_tpu itself.
 
 from __future__ import annotations
 
+import os
 import sys
 import types
+
+REFERENCE_ROOT = "/root/reference"
+_REFERENCE_SRC = os.path.join(REFERENCE_ROOT, "alphafold2_pytorch", "alphafold2.py")
+
+
+def reference_available() -> bool:
+    return os.path.exists(_REFERENCE_SRC)
 
 
 def load_reference():
     """Import alphafold2_pytorch from /root/reference with stubbed externals.
+
+    When the reference checkout is absent (it is an environment fixture,
+    not part of this repo), the calling test — or, at collection time, the
+    whole calling module — SKIPS instead of erroring: parity against an
+    absent oracle is not a failure of this codebase.
 
     One in-memory patch is applied: `msa_shape = None` is pre-bound in
     Alphafold2.forward, because the unpatched reference crashes with
@@ -21,6 +34,13 @@ def load_reference():
     own train_pre.py path is broken at v0.0.28). The patch only un-breaks
     that path; everything else is byte-identical reference behavior.
     """
+    if not reference_available():
+        import pytest
+
+        pytest.skip(
+            f"reference implementation not present at {REFERENCE_ROOT}",
+            allow_module_level=True,
+        )
     if "se3_transformer_pytorch" not in sys.modules:
         stub = types.ModuleType("se3_transformer_pytorch")
         stub.SE3Transformer = object

@@ -1,0 +1,380 @@
+"""af2lint (alphafold2_tpu/analysis) tests: every pass must fire on its
+violation fixture and stay silent on the matching clean fixture — the
+analyzer is repo infrastructure, so it gets tier-1 coverage like any op.
+
+The repo-wide strict run (the CI gate) is also pinned here: the compat /
+trace / sharding passes must be clean on this very repo, and a
+deliberately re-introduced `pltpu.CompilerParams` direct access (the
+exact API-drift defect that had the seed suite red) must be caught.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from alphafold2_tpu.analysis import run_passes
+from alphafold2_tpu.analysis.__main__ import main as af2lint_main
+from alphafold2_tpu.analysis.compat_lint import run as compat_run
+from alphafold2_tpu.analysis.sharding_lint import run as sharding_run
+from alphafold2_tpu.analysis.trace_safety import run as trace_run
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def _codes(findings):
+    return sorted({f.code for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# compat pass
+# ---------------------------------------------------------------------------
+
+
+class TestCompatPass:
+    def test_reintroduced_compiler_params_is_caught(self, tmp_path):
+        """The seed's actual defect, re-introduced on purpose: direct
+        pltpu.CompilerParams access must be flagged under BOTH spellings."""
+        f = _write(
+            tmp_path,
+            "kernel.py",
+            """
+            from jax.experimental.pallas import tpu as pltpu
+
+            PARAMS = pltpu.CompilerParams(
+                dimension_semantics=("parallel",)
+            )
+            OLD = pltpu.TPUCompilerParams(
+                dimension_semantics=("parallel",)
+            )
+            """,
+        )
+        findings = compat_run(tmp_path, files=[f])
+        assert "COMPAT001" in _codes(findings)  # the experimental import
+        drift_lines = [x.line for x in findings if x.code == "COMPAT002"]
+        assert 4 in drift_lines and 7 in drift_lines
+
+    def test_experimental_attribute_access_flagged(self, tmp_path):
+        f = _write(
+            tmp_path,
+            "m.py",
+            """
+            import jax
+
+            mesh = jax.experimental.mesh_utils.create_device_mesh((2,))
+            """,
+        )
+        assert _codes(compat_run(tmp_path, files=[f])) == ["COMPAT001"]
+
+    def test_from_jax_import_shard_map_flagged(self, tmp_path):
+        """`from jax import shard_map` — the exact line that had
+        tests/test_sequence_parallel.py red at collection on old JAX."""
+        f = _write(tmp_path, "m.py", "from jax import shard_map\n")
+        assert "COMPAT002" in _codes(compat_run(tmp_path, files=[f]))
+
+    def test_drifted_keyword_flagged_and_compat_route_allowed(self, tmp_path):
+        f = _write(
+            tmp_path,
+            "m.py",
+            """
+            import functools
+            from somewhere import shard_map as sm
+            from alphafold2_tpu import compat
+            from alphafold2_tpu.compat import shard_map
+
+            bad = sm(lambda x: x, mesh=None, in_specs=(), out_specs=(),
+                     check_rep=False)
+            ok1 = shard_map(lambda x: x, mesh=None, in_specs=(),
+                            out_specs=(), check_vma=False)
+            ok2 = functools.partial(compat.shard_map, mesh=None, in_specs=(),
+                                    out_specs=(), check_vma=False)
+            """,
+        )
+        findings = compat_run(tmp_path, files=[f])
+        assert [x.code for x in findings] == ["COMPAT003"]
+        assert findings[0].line == 7
+
+    def test_suppression_comment(self, tmp_path):
+        f = _write(
+            tmp_path,
+            "m.py",
+            "import jax.experimental.pallas  # af2lint: disable=COMPAT001\n",
+        )
+        assert compat_run(tmp_path, files=[f]) == []
+
+    def test_clean_compat_usage_not_flagged(self, tmp_path):
+        f = _write(
+            tmp_path,
+            "m.py",
+            """
+            from alphafold2_tpu import compat
+            from alphafold2_tpu.compat import pallas as pl, pallas_tpu as pltpu
+
+            P = compat.CompilerParams(dimension_semantics=("parallel",))
+            S = compat.out_struct((2, 2), "float32")
+            """,
+        )
+        assert compat_run(tmp_path, files=[f]) == []
+
+
+# ---------------------------------------------------------------------------
+# trace-safety pass
+# ---------------------------------------------------------------------------
+
+
+class TestTracePass:
+    def test_all_four_codes_fire(self, tmp_path):
+        f = _write(
+            tmp_path,
+            "m.py",
+            """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                print("tracing")
+                y = np.asarray(x)
+                if x > 0:
+                    return float(x)
+                return helper(x)
+
+            def helper(z):
+                return z.tolist()
+            """,
+        )
+        codes = _codes(trace_run(tmp_path, files=[f]))
+        assert codes == ["TRACE001", "TRACE002", "TRACE003", "TRACE004"]
+
+    def test_reachability_through_local_calls(self, tmp_path):
+        """helper() is flagged ONLY because a jitted entry point reaches it."""
+        f = _write(
+            tmp_path,
+            "m.py",
+            """
+            import jax
+
+            def helper(z):
+                return z.tolist()
+
+            g = jax.jit(lambda x: helper(x))
+            """,
+        )
+        findings = trace_run(tmp_path, files=[f])
+        assert _codes(findings) == ["TRACE004"]
+
+    def test_unreached_code_not_flagged(self, tmp_path):
+        f = _write(
+            tmp_path,
+            "m.py",
+            """
+            def host_side(z):
+                print(z)
+                return float(z)
+            """,
+        )
+        assert trace_run(tmp_path, files=[f]) == []
+
+    def test_static_metadata_and_guards_not_flagged(self, tmp_path):
+        f = _write(
+            tmp_path,
+            "m.py",
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x, m):
+                if m is None:
+                    m = jnp.ones(x.shape[:1], bool)
+                if x.ndim != 2:
+                    raise ValueError(x.shape)
+                if len(x.shape) > 1 and x.shape[0] % 8 != 0:
+                    raise ValueError("pad first")
+                return jnp.where(m[:, None], x, 0.0)
+            """,
+        )
+        assert trace_run(tmp_path, files=[f]) == []
+
+    def test_suppression(self, tmp_path):
+        f = _write(
+            tmp_path,
+            "m.py",
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                print("deliberate")  # af2lint: disable=TRACE001
+                return x
+            """,
+        )
+        assert trace_run(tmp_path, files=[f]) == []
+
+
+# ---------------------------------------------------------------------------
+# sharding pass
+# ---------------------------------------------------------------------------
+
+
+class TestShardingPass:
+    AXES = {"data", "model", "seq"}
+
+    def test_unknown_axis(self, tmp_path):
+        f = _write(
+            tmp_path,
+            "m.py",
+            'from jax.sharding import PartitionSpec as P\nS = P(None, "dat")\n',
+        )
+        fs = sharding_run(tmp_path, files=[f], axes=self.AXES)
+        assert _codes(fs) == ["SHARD002"]
+
+    def test_duplicate_axis(self, tmp_path):
+        f = _write(
+            tmp_path,
+            "m.py",
+            'from jax.sharding import PartitionSpec as P\n'
+            'S = P("data", None, "data")\n',
+        )
+        assert _codes(sharding_run(tmp_path, files=[f], axes=self.AXES)) == [
+            "SHARD003"
+        ]
+
+    def test_rank_annotation_mismatch(self, tmp_path):
+        f = _write(
+            tmp_path,
+            "m.py",
+            'from jax.sharding import PartitionSpec as P\n'
+            'S = P(None, "data", None)  # af2lint: rank=2\n'
+            'OK = P(None, "data")  # af2lint: rank=4 — trailing dims replicate\n',
+        )
+        fs = sharding_run(tmp_path, files=[f], axes=self.AXES)
+        assert _codes(fs) == ["SHARD001"] and fs[0].line == 2
+
+    def test_shard_map_arity_mismatch(self, tmp_path):
+        f = _write(
+            tmp_path,
+            "m.py",
+            """
+            from alphafold2_tpu.compat import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            spec = P("data")
+            fn = shard_map(lambda q, k, v: q, mesh=None,
+                           in_specs=(spec, spec), out_specs=spec)
+            """,
+        )
+        fs = sharding_run(tmp_path, files=[f], axes=self.AXES)
+        assert _codes(fs) == ["SHARD004"]
+
+    def test_axes_registry_static_parse_fallback(self, tmp_path):
+        """The fallback for an unimportable parallel package: KNOWN_AXES is
+        read statically out of mesh.py (and agrees with the live registry
+        on the real repo)."""
+        from alphafold2_tpu.analysis.sharding_lint import _parse_axes_registry
+        from alphafold2_tpu.parallel.mesh import KNOWN_AXES
+
+        mesh_py = tmp_path / "mesh.py"
+        mesh_py.write_text('KNOWN_AXES = frozenset({"data", "xaxis"})\n')
+        assert _parse_axes_registry(mesh_py) == {"data", "xaxis"}
+        assert _parse_axes_registry(tmp_path / "missing.py") is None
+        real = os.path.join(
+            REPO_ROOT, "alphafold2_tpu", "parallel", "mesh.py"
+        )
+        assert _parse_axes_registry(real) == set(KNOWN_AXES)
+
+    def test_registry_unavailable_is_loud(self, tmp_path, monkeypatch):
+        import alphafold2_tpu.analysis.sharding_lint as sl
+
+        monkeypatch.setattr(sl, "_default_axes", lambda root: None)
+        f = _write(
+            tmp_path, "m.py",
+            'from jax.sharding import PartitionSpec as P\nS = P("typo")\n',
+        )
+        fs = sl.run(tmp_path, files=[f], axes=None)
+        assert "SHARD000" in _codes(fs)
+
+    def test_clean_specs(self, tmp_path):
+        f = _write(
+            tmp_path,
+            "m.py",
+            """
+            from jax.sharding import PartitionSpec as P
+
+            A = P(None, "seq", None, None)  # af2lint: rank=4
+            B = P(("data", "model"), None)
+            """,
+        )
+        assert sharding_run(tmp_path, files=[f], axes=self.AXES) == []
+
+
+# ---------------------------------------------------------------------------
+# the repo itself + CLI
+# ---------------------------------------------------------------------------
+
+
+class TestRepoIsClean:
+    def test_static_passes_clean_on_repo(self):
+        """The CI gate, pinned as a test: compat + trace + sharding must
+        hold on this very repo (smoke is covered separately — it traces
+        real programs and gets the slow marker)."""
+        findings = run_passes(
+            REPO_ROOT, select=("compat", "trace", "sharding")
+        )
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_cli_strict_exit_codes(self, tmp_path, capsys):
+        bad = _write(
+            tmp_path,
+            "bad.py",
+            "from jax.experimental import pallas\n",
+        )
+        assert af2lint_main(["--strict", "--select", "compat", bad]) == 1
+        # non-strict never gates
+        assert af2lint_main(["--select", "compat", bad]) == 0
+        ok = _write(tmp_path, "ok.py", "import jax\n")
+        assert af2lint_main(["--strict", "--select", "compat", ok]) == 0
+        capsys.readouterr()
+
+    def test_file_scoped_run_skips_smoke(self, tmp_path, capsys):
+        """`af2lint path/to/file.py` must not pay (or fail on) the
+        repo-wide eval_shape sweep; selecting smoke explicitly still runs
+        it."""
+        from alphafold2_tpu.analysis import run_passes
+
+        ok = _write(tmp_path, "ok.py", "import jax\n")
+        called = []
+        import alphafold2_tpu.analysis as an
+
+        orig = an.PASSES["smoke"]
+        an.PASSES["smoke"] = lambda *a, **k: called.append(1) or []
+        try:
+            run_passes(tmp_path, files=[ok])
+            assert called == []
+            run_passes(tmp_path, select=("smoke",), files=[ok])
+            assert called == [1]
+        finally:
+            an.PASSES["smoke"] = orig
+
+    @pytest.mark.slow
+    def test_abstract_smoke_clean_on_repo(self):
+        from alphafold2_tpu.analysis.abstract_smoke import run as smoke_run
+
+        findings = smoke_run()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_abstract_smoke_single_target_fast(self):
+        """One cheap eval_shape target inline in tier-1 so the smoke
+        harness itself (registry construction + thunk execution) cannot
+        rot unnoticed between slow-tier runs."""
+        from alphafold2_tpu.analysis.abstract_smoke import _targets
+
+        targets = _targets()
+        assert "ops.feed_forward" in targets
+        targets["ops.feed_forward"]()  # raises on breakage

@@ -88,13 +88,19 @@ def test_mds_reconstructs_known_structure():
     coords, history = mds(dist, iters=500, tol=1e-9, key=jax.random.PRNGKey(2))
     assert coords.shape == (1, 3, n)
     assert history.shape[0] == 500
-    X, Y = Kabsch(coords[0], jnp.transpose(truth[0]))
-    err = RMSD(X, Y)
-    assert float(err[0]) < 0.5
-    # try mirror too: MDS has reflection ambiguity
-    Xm, Ym = Kabsch(coords[0] * jnp.array([[1.0], [1.0], [-1.0]]), jnp.transpose(truth[0]))
-    err_m = RMSD(Xm, Ym)
-    assert min(float(err[0]), float(err_m[0])) < 0.1
+    # MDS is reflection-ambiguous: the embedding is unique only up to rigid
+    # motion PLUS mirror, and which chirality the Guttman iteration lands in
+    # depends on the random init (PRNGKey(2) happens to land in the mirror
+    # image — RMSD ~4.0 unflipped, ~3e-5 flipped; every key in 0..11
+    # reconstructs to ~3e-5 on its preferred image). Asserting a bound on
+    # the UNFLIPPED alignment alone was therefore unsound; the
+    # reconstruction claim is min over both images.
+    errs = []
+    for flip in (1.0, -1.0):
+        X, Y = Kabsch(coords[0] * jnp.array([[1.0], [1.0], [flip]]),
+                      jnp.transpose(truth[0]))
+        errs.append(float(RMSD(X, Y)[0]))
+    assert min(errs) < 0.1, errs
 
 
 def test_distogram_confidence_bounds_and_mask():

@@ -13,7 +13,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from alphafold2_tpu.compat import shard_map
 
 from alphafold2_tpu.parallel import make_mesh
 from alphafold2_tpu.parallel.sequence import (
