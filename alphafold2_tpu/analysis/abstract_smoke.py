@@ -159,6 +159,59 @@ def _targets() -> Dict[str, Callable[[], None]]:
         seq = abstract((1, 12), jnp.int32)
         jax.eval_shape(lambda p, s: alphafold2_apply(p, cfg, s), params, seq)
 
+    # --- serving -------------------------------------------------------------
+    @register("serving.pipeline")
+    def _serving_pipeline():
+        from alphafold2_tpu.models import (
+            Alphafold2Config,
+            alphafold2_init,
+        )
+        from alphafold2_tpu.serving.pipeline import predict_structure
+
+        cfg = Alphafold2Config(dim=32, depth=1, heads=4, dim_head=8,
+                               max_seq_len=64)
+        params = jax.eval_shape(lambda k: alphafold2_init(k, cfg), key)
+        jax.eval_shape(
+            lambda p, t, m: predict_structure(
+                p, cfg, t, mask=m, mds_iters=2, mds_init="classical"
+            ),
+            params, abstract((2, 12), jnp.int32), abstract((2, 12), jnp.bool_),
+        )
+
+    @register("serving.engine.bucketed_batch")
+    def _serving_bucketed():
+        # the exact shape family the engine AOT-compiles: a (max_batch,
+        # bucket) padded batch for every ladder rung, msa-free and with a
+        # fixed-row MSA stream (ServingConfig.msa_rows)
+        from alphafold2_tpu.models import (
+            Alphafold2Config,
+            alphafold2_init,
+        )
+        from alphafold2_tpu.serving.bucketing import BucketLadder
+        from alphafold2_tpu.serving.pipeline import predict_structure
+
+        cfg = Alphafold2Config(dim=32, depth=1, heads=4, dim_head=8,
+                               max_seq_len=32)
+        params = jax.eval_shape(lambda k: alphafold2_init(k, cfg), key)
+        ladder = BucketLadder((16, 32))
+        assert ladder.bucket_for(9) == 16
+        for bucket in ladder.buckets:
+            jax.eval_shape(
+                lambda p, t, m: predict_structure(
+                    p, cfg, t, mask=m, mds_iters=2, mds_init="classical"
+                ),
+                params, abstract((4, bucket), jnp.int32),
+                abstract((4, bucket), jnp.bool_),
+            )
+        jax.eval_shape(
+            lambda p, t, m, ms, mm: predict_structure(
+                p, cfg, t, mask=m, msa=ms, msa_mask=mm,
+                mds_iters=2, mds_init="classical"
+            ),
+            params, abstract((4, 16), jnp.int32), abstract((4, 16), jnp.bool_),
+            abstract((4, 4, 16), jnp.int32), abstract((4, 4, 16), jnp.bool_),
+        )
+
     # --- training presets ---------------------------------------------------
     def _preset_init(tier):
         def thunk():

@@ -75,9 +75,26 @@ ATOMS_PER_TOKEN = np.array(
 )
 
 
-def aa_to_tokens(seq: str) -> np.ndarray:
-    """Encode a one-letter amino-acid string into integer tokens."""
+def aa_to_tokens(seq: str, strict: bool = False) -> np.ndarray:
+    """Encode a one-letter amino-acid string into integer tokens.
+
+    By default unknown characters map to PAD_TOKEN_ID — the lenient
+    behavior alignment parsing relies on (gaps and a3m '-' become pad).
+    With ``strict=True`` any character outside the 20-residue vocabulary
+    raises ValueError instead: request-facing boundaries (predict.py,
+    serving.engine) must fail garbage input fast rather than silently
+    predicting a structure for padding.
+    """
     lookup = {aa: i for i, aa in enumerate(AA_ORDER)}
+    if strict:
+        bad = sorted({c for c in seq if c.upper() not in lookup})
+        if bad:
+            raise ValueError(
+                f"invalid residue code(s) {''.join(bad)!r} in sequence "
+                f"(valid one-letter codes: {AA_ORDER})"
+            )
+        if not seq:
+            raise ValueError("empty sequence")
     return np.array([lookup.get(c.upper(), PAD_TOKEN_ID) for c in seq], dtype=np.int32)
 
 

@@ -1,0 +1,61 @@
+"""Serving layer: request-level inference in front of the model stack.
+
+`predict.py` is a one-shot CLI — one process, one request, a fresh XLA
+trace per sequence length. This package is the production front end
+(docs/SERVING.md): a pure pipeline function (`pipeline.predict_structure`),
+a length-bucket ladder with an AOT-compiled-executable cache
+(`bucketing`), a dynamic micro-batching scheduler with bounded-queue
+backpressure (`engine.ServingEngine`), a result LRU (`cache`), and
+serving metrics with latency quantiles (`metrics`). `serve.py` at the
+repo root drives it over a many-record FASTA as a traffic-replay harness.
+"""
+
+from alphafold2_tpu.serving.bucketing import (
+    DEFAULT_BUCKETS,
+    BucketLadder,
+    pad_batch,
+)
+from alphafold2_tpu.serving.cache import ResultCache, request_key
+from alphafold2_tpu.serving.engine import (
+    PredictionResult,
+    ServingConfig,
+    ServingEngine,
+    ServingRequest,
+)
+from alphafold2_tpu.serving.errors import (
+    EngineClosedError,
+    InvalidSequenceError,
+    PredictionError,
+    QueueFullError,
+    RequestTimeoutError,
+    RequestTooLongError,
+    ServingError,
+)
+from alphafold2_tpu.serving.metrics import ServingMetrics
+
+# NOTE deliberately NOT re-exported here: serving.pipeline.predict_structure.
+# `alphafold2_tpu.training` already package-exports a predict_structure with
+# a different signature (E2EConfig -> refined 14-atom cloud); keeping the
+# serving one at its module path (`from alphafold2_tpu.serving.pipeline
+# import predict_structure`) avoids two same-named siblings whose mixup
+# would surface only as a shape error deep in the trunk.
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "BucketLadder",
+    "pad_batch",
+    "ResultCache",
+    "request_key",
+    "PredictionResult",
+    "ServingConfig",
+    "ServingEngine",
+    "ServingRequest",
+    "ServingMetrics",
+    "EngineClosedError",
+    "InvalidSequenceError",
+    "PredictionError",
+    "QueueFullError",
+    "RequestTimeoutError",
+    "RequestTooLongError",
+    "ServingError",
+]

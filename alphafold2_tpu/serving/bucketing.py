@@ -1,0 +1,116 @@
+"""Length-bucket ladder + shape padding for the compiled-executable cache.
+
+XLA specializes every executable to concrete input shapes, so a naive
+serving loop pays a full trace+compile for EVERY new sequence length — on
+real traffic that is a compile per request (HelixFold, arxiv 2207.05477,
+measures exactly this failure mode). The fix is a fixed ladder of padded
+lengths: a request of length L runs at the smallest bucket >= L, so an
+arbitrary stream of lengths compiles at most `len(buckets)` executables,
+ever. Padding is masked end to end (serving/pipeline.py): excluded from
+attention, zero-weighted AND zero-distanced in the MDS objective,
+zero-confidence in the output. One residual bucket sensitivity is
+geometric — Torgerson double-centering and the Guttman `/n` step see the
+padded matrix size — so a structure is a deterministic function of
+(sequence, bucket): identical across batches and replicas, but not
+bit-identical across DIFFERENT ladders (the engine's cache tag includes
+the ladder for exactly this reason).
+
+Batch rows are padded the same way: a partial batch is topped up by
+DUPLICATING the last real row rather than all-pad rows. Duplicate rows
+cost the same FLOPs, but keep every per-structure quantity finite — an
+all-pad row has an all-zero MDS weight matrix, which turns the per-row
+normalized stress into 0/0 NaNs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from alphafold2_tpu.constants import PAD_TOKEN_ID
+from alphafold2_tpu.serving.errors import RequestTooLongError
+
+# ladder for real traffic: fine-grained at the short end where most
+# sequences live, coarse past the median protein length
+DEFAULT_BUCKETS: Tuple[int, ...] = (64, 128, 256, 384, 512)
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLadder:
+    """Sorted, deduplicated ladder of padded sequence lengths."""
+
+    buckets: Tuple[int, ...] = DEFAULT_BUCKETS
+
+    def __post_init__(self):
+        cleaned = tuple(sorted({int(b) for b in self.buckets}))
+        if not cleaned:
+            raise ValueError("bucket ladder must have at least one bucket")
+        if cleaned[0] <= 0:
+            raise ValueError(f"buckets must be positive, got {cleaned}")
+        object.__setattr__(self, "buckets", cleaned)
+
+    def __len__(self) -> int:
+        return len(self.buckets)
+
+    @property
+    def max_len(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, length: int) -> int:
+        """Smallest bucket that fits `length`; raises RequestTooLongError
+        past the top of the ladder (an explicit rejection the client can
+        route to a bigger deployment, not a silent truncation)."""
+        if length <= 0:
+            raise ValueError(f"sequence length must be positive, got {length}")
+        for b in self.buckets:
+            if length <= b:
+                return b
+        raise RequestTooLongError(
+            f"sequence length {length} exceeds the largest bucket "
+            f"{self.max_len} (ladder: {self.buckets})"
+        )
+
+
+def pad_tokens(tokens: np.ndarray, bucket: int):
+    """(L,) int tokens -> ((bucket,) padded tokens, (bucket,) bool mask).
+    Padding depends only on the target length, not on ladder state."""
+    tokens = np.asarray(tokens, np.int32)
+    length = tokens.shape[0]
+    if length > bucket:
+        raise ValueError(f"length {length} does not fit bucket {bucket}")
+    out = np.full((bucket,), PAD_TOKEN_ID, np.int32)
+    out[:length] = tokens
+    mask = np.zeros((bucket,), bool)
+    mask[:length] = True
+    return out, mask
+
+
+def pad_batch(rows: Sequence[np.ndarray], bucket: int, max_batch: int):
+    """Assemble per-request token rows into one fixed-shape batch.
+
+    Args:
+      rows: 1..max_batch arrays of (L_i,) int tokens, each L_i <= bucket.
+      bucket: padded length.
+      max_batch: fixed batch dimension of the compiled executable.
+
+    Returns:
+      tokens: (max_batch, bucket) int32 — unused slots duplicate the last
+        real row (see module docstring for why not all-pad).
+      mask: (max_batch, bucket) bool — duplicate slots carry the
+        duplicated row's real mask so their compute stays finite; callers
+        slice results by `n_real` and never read duplicate slots.
+      n_real: number of real rows.
+    """
+    if not rows:
+        raise ValueError("pad_batch needs at least one row")
+    if len(rows) > max_batch:
+        raise ValueError(f"{len(rows)} rows exceed max_batch {max_batch}")
+    tokens = np.empty((max_batch, bucket), np.int32)
+    mask = np.empty((max_batch, bucket), bool)
+    for i, row in enumerate(rows):
+        tokens[i], mask[i] = pad_tokens(row, bucket)
+    for i in range(len(rows), max_batch):
+        tokens[i], mask[i] = tokens[len(rows) - 1], mask[len(rows) - 1]
+    return tokens, mask, len(rows)

@@ -1,0 +1,691 @@
+"""Request-level inference engine: AOT compile cache + micro-batching.
+
+The one-shot `predict.py` CLI re-traces XLA for every new sequence length
+and serves one request per process. This engine is the production front
+end the ROADMAP north star needs (ParaFold, arxiv 2111.06340: batch many
+predictions through one warm model; HelixFold, arxiv 2207.05477: fixed
+padded shapes + executable reuse):
+
+  * **Compiled-executable cache** — requests are padded onto a length
+    bucket ladder (`bucketing.BucketLadder`) and each bucket is
+    AOT-compiled ONCE via ``jax.jit(...).lower(...).compile()``; an
+    arbitrary stream of lengths pays at most ``len(buckets)`` compiles
+    (exposed as `compile_count` for tests and health checks).
+  * **Dynamic micro-batching scheduler** — a bounded queue feeds a worker
+    thread that assembles same-bucket batches: dispatch when a batch
+    fills (`max_batch`) or its oldest request has waited `max_wait_s`.
+    Queue-full is an explicit `QueueFullError` (never a silent block),
+    per-request deadlines expire scheduler-side, and shutdown either
+    drains or fails pending work.
+  * **Result LRU cache** — keyed by (sequence, MSA hash, config tag); a
+    hit completes at submit() without touching the queue or the model.
+  * **Metrics** — queue depth, batch occupancy, p50/p95/p99 latency,
+    cache hit rate, compile count (`serving/metrics.py`), surfaced as a
+    JSON snapshot via `stats()`.
+
+Thread model: clients call `submit()`/`result()` from any thread; all
+model dispatch happens on the single worker thread, so device traffic is
+serialized by construction. Failure isolation: a model-call exception
+fails only the requests of that batch — and a multi-request batch is
+retried one request at a time first, so a single poison request cannot
+take its batchmates down with it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from alphafold2_tpu.constants import aa_to_tokens
+from alphafold2_tpu.serving.bucketing import (
+    DEFAULT_BUCKETS,
+    BucketLadder,
+    pad_batch,
+)
+from alphafold2_tpu.serving.cache import ResultCache, request_key
+from alphafold2_tpu.serving.errors import (
+    EngineClosedError,
+    InvalidSequenceError,
+    PredictionError,
+    QueueFullError,
+    RequestTimeoutError,
+    ServingError,
+)
+from alphafold2_tpu.serving.metrics import ServingMetrics
+from alphafold2_tpu.serving.pipeline import predict_structure
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Scheduler/cache knobs (model hyperparameters live in
+    `Alphafold2Config`; see docs/SERVING.md for tuning guidance)."""
+
+    buckets: Tuple[int, ...] = DEFAULT_BUCKETS
+    max_batch: int = 4           # fixed batch dim of every executable
+    max_queue: int = 64          # bounded request queue (backpressure)
+    max_wait_s: float = 0.05     # batch-assembly deadline for partial batches
+    request_timeout_s: Optional[float] = 60.0  # default per-request deadline
+    cache_capacity: int = 256    # result LRU entries (0 disables)
+    msa_rows: int = 0            # >0: executables take a fixed-row MSA stream
+    mds_iters: int = 32
+    mds_init: str = "classical"
+    seed: int = 0
+    precompile: bool = False     # AOT-compile every bucket at startup
+    latency_window: int = 2048
+    params_tag: str = ""         # checkpoint fingerprint for cache keys
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.max_wait_s < 0:
+            raise ValueError(f"max_wait_s must be >= 0, got {self.max_wait_s}")
+        if self.mds_init == "random" and self.cache_capacity:
+            # random MDS inits draw from a per-dispatch key, so identical
+            # requests served in different batches yield different
+            # structures — a cached entry could not honor the cache's
+            # equal-key == identical-computation contract (serving/cache.py)
+            raise ValueError(
+                "mds_init='random' is not reproducible across dispatches "
+                "and cannot back the result cache; use mds_init="
+                "'classical' (deterministic) or cache_capacity=0"
+            )
+
+
+@dataclasses.dataclass
+class PredictionResult:
+    """One served structure (host numpy, sliced to the true length)."""
+
+    seq: str
+    coords: np.ndarray        # (L, 3) CA trace
+    confidence: np.ndarray    # (L,) in [0, 1]
+    stress: float             # final normalized MDS stress
+    bucket: int
+    from_cache: bool
+    latency_s: float
+
+
+class ServingRequest:
+    """Client handle: a future resolved by the scheduler worker."""
+
+    def __init__(self, seq: str, tokens: np.ndarray, msa, msa_mask,
+                 cache_key: str, bucket: int, deadline: Optional[float]):
+        self.seq = seq
+        self.tokens = tokens
+        self.msa = msa
+        self.msa_mask = msa_mask
+        self.cache_key = cache_key
+        self.bucket = bucket
+        self.deadline = deadline
+        self.submitted_at = time.monotonic()
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._result: Optional[PredictionResult] = None
+        self._exc: Optional[BaseException] = None
+
+    @property
+    def length(self) -> int:
+        return self.tokens.shape[0]
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) >= self.deadline
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def _finish(self, result=None, exc=None) -> bool:
+        """Resolve once; later resolutions (e.g. a drain racing a timeout)
+        are dropped. Returns True when this call resolved the request."""
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._result, self._exc = result, exc
+            self._event.set()
+            return True
+
+    def result(self, timeout: Optional[float] = None) -> PredictionResult:
+        """Block for the outcome. Raises the request's terminal
+        ServingError, or builtin TimeoutError if the CALLER's wait budget
+        expires first (the request itself may still complete later).
+
+        Every call returns freshly copied arrays: a request can be shared
+        (in-flight coalescing) and its resolved result can alias a cache
+        entry — one caller's in-place edit must never reach another caller
+        or a later cache hit."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request ({len(self.seq)} residues) not completed within "
+                f"{timeout}s wait"
+            )
+        if self._exc is not None:
+            raise self._exc
+        return dataclasses.replace(
+            self._result,
+            coords=self._result.coords.copy(),
+            confidence=self._result.confidence.copy(),
+        )
+
+
+_IDLE_POLL_S = 0.05  # worker wake cadence when nothing is staged
+
+
+class ServingEngine:
+    """Length-bucketed, micro-batching inference engine over
+    `serving.pipeline.predict_structure`.
+
+    Args:
+      params: trunk parameter pytree (placed on device once).
+      model_cfg: `Alphafold2Config`; `max_seq_len` must cover the ladder.
+      cfg: `ServingConfig`.
+      model_apply_fn: trunk-forward override threaded to the pipeline
+        (e.g. a sequence-parallel wrapper).
+      metrics_logger: optional `utils.MetricsLogger` receiving one record
+        per dispatched batch.
+    """
+
+    def __init__(self, params, model_cfg, cfg: ServingConfig = ServingConfig(),
+                 *, model_apply_fn=None, metrics_logger=None):
+        self._ladder = BucketLadder(cfg.buckets)
+        if self._ladder.max_len > model_cfg.max_seq_len:
+            raise ValueError(
+                f"largest bucket {self._ladder.max_len} exceeds the model's "
+                f"max_seq_len {model_cfg.max_seq_len}"
+            )
+        if cfg.msa_rows > model_cfg.max_num_msa:
+            raise ValueError(
+                f"msa_rows {cfg.msa_rows} exceeds the model's max_num_msa "
+                f"{model_cfg.max_num_msa}"
+            )
+        self.cfg = cfg
+        self.model_cfg = model_cfg
+        self._model_apply_fn = model_apply_fn
+        self._params = jax.device_put(params)
+        self._base_key = jax.random.PRNGKey(cfg.seed)
+        # the ladder is part of the numeric fingerprint: a sequence's
+        # structure is a deterministic function of (sequence, bucket), and
+        # bucket assignment follows the ladder (serving/bucketing.py)
+        self._config_tag = repr((
+            model_cfg, cfg.mds_iters, cfg.mds_init, cfg.seed, cfg.msa_rows,
+            cfg.params_tag, self._ladder.buckets,
+        ))
+
+        self._executables = {}
+        self._compile_lock = threading.Lock()
+        self._batch_counter = 0
+
+        self._queue: "queue.Queue[ServingRequest]" = queue.Queue(
+            maxsize=cfg.max_queue
+        )
+        self._cache = ResultCache(cfg.cache_capacity)
+        # in-flight coalescing map: cache_key -> pending request, so a
+        # thundering herd of identical queries shares ONE computation
+        self._inflight = {}
+        self._inflight_lock = threading.Lock()
+        self.metrics = ServingMetrics(
+            latency_window=cfg.latency_window, logger=metrics_logger
+        )
+
+        self._closed = False
+        self._drain_on_stop = True
+        self._stop = threading.Event()
+        # precompile BEFORE the worker thread exists: a failing compile
+        # must abort construction cleanly, not strand a started worker
+        # (and the device params it references) behind a raised __init__
+        if cfg.precompile:
+            for bucket in self._ladder.buckets:
+                self._executable_for(bucket)
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="serving-engine-worker", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------------ API
+
+    def submit(self, seq: str, *, msa=None, msa_mask=None,
+               timeout: Optional[float] = None) -> ServingRequest:
+        """Enqueue one sequence; returns immediately with a future.
+
+        Raises EngineClosedError / InvalidSequenceError /
+        RequestTooLongError / QueueFullError synchronously — a rejected
+        request never occupies queue capacity.
+        """
+        if self._closed:
+            raise EngineClosedError("engine is shut down")
+        seq = seq.strip().upper()
+        try:
+            tokens = aa_to_tokens(seq, strict=True)
+        except ValueError as e:
+            self.metrics.inc("rejected")
+            raise InvalidSequenceError(str(e)) from None
+        try:
+            bucket = self._ladder.bucket_for(len(seq))
+        except ServingError:
+            self.metrics.inc("rejected")
+            raise
+
+        msa_arr = None
+        if msa is None and msa_mask is not None:
+            # a mask without an alignment is meaningless — and if let
+            # through it would reach batch assembly shaped against a
+            # query-row MSA (or silently split cache keys on msa_rows=0)
+            self.metrics.inc("rejected")
+            raise ServingError("msa_mask given without msa")
+        if msa is not None:
+            if self.cfg.msa_rows == 0:
+                self.metrics.inc("rejected")
+                raise ServingError(
+                    "engine is configured sequence-only (msa_rows=0); "
+                    "rebuild with ServingConfig(msa_rows=N) to serve MSAs"
+                )
+            msa_arr = np.asarray(msa, np.int32)
+            if msa_arr.ndim != 2 or msa_arr.shape[1] != len(seq):
+                self.metrics.inc("rejected")
+                raise ServingError(
+                    f"msa must be (rows, {len(seq)}) tokens, got "
+                    f"{msa_arr.shape}"
+                )
+            if msa_arr.shape[0] > self.cfg.msa_rows:
+                # explicit rejection, not silent truncation (the same
+                # stance as RequestTooLongError): conditioning data must
+                # never be discarded without the client knowing
+                self.metrics.inc("rejected")
+                raise ServingError(
+                    f"msa has {msa_arr.shape[0]} rows; this engine serves "
+                    f"at most msa_rows={self.cfg.msa_rows} — subsample "
+                    f"client-side or deploy with a larger msa_rows"
+                )
+            if msa_mask is not None:
+                msa_mask = np.asarray(msa_mask, bool)
+                if msa_mask.shape != msa_arr.shape:
+                    self.metrics.inc("rejected")
+                    raise ServingError(
+                        f"msa_mask shape {msa_mask.shape} does not match "
+                        f"msa shape {msa_arr.shape}"
+                    )
+
+        key = request_key(seq, msa_arr, self._config_tag, msa_mask=msa_mask)
+
+        cached = self._cache.get(key)
+        if cached is not None:
+            # free path: never touches the queue, the scheduler, or the model
+            self.metrics.inc("submitted")
+            self.metrics.inc("cache_hits")
+            self.metrics.inc("completed")
+            self.metrics.latency.observe(0.0)
+            req = ServingRequest(seq, tokens, msa_arr, msa_mask, key, bucket,
+                                 deadline=None)
+            # array aliasing with the cache entry is fine here: result()
+            # copies on every read, so clients can never reach it
+            req._finish(result=dataclasses.replace(
+                cached, from_cache=True, latency_s=0.0,
+            ))
+            return req
+
+        ttl = self.cfg.request_timeout_s if timeout is None else timeout
+        deadline = (time.monotonic() + ttl) if ttl is not None else None
+        with self._inflight_lock:
+            existing = self._inflight.get(key)
+            if existing is not None and not existing.done():
+                # identical query already pending: share its future (the
+                # shared request keeps the FIRST submitter's deadline)
+                self.metrics.inc("coalesced")
+                return existing
+            req = ServingRequest(seq, tokens, msa_arr, msa_mask, key, bucket,
+                                 deadline)
+            # count submitted BEFORE the worker can possibly complete the
+            # request — counting after enqueue lets a stats() reader see
+            # completed > submitted (negative in_flight) transiently
+            self.metrics.inc("submitted")
+            try:
+                self._queue.put_nowait(req)
+            except queue.Full:
+                self.metrics.inc("submitted", -1)
+                self.metrics.inc("rejected")
+                raise QueueFullError(
+                    f"request queue at capacity ({self.cfg.max_queue}); "
+                    f"retry with backoff or raise ServingConfig.max_queue"
+                ) from None
+            self._inflight[key] = req
+        # close the TOCTOU window against shutdown(): if the closed flag
+        # flipped after the entry check, the worker (and shutdown's
+        # post-join drain) may already be past this request — resolve it
+        # ourselves; _finish is resolve-once, so losing the race to a
+        # draining worker is harmless
+        if self._closed and self._resolve(req, exc=EngineClosedError(
+                "engine shut down while the request was being submitted")):
+            self.metrics.inc("failed")
+            raise EngineClosedError("engine is shut down")
+        return req
+
+    def predict(self, seq: str, *, msa=None, msa_mask=None,
+                timeout: Optional[float] = None) -> PredictionResult:
+        """Synchronous convenience: submit + block for the result."""
+        return self.submit(seq, msa=msa, msa_mask=msa_mask,
+                           timeout=timeout).result()
+
+    @property
+    def compile_count(self) -> int:
+        return self.metrics.compile_count
+
+    def stats(self) -> dict:
+        """JSON-ready health/stats snapshot."""
+        snap = self.metrics.snapshot(self.cfg.max_batch)
+        snap["queue"] = {
+            "depth": self._queue.qsize(),
+            "capacity": self.cfg.max_queue,
+        }
+        snap["cache"] = self._cache.snapshot()
+        snap["buckets"] = list(self._ladder.buckets)
+        snap["max_batch"] = self.cfg.max_batch
+        snap["closed"] = self._closed
+        return snap
+
+    def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop accepting work and stop the worker.
+
+        drain=True: pending requests (queued + staged) are served first —
+        batch-assembly deadlines are waived, expiry still honored.
+        drain=False: pending requests fail with EngineClosedError.
+        Idempotent; safe to call from any thread except the worker.
+        """
+        self._closed = True
+        self._drain_on_stop = drain
+        self._stop.set()
+        self._worker.join(timeout)
+        # a submit() racing the close flag can strand a request in the
+        # queue after the worker exited; nothing will serve it — fail it.
+        # Only once the worker is actually DEAD: with a finite join
+        # timeout the worker may still be draining, and popping its queue
+        # here would fail requests drain=True promised to serve
+        if self._worker.is_alive():
+            return
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if self._resolve(req, exc=EngineClosedError(
+                    "engine shut down before request was served")):
+                self.metrics.inc("failed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=True)
+        return False
+
+    def _resolve(self, req: ServingRequest, *, result=None, exc=None) -> bool:
+        """Finish a request and drop it from the coalescing map."""
+        finished = req._finish(result=result, exc=exc)
+        if finished:
+            with self._inflight_lock:
+                if self._inflight.get(req.cache_key) is req:
+                    del self._inflight[req.cache_key]
+        return finished
+
+    # ------------------------------------------------- compile cache
+
+    def _executable_for(self, bucket: int):
+        """AOT-compiled executable for (bucket, engine config); compiled
+        at most once per bucket, under a lock (precompile + worker can
+        race)."""
+        with self._compile_lock:
+            exe = self._executables.get(bucket)
+            if exe is not None:
+                return exe
+            B, rows = self.cfg.max_batch, self.cfg.msa_rows
+            mcfg, iters, init = self.model_cfg, self.cfg.mds_iters, self.cfg.mds_init
+            apply_fn = self._model_apply_fn
+
+            def run(params, tokens, mask, key, msa=None, msa_mask=None):
+                out = predict_structure(
+                    params, mcfg, tokens, mask=mask, msa=msa,
+                    msa_mask=msa_mask, rng=key, mds_iters=iters,
+                    mds_init=init, model_apply_fn=apply_fn,
+                )
+                # the (B, Lb, Lb, buckets) logits stay on device: at
+                # bucket 512 they are ~150 MB per batch of host transfer
+                # nothing in the serving path reads
+                return {k: out[k] for k in ("coords", "confidence", "stress")}
+
+            s_tok = jax.ShapeDtypeStruct((B, bucket), np.int32)
+            s_mask = jax.ShapeDtypeStruct((B, bucket), np.bool_)
+            s_key = jax.ShapeDtypeStruct(
+                self._base_key.shape, self._base_key.dtype
+            )
+            t0 = time.perf_counter()
+            if rows:
+                s_msa = jax.ShapeDtypeStruct((B, rows, bucket), np.int32)
+                s_msam = jax.ShapeDtypeStruct((B, rows, bucket), np.bool_)
+                exe = (
+                    jax.jit(run)
+                    .lower(self._params, s_tok, s_mask, s_key, s_msa, s_msam)
+                    .compile()
+                )
+            else:
+                exe = (
+                    jax.jit(run)
+                    .lower(self._params, s_tok, s_mask, s_key)
+                    .compile()
+                )
+            self.metrics.record_compile(bucket, time.perf_counter() - t0)
+            self._executables[bucket] = exe
+            return exe
+
+    def _call_executable(self, bucket: int, tokens, mask, msa=None,
+                         msa_mask=None):
+        """One device call. Overridable seam: tests substitute failure
+        injection or fake outputs here without touching the scheduler."""
+        exe = self._executable_for(bucket)
+        self._batch_counter += 1
+        key = jax.random.fold_in(self._base_key, self._batch_counter)
+        if self.cfg.msa_rows:
+            return exe(self._params, tokens, mask, key, msa, msa_mask)
+        return exe(self._params, tokens, mask, key)
+
+    # ------------------------------------------------- scheduler worker
+
+    def _worker_loop(self):
+        staged = {}  # bucket -> list[ServingRequest], FIFO
+        try:
+            while True:
+                self._dispatch_ready(staged, force=False)
+                if self._stop.is_set():
+                    self._final_flush(staged)
+                    return
+                try:
+                    req = self._queue.get(timeout=self._poll_timeout(staged))
+                except queue.Empty:
+                    continue
+                self._stage(staged, req)
+                # opportunistically drain whatever arrived with it, so a
+                # burst becomes one batch instead of max_batch singleton
+                # batches
+                while True:
+                    try:
+                        self._stage(staged, self._queue.get_nowait())
+                    except queue.Empty:
+                        break
+        except BaseException as e:  # noqa: BLE001 — last-resort guard
+            # anything escaping the scheduler (host-side bookkeeping bugs,
+            # a metrics sink hitting a full disk, ...) must not strand
+            # pending requests behind a silently dead thread: fail
+            # everything loudly (traceback included) and refuse further
+            # traffic; no re-raise — the abort IS the report
+            self._abort_worker(staged, e)
+
+    def _abort_worker(self, staged, cause: BaseException):
+        import traceback
+
+        self._closed = True
+        traceback.print_exc()
+        err = PredictionError(
+            f"serving worker crashed: {type(cause).__name__}: {cause}; "
+            f"engine is closed"
+        )
+        err.__cause__ = cause
+        while True:
+            try:
+                self._stage(staged, self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for reqs in staged.values():
+            for req in reqs:
+                if self._resolve(req, exc=err):
+                    self.metrics.inc("failed")
+        staged.clear()
+
+    def _stage(self, staged, req: ServingRequest):
+        staged.setdefault(req.bucket, []).append(req)
+
+    def _poll_timeout(self, staged) -> float:
+        """Sleep until the nearest batch-assembly deadline, capped so stop
+        requests are noticed promptly."""
+        if not staged:
+            return _IDLE_POLL_S
+        now = time.monotonic()
+        nearest = min(
+            reqs[0].submitted_at + self.cfg.max_wait_s
+            for reqs in staged.values() if reqs
+        )
+        return min(_IDLE_POLL_S, max(1e-3, nearest - now))
+
+    def _dispatch_ready(self, staged, force: bool):
+        for bucket in list(staged):
+            reqs = staged[bucket]
+            while reqs and (
+                force
+                or len(reqs) >= self.cfg.max_batch
+                or time.monotonic() - reqs[0].submitted_at
+                >= self.cfg.max_wait_s
+            ):
+                batch = reqs[: self.cfg.max_batch]
+                del reqs[: self.cfg.max_batch]
+                self._run_batch(bucket, batch)
+            if not reqs:
+                staged.pop(bucket)
+
+    def _final_flush(self, staged):
+        """Stop path: drain the queue, then serve or fail everything."""
+        while True:
+            try:
+                self._stage(staged, self._queue.get_nowait())
+            except queue.Empty:
+                break
+        if self._drain_on_stop:
+            self._dispatch_ready(staged, force=True)
+        else:
+            for reqs in staged.values():
+                for req in reqs:
+                    if self._resolve(req, exc=EngineClosedError(
+                            "engine shut down before request was served")):
+                        self.metrics.inc("failed")
+            staged.clear()
+
+    def _run_batch(self, bucket: int, reqs, allow_split: bool = True):
+        now = time.monotonic()
+        live = []
+        for req in reqs:
+            if req.expired(now):
+                if self._resolve(req, exc=RequestTimeoutError(
+                        f"deadline passed after "
+                        f"{now - req.submitted_at:.3f}s in queue")):
+                    self.metrics.inc("timed_out")
+            else:
+                live.append(req)
+        if not live:
+            return
+
+        try:
+            # batch assembly sits INSIDE the guard: a request that breaks
+            # host-side padding must fail like one that breaks the model
+            # call — isolated to its batch, never escalated to the
+            # worker's last-resort abort
+            tokens, mask, n_real = pad_batch(
+                [r.tokens for r in live], bucket, self.cfg.max_batch
+            )
+            msa = msa_mask = None
+            if self.cfg.msa_rows:
+                msa, msa_mask = self._pad_msa_batch(live, bucket)
+            out = self._call_executable(bucket, tokens, mask, msa, msa_mask)
+            coords = np.asarray(out["coords"])
+            conf = np.asarray(out["confidence"])
+            stress = np.asarray(out["stress"])
+        except Exception as e:  # noqa: BLE001 — isolate, report, keep serving
+            if allow_split and len(live) > 1:
+                # a poison request must not take its batchmates down:
+                # retry one at a time so only the offender fails
+                for req in live:
+                    self._run_batch(bucket, [req], allow_split=False)
+                return
+            err = PredictionError(
+                f"prediction failed for bucket {bucket}: "
+                f"{type(e).__name__}: {e}"
+            )
+            err.__cause__ = e
+            for req in live:
+                if self._resolve(req, exc=err):
+                    self.metrics.inc("failed")
+            return
+
+        done_at = time.monotonic()
+        for i, req in enumerate(live):
+            L = req.length
+            # copies, not views: a view would both pin the whole
+            # (max_batch, bucket, 3) batch array in the cache and let a
+            # client's in-place edit corrupt later cache hits
+            result = PredictionResult(
+                seq=req.seq,
+                coords=coords[i, :L].copy(),
+                confidence=conf[i, :L].copy(),
+                stress=float(stress[i]),
+                bucket=bucket,
+                from_cache=False,
+                latency_s=done_at - req.submitted_at,
+            )
+            # the cached entry and the resolved result may share arrays:
+            # clients only ever see result() copies
+            self._cache.put(req.cache_key, result)
+            if self._resolve(req, result=result):
+                self.metrics.inc("completed")
+                self.metrics.latency.observe(result.latency_s)
+        self.metrics.observe_batch(
+            n_real, self.cfg.max_batch,
+            latency_s=done_at - live[0].submitted_at,
+        )
+
+    def _pad_msa_batch(self, live, bucket: int):
+        """(B, rows, bucket) MSA stream. A request without an MSA gets its
+        query as row 0 (an alignment always contains the query); unused
+        rows duplicate row 0 under a False mask — finite values that
+        masked attention zero-weights, never NaN-generating garbage."""
+        B, rows = self.cfg.max_batch, self.cfg.msa_rows
+        from alphafold2_tpu.constants import PAD_TOKEN_ID
+
+        msa = np.full((B, rows, bucket), PAD_TOKEN_ID, np.int32)
+        msam = np.zeros((B, rows, bucket), bool)
+        for i, req in enumerate(live):
+            L = req.length
+            src = req.msa if req.msa is not None else req.tokens[None]
+            src_mask = (
+                req.msa_mask if req.msa_mask is not None
+                else np.ones(src.shape, bool)
+            )
+            r = src.shape[0]
+            msa[i, :r, :L] = src
+            msam[i, :r, :L] = src_mask
+            for j in range(r, rows):
+                msa[i, j] = msa[i, 0]  # finite filler, masked out
+        for i in range(len(live), B):
+            msa[i], msam[i] = msa[len(live) - 1], msam[len(live) - 1]
+        return msa, msam

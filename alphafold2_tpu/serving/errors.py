@@ -1,0 +1,43 @@
+"""Serving-engine exception taxonomy.
+
+Every rejection the engine can hand a client is an explicit, typed error
+— the backpressure contract is "fail loudly, never block silently"
+(docs/SERVING.md). Kept in their own module so `bucketing`, `cache`, and
+`engine` can share them without import cycles.
+"""
+
+from __future__ import annotations
+
+
+class ServingError(Exception):
+    """Base class for all serving-engine errors."""
+
+
+class InvalidSequenceError(ServingError):
+    """Request sequence contains characters outside the residue vocabulary
+    (constants.aa_to_tokens strict mode) or is empty."""
+
+
+class RequestTooLongError(ServingError):
+    """Request sequence is longer than the largest configured bucket."""
+
+
+class QueueFullError(ServingError):
+    """The bounded request queue is at capacity. Backpressure is explicit:
+    the caller decides whether to retry, shed, or escalate — the engine
+    never blocks a submitter."""
+
+
+class RequestTimeoutError(ServingError):
+    """The request's deadline passed before it was dispatched to the
+    model (scheduler-side expiry)."""
+
+
+class PredictionError(ServingError):
+    """The model call for this request raised. The original exception is
+    chained as ``__cause__``; the engine itself keeps serving."""
+
+
+class EngineClosedError(ServingError):
+    """The engine is shut down (or shutting down without draining); the
+    request was not and will not be served."""

@@ -1,0 +1,105 @@
+"""Serving metrics: counters, batch occupancy, latency quantiles, compiles.
+
+Built on `utils.observability` — `LatencyHistogram` provides the
+sliding-window p50/p95/p99, and an optional `MetricsLogger` streams one
+record per dispatched batch to stdout/JSONL with the same cadence
+contract training uses. `snapshot()` returns a plain-JSON dict, which is
+the engine's health-check payload (`ServingEngine.stats()`).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional
+
+from alphafold2_tpu.utils.observability import LatencyHistogram, MetricsLogger
+
+# request-terminal counter names; everything submitted eventually lands in
+# exactly one of these (or stays in flight)
+_COUNTERS = (
+    "submitted",      # accepted by submit() (cache hits included)
+    "completed",      # result delivered (cache hits included)
+    "failed",         # PredictionError / EngineClosedError terminal
+    "timed_out",      # scheduler-side deadline expiry
+    "rejected",       # refused at submit(): queue full / too long / invalid
+    "cache_hits",     # completed without touching the queue or the model
+    "coalesced",      # submission attached to an identical in-flight request
+)
+
+
+class ServingMetrics:
+    """Thread-safe counters + histograms for one engine instance."""
+
+    def __init__(self, latency_window: int = 2048,
+                 logger: Optional[MetricsLogger] = None):
+        self._lock = threading.Lock()
+        self._counts = {name: 0 for name in _COUNTERS}
+        self.latency = LatencyHistogram(window=latency_window)
+        self._batches = 0
+        self._batch_requests = 0
+        self._recent_batch_sizes = collections.deque(maxlen=256)
+        self._compiles = {}  # bucket -> seconds spent compiling
+        self._logger = logger
+        self._t0 = time.monotonic()
+
+    def inc(self, name: str, n: int = 1):
+        with self._lock:
+            self._counts[name] += n
+
+    def observe_batch(self, n_real: int, max_batch: int, latency_s: float):
+        """One dispatched batch: n_real real requests of max_batch slots;
+        latency_s is the oldest member's submit->complete latency."""
+        with self._lock:
+            self._batches += 1
+            self._batch_requests += n_real
+            self._recent_batch_sizes.append(n_real)
+            step = self._batches
+        if self._logger is not None:
+            self._logger.log(step, {
+                "batch_requests": n_real,
+                "batch_occupancy": n_real / max_batch,
+                "batch_latency_s": latency_s,
+            })
+
+    def record_compile(self, bucket: int, seconds: float):
+        with self._lock:
+            self._compiles[bucket] = self._compiles.get(bucket, 0.0) + seconds
+
+    @property
+    def compile_count(self) -> int:
+        with self._lock:
+            return len(self._compiles)
+
+    def snapshot(self, max_batch: int) -> dict:
+        with self._lock:
+            counts = dict(self._counts)
+            batches = self._batches
+            batch_requests = self._batch_requests
+            recent = list(self._recent_batch_sizes)
+            compiles = dict(self._compiles)
+            uptime = time.monotonic() - self._t0
+        in_flight = (
+            counts["submitted"] - counts["completed"]
+            - counts["failed"] - counts["timed_out"]
+        )
+        return {
+            "uptime_s": uptime,
+            "requests": {**counts, "in_flight": in_flight},
+            "batches": {
+                "count": batches,
+                "mean_requests_per_batch": (
+                    batch_requests / batches if batches else 0.0
+                ),
+                "mean_occupancy": (
+                    batch_requests / (batches * max_batch) if batches else 0.0
+                ),
+                "recent_sizes": recent,
+            },
+            "compiles": {
+                "count": len(compiles),
+                "seconds_by_bucket": {str(k): v for k, v in compiles.items()},
+            },
+            "latency": self.latency.snapshot(),
+        }

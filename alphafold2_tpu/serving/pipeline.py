@@ -1,0 +1,126 @@
+"""The sequence→structure inference pipeline as one pure, jit-compilable
+function.
+
+This is the body of `predict.py`'s CA-trace path (trunk forward →
+distogram softmax → centering → stress-majorization MDS → entropy
+confidence) factored out of its 200-line `main()` so that
+
+  * `predict.py` stays a thin CLI client (checkpoint restore, file I/O,
+    argument plumbing — nothing numerical), and
+  * the serving engine (`serving/engine.py`) can AOT-compile exactly this
+    function once per length bucket and drive it with batched, padded
+    request streams.
+
+Everything here is traceable: no host I/O, no Python branching on traced
+values, static knobs (`cfg`, `mds_iters`, `mds_init`) passed as Python
+values closed over at jit time. Batch-capable end to end — `tokens` is
+(b, L) and every output carries the batch axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu.geometry import (
+    MDScaling,
+    center_distogram,
+    distogram_confidence,
+)
+from alphafold2_tpu.models import alphafold2_apply
+
+
+def predict_structure(
+    params,
+    cfg,
+    tokens,
+    *,
+    mask=None,
+    msa=None,
+    msa_mask=None,
+    embedds=None,
+    templates=None,
+    templates_mask=None,
+    rng=None,
+    mds_iters: int = 200,
+    mds_init: str = "classical",
+    model_apply_fn=None,
+):
+    """Tokens (+ optional MSA/embedds/templates) → CA trace + confidence.
+
+    Args:
+      params: trunk parameter pytree (`alphafold2_init`).
+      cfg: `Alphafold2Config` — static under jit.
+      tokens: (b, L) int residue tokens. Padded positions carry
+        PAD_TOKEN_ID and must be excluded via `mask`.
+      mask: (b, L) bool residue validity. Padded pairs are zero-weighted
+        in the MDS objective and masked residues score zero confidence,
+        so a sequence's structure does not depend on how far its bucket
+        over-pads it.
+      msa / msa_mask: (b, rows, L) int tokens / bool validity, or None.
+      embedds: (b, L, num_embedds) LM-embedding MSA substitute, or None.
+      templates / templates_mask: (b, T, L, L) template conditioning.
+      rng: PRNG key for the MDS random init (unused with
+        mds_init="classical"); model forward is deterministic (eval).
+      mds_iters / mds_init: static MDS knobs (see geometry/mds.py).
+      model_apply_fn: trunk-forward override with the `alphafold2_apply`
+        keyword signature — e.g. a sequence-parallel wrapper
+        (parallel/sp_trunk.py). Geometry always runs replicated.
+
+    Returns dict:
+      coords: (b, L, 3) CA trace.
+      confidence: (b, L) per-residue confidence in [0, 1]
+        (distogram-entropy pLDDT analog).
+      stress: (b,) final normalized MDS stress.
+      distogram_logits: (b, L, L, buckets) float32.
+    """
+    apply_fn = model_apply_fn if model_apply_fn is not None else alphafold2_apply
+    logits = apply_fn(
+        params, cfg, tokens, msa,
+        mask=mask, msa_mask=msa_mask, embedds=embedds,
+        templates=templates, templates_mask=templates_mask,
+    )  # (b, L, L, buckets)
+
+    # geometry runs in float32 regardless of the trunk compute dtype: the
+    # distogram -> MDS pipeline divides by pairwise distances and small
+    # weights, which overflows/NaNs in bfloat16 (same stance as
+    # training/e2e.py)
+    logits = logits.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    distances, weights = center_distogram(probs)
+    if mask is not None:
+        pair_mask = (mask[:, :, None] & mask[:, None, :]).astype(weights.dtype)
+        # zero BOTH channels for padded pairs: weights silence them in the
+        # Guttman iterations, but the classical (Torgerson) init
+        # double-centers the raw distance matrix with no weighting — junk
+        # model distances for pad pairs would shift the real residues'
+        # eigendecomposition start
+        weights = weights * pair_mask
+        distances = distances * pair_mask
+
+    coords, stresses = MDScaling(
+        distances,
+        weights=weights,
+        iters=mds_iters,
+        # disable the convergence freeze: its trigger averages improvement
+        # over the whole batch (geometry/mds.py), which would make one
+        # request's iteration count — and thus its coordinates — depend on
+        # its batchmates. Serving results must be batch-composition
+        # independent (the result cache asserts equal key == identical
+        # computation); Guttman steps past convergence are no-ops, so the
+        # only cost is finishing the fixed iteration budget.
+        tol=-jnp.inf,
+        # single-atom-per-residue trace has no phi signal to decide
+        # chirality from (same stance as predict.py's historical path)
+        fix_mirror=False,
+        key=rng,
+        init=mds_init,
+    )  # (b, 3, L), (iters, b)
+
+    conf = distogram_confidence(probs, mask=mask)  # (b, L)
+    return {
+        "coords": jnp.transpose(coords, (0, 2, 1)),  # (b, L, 3)
+        "confidence": conf,
+        "stress": stresses[-1],
+        "distogram_logits": logits,
+    }

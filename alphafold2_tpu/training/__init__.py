@@ -49,6 +49,7 @@ from alphafold2_tpu.training.checkpoint import (
     finish,
     open_or_init,
     restore_or_init,
+    restore_params_for_inference,
 )
 from alphafold2_tpu.training.resilience import (
     BadStepError,
@@ -67,6 +68,7 @@ __all__ = [
     "finish",
     "open_or_init",
     "restore_or_init",
+    "restore_params_for_inference",
     "E2EConfig",
     "e2e_loss_fn",
     "make_e2e_loss_fn",

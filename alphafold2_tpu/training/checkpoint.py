@@ -147,6 +147,43 @@ def open_or_init(
     return mgr, state, resumed
 
 
+def restore_params_for_inference(ckpt_dir: Optional[str], init_fn, *init_args,
+                                 cold_params_fn=None):
+    """Inference-entry idiom shared by predict.py and serve.py: restore the
+    latest checkpoint read-only (no manager kept open, nothing to flush),
+    fall back to fresh init with a warning.
+
+    `init_fn(*init_args)` is the TRAIN-state init matching the checkpoint
+    layout; on the restore path it is only eval_shape'd (restore_or_init),
+    so optimizer moments are never materialized. `cold_params_fn()` is the
+    params-only init for the no-checkpoint path — without it the cold
+    start would materialize (and immediately discard) the full opt state,
+    ~2x parameter memory under Adam.
+
+    Returns (params, step, resumed) — step is 0 when cold-started. Callers
+    use `f"{ckpt_dir}@step{step}"` as the result-cache fingerprint.
+    """
+    def cold_params():
+        if cold_params_fn is not None:
+            return cold_params_fn()
+        return init_fn(*init_args)["params"]
+
+    if ckpt_dir is None:
+        print("no --ckpt-dir: using randomly initialized params")
+        return cold_params(), 0, False
+    with CheckpointManager(ckpt_dir) as mgr:
+        # probe before delegating to restore_or_init: its cold branch
+        # materializes the full train state, which would defeat
+        # cold_params_fn on an empty/not-yet-written checkpoint dir
+        if mgr.latest_step() is None:
+            print(f"warning: no checkpoint in {ckpt_dir}; random params")
+            return cold_params(), 0, False
+        state, _ = restore_or_init(mgr, init_fn, *init_args)
+    step = int(np.asarray(jax.device_get(state["step"])))
+    print(f"restored step-{step} params from {ckpt_dir}")
+    return state["params"], step, True
+
+
 def finish(mgr: Optional["CheckpointManager"], state: Any):
     """Final flush at end of training: save the last step if the periodic
     cadence missed it, then drain and close."""

@@ -221,14 +221,20 @@ def make_e2e_loss_fn(model_apply_fn=None):
 e2e_loss_fn = make_e2e_loss_fn()
 
 
+def e2e_params_init(key, ecfg: E2EConfig):
+    """Joint (trunk, refiner) param pytree — the params-only init
+    inference entry points use (no optimizer moments allocated)."""
+    k1, k2 = jax.random.split(key)
+    return {
+        "model": alphafold2_init(k1, ecfg.model),
+        "refiner": refiner_init(k2, ecfg.refiner),
+    }
+
+
 def e2e_train_state_init(key, ecfg: E2EConfig, tcfg):
     """TrainState over the joint (trunk, refiner) param pytree."""
     from alphafold2_tpu.training.harness import make_optimizer
 
-    k1, k2 = jax.random.split(key)
-    params = {
-        "model": alphafold2_init(k1, ecfg.model),
-        "refiner": refiner_init(k2, ecfg.refiner),
-    }
+    params = e2e_params_init(key, ecfg)
     opt = make_optimizer(tcfg)
     return {"params": params, "opt_state": opt.init(params), "step": jnp.zeros((), jnp.int32)}

@@ -5,9 +5,15 @@ wiring at all (SURVEY.md §5).
 """
 
 from alphafold2_tpu.utils.observability import (
+    LatencyHistogram,
     MetricsLogger,
     profile_trace,
     structure_eval,
 )
 
-__all__ = ["MetricsLogger", "profile_trace", "structure_eval"]
+__all__ = [
+    "LatencyHistogram",
+    "MetricsLogger",
+    "profile_trace",
+    "structure_eval",
+]

@@ -18,8 +18,10 @@ anywhere (SURVEY.md §5). This module makes all three first-class:
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
+import threading
 import time
 from typing import Optional
 
@@ -66,8 +68,11 @@ class MetricsLogger:
         return vals
 
     def close(self):
+        # idempotent: context-manager exit followed by an explicit close()
+        # (or two owners sharing one logger) must not hit a closed file
         if self._file is not None:
             self._file.close()
+            self._file = None
 
     def __enter__(self):
         return self
@@ -75,6 +80,67 @@ class MetricsLogger:
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+class LatencyHistogram:
+    """Streaming latency percentiles over a sliding window.
+
+    The serving engine (serving/metrics.py) needs request-latency
+    quantiles that (a) track the RECENT traffic mix, not the lifetime mix
+    — a bucket-ladder warmup with two 30 s compiles must age out of p99
+    once steady-state batches flow — and (b) cost O(window) memory
+    regardless of how many requests pass through. A bounded deque of the
+    last `window` observations gives both; percentiles are computed by
+    nearest-rank over a sorted snapshot (window is small, sorting at
+    snapshot time beats maintaining an order statistic per observe()).
+
+    Thread-safe: `observe` is called from the scheduler worker thread
+    while `snapshot` is called from health-check/stats readers.
+    """
+
+    def __init__(self, window: int = 2048):
+        if window <= 0:
+            raise ValueError(f"window must be positive, got {window}")
+        self._values = collections.deque(maxlen=window)
+        self._lock = threading.Lock()
+        self._count = 0  # lifetime observations (window evicts, this doesn't)
+        self._max = 0.0
+
+    def observe(self, value: float):
+        v = float(value)
+        with self._lock:
+            self._values.append(v)
+            self._count += 1
+            if v > self._max:
+                self._max = v
+
+    @staticmethod
+    def _percentile(ordered, q: float) -> float:
+        # nearest-rank on a pre-sorted list; q in [0, 100]
+        if not ordered:
+            return 0.0
+        idx = min(len(ordered) - 1, int(round(q / 100.0 * (len(ordered) - 1))))
+        return ordered[idx]
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            ordered = sorted(self._values)
+        return self._percentile(ordered, q)
+
+    def snapshot(self) -> dict:
+        """Plain-float summary: count (lifetime), window stats, p50/p95/p99."""
+        with self._lock:
+            ordered = sorted(self._values)
+            count, vmax = self._count, self._max
+        return {
+            "count": count,
+            "window": len(ordered),
+            "mean": (sum(ordered) / len(ordered)) if ordered else 0.0,
+            "p50": self._percentile(ordered, 50.0),
+            "p95": self._percentile(ordered, 95.0),
+            "p99": self._percentile(ordered, 99.0),
+            "max": vmax,
+        }
 
 
 @contextlib.contextmanager

@@ -97,14 +97,18 @@ def main():
     import jax.numpy as jnp
 
     from alphafold2_tpu.constants import aa_to_tokens
-    from alphafold2_tpu.geometry import (MDScaling, center_distogram,
-                                         distogram_confidence)
     from alphafold2_tpu.geometry.pdb import coords_to_pdb
-    from alphafold2_tpu.models import Alphafold2Config, alphafold2_apply, alphafold2_init
+    from alphafold2_tpu.models import Alphafold2Config
     from alphafold2_tpu.training import TrainConfig, train_state_init
 
     seq_str = args.seq.strip().upper()
-    tokens = jnp.asarray(aa_to_tokens(seq_str))[None]  # (1, L)
+    # strict tokenization at the CLI boundary: unknown residue letters
+    # must fail fast, not silently predict a structure for padding
+    try:
+        tokens_np = aa_to_tokens(seq_str, strict=True)
+    except ValueError as e:
+        ap.error(str(e))
+    tokens = jnp.asarray(tokens_np)[None]  # (1, L)
     L = tokens.shape[1]
 
     msa_tokens = msa_mask = None
@@ -196,57 +200,54 @@ def main():
                            embedds, templates, templates_mask)
         return
 
-    if args.ckpt_dir is not None:
-        from alphafold2_tpu.training import CheckpointManager, restore_or_init
+    from alphafold2_tpu.models import alphafold2_init
+    from alphafold2_tpu.training import restore_params_for_inference
 
-        with CheckpointManager(args.ckpt_dir) as mgr:
-            state, resumed = restore_or_init(
-                mgr, train_state_init, jax.random.PRNGKey(0), cfg, TrainConfig()
-            )
-        if not resumed:
-            print(f"warning: no checkpoint in {args.ckpt_dir}; random params")
-        else:
-            print(f"restored step-{int(state['step'])} params from {args.ckpt_dir}")
-        params = state["params"]
-    else:
-        print("no --ckpt-dir: using randomly initialized params")
-        params = alphafold2_init(jax.random.PRNGKey(0), cfg)
+    params, _, _ = restore_params_for_inference(
+        args.ckpt_dir, train_state_init, jax.random.PRNGKey(0), cfg,
+        TrainConfig(),
+        cold_params_fn=lambda: alphafold2_init(jax.random.PRNGKey(0), cfg),
+    )
 
+    # the pipeline body lives in serving/pipeline.py — one pure function
+    # shared by this CLI and the batching serving engine (serve.py)
+    from alphafold2_tpu.serving.pipeline import predict_structure
+
+    model_apply_fn = None
     if args.sp_shards:
         # trunk sequence-parallel over the mesh; embeddings/head replicated
         from alphafold2_tpu.parallel import alphafold2_apply_sp, make_mesh
 
         mesh = make_mesh({"seq": args.sp_shards})
-        logits = jax.jit(
-            lambda p, t, m, mm, tp, tpm: alphafold2_apply_sp(
-                p, cfg, t, m, mesh, msa_mask=mm,
-                templates=tp, templates_mask=tpm)
-        )(params, tokens, msa_tokens, msa_mask, templates,
-          templates_mask)  # (1, L, L, 37)
-    else:
-        logits = jax.jit(
-            lambda p, t, m, mm, e, tp, tpm: alphafold2_apply(
-                p, cfg, t, m, msa_mask=mm, embedds=e,
-                templates=tp, templates_mask=tpm)
-        )(params, tokens, msa_tokens, msa_mask, embedds, templates,
-          templates_mask)  # (1, L, L, 37)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    distances, weights = center_distogram(probs)
 
-    coords, stresses = MDScaling(
-        distances,
-        weights=weights,
-        iters=args.mds_iters,
-        fix_mirror=False,  # single-atom-per-residue trace has no phi signal
-        key=jax.random.PRNGKey(args.seed),
-        init=args.mds_init,
-    )  # (1, 3, L)
-    trace = np.asarray(jnp.transpose(coords, (0, 2, 1))[0])  # (L, 3)
-    print(f"MDS final stress: {float(stresses[-1][0]):.4f}")
+        def model_apply_fn(p, c, s, m, *, mask=None, msa_mask=None,
+                           embedds=None, templates=None, templates_mask=None):
+            del embedds  # CLI already rejects --embedds-file with --sp-shards
+            return alphafold2_apply_sp(
+                p, c, s, m, mesh, mask=mask, msa_mask=msa_mask,
+                templates=templates, templates_mask=templates_mask,
+            )
+
+    def run(p, t, m, mm, e, tp, tpm):
+        out = predict_structure(
+            p, cfg, t, msa=m, msa_mask=mm, embedds=e,
+            templates=tp, templates_mask=tpm,
+            rng=jax.random.PRNGKey(args.seed),
+            mds_iters=args.mds_iters, mds_init=args.mds_init,
+            model_apply_fn=model_apply_fn,
+        )
+        # the (1, L, L, 37) distogram logits stay on device — nothing
+        # below reads them (same stance as serving/engine.py)
+        return {k: out[k] for k in ("coords", "confidence", "stress")}
+
+    out = jax.jit(run)(params, tokens, msa_tokens, msa_mask, embedds,
+                       templates, templates_mask)
+    trace = np.asarray(out["coords"][0])  # (L, 3)
+    print(f"MDS final stress: {float(out['stress'][0]):.4f}")
 
     # per-residue confidence from distogram entropy, written as B-factors
     # (x100, pLDDT-style; the reference exposes no confidence signal)
-    conf = np.asarray(distogram_confidence(probs))[0]
+    conf = np.asarray(out["confidence"])[0]
     print(f"mean confidence: {100 * conf.mean():.1f}/100")
 
     # NOTE: geometric relaxation (scripts/refinement.py) operates on full
@@ -277,30 +278,14 @@ def _predict_full_atom(args, cfg, tokens, seq_str, msa_tokens=None,
         mds_iters=args.mds_iters,
         mds_init=args.mds_init,
     )
-    if args.ckpt_dir is not None:
-        from alphafold2_tpu.training import open_or_init
+    from alphafold2_tpu.training import restore_params_for_inference
+    from alphafold2_tpu.training.e2e import e2e_params_init
 
-        mgr, state, resumed = open_or_init(
-            args.ckpt_dir, e2e_train_state_init, jax.random.PRNGKey(0), ecfg,
-            TrainConfig(),
-        )
-        if mgr is not None:
-            mgr.close()  # inference only reads; no saves to flush
-        print(
-            f"restored step-{int(state['step'])} params from {args.ckpt_dir}"
-            if resumed
-            else f"warning: no checkpoint in {args.ckpt_dir}; random params"
-        )
-        params = state["params"]
-    else:
-        from alphafold2_tpu.models import alphafold2_init, refiner_init
-
-        print("no --ckpt-dir: using randomly initialized params")
-        k1, k2 = jax.random.split(jax.random.PRNGKey(0))
-        params = {
-            "model": alphafold2_init(k1, cfg),
-            "refiner": refiner_init(k2, ecfg.refiner),
-        }
+    params, _, _ = restore_params_for_inference(
+        args.ckpt_dir, e2e_train_state_init, jax.random.PRNGKey(0), ecfg,
+        TrainConfig(),
+        cold_params_fn=lambda: e2e_params_init(jax.random.PRNGKey(0), ecfg),
+    )
 
     model_apply_fn = None
     if args.sp_shards:

@@ -8,7 +8,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from alphafold2_tpu.utils import MetricsLogger, profile_trace, structure_eval
+from alphafold2_tpu.utils import (LatencyHistogram, MetricsLogger,
+                                  profile_trace, structure_eval)
 
 
 def test_metrics_logger_jsonl(tmp_path):
@@ -20,6 +21,54 @@ def test_metrics_logger_jsonl(tmp_path):
     assert [l["step"] for l in lines] == [0, 1]
     assert lines[0]["loss"] == 2.5
     assert "steps_per_sec" in lines[1]
+
+
+def test_metrics_logger_close_is_idempotent(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    # context-manager exit + explicit close (the serving engine and the
+    # CLI can both own the logger's lifecycle) must not raise
+    with MetricsLogger(jsonl_path=path, print_every=1) as logger:
+        logger.log(0, {"loss": 1.0})
+    logger.close()
+    logger.close()
+    # the no-file variant closes cleanly too
+    bare = MetricsLogger()
+    bare.close()
+    bare.close()
+
+
+def test_latency_histogram_percentiles():
+    hist = LatencyHistogram(window=256)
+    for v in range(1, 101):
+        hist.observe(float(v))
+    assert 50.0 <= hist.percentile(50) <= 51.0
+    assert 95.0 <= hist.percentile(95) <= 96.0
+    assert 99.0 <= hist.percentile(99) <= 100.0
+    snap = hist.snapshot()
+    assert snap["count"] == 100 and snap["window"] == 100
+    assert snap["max"] == 100.0
+    assert abs(snap["mean"] - 50.5) < 1e-9
+    assert snap["p50"] == hist.percentile(50)
+
+
+def test_latency_histogram_sliding_window_evicts():
+    hist = LatencyHistogram(window=10)
+    for _ in range(50):
+        hist.observe(1000.0)  # warmup spike (e.g. a bucket compile)
+    for _ in range(10):
+        hist.observe(1.0)  # steady state fills the whole window
+    snap = hist.snapshot()
+    assert snap["count"] == 60  # lifetime count keeps everything
+    assert snap["p99"] == 1.0  # ...but quantiles track the recent window
+    assert snap["max"] == 1000.0  # lifetime max still visible
+
+
+def test_latency_histogram_empty():
+    hist = LatencyHistogram()
+    assert hist.percentile(99) == 0.0
+    snap = hist.snapshot()
+    assert snap == {"count": 0, "window": 0, "mean": 0.0, "p50": 0.0,
+                    "p95": 0.0, "p99": 0.0, "max": 0.0}
 
 
 def test_profile_trace_writes(tmp_path):

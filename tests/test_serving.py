@@ -1,0 +1,518 @@
+"""Serving-engine tests (tier-1, CPU): bucketed compile cache, dynamic
+micro-batching, backpressure, failure isolation, result cache, shutdown.
+
+Scheduler-behavior tests run against a `FakeModelEngine` that overrides
+the `_call_executable` seam (documented in engine.py) so they exercise
+queueing/batching/failure paths in milliseconds with zero XLA compiles;
+the compile-cache and end-to-end tests use the real tiny model.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from alphafold2_tpu.constants import AA_ORDER, PAD_TOKEN_ID, aa_to_tokens
+from alphafold2_tpu.models import Alphafold2Config, alphafold2_init
+from alphafold2_tpu.serving import (
+    BucketLadder,
+    EngineClosedError,
+    InvalidSequenceError,
+    PredictionError,
+    QueueFullError,
+    RequestTimeoutError,
+    RequestTooLongError,
+    ServingConfig,
+    ServingEngine,
+    ServingError,
+    pad_batch,
+)
+
+TINY = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8, max_seq_len=16)
+# vocabulary minus W: all-W sequences are the poison marker in failure tests
+AA = AA_ORDER.replace("W", "")
+W_TOKEN = AA_ORDER.index("W")
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return alphafold2_init(jax.random.PRNGKey(0), TINY)
+
+
+def seq_of(length, offset=0):
+    return "".join(AA[(offset + i) % len(AA)] for i in range(length))
+
+
+def serving_cfg(**overrides):
+    base = dict(buckets=(8, 16), max_batch=3, max_queue=8, max_wait_s=0.05,
+                request_timeout_s=30.0, mds_iters=4)
+    base.update(overrides)
+    return ServingConfig(**base)
+
+
+class FakeModelEngine(ServingEngine):
+    """Engine with the device call stubbed out at the documented seam.
+
+    `call_hook(bucket, tokens, mask)` runs before the fake output is
+    produced — tests use it to block the worker or inject failures.
+    Counts calls so cache tests can assert the model was not touched.
+    """
+
+    def __init__(self, *args, call_hook=None, **kwargs):
+        self.calls = 0
+        self.batch_rows = []  # mask-derived real-row signature per call
+        self._hook = call_hook
+        super().__init__(*args, **kwargs)
+
+    def _call_executable(self, bucket, tokens, mask, msa=None, msa_mask=None):
+        self.calls += 1
+        self.batch_rows.append(tokens.shape)
+        if self._hook is not None:
+            self._hook(bucket, tokens, mask)
+        B, Lb = tokens.shape
+        return {
+            "coords": np.zeros((B, Lb, 3), np.float32),
+            "confidence": np.full((B, Lb), 0.5, np.float32),
+            "stress": np.zeros((B,), np.float32),
+        }
+
+
+def fake_engine(**overrides):
+    hook = overrides.pop("call_hook", None)
+    # params are never touched when _call_executable is overridden
+    return FakeModelEngine({}, TINY, serving_cfg(**overrides),
+                           call_hook=hook)
+
+
+# --------------------------------------------------------------- bucketing
+
+
+def test_bucket_ladder_selection_and_rejection():
+    ladder = BucketLadder((128, 64, 64, 256))  # unsorted + dup input
+    assert ladder.buckets == (64, 128, 256)
+    assert ladder.bucket_for(1) == 64
+    assert ladder.bucket_for(64) == 64
+    assert ladder.bucket_for(65) == 128
+    assert ladder.bucket_for(256) == 256
+    with pytest.raises(RequestTooLongError):
+        ladder.bucket_for(257)
+    with pytest.raises(ValueError):
+        BucketLadder(())
+
+
+def test_pad_batch_duplicates_last_row():
+    rows = [aa_to_tokens("ACD"), aa_to_tokens("ACDEF")]
+    tokens, mask, n_real = pad_batch(rows, bucket=8, max_batch=4)
+    assert tokens.shape == (4, 8) and mask.shape == (4, 8)
+    assert n_real == 2
+    assert mask[0].sum() == 3 and mask[1].sum() == 5
+    assert (tokens[0, 3:] == PAD_TOKEN_ID).all()
+    # filler slots duplicate the last REAL row (finite compute, no all-pad
+    # rows feeding zero-weight MDS)
+    assert (tokens[2] == tokens[1]).all() and (mask[3] == mask[1]).all()
+
+
+# ------------------------------------------------- submit-time validation
+
+
+def test_submit_rejects_invalid_and_oversized():
+    eng = fake_engine()
+    try:
+        with pytest.raises(InvalidSequenceError):
+            eng.submit("ACXZ")  # X, Z outside the vocabulary
+        with pytest.raises(InvalidSequenceError):
+            eng.submit("")
+        with pytest.raises(RequestTooLongError):
+            eng.submit(seq_of(17))  # largest bucket is 16
+        with pytest.raises(ServingError):
+            eng.submit(seq_of(4), msa=np.zeros((2, 4), np.int32))  # msa_rows=0
+        with pytest.raises(ServingError):
+            eng.submit(seq_of(4), msa_mask=np.ones((2, 4), bool))  # mask, no msa
+        assert eng.stats()["requests"]["rejected"] == 5
+        assert eng.calls == 0
+    finally:
+        eng.shutdown()
+
+
+def test_random_mds_init_incompatible_with_cache():
+    with pytest.raises(ValueError, match="random"):
+        serving_cfg(mds_init="random", cache_capacity=8)
+    serving_cfg(mds_init="random", cache_capacity=0)  # explicit opt-out OK
+
+
+def test_results_do_not_alias_the_cache():
+    eng = fake_engine()
+    try:
+        seq = seq_of(6)
+        first = eng.predict(seq)
+        first.coords += 99.0  # client-side in-place edit
+        second = eng.predict(seq)
+        assert second.from_cache
+        assert second.coords.max() < 99.0  # cache entry stayed pristine
+        second.confidence[:] = -1.0
+        assert eng.predict(seq).confidence.min() >= 0.0
+    finally:
+        eng.shutdown()
+
+
+def test_strict_aa_to_tokens_modes():
+    # lenient (default): unknown chars silently map to PAD — alignment
+    # parsing depends on this
+    assert aa_to_tokens("AXA").tolist() == [0, PAD_TOKEN_ID, 0]
+    with pytest.raises(ValueError, match="X"):
+        aa_to_tokens("AXA", strict=True)
+
+
+# ------------------------------------------------------- batch assembly
+
+
+def test_burst_becomes_one_batch_and_max_batch_splits():
+    eng = fake_engine(max_wait_s=0.5)
+    try:
+        # worker sleeps up to max_wait for more work -> a burst of
+        # max_batch same-bucket requests must form ONE full batch
+        reqs = [eng.submit(seq_of(4, offset=i)) for i in range(3)]
+        for r in reqs:
+            r.result(timeout=10)
+        stats = eng.stats()
+        assert stats["batches"]["count"] == 1
+        assert stats["batches"]["recent_sizes"] == [3]
+
+        # 4 more distinct requests with max_batch=3 -> a full batch plus
+        # a max-wait-expired partial batch; never more than max_batch
+        reqs = [eng.submit(seq_of(5, offset=10 + i)) for i in range(4)]
+        for r in reqs:
+            r.result(timeout=10)
+        sizes = eng.stats()["batches"]["recent_sizes"]
+        assert sum(sizes) == 7
+        assert max(sizes) <= 3
+    finally:
+        eng.shutdown()
+
+
+def test_partial_batch_dispatches_after_max_wait():
+    eng = fake_engine(max_wait_s=0.05)
+    try:
+        res = eng.submit(seq_of(6)).result(timeout=10)
+        assert res.coords.shape == (6, 3)
+        stats = eng.stats()
+        assert stats["batches"]["recent_sizes"] == [1]
+        assert stats["batches"]["mean_occupancy"] < 1.0
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------------------- backpressure
+
+
+def test_queue_full_rejects_instead_of_blocking():
+    entered, release = threading.Event(), threading.Event()
+
+    def hook(bucket, tokens, mask):
+        entered.set()
+        release.wait(10)
+
+    eng = fake_engine(max_queue=2, max_batch=1, max_wait_s=0.0,
+                      call_hook=hook)
+    try:
+        first = eng.submit(seq_of(3))
+        assert entered.wait(5)  # worker is now wedged inside the model call
+        q1 = eng.submit(seq_of(4))
+        q2 = eng.submit(seq_of(5))
+        t0 = time.monotonic()
+        with pytest.raises(QueueFullError):
+            eng.submit(seq_of(6))
+        assert time.monotonic() - t0 < 1.0  # rejected, not blocked
+        assert eng.stats()["requests"]["rejected"] == 1
+        release.set()
+        for r in (first, q1, q2):
+            r.result(timeout=10)
+    finally:
+        release.set()
+        eng.shutdown()
+
+
+# ------------------------------------------------- failure isolation
+
+
+def test_poison_request_fails_alone_and_engine_keeps_serving():
+    def hook(bucket, tokens, mask):
+        # poison = any real row that is entirely tryptophan
+        for row, m in zip(tokens, mask):
+            if m.any() and (row[m] == W_TOKEN).all():
+                raise RuntimeError("poison row")
+
+    eng = fake_engine(max_wait_s=0.5, call_hook=hook)
+    try:
+        good1 = eng.submit(seq_of(4))
+        poison = eng.submit("WWWW")
+        good2 = eng.submit(seq_of(5, offset=3))
+        # batch of 3 fails -> engine retries each alone -> only the
+        # poison request surfaces the failure
+        assert good1.result(timeout=10).coords.shape == (4, 3)
+        assert good2.result(timeout=10).coords.shape == (5, 3)
+        with pytest.raises(PredictionError) as exc_info:
+            poison.result(timeout=10)
+        assert "poison row" in str(exc_info.value)
+        # the worker survived: a fresh request still completes
+        assert eng.submit(seq_of(7)).result(timeout=10).confidence.shape == (7,)
+        stats = eng.stats()
+        assert stats["requests"]["failed"] == 1
+        assert stats["requests"]["completed"] == 3
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------------- deadlines and timeouts
+
+
+def test_request_deadline_expires_scheduler_side():
+    entered, release = threading.Event(), threading.Event()
+
+    def hook(bucket, tokens, mask):
+        entered.set()
+        release.wait(10)
+
+    eng = fake_engine(max_batch=1, max_wait_s=0.0, call_hook=hook)
+    try:
+        blocker = eng.submit(seq_of(3))
+        assert entered.wait(5)
+        victim = eng.submit(seq_of(4), timeout=0.05)
+        # caller-side wait budget is independent of the request deadline
+        with pytest.raises(TimeoutError):
+            victim.result(timeout=0.01)
+        time.sleep(0.1)  # let the deadline lapse while the worker is wedged
+        release.set()
+        blocker.result(timeout=10)
+        with pytest.raises(RequestTimeoutError):
+            victim.result(timeout=10)
+        assert eng.stats()["requests"]["timed_out"] == 1
+    finally:
+        release.set()
+        eng.shutdown()
+
+
+# ------------------------------------------------- result cache + coalescing
+
+
+def test_cache_hit_returns_without_touching_the_model():
+    eng = fake_engine()
+    try:
+        seq = seq_of(6)
+        first = eng.predict(seq)
+        calls_after_first = eng.calls
+        second = eng.predict(seq)
+        assert eng.calls == calls_after_first  # no new model call
+        assert second.from_cache and not first.from_cache
+        np.testing.assert_array_equal(first.coords, second.coords)
+        snap = eng.stats()["cache"]
+        assert snap["hits"] == 1 and snap["hit_rate"] > 0
+        # distinct sequence still computes
+        eng.predict(seq_of(6, offset=2))
+        assert eng.calls == calls_after_first + 1
+    finally:
+        eng.shutdown()
+
+
+def test_identical_inflight_requests_coalesce():
+    entered, release = threading.Event(), threading.Event()
+
+    def hook(bucket, tokens, mask):
+        entered.set()
+        release.wait(10)
+
+    eng = fake_engine(max_batch=1, max_wait_s=0.0, call_hook=hook)
+    try:
+        blocker = eng.submit(seq_of(3))
+        assert entered.wait(5)
+        a = eng.submit(seq_of(4))
+        b = eng.submit(seq_of(4))  # identical, still queued -> same future
+        assert a is b
+        release.set()
+        blocker.result(timeout=10)
+        assert a.result(timeout=10).coords.shape == (4, 3)
+        assert eng.stats()["requests"]["coalesced"] == 1
+    finally:
+        release.set()
+        eng.shutdown()
+
+
+# ------------------------------------------------------------ shutdown
+
+
+def test_shutdown_drains_pending_requests():
+    eng = fake_engine(max_wait_s=5.0)  # long wait: only drain can flush
+    try:
+        reqs = [eng.submit(seq_of(4, offset=i)) for i in range(5)]
+        eng.shutdown(drain=True, timeout=30)
+        for i, r in enumerate(reqs):
+            assert r.result(timeout=1).coords.shape == (4, 3), i
+        with pytest.raises(EngineClosedError):
+            eng.submit(seq_of(3))
+    finally:
+        eng.shutdown()
+
+
+def test_worker_crash_fails_pending_and_closes_engine():
+    entered, release = threading.Event(), threading.Event()
+
+    def hook(bucket, tokens, mask):
+        entered.set()
+        release.wait(10)
+
+    eng = fake_engine(max_batch=1, max_wait_s=0.0, call_hook=hook)
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("metrics sink exploded")
+
+    # crash the scheduler OUTSIDE the guarded model call: the post-success
+    # bookkeeping path must not strand requests behind a dead thread
+    eng.metrics.observe_batch = boom
+    first = eng.submit(seq_of(4))
+    assert entered.wait(5)
+    stranded = eng.submit(seq_of(5))  # queued behind the crashing batch
+    release.set()
+    first.result(timeout=10)  # resolved before the crash propagates
+    with pytest.raises(PredictionError, match="worker crashed"):
+        stranded.result(timeout=10)
+    eng._worker.join(timeout=10)
+    assert not eng._worker.is_alive()
+    with pytest.raises(EngineClosedError):
+        eng.submit(seq_of(6))
+
+
+def test_shutdown_without_drain_fails_pending():
+    entered, release = threading.Event(), threading.Event()
+
+    def hook(bucket, tokens, mask):
+        entered.set()
+        release.wait(10)
+
+    eng = fake_engine(max_batch=1, max_wait_s=0.0, call_hook=hook)
+    blocker = eng.submit(seq_of(3))
+    assert entered.wait(5)
+    pending = [eng.submit(seq_of(4)), eng.submit(seq_of(5))]
+    threading.Timer(0.05, release.set).start()
+    eng.shutdown(drain=False, timeout=30)
+    blocker.result(timeout=1)  # in-flight batch still completed
+    for r in pending:
+        with pytest.raises(EngineClosedError):
+            r.result(timeout=1)
+
+
+# ------------------------------------------- real model: compile cache
+
+
+def test_mixed_length_stream_compiles_at_most_len_buckets(tiny_params):
+    eng = ServingEngine(
+        tiny_params, TINY,
+        serving_cfg(max_batch=2, max_queue=16, max_wait_s=0.02,
+                    request_timeout_s=300.0),
+    )
+    try:
+        lengths = [3, 5, 8, 9, 12, 16, 4, 10, 2, 15]
+        reqs = [eng.submit(seq_of(n, offset=i))
+                for i, n in enumerate(lengths)]
+        results = [r.result(timeout=300) for r in reqs]
+        # the tentpole guarantee: arbitrary lengths, bounded compiles
+        assert eng.compile_count <= 2
+        by_bucket = eng.stats()["compiles"]["seconds_by_bucket"]
+        assert set(by_bucket) <= {"8", "16"}
+        for n, res in zip(lengths, results):
+            assert res.coords.shape == (n, 3)
+            assert res.confidence.shape == (n,)
+            assert np.isfinite(res.coords).all()
+            assert np.isfinite(res.confidence).all()
+            assert 0.0 <= res.confidence.min() <= res.confidence.max() <= 1.0
+            assert res.bucket == (8 if n <= 8 else 16)
+        # cache round-trip against the warm engine: no third compile
+        again = eng.predict(seq_of(lengths[0], offset=0))
+        assert again.from_cache
+        assert eng.compile_count <= 2
+    finally:
+        eng.shutdown()
+
+
+def test_result_independent_of_batch_composition(tiny_params):
+    """The cache contract (equal key == identical computation) requires a
+    structure to depend only on (sequence, bucket) — never on which
+    batchmates it shipped with: the serving pipeline disables the
+    batch-global MDS convergence freeze and zero-fills pad-pair distances
+    to guarantee it."""
+    eng = ServingEngine(
+        tiny_params, TINY,
+        serving_cfg(buckets=(8,), max_batch=3, cache_capacity=0,
+                    max_wait_s=0.3, request_timeout_s=300.0),
+    )
+    try:
+        seq = seq_of(6)
+        solo = eng.predict(seq)  # filler slots duplicate the request itself
+        batched = [
+            eng.submit(seq),
+            eng.submit(seq_of(7, offset=3)),
+            eng.submit(seq_of(5, offset=8)),
+        ]
+        mixed = batched[0].result(timeout=300)
+        assert not mixed.from_cache
+        np.testing.assert_array_equal(solo.coords, mixed.coords)
+        np.testing.assert_array_equal(solo.confidence, mixed.confidence)
+        for r in batched[1:]:
+            r.result(timeout=300)
+    finally:
+        eng.shutdown()
+
+
+def test_msa_configured_engine_serves_with_and_without_msa(tiny_params):
+    eng = ServingEngine(
+        tiny_params, TINY,
+        serving_cfg(buckets=(8,), max_batch=2, msa_rows=4,
+                    request_timeout_s=300.0),
+    )
+    try:
+        seq = seq_of(6)
+        msa = np.stack([aa_to_tokens(seq), aa_to_tokens(seq_of(6, offset=1))])
+        with_msa = eng.submit(seq, msa=msa)
+        without = eng.submit(seq)  # same sequence, no MSA: distinct cache key
+        # same alignment under a different mask is a different computation
+        # — it must neither coalesce nor share a cache entry
+        masked = eng.submit(
+            seq, msa=msa,
+            msa_mask=np.stack([np.ones(6, bool), np.zeros(6, bool)]),
+        )
+        r1, r2 = with_msa.result(timeout=300), without.result(timeout=300)
+        r3 = masked.result(timeout=300)
+        assert with_msa is not without  # different keys must not coalesce
+        assert masked is not with_msa
+        assert not r3.from_cache
+        assert not np.allclose(r1.coords, r3.coords)
+        for r in (r1, r2):
+            assert r.coords.shape == (6, 3)
+            assert np.isfinite(r.coords).all()
+            assert np.isfinite(r.confidence).all()
+        assert eng.compile_count == 1  # one executable covers both forms
+        # conditioning on an alignment must actually reach the model
+        assert not np.allclose(r1.coords, r2.coords)
+        # over-row alignments are rejected, never silently truncated
+        with pytest.raises(ServingError, match="at most msa_rows"):
+            eng.submit(seq, msa=np.tile(aa_to_tokens(seq), (5, 1)))
+    finally:
+        eng.shutdown()
+
+
+def test_stats_snapshot_is_json_ready(tiny_params):
+    import json
+
+    eng = fake_engine()
+    try:
+        eng.predict(seq_of(5))
+        snap = eng.stats()
+        parsed = json.loads(json.dumps(snap))
+        for key in ("requests", "batches", "compiles", "latency", "queue",
+                    "cache", "buckets"):
+            assert key in parsed, key
+        assert parsed["latency"]["count"] == 1
+        assert parsed["queue"]["capacity"] == 8
+    finally:
+        eng.shutdown()
