@@ -11,9 +11,13 @@ instead of minutes into a paid TPU reservation.
 Each target is a named thunk; a target that raises becomes one SMOKE001
 finding carrying the exception head. Registered targets:
 
-  ops.*       flash / blockwise / dense / axial attention, feed-forward
-  model.*     alphafold2 init+apply at smoke shapes
-  presets.*   e2e train-state init for every tier; full e2e loss (fwd +
+  ops.*        flash / blockwise / dense / axial attention, feed-forward
+  model.*      alphafold2 init+apply at smoke shapes
+  serving.*    the serving pipeline + the engine's bucketed batch shapes
+  reliability.* fault-plan parse/roundtrip, circuit-breaker transitions,
+               verified-checkpoint save/restore (host-side construction
+               checks — same gate, no shapes involved)
+  presets.*    e2e train-state init for every tier; full e2e loss (fwd +
               structure module) at smoke shapes
 
 Add a target when adding a public op: append to `_targets()`.
@@ -21,6 +25,7 @@ Add a target when adding a public op: append to `_targets()`.
 
 from __future__ import annotations
 
+import json
 import traceback
 from typing import Callable, Dict, List
 
@@ -211,6 +216,54 @@ def _targets() -> Dict[str, Callable[[], None]]:
             params, abstract((4, 16), jnp.int32), abstract((4, 16), jnp.bool_),
             abstract((4, 4, 16), jnp.int32), abstract((4, 4, 16), jnp.bool_),
         )
+
+    # --- reliability --------------------------------------------------------
+    # host-side subsystems: no shapes to eval, but the same failure class —
+    # an import- or construction-time regression in the chaos layer must
+    # surface in the seconds-cheap gate, not first in a paid chaos run
+    @register("reliability.fault_plan")
+    def _fault_plan():
+        from alphafold2_tpu.reliability import FAULT_KINDS, FaultPlan
+
+        plan = FaultPlan.from_json(json.dumps({
+            "seed": 7,
+            "faults": [{"kind": k, "at": i} for i, k in enumerate(FAULT_KINDS)],
+        }))
+        assert FaultPlan.from_json(plan.to_json()) == plan
+        inj = plan.injector()
+        assert not inj.exhausted()
+        inj.checkpoint_hook(), inj.serving_hook()  # hook factories build
+
+    @register("reliability.breaker")
+    def _breaker():
+        from alphafold2_tpu.reliability import CircuitBreaker, CircuitState
+
+        t = [0.0]
+        b = CircuitBreaker(threshold=2, reset_s=5.0, clock=lambda: t[0])
+        assert b.allow()
+        b.record_failure(), b.record_failure()
+        assert b.state is CircuitState.OPEN and not b.allow()
+        t[0] = 6.0
+        assert b.allow() and not b.allow()  # one half-open probe
+        b.record_success()
+        assert b.state is CircuitState.CLOSED
+
+    @register("reliability.verified_checkpoint")
+    def _verified_ckpt():
+        import tempfile
+
+        import numpy as np
+
+        from alphafold2_tpu.training.checkpoint import VerifiedCheckpointManager
+
+        with tempfile.TemporaryDirectory() as d:
+            mgr = VerifiedCheckpointManager(d)
+            state = {"params": {"w": np.arange(4.0)},
+                     "step": np.asarray(1, np.int32)}
+            assert mgr.save(state, force=True)
+            assert mgr.latest_step() == 1
+            out = mgr.restore()
+            np.testing.assert_array_equal(out["params"]["w"], state["params"]["w"])
 
     # --- training presets ---------------------------------------------------
     def _preset_init(tier):

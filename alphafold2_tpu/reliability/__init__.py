@@ -1,0 +1,46 @@
+"""Reliability layer: deterministic fault injection + the hardening it exercises.
+
+Production-scale preemptible-TPU training (the FastFold/ScaleFold regime —
+multi-day runs where preemption and corruption are statistically certain)
+needs machine-verified recovery, not hand-written hope. This package makes
+failure a first-class, TESTABLE input across the whole stack:
+
+  * `faults` — `FaultPlan`/`FaultInjector`: a seeded, deterministic schedule
+    of faults (step-N exception, NaN-poisoned grads, checkpoint write
+    truncation/corruption, data-batch errors, slow/hung serving requests,
+    SIGTERM-style preemption) delivered through small hook points in
+    `training/harness.py`, `training/data.py`, `training/checkpoint.py`,
+    and `serving/engine.py`.
+  * `breaker` — `CircuitBreaker`: the serving engine's consecutive-failure
+    circuit (open -> fast-reject, half-open probe -> close).
+  * `preemption` — `PreemptionHandler`/`Preempted`: SIGTERM-aware clean
+    shutdown; `run_resilient` drains to a final checkpoint and a fresh run
+    resumes bit-exact from it.
+
+The chaos test matrix (`tests/test_chaos.py`, `-m chaos`) asserts the
+recovery invariant for every fault kind: the guarded run completes and
+matches the fault-free run's final state within declared tolerance (mostly
+bit-exact), and never hangs.
+"""
+
+from alphafold2_tpu.reliability.breaker import CircuitBreaker, CircuitState
+from alphafold2_tpu.reliability.faults import (
+    FAULT_KINDS,
+    Fault,
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+)
+from alphafold2_tpu.reliability.preemption import Preempted, PreemptionHandler
+
+__all__ = [
+    "FAULT_KINDS",
+    "Fault",
+    "FaultInjector",
+    "FaultPlan",
+    "InjectedFault",
+    "CircuitBreaker",
+    "CircuitState",
+    "Preempted",
+    "PreemptionHandler",
+]

@@ -1,0 +1,121 @@
+"""Consecutive-failure circuit breaker for the serving engine.
+
+When the model call starts failing every dispatch (wedged device, bad
+params push, poisoned executable cache), retry-per-request turns the
+engine into a failure amplifier: every queued request burns a device call
+to learn what the last one already proved. The breaker converts that into
+fast rejection:
+
+  closed     normal serving; `failures` consecutive dispatch failures trip
+             it (any success resets the count).
+  open       submit() fast-rejects with CircuitOpenError — no queue time,
+             no device call — until `reset_s` has elapsed.
+  half_open  exactly one probe dispatch is admitted; success closes the
+             circuit, failure re-opens it for another `reset_s`.
+
+The state machine is standalone and clock-injectable so tests drive it
+deterministically; the engine wires it via `ServingConfig.breaker_threshold`
+/ `breaker_reset_s` and reports dispatch outcomes from the worker thread.
+
+Thread model: `allow()` runs on submitter threads, `record_*` on the
+engine worker — every transition happens under one lock. A success
+recorded while open (a straggler dispatch from before the trip) closes
+the circuit: evidence the model works beats the timer.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+
+
+class CircuitState(str, enum.Enum):
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    def __init__(self, threshold: int, reset_s: float, clock=time.monotonic):
+        if threshold < 1:
+            raise ValueError(f"threshold must be >= 1, got {threshold}")
+        if reset_s < 0:
+            raise ValueError(f"reset_s must be >= 0, got {reset_s}")
+        self.threshold = threshold
+        self.reset_s = reset_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CircuitState.CLOSED
+        self._failures = 0          # consecutive failures while closed
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        self._trips = 0             # lifetime open transitions (stats)
+
+    @property
+    def state(self) -> CircuitState:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May a new request be admitted right now? Claims the half-open
+        probe slot when the reset window has elapsed."""
+        with self._lock:
+            if self._state is CircuitState.CLOSED:
+                return True
+            if (
+                self._state is CircuitState.OPEN
+                and self._clock() - self._opened_at >= self.reset_s
+            ):
+                self._state = CircuitState.HALF_OPEN
+                self._probe_in_flight = True
+                return True
+            # open inside the reset window, or half-open with the probe
+            # already out: shed
+            return False
+
+    def record_success(self):
+        with self._lock:
+            self._state = CircuitState.CLOSED
+            self._failures = 0
+            self._probe_in_flight = False
+
+    def record_failure(self):
+        with self._lock:
+            now = self._clock()
+            if self._state is CircuitState.HALF_OPEN:
+                # the probe failed: back to open for a fresh window
+                self._state = CircuitState.OPEN
+                self._opened_at = now
+                self._probe_in_flight = False
+                self._trips += 1
+            elif self._state is CircuitState.CLOSED:
+                self._failures += 1
+                if self._failures >= self.threshold:
+                    self._state = CircuitState.OPEN
+                    self._opened_at = now
+                    self._trips += 1
+            # already open: stragglers from pre-trip dispatches are no news
+
+    def abandon_probe(self):
+        """The admitted half-open probe never produced a dispatch outcome
+        (queue full, scheduler-side expiry): return to open WITHOUT
+        counting a failure or restarting the window, so the next submit
+        can claim a fresh probe immediately. No-op outside half-open."""
+        with self._lock:
+            if self._state is CircuitState.HALF_OPEN:
+                self._state = CircuitState.OPEN
+                self._probe_in_flight = False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            snap = {
+                "state": self._state.value,
+                "consecutive_failures": self._failures,
+                "threshold": self.threshold,
+                "reset_s": self.reset_s,
+                "trips": self._trips,
+            }
+            if self._state is not CircuitState.CLOSED:
+                snap["open_for_s"] = max(0.0, self._clock() - self._opened_at)
+            return snap

@@ -1,0 +1,268 @@
+"""Deterministic fault injection: a seeded schedule of failures.
+
+A `FaultPlan` is a declarative list of faults, loadable from JSON (the
+`--fault-plan` trainer flag), and a `FaultInjector` is its stateful
+executor: each hook site in the stack asks the injector whether a fault
+fires at the current index, and the injector delivers it (raise, poison,
+corrupt, sleep) at most `count` times. Determinism is the whole point —
+the same plan against the same seeds produces the same failure sequence,
+so the chaos suite can assert BIT-EXACT recovery instead of "it didn't
+crash".
+
+Hook sites (all optional, zero-cost when no injector is wired):
+
+  training/harness.py   `with_fault_injection(step_fn, injector)` — the
+                        host-side step wrapper; delivers `step_exception`,
+                        `nan_grads` (the step's reported loss/grad_norm
+                        come back NaN, so StepGuard must detect and roll
+                        back), and `preempt` (SIGTERM-style, via a bound
+                        PreemptionHandler).
+  training/data.py      `resilient_batches(..., injector=...)` — delivers
+                        `data_error` at fetch index N.
+  training/checkpoint.py  `VerifiedCheckpointManager(fault_hook=
+                        injector.checkpoint_hook())` — delivers
+                        `ckpt_corrupt` (truncate / bit-corrupt /
+                        manifest-missing) against the just-written step.
+  serving/engine.py     `ServingEngine(fault_hook=injector.serving_hook())`
+                        — delivers `request_error`, `slow_request`,
+                        `hung_request` at dispatch index N.
+
+Indices are per-site counters (train step number, batch fetch index,
+checkpoint step, serving dispatch index), so one plan can script a whole
+scenario: "data error at batch 2, corrupt the step-3 checkpoint, crash
+step 4, preempt at step 6".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+FAULT_KINDS = (
+    "step_exception",   # raise InjectedFault before train step `at`
+    "nan_grads",        # step `at` reports NaN loss/grad_norm (rollback bait)
+    "preempt",          # SIGTERM-style preemption request at step `at`
+    "ckpt_corrupt",     # damage the checkpoint written for step `at`
+    "data_error",       # raise InjectedFault at batch fetch index `at`
+    "request_error",    # raise InjectedFault at serving dispatch index `at`
+    "slow_request",     # sleep `delay_s` at serving dispatch index `at`
+    "hung_request",     # sleep `hang_s` (watchdog fodder) at dispatch `at`
+)
+
+_CKPT_MODES = ("truncate", "corrupt", "no_manifest")
+
+
+class InjectedFault(RuntimeError):
+    """The exception every raising fault kind delivers — chaos tests (and
+    recovery-path logs) can tell injected failures from organic ones."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One scheduled fault. Fires while `index >= at` and fewer than
+    `count` deliveries have happened — count=1 (the default) fires exactly
+    once at index `at`; a large count models an always-failing component."""
+
+    kind: str
+    at: int = 0
+    count: int = 1
+    mode: str = "truncate"      # ckpt_corrupt: truncate | corrupt | no_manifest
+    delay_s: float = 0.05       # slow_request sleep
+    hang_s: float = 30.0        # hung_request sleep (past any sane watchdog)
+    message: str = ""
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; expected one of {FAULT_KINDS}"
+            )
+        if self.kind == "ckpt_corrupt" and self.mode not in _CKPT_MODES:
+            raise ValueError(
+                f"ckpt_corrupt mode {self.mode!r} not in {_CKPT_MODES}"
+            )
+        if self.count < 1:
+            raise ValueError(f"count must be >= 1, got {self.count}")
+
+    def describe(self) -> str:
+        return self.message or f"injected {self.kind} at index {self.at}"
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Immutable fault schedule; `injector()` mints a fresh stateful
+    executor (one per run — delivery counters live on the injector, so a
+    plan can drive the faulted and fault-free arms of a comparison)."""
+
+    faults: tuple = ()
+    seed: int = 0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        faults = []
+        for f in d.get("faults", ()):
+            f = dict(f)
+            # "step"/"index" read more naturally in hand-written plans
+            for alias in ("step", "index"):
+                if alias in f:
+                    f["at"] = f.pop(alias)
+            faults.append(Fault(**f))
+        return cls(faults=tuple(faults), seed=int(d.get("seed", 0)))
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        return cls.from_dict(json.loads(text))
+
+    @classmethod
+    def from_file(cls, path: str) -> "FaultPlan":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "seed": self.seed,
+            "faults": [dataclasses.asdict(f) for f in self.faults],
+        }, indent=2)
+
+    def injector(self) -> "FaultInjector":
+        return FaultInjector(self)
+
+
+def poison_metrics(metrics: dict) -> dict:
+    """NaN the scalar health signals a step reports (loss, grad_norm).
+
+    This is what a NaN-poisoned gradient LOOKS LIKE to the supervisor — a
+    non-finite metric crossing the host boundary — and the detection path
+    (StepGuard's isfinite watchdog, rollback, retry) cannot tell where the
+    NaN originated, so poisoning at the boundary exercises the identical
+    recovery machinery for every task (the seq-only distogram task has no
+    float model input to poison upstream).
+    """
+    out = dict(metrics)
+    for key in ("loss", "grad_norm"):
+        if key in out:
+            out[key] = np.float32(np.nan)
+    return out
+
+
+class FaultInjector:
+    """Stateful executor of a FaultPlan. Thread-safe: the serving hook is
+    called from the engine worker thread while training hooks run on the
+    main thread."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._fired = [0] * len(plan.faults)
+        self._preemption = None  # bound PreemptionHandler for `preempt`
+        self.delivered: List[str] = []  # audit log of delivered faults
+
+    def bind_preemption(self, handler):
+        """Attach the PreemptionHandler that `preempt` faults trip (the
+        deterministic stand-in for the cluster's SIGTERM delivery)."""
+        self._preemption = handler
+        return self
+
+    def _take(self, kind: str, index: int) -> Optional[Fault]:
+        """Claim a matching fault (at most `count` deliveries), or None."""
+        with self._lock:
+            for i, f in enumerate(self.plan.faults):
+                if f.kind == kind and index >= f.at and self._fired[i] < f.count:
+                    self._fired[i] += 1
+                    self.delivered.append(f"{kind}@{index}")
+                    return f
+        return None
+
+    def exhausted(self) -> bool:
+        """True when every scheduled fault has delivered all its counts —
+        chaos tests assert this so a plan that never fired cannot pass."""
+        with self._lock:
+            return all(
+                fired >= f.count
+                for fired, f in zip(self._fired, self.plan.faults)
+            )
+
+    # -- hook: training step (training/harness.py) --------------------------
+
+    def before_train_step(self, step: int, batch):
+        """Called host-side before each train step; returns the batch or
+        raises (step_exception) / trips preemption."""
+        f = self._take("preempt", step)
+        if f is not None:
+            if self._preemption is None:
+                raise RuntimeError(
+                    "preempt fault scheduled but no PreemptionHandler bound "
+                    "(injector.bind_preemption)"
+                )
+            self._preemption.deliver()
+        f = self._take("step_exception", step)
+        if f is not None:
+            raise InjectedFault(f.describe())
+        return batch
+
+    def after_train_step(self, step: int, new_state, metrics):
+        """Called host-side on each step's result; a `nan_grads` fault
+        makes the step's reported metrics non-finite, which StepGuard must
+        catch and roll back (the retry refetches the same step and, with
+        the fault spent, reconverges bit-exact)."""
+        if self._take("nan_grads", step) is not None:
+            return new_state, poison_metrics(metrics)
+        return new_state, metrics
+
+    # -- hook: data pipeline (training/data.py) ------------------------------
+
+    def before_batch(self, index: int):
+        f = self._take("data_error", index)
+        if f is not None:
+            raise InjectedFault(f.describe())
+
+    # -- hook: checkpoint writes (training/checkpoint.py) --------------------
+
+    def checkpoint_hook(self):
+        """Returns the VerifiedCheckpointManager fault_hook: called with
+        (step, state_path, manifest_path) after a completed write, it
+        damages the files the way a crash mid-write would."""
+        import os
+
+        def hook(step: int, state_path: str, manifest_path: str):
+            f = self._take("ckpt_corrupt", step)
+            if f is None:
+                return
+            if f.mode == "no_manifest":
+                # crash between data write and manifest write
+                os.unlink(manifest_path)
+                return
+            size = os.path.getsize(state_path)
+            with open(state_path, "r+b") as fh:
+                if f.mode == "truncate":
+                    fh.truncate(max(1, size // 2))  # torn write
+                else:  # corrupt: flip bytes mid-file, size preserved
+                    fh.seek(size // 2)
+                    fh.write(b"\xde\xad\xbe\xef")
+
+        return hook
+
+    # -- hook: serving dispatch (serving/engine.py) --------------------------
+
+    def serving_hook(self):
+        """Returns the ServingEngine fault_hook: called with
+        (dispatch_index, bucket) at the top of every model dispatch."""
+        import time
+
+        def hook(index: int, bucket: int):
+            f = self._take("slow_request", index)
+            if f is not None:
+                time.sleep(f.delay_s)
+            f = self._take("hung_request", index)
+            if f is not None:
+                # a wedged device call: sleeps far past the watchdog, on
+                # the (abandonable) dispatch thread
+                time.sleep(f.hang_s)
+            f = self._take("request_error", index)
+            if f is not None:
+                raise InjectedFault(f.describe())
+
+        return hook

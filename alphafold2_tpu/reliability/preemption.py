@@ -1,0 +1,136 @@
+"""Preemption-safe shutdown: catch SIGTERM, checkpoint, exit clean.
+
+Preemptible TPU VMs get a SIGTERM and a short grace window before the
+plug is pulled. The kernel default (die mid-step, mid-checkpoint-write)
+loses up to a full checkpoint interval of work and can leave a torn
+write behind; the handler here converts the signal into a cooperative
+flag that `run_resilient` polls at its step boundary:
+
+    with PreemptionHandler() as preemption:
+        run_resilient(..., preemption=preemption)   # raises Preempted
+                                                    # after a final save
+
+On the flag, the loop force-saves the current state, drains the
+checkpoint manager, and raises `Preempted` — the process exits clean,
+and the NEXT run restores that exact state and continues bit-exact
+(asserted in tests/test_chaos.py).
+
+Signal-handler discipline: the handler itself only sets an Event and
+remembers the signum — no I/O, no locks, nothing async-signal-unsafe.
+All real work (checkpoint save, engine drain) happens on the polling
+thread. Install is main-thread-only (a CPython constraint on signal());
+`deliver()` is the in-process stand-in the fault injector uses, so chaos
+tests exercise the identical polling path without cross-thread signal
+timing, while one direct test covers real `signal.raise_signal` delivery.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Optional
+
+
+class Preempted(RuntimeError):
+    """Raised by the guarded loop after a preemption-triggered final save.
+
+    Carries `step` and `checkpointed` so entry scripts can print an
+    HONEST resume message and exit 0 — preemption is not a failure, but a
+    run with no checkpoint manager must not claim its progress was saved.
+    """
+
+    def __init__(self, step: int, message: str = "", checkpointed: bool = True):
+        self.step = step
+        self.checkpointed = checkpointed
+        if not message:
+            message = (
+                f"preempted: final checkpoint saved at step {step}; "
+                "rerun with the same --ckpt-dir to resume"
+                if checkpointed else
+                f"preempted at step {step} with NO checkpoint manager — "
+                "progress was not saved; rerun with --ckpt-dir to make "
+                "future preemptions resumable"
+            )
+        super().__init__(message)
+
+
+class PreemptionHandler:
+    """Latching SIGTERM flag with handler install/restore.
+
+    Usable uninstalled (the fault injector delivers via `deliver()`), as a
+    context manager, or via explicit install()/uninstall(). `callbacks`
+    added with `add_callback` run on the FIRST `check()` that observes the
+    flag — on the polling thread, never in the signal handler — e.g. a
+    serving engine's `shutdown(drain=True)`.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self.signals = tuple(signals)
+        self._event = threading.Event()
+        self._signum: Optional[int] = None
+        self._previous = {}
+        self._installed = False
+        self._callbacks = []
+        self._callbacks_fired = False
+        self._lock = threading.Lock()
+
+    # -- signal plumbing ----------------------------------------------------
+
+    def _handler(self, signum, frame):
+        # async-signal-safe: set a flag, remember who called, return
+        self._signum = signum
+        self._event.set()
+
+    def install(self) -> "PreemptionHandler":
+        if self._installed:
+            return self
+        for sig in self.signals:
+            self._previous[sig] = signal.signal(sig, self._handler)
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        if not self._installed:
+            return
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+        self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- the cooperative surface ---------------------------------------------
+
+    def deliver(self, signum: int = signal.SIGTERM):
+        """In-process delivery (what a SIGTERM does, minus the kernel):
+        the fault injector's `preempt` kind and unit tests call this."""
+        self._handler(signum, None)
+
+    @property
+    def preempted(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def signum(self) -> Optional[int]:
+        return self._signum
+
+    def add_callback(self, fn):
+        """Run `fn()` once, on the first check() after the flag trips."""
+        self._callbacks.append(fn)
+
+    def check(self) -> bool:
+        """Poll point for long-running loops: returns True once preempted,
+        firing any registered drain callbacks exactly once."""
+        if not self._event.is_set():
+            return False
+        with self._lock:
+            if not self._callbacks_fired:
+                self._callbacks_fired = True
+                for fn in self._callbacks:
+                    fn()
+        return True

@@ -23,7 +23,9 @@ from alphafold2_tpu.serving.engine import (
     ServingRequest,
 )
 from alphafold2_tpu.serving.errors import (
+    CircuitOpenError,
     EngineClosedError,
+    HungBatchError,
     InvalidSequenceError,
     PredictionError,
     QueueFullError,
@@ -51,7 +53,9 @@ __all__ = [
     "ServingEngine",
     "ServingRequest",
     "ServingMetrics",
+    "CircuitOpenError",
     "EngineClosedError",
+    "HungBatchError",
     "InvalidSequenceError",
     "PredictionError",
     "QueueFullError",

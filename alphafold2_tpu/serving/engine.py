@@ -29,6 +29,14 @@ serialized by construction. Failure isolation: a model-call exception
 fails only the requests of that batch — and a multi-request batch is
 retried one request at a time first, so a single poison request cannot
 take its batchmates down with it.
+
+Self-protection (reliability layer, both off by default): a
+consecutive-failure **circuit breaker** (`breaker_threshold` — open →
+`CircuitOpenError` fast-reject → half-open probe → close) and a
+**hung-batch watchdog** (`watchdog_timeout_s` — a wedged dispatch fails
+its batch instead of the worker). Every terminal error is counted under
+its stable code in `stats()["errors"]`; chaos tests inject faults via the
+`fault_hook` seam (docs/OPERATIONS.md "Failure model & runbook").
 """
 
 from __future__ import annotations
@@ -49,8 +57,11 @@ from alphafold2_tpu.serving.bucketing import (
     pad_batch,
 )
 from alphafold2_tpu.serving.cache import ResultCache, request_key
+from alphafold2_tpu.reliability.breaker import CircuitBreaker
 from alphafold2_tpu.serving.errors import (
+    CircuitOpenError,
     EngineClosedError,
+    HungBatchError,
     InvalidSequenceError,
     PredictionError,
     QueueFullError,
@@ -79,6 +90,13 @@ class ServingConfig:
     precompile: bool = False     # AOT-compile every bucket at startup
     latency_window: int = 2048
     params_tag: str = ""         # checkpoint fingerprint for cache keys
+    # self-protection (reliability layer; docs/OPERATIONS.md runbook):
+    breaker_threshold: int = 0   # consecutive dispatch failures that open
+    #                              the circuit (0 = breaker disabled)
+    breaker_reset_s: float = 30.0  # open -> half-open probe window
+    watchdog_timeout_s: Optional[float] = None  # hung-batch watchdog: a
+    #                              dispatch exceeding this fails its batch
+    #                              instead of wedging the worker (None = off)
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -87,6 +105,15 @@ class ServingConfig:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
         if self.max_wait_s < 0:
             raise ValueError(f"max_wait_s must be >= 0, got {self.max_wait_s}")
+        if self.breaker_threshold < 0:
+            raise ValueError(
+                f"breaker_threshold must be >= 0, got {self.breaker_threshold}"
+            )
+        if self.watchdog_timeout_s is not None and self.watchdog_timeout_s <= 0:
+            raise ValueError(
+                f"watchdog_timeout_s must be positive or None, got "
+                f"{self.watchdog_timeout_s}"
+            )
         if self.mds_init == "random" and self.cache_capacity:
             # random MDS inits draw from a per-dispatch key, so identical
             # requests served in different batches yield different
@@ -190,10 +217,15 @@ class ServingEngine:
         (e.g. a sequence-parallel wrapper).
       metrics_logger: optional `utils.MetricsLogger` receiving one record
         per dispatched batch.
+      fault_hook: chaos-injection seam (reliability.FaultInjector
+        .serving_hook()): called with (dispatch_index, bucket) at the top
+        of every model dispatch, INSIDE the watchdog and failure-isolation
+        guards — an injected fault travels the exact path an organic one
+        would. None (production) costs nothing.
     """
 
     def __init__(self, params, model_cfg, cfg: ServingConfig = ServingConfig(),
-                 *, model_apply_fn=None, metrics_logger=None):
+                 *, model_apply_fn=None, metrics_logger=None, fault_hook=None):
         self._ladder = BucketLadder(cfg.buckets)
         if self._ladder.max_len > model_cfg.max_seq_len:
             raise ValueError(
@@ -221,6 +253,12 @@ class ServingEngine:
         self._executables = {}
         self._compile_lock = threading.Lock()
         self._batch_counter = 0
+        self._fault_hook = fault_hook
+        self._dispatch_counter = 0  # worker-thread only (the chaos clock)
+        self._breaker = (
+            CircuitBreaker(cfg.breaker_threshold, cfg.breaker_reset_s)
+            if cfg.breaker_threshold else None
+        )
 
         self._queue: "queue.Queue[ServingRequest]" = queue.Queue(
             maxsize=cfg.max_queue
@@ -255,62 +293,55 @@ class ServingEngine:
         """Enqueue one sequence; returns immediately with a future.
 
         Raises EngineClosedError / InvalidSequenceError /
-        RequestTooLongError / QueueFullError synchronously — a rejected
-        request never occupies queue capacity.
+        RequestTooLongError / QueueFullError / CircuitOpenError
+        synchronously — a rejected request never occupies queue capacity.
         """
         if self._closed:
-            raise EngineClosedError("engine is shut down")
+            self._reject(EngineClosedError("engine is shut down"))
         seq = seq.strip().upper()
         try:
             tokens = aa_to_tokens(seq, strict=True)
         except ValueError as e:
-            self.metrics.inc("rejected")
-            raise InvalidSequenceError(str(e)) from None
+            self._reject(InvalidSequenceError(str(e)))
         try:
             bucket = self._ladder.bucket_for(len(seq))
-        except ServingError:
-            self.metrics.inc("rejected")
-            raise
+        except ServingError as e:
+            self._reject(e)
 
         msa_arr = None
         if msa is None and msa_mask is not None:
             # a mask without an alignment is meaningless — and if let
             # through it would reach batch assembly shaped against a
             # query-row MSA (or silently split cache keys on msa_rows=0)
-            self.metrics.inc("rejected")
-            raise ServingError("msa_mask given without msa")
+            self._reject(ServingError("msa_mask given without msa"))
         if msa is not None:
             if self.cfg.msa_rows == 0:
-                self.metrics.inc("rejected")
-                raise ServingError(
+                self._reject(ServingError(
                     "engine is configured sequence-only (msa_rows=0); "
                     "rebuild with ServingConfig(msa_rows=N) to serve MSAs"
-                )
+                ))
             msa_arr = np.asarray(msa, np.int32)
             if msa_arr.ndim != 2 or msa_arr.shape[1] != len(seq):
-                self.metrics.inc("rejected")
-                raise ServingError(
+                self._reject(ServingError(
                     f"msa must be (rows, {len(seq)}) tokens, got "
                     f"{msa_arr.shape}"
-                )
+                ))
             if msa_arr.shape[0] > self.cfg.msa_rows:
                 # explicit rejection, not silent truncation (the same
                 # stance as RequestTooLongError): conditioning data must
                 # never be discarded without the client knowing
-                self.metrics.inc("rejected")
-                raise ServingError(
+                self._reject(ServingError(
                     f"msa has {msa_arr.shape[0]} rows; this engine serves "
                     f"at most msa_rows={self.cfg.msa_rows} — subsample "
                     f"client-side or deploy with a larger msa_rows"
-                )
+                ))
             if msa_mask is not None:
                 msa_mask = np.asarray(msa_mask, bool)
                 if msa_mask.shape != msa_arr.shape:
-                    self.metrics.inc("rejected")
-                    raise ServingError(
+                    self._reject(ServingError(
                         f"msa_mask shape {msa_mask.shape} does not match "
                         f"msa shape {msa_arr.shape}"
-                    )
+                    ))
 
         key = request_key(seq, msa_arr, self._config_tag, msa_mask=msa_mask)
 
@@ -339,6 +370,18 @@ class ServingEngine:
                 # shared request keeps the FIRST submitter's deadline)
                 self.metrics.inc("coalesced")
                 return existing
+            if self._breaker is not None and not self._breaker.allow():
+                # fast rejection, not queue time: the breaker has seen
+                # enough consecutive dispatch failures that this request
+                # would almost certainly burn a device call to fail. Cache
+                # hits and coalesced attaches (above) stay free — they
+                # cost no new dispatch.
+                snap = self._breaker.snapshot()
+                self._reject(CircuitOpenError(
+                    f"circuit {snap['state']} after repeated dispatch "
+                    f"failures (threshold {snap['threshold']}); retry "
+                    f"after {self.cfg.breaker_reset_s}s"
+                ))
             req = ServingRequest(seq, tokens, msa_arr, msa_mask, key, bucket,
                                  deadline)
             # count submitted BEFORE the worker can possibly complete the
@@ -349,7 +392,12 @@ class ServingEngine:
                 self._queue.put_nowait(req)
             except queue.Full:
                 self.metrics.inc("submitted", -1)
+                if self._breaker is not None:
+                    # an admitted half-open probe that never enqueued must
+                    # not leave the breaker waiting on it forever
+                    self._breaker.abandon_probe()
                 self.metrics.inc("rejected")
+                self.metrics.inc_error("queue_full")
                 raise QueueFullError(
                     f"request queue at capacity ({self.cfg.max_queue}); "
                     f"retry with backoff or raise ServingConfig.max_queue"
@@ -363,8 +411,16 @@ class ServingEngine:
         if self._closed and self._resolve(req, exc=EngineClosedError(
                 "engine shut down while the request was being submitted")):
             self.metrics.inc("failed")
+            self.metrics.inc_error("engine_closed")
             raise EngineClosedError("engine is shut down")
         return req
+
+    def _reject(self, exc: ServingError):
+        """Count (terminal counter + stable per-code counter) and raise a
+        submit-time rejection."""
+        self.metrics.inc("rejected")
+        self.metrics.inc_error(exc)
+        raise exc from None
 
     def predict(self, seq: str, *, msa=None, msa_mask=None,
                 timeout: Optional[float] = None) -> PredictionResult:
@@ -387,6 +443,8 @@ class ServingEngine:
         snap["buckets"] = list(self._ladder.buckets)
         snap["max_batch"] = self.cfg.max_batch
         snap["closed"] = self._closed
+        if self._breaker is not None:
+            snap["breaker"] = self._breaker.snapshot()
         return snap
 
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
@@ -416,6 +474,7 @@ class ServingEngine:
             if self._resolve(req, exc=EngineClosedError(
                     "engine shut down before request was served")):
                 self.metrics.inc("failed")
+                self.metrics.inc_error("engine_closed")
 
     def __enter__(self):
         return self
@@ -493,6 +552,52 @@ class ServingEngine:
             return exe(self._params, tokens, mask, key, msa, msa_mask)
         return exe(self._params, tokens, mask, key)
 
+    def _dispatch(self, bucket: int, tokens, mask, msa=None, msa_mask=None):
+        """One guarded dispatch: the chaos fault hook plus the optional
+        hung-batch watchdog around `_call_executable`.
+
+        With a watchdog configured, the call runs on a throwaway daemon
+        thread; exceeding the timeout raises HungBatchError and ABANDONS
+        the call (Python threads cannot be killed) — the orphan thread's
+        late result is written into a container nobody reads, and the
+        worker keeps serving instead of wedging. Without a watchdog the
+        call runs inline (zero thread overhead, the production default
+        when the runtime already bounds execution time).
+        """
+        idx = self._dispatch_counter
+        self._dispatch_counter += 1
+
+        def call():
+            if self._fault_hook is not None:
+                self._fault_hook(idx, bucket)
+            return self._call_executable(bucket, tokens, mask, msa, msa_mask)
+
+        timeout = self.cfg.watchdog_timeout_s
+        if timeout is None:
+            return call()
+        box = {}
+        done = threading.Event()
+
+        def runner():
+            try:
+                box["out"] = call()
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                box["exc"] = e
+            finally:
+                done.set()
+
+        threading.Thread(
+            target=runner, daemon=True, name=f"serving-dispatch-{idx}"
+        ).start()
+        if not done.wait(timeout):
+            raise HungBatchError(
+                f"dispatch {idx} (bucket {bucket}) exceeded the {timeout}s "
+                f"hung-batch watchdog; call abandoned"
+            )
+        if "exc" in box:
+            raise box["exc"]
+        return box["out"]
+
     # ------------------------------------------------- scheduler worker
 
     def _worker_loop(self):
@@ -543,6 +648,7 @@ class ServingEngine:
             for req in reqs:
                 if self._resolve(req, exc=err):
                     self.metrics.inc("failed")
+                    self.metrics.inc_error(err)
         staged.clear()
 
     def _stage(self, staged, req: ServingRequest):
@@ -590,6 +696,7 @@ class ServingEngine:
                     if self._resolve(req, exc=EngineClosedError(
                             "engine shut down before request was served")):
                         self.metrics.inc("failed")
+                        self.metrics.inc_error("engine_closed")
             staged.clear()
 
     def _run_batch(self, bucket: int, reqs, allow_split: bool = True):
@@ -597,12 +704,19 @@ class ServingEngine:
         live = []
         for req in reqs:
             if req.expired(now):
-                if self._resolve(req, exc=RequestTimeoutError(
-                        f"deadline passed after "
-                        f"{now - req.submitted_at:.3f}s in queue")):
+                exc = RequestTimeoutError(
+                    f"deadline passed after "
+                    f"{now - req.submitted_at:.3f}s in queue")
+                if self._resolve(req, exc=exc):
                     self.metrics.inc("timed_out")
+                    self.metrics.inc_error(exc)
             else:
                 live.append(req)
+        # an expired request may have been the breaker's half-open
+        # probe; without a dispatch outcome the probe must be released
+        # or the circuit would wait on it forever
+        if len(live) < len(reqs) and self._breaker is not None:
+            self._breaker.abandon_probe()
         if not live:
             return
 
@@ -617,27 +731,40 @@ class ServingEngine:
             msa = msa_mask = None
             if self.cfg.msa_rows:
                 msa, msa_mask = self._pad_msa_batch(live, bucket)
-            out = self._call_executable(bucket, tokens, mask, msa, msa_mask)
+            out = self._dispatch(bucket, tokens, mask, msa, msa_mask)
             coords = np.asarray(out["coords"])
             conf = np.asarray(out["confidence"])
             stress = np.asarray(out["stress"])
         except Exception as e:  # noqa: BLE001 — isolate, report, keep serving
-            if allow_split and len(live) > 1:
+            hung = isinstance(e, HungBatchError)
+            if not hung and allow_split and len(live) > 1:
                 # a poison request must not take its batchmates down:
-                # retry one at a time so only the offender fails
+                # retry one at a time so only the offender fails. A HUNG
+                # batch is different — the device (not a request) is the
+                # suspect, and each per-request retry would burn another
+                # full watchdog window against a wedged call
                 for req in live:
                     self._run_batch(bucket, [req], allow_split=False)
                 return
-            err = PredictionError(
-                f"prediction failed for bucket {bucket}: "
-                f"{type(e).__name__}: {e}"
-            )
-            err.__cause__ = e
+            # terminal dispatch outcome: the breaker counts it
+            if self._breaker is not None:
+                self._breaker.record_failure()
+            if hung:
+                err = e
+            else:
+                err = PredictionError(
+                    f"prediction failed for bucket {bucket}: "
+                    f"{type(e).__name__}: {e}"
+                )
+                err.__cause__ = e
             for req in live:
                 if self._resolve(req, exc=err):
                     self.metrics.inc("failed")
+                    self.metrics.inc_error(err)
             return
 
+        if self._breaker is not None:
+            self._breaker.record_success()
         done_at = time.monotonic()
         for i, req in enumerate(live):
             L = req.length

@@ -4,6 +4,11 @@ Every rejection the engine can hand a client is an explicit, typed error
 — the backpressure contract is "fail loudly, never block silently"
 (docs/SERVING.md). Kept in their own module so `bucketing`, `cache`, and
 `engine` can share them without import cycles.
+
+Every error carries a STABLE `code` string (the wire/ops identifier:
+error-rate dashboards, client retry policies, and the engine's per-code
+counters in `stats()["errors"]` all key on it — renaming a code is a
+breaking API change) and serializes with `to_json()` for HTTP front ends.
 """
 
 from __future__ import annotations
@@ -12,14 +17,28 @@ from __future__ import annotations
 class ServingError(Exception):
     """Base class for all serving-engine errors."""
 
+    code = "serving_error"
+
+    def to_json(self) -> dict:
+        """Wire-format payload: stable code + human-readable message."""
+        return {
+            "code": self.code,
+            "error": type(self).__name__,
+            "message": str(self),
+        }
+
 
 class InvalidSequenceError(ServingError):
     """Request sequence contains characters outside the residue vocabulary
     (constants.aa_to_tokens strict mode) or is empty."""
 
+    code = "invalid_sequence"
+
 
 class RequestTooLongError(ServingError):
     """Request sequence is longer than the largest configured bucket."""
+
+    code = "request_too_long"
 
 
 class QueueFullError(ServingError):
@@ -27,17 +46,43 @@ class QueueFullError(ServingError):
     the caller decides whether to retry, shed, or escalate — the engine
     never blocks a submitter."""
 
+    code = "queue_full"
+
 
 class RequestTimeoutError(ServingError):
     """The request's deadline passed before it was dispatched to the
     model (scheduler-side expiry)."""
+
+    code = "request_timeout"
 
 
 class PredictionError(ServingError):
     """The model call for this request raised. The original exception is
     chained as ``__cause__``; the engine itself keeps serving."""
 
+    code = "prediction_failed"
+
 
 class EngineClosedError(ServingError):
     """The engine is shut down (or shutting down without draining); the
     request was not and will not be served."""
+
+    code = "engine_closed"
+
+
+class CircuitOpenError(ServingError):
+    """The circuit breaker is open: recent dispatches failed consecutively
+    past the threshold, so the engine fast-rejects instead of queueing
+    work it expects to fail. Retry after the breaker's reset window
+    (reliability.breaker; `stats()["breaker"]` shows the state)."""
+
+    code = "circuit_open"
+
+
+class HungBatchError(ServingError):
+    """The batch's model call exceeded the hung-batch watchdog timeout.
+    The dispatch was abandoned (its thread is orphaned, not killed — a
+    CPython constraint) and the batch's requests failed, so the worker
+    keeps serving instead of wedging."""
+
+    code = "hung_batch"

@@ -41,12 +41,22 @@ class ServingMetrics:
         self._batch_requests = 0
         self._recent_batch_sizes = collections.deque(maxlen=256)
         self._compiles = {}  # bucket -> seconds spent compiling
+        self._errors = {}    # stable error code -> count (serving/errors.py)
         self._logger = logger
         self._t0 = time.monotonic()
 
     def inc(self, name: str, n: int = 1):
         with self._lock:
             self._counts[name] += n
+
+    def inc_error(self, code_or_exc, n: int = 1):
+        """Count one error by its stable code. Accepts a code string or a
+        ServingError instance (its `code` attribute is used) — every
+        terminal failure and submit-time rejection lands here, keyed the
+        way ops dashboards and the circuit breaker see the world."""
+        code = getattr(code_or_exc, "code", code_or_exc)
+        with self._lock:
+            self._errors[code] = self._errors.get(code, 0) + n
 
     def observe_batch(self, n_real: int, max_batch: int, latency_s: float):
         """One dispatched batch: n_real real requests of max_batch slots;
@@ -79,6 +89,7 @@ class ServingMetrics:
             batch_requests = self._batch_requests
             recent = list(self._recent_batch_sizes)
             compiles = dict(self._compiles)
+            errors = dict(self._errors)
             uptime = time.monotonic() - self._t0
         in_flight = (
             counts["submitted"] - counts["completed"]
@@ -101,5 +112,6 @@ class ServingMetrics:
                 "count": len(compiles),
                 "seconds_by_bucket": {str(k): v for k, v in compiles.items()},
             },
+            "errors": errors,
             "latency": self.latency.snapshot(),
         }

@@ -19,13 +19,17 @@ from alphafold2_tpu.training.harness import (
     make_optimizer,
     make_train_step,
     train_state_init,
+    with_fault_injection,
 )
 from alphafold2_tpu.training.data import (
     DataConfig,
+    ResilientBatches,
     bucket_batches,
     bucketed_microbatches,
+    resilient_batches,
     stack_microbatches,
     synthetic_batches,
+    synthetic_microbatch_fn,
     synthetic_structure_batches,
     sidechainnet_batches,
     sidechainnet_structure_batches,
@@ -45,6 +49,7 @@ from alphafold2_tpu.training.segmented import (
 )
 from alphafold2_tpu.training.checkpoint import (
     CheckpointManager,
+    VerifiedCheckpointManager,
     abstract_like,
     finish,
     open_or_init,
@@ -54,6 +59,9 @@ from alphafold2_tpu.training.checkpoint import (
 from alphafold2_tpu.training.resilience import (
     BadStepError,
     StepGuard,
+    add_resilience_args,
+    chaos_from_args,
+    resilient_mode,
     run_resilient,
 )
 
@@ -62,8 +70,13 @@ __all__ = [
     "tcfg_from_args",
     "BadStepError",
     "StepGuard",
+    "add_resilience_args",
+    "chaos_from_args",
+    "resilient_mode",
     "run_resilient",
+    "with_fault_injection",
     "CheckpointManager",
+    "VerifiedCheckpointManager",
     "abstract_like",
     "finish",
     "open_or_init",
@@ -84,10 +97,13 @@ __all__ = [
     "make_train_step",
     "train_state_init",
     "DataConfig",
+    "ResilientBatches",
     "bucket_batches",
     "bucketed_microbatches",
+    "resilient_batches",
     "stack_microbatches",
     "synthetic_batches",
+    "synthetic_microbatch_fn",
     "sidechainnet_batches",
     "sidechainnet_structure_batches",
     "north_star_e2e_config",

@@ -20,8 +20,11 @@ Design notes:
 
 from __future__ import annotations
 
+import glob
+import hashlib
+import json
 import os
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
@@ -41,6 +44,7 @@ class CheckpointManager:
             raise ImportError(f"orbax.checkpoint unavailable: {_import_error}")
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
+        self._closed = False
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -86,9 +90,347 @@ class CheckpointManager:
         """Block until queued async saves hit disk."""
         self._mgr.wait_until_finished()
 
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
     def close(self):
+        # idempotent: context-manager exit + an explicit finish()/close()
+        # (two owners sharing one manager) must not double-close orbax
+        if self._closed:
+            return
+        self._closed = True
         self._mgr.wait_until_finished()
         self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# --- crash-consistent checkpoints (reliability layer) ------------------------
+#
+# The orbax wrapper above trusts its files; a preempted VM can leave a torn
+# write behind that only surfaces as a deserialization error days later, at
+# the worst possible time (the resume after the crash). The verified manager
+# makes corruption a HANDLED input instead:
+#
+#   * atomic writes — serialize to a tmp file in the same directory, fsync,
+#     then `os.replace` (POSIX-atomic), so a reader never sees a partial
+#     step file under its final name;
+#   * per-step sha256 manifest — written (atomically) AFTER the data file;
+#     a step without a matching manifest hash is treated as absent;
+#   * fallback restore — `restore()` walks verified steps newest-first, so
+#     a truncated/corrupted newest step degrades to the previous verified
+#     one (with a printed warning) instead of a crash;
+#   * bounded retention — `max_to_keep` pruning that NEVER deletes the
+#     newest verified step, even when newer (unverified) files exist.
+#
+# Fault injection: `fault_hook(step, state_path, manifest_path)` runs after
+# each completed write — reliability.FaultInjector.checkpoint_hook() damages
+# the files there exactly the way a crash mid-write would
+# (tests/test_chaos.py asserts the fallback).
+
+
+_STATE_FMT = "step_{:08d}.npz"
+_MANIFEST_SUFFIX = ".manifest.json"
+
+
+def _leaf_paths(tree):
+    """(json-able path, host numpy leaf) pairs in flatten order."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        segs = []
+        for p in path:
+            if isinstance(p, jax.tree_util.DictKey):
+                segs.append(["k", p.key])
+            elif isinstance(p, jax.tree_util.SequenceKey):
+                segs.append(["i", p.idx])
+            elif isinstance(p, jax.tree_util.GetAttrKey):
+                segs.append(["a", p.name])
+            else:
+                raise TypeError(
+                    f"unsupported pytree path entry {p!r} — the verified "
+                    "manager serializes dict/tuple/list states"
+                )
+        out.append((segs, leaf))
+    return out
+
+
+def _rebuild_from_paths(items):
+    """Nested dict/list pytree from (path segments, array) pairs — the
+    no-template restore path. Sequence nodes come back as lists over the
+    indices PRESENT in the paths (leaf-free subtrees like optax's
+    EmptyState leave index gaps and are dropped from this host-side view);
+    a template restore preserves the exact container types and structure."""
+    root: dict = {}
+    for segs, arr in items:
+        node = root
+        for kind, key in segs[:-1]:
+            node = node.setdefault((kind, key), {})
+        kind, key = segs[-1]
+        node[(kind, key)] = arr
+
+    def materialize(node):
+        if not isinstance(node, dict):
+            return node
+        if node and all(k[0] == "i" for k in node):
+            return [materialize(node[k]) for k in sorted(node)]
+        return {key: materialize(v) for (kind, key), v in node.items()}
+
+    return materialize(root)
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _pack_leaf(arr: np.ndarray):
+    """(storable array, meta) for one leaf. npz round-trips native dtypes
+    but silently degrades extension dtypes (ml_dtypes bfloat16 and friends,
+    numpy kind 'V') to raw void — a bf16 checkpoint would then verify on
+    save and crash on restore. Such leaves are stored as flat uint8 with
+    the true dtype/shape in the manifest."""
+    if arr.dtype.kind == "V":
+        return np.frombuffer(arr.tobytes(), np.uint8), {
+            "dtype": str(arr.dtype), "shape": list(arr.shape), "packed": True,
+        }
+    return arr, {"dtype": str(arr.dtype), "shape": list(arr.shape),
+                 "packed": False}
+
+
+def _unpack_leaf(arr: np.ndarray, meta) -> np.ndarray:
+    if not meta or not meta.get("packed"):
+        return arr
+    try:
+        dtype = np.dtype(meta["dtype"])
+    except TypeError:
+        import ml_dtypes  # registers bfloat16/float8 dtype names
+
+        dtype = np.dtype(getattr(ml_dtypes, meta["dtype"]))
+    return np.frombuffer(arr.tobytes(), dtype).reshape(meta["shape"])
+
+
+class VerifiedCheckpointManager:
+    """Crash-consistent, content-verified checkpoints (API-compatible with
+    `CheckpointManager`, so `restore_or_init` / `open_or_init` / `finish`
+    and `run_resilient` drive either).
+
+    Synchronous by design: the write must be durable before the train loop
+    advances past a preemption poll point, and the npz serialization the
+    sizes this repo trains at is milliseconds — async would only reopen
+    the torn-write window this class exists to close.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 1,
+                 fault_hook: Optional[Callable[[int, str, str], None]] = None):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self.save_interval_steps = max(1, save_interval_steps)
+        self._fault_hook = fault_hook
+        self._closed = False
+        # steps whose sha256 already checked out: checkpoint files are
+        # immutable once their manifest matches, so re-hashing multi-GB
+        # states on every save/latest_step would make checkpoint cadence
+        # cost grow with retention
+        self._verified = set()
+
+    # -- paths / verification ------------------------------------------------
+
+    def _state_path(self, step: int) -> str:
+        return os.path.join(self.directory, _STATE_FMT.format(step))
+
+    def _manifest_path(self, step: int) -> str:
+        return self._state_path(step) + _MANIFEST_SUFFIX
+
+    def all_steps(self):
+        """Every step with a state file on disk, verified or not, ascending."""
+        steps = []
+        for p in glob.glob(os.path.join(self.directory, "step_*.npz")):
+            name = os.path.basename(p)
+            try:
+                steps.append(int(name[len("step_"):-len(".npz")]))
+            except ValueError:
+                continue
+        return sorted(steps)
+
+    def verify(self, step: int) -> bool:
+        """True when the step's manifest exists and its sha256 matches the
+        data file — the crash-consistency check restore trusts. The full
+        hash runs once per step per manager (cached; existence is still
+        re-checked so external deletion is noticed)."""
+        state_path, manifest_path = self._state_path(step), self._manifest_path(step)
+        if not (os.path.exists(state_path) and os.path.exists(manifest_path)):
+            self._verified.discard(step)
+            return False
+        if step in self._verified:
+            return True
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return False
+        ok = (
+            manifest.get("step") == step
+            and manifest.get("sha256") == _sha256_file(state_path)
+        )
+        if ok:
+            self._verified.add(step)
+        return ok
+
+    def verified_steps(self):
+        return [s for s in self.all_steps() if self.verify(s)]
+
+    def latest_step(self) -> Optional[int]:
+        """Newest VERIFIED step (corrupt/torn steps are invisible here)."""
+        steps = self.verified_steps()
+        return steps[-1] if steps else None
+
+    # -- save ---------------------------------------------------------------
+
+    def save(self, state: Any, step: Optional[int] = None, force: bool = False) -> bool:
+        if self._closed:
+            raise RuntimeError("save() on a closed VerifiedCheckpointManager")
+        if step is None:
+            step = int(np.asarray(jax.device_get(state["step"])))
+        if not force and step % self.save_interval_steps != 0:
+            return False
+        items = _leaf_paths(jax.device_get(state))
+        arrays, leaf_meta = {}, []
+        for i, (_, leaf) in enumerate(items):
+            packed, meta = _pack_leaf(np.asarray(leaf))
+            arrays[f"leaf_{i:05d}"] = packed
+            leaf_meta.append(meta)
+
+        state_path = self._state_path(step)
+        tmp = state_path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, state_path)
+
+        manifest = {
+            "step": step,
+            "sha256": _sha256_file(state_path),
+            "leaves": len(items),
+            "paths": [segs for segs, _ in items],
+            "leaf_meta": leaf_meta,
+        }
+        manifest_path = self._manifest_path(step)
+        tmp = manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, manifest_path)
+
+        if self._fault_hook is not None:
+            self._fault_hook(step, state_path, manifest_path)
+        self._prune()
+        return True
+
+    def _prune(self):
+        """Drop oldest steps beyond max_to_keep — but the newest verified
+        step is sacrosanct: with the newest file torn, it is the only
+        restore target, and retention must never widen a corruption event
+        into total loss."""
+        if self.max_to_keep is None or self.max_to_keep < 1:
+            return
+        steps = self.all_steps()
+        excess = len(steps) - self.max_to_keep
+        if excess <= 0:
+            return
+        newest_verified = self.latest_step()
+        for step in steps:
+            if excess <= 0:
+                break
+            if step == newest_verified:
+                continue
+            for p in (self._state_path(step), self._manifest_path(step)):
+                if os.path.exists(p):
+                    os.unlink(p)
+            self._verified.discard(step)
+            excess -= 1
+
+    # -- restore ------------------------------------------------------------
+
+    def _load(self, step: int, abstract_state: Any):
+        with open(self._manifest_path(step)) as f:
+            manifest = json.load(f)
+        meta = manifest.get("leaf_meta") or [None] * manifest["leaves"]
+        with np.load(self._state_path(step)) as data:
+            arrays = [
+                _unpack_leaf(data[f"leaf_{i:05d}"], meta[i])
+                for i in range(manifest["leaves"])
+            ]
+        if abstract_state is None:
+            return _rebuild_from_paths(zip(manifest["paths"], arrays))
+        stored = {json.dumps(segs): arr
+                  for segs, arr in zip(manifest["paths"], arrays)}
+        out = []
+        for segs, template in _leaf_paths(abstract_state):
+            key = json.dumps(segs)
+            if key not in stored:
+                raise KeyError(
+                    f"checkpoint step {step} has no leaf at {key} — template "
+                    "and checkpoint layouts diverged"
+                )
+            arr = stored[key]
+            sharding = getattr(template, "sharding", None)
+            out.append(
+                jax.device_put(arr, sharding) if sharding is not None
+                else jax.numpy.asarray(arr)
+            )
+        leaves, treedef = jax.tree_util.tree_flatten(abstract_state)
+        assert len(leaves) == len(out)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def restore(self, abstract_state: Any = None, step: Optional[int] = None) -> Any:
+        """Restore `step` (must verify) or, by default, the newest step that
+        PASSES verification — falling back past corrupt/truncated newer
+        steps with a printed warning per skipped step."""
+        if step is not None:
+            if not self.verify(step):
+                raise FileNotFoundError(
+                    f"checkpoint step {step} in {self.directory} is missing "
+                    "or failed sha256 verification"
+                )
+            return self._load(step, abstract_state)
+        candidates = self.all_steps()
+        if not candidates:
+            raise FileNotFoundError(f"no checkpoint found under {self.directory}")
+        for s in reversed(candidates):
+            if self.verify(s):
+                return self._load(s, abstract_state)
+            print(f"warning: checkpoint step {s} in {self.directory} failed "
+                  "verification (torn write or corruption) — falling back")
+        raise FileNotFoundError(
+            f"no checkpoint under {self.directory} passes verification "
+            f"(steps on disk: {candidates})"
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def wait(self):
+        """Writes are synchronous; nothing to drain."""
+
+    def close(self):
+        self._closed = True
 
     def __enter__(self):
         return self
@@ -133,16 +475,34 @@ def open_or_init(
     *init_args,
     save_every: int = 1,
     shardings: Any = None,
+    verify: bool = False,
+    fault_hook=None,
 ):
     """Entry-script idiom shared by train_pre.py / train_end2end.py.
 
     Returns (mgr, state, resumed); mgr is None when ckpt_dir is None.
-    Interval gating is delegated to orbax's save_interval_steps — call
-    `mgr.save(state)` every step and orbax decides.
+    Interval gating is delegated to the manager's save_interval_steps —
+    call `mgr.save(state)` every step and it decides.
+
+    verify=True (the --ckpt-verify flag) selects the crash-consistent
+    `VerifiedCheckpointManager` (atomic writes + sha256 manifests +
+    fallback restore); `fault_hook` is its chaos-injection seam and
+    requires verify=True.
     """
     if ckpt_dir is None:
         return None, init_fn(*init_args), False
-    mgr = CheckpointManager(ckpt_dir, save_interval_steps=max(1, save_every))
+    if verify:
+        mgr = VerifiedCheckpointManager(
+            ckpt_dir, save_interval_steps=max(1, save_every),
+            fault_hook=fault_hook,
+        )
+    else:
+        if fault_hook is not None:
+            raise ValueError(
+                "checkpoint fault injection needs the verified manager — "
+                "pass verify=True (--ckpt-verify)"
+            )
+        mgr = CheckpointManager(ckpt_dir, save_interval_steps=max(1, save_every))
     state, resumed = restore_or_init(mgr, init_fn, *init_args, shardings=shardings)
     return mgr, state, resumed
 
@@ -186,8 +546,11 @@ def restore_params_for_inference(ckpt_dir: Optional[str], init_fn, *init_args,
 
 def finish(mgr: Optional["CheckpointManager"], state: Any):
     """Final flush at end of training: save the last step if the periodic
-    cadence missed it, then drain and close."""
-    if mgr is None:
+    cadence missed it, then drain and close. A no-op on an
+    already-closed manager (a preemption path that checkpointed and
+    closed, followed by the entry script's unconditional finish, must not
+    crash the clean exit)."""
+    if mgr is None or getattr(mgr, "closed", False):
         return
     step = int(np.asarray(jax.device_get(state["step"])))
     if mgr.latest_step() != step:

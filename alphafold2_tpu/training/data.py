@@ -110,6 +110,117 @@ def stack_microbatches(it: Iterator[dict], grad_accum: int) -> Iterator[dict]:
         yield {k: np.stack([m[k] for m in mbs]) for k in mbs[0]}
 
 
+def synthetic_microbatch_fn(cfg: DataConfig, grad_accum: int, source=None):
+    """Step-indexed microbatch fetch: `fetch(step)` is a PURE function of
+    the step number (synthetic streams are index-pure), so a retried or
+    resumed step re-fetches the IDENTICAL batch — the property that lets
+    the chaos suite assert bit-exact recovery, and `run_resilient` replay
+    a crashed step instead of silently training it on the next batch.
+
+    `source`: synthetic_batches (default) or synthetic_structure_batches.
+    """
+    src = source if source is not None else synthetic_batches
+
+    def fetch(step: int) -> dict:
+        it = src(cfg, start_index=step * grad_accum)
+        mbs = [next(it) for _ in range(grad_accum)]
+        return {k: np.stack([m[k] for m in mbs]) for k in mbs[0]}
+
+    return fetch
+
+
+class ResilientBatches:
+    """Retrying/skipping wrapper over a batch source — the data-pipeline
+    answer to a flaky filesystem or a corrupt shard: a failed fetch is
+    retried with bounded exponential backoff, and a record that keeps
+    failing is SKIPPED (counted, reported) instead of killing a multi-day
+    run. StopIteration is end-of-data, not a fault, and passes through.
+
+    Wraps either an iterator (`next` semantics) or a step-indexed fetch
+    callable (`fetch(step)`, e.g. `synthetic_microbatch_fn`) — in the
+    callable form a retry re-fetches the SAME step, keeping recovery
+    bit-exact. The chaos hook (`injector.before_batch(index)`) fires
+    before each underlying fetch, so an injected transient error never
+    consumes a record: retry really does see the same data.
+
+    Iterating yields batches; in callable form use `fetch(step)` directly.
+    """
+
+    def __init__(self, source, *, max_retries: int = 2,
+                 backoff_s: float = 0.05, max_backoff_s: float = 2.0,
+                 injector=None, max_skipped: Optional[int] = None):
+        self._it = iter(source) if not callable(source) else None
+        self._fn = source if callable(source) else None
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
+        self._injector = injector
+        self.max_skipped = max_skipped
+        self.skipped = 0      # records abandoned after retries
+        self.retries = 0      # total retry attempts (observability)
+        self._index = 0       # fetch index (the chaos hook's clock)
+
+    def _attempt(self, step: Optional[int]):
+        index = self._index
+        self._index += 1
+        if self._injector is not None:
+            self._injector.before_batch(index)
+        if self._fn is not None:
+            return self._fn(step if step is not None else index)
+        return next(self._it)
+
+    def _fetch(self, step: Optional[int] = None):
+        import time as _time
+
+        while True:  # per-record loop: a skip moves on to the next record
+            for attempt in range(self.max_retries + 1):
+                try:
+                    return self._attempt(step)
+                except StopIteration:
+                    raise
+                except Exception as e:
+                    if attempt < self.max_retries:
+                        self.retries += 1
+                        delay = min(self.backoff_s * (2 ** attempt),
+                                    self.max_backoff_s)
+                        if delay > 0:
+                            _time.sleep(delay)
+                        continue
+                    self.skipped += 1
+                    print(f"data: record at fetch index {self._index - 1} "
+                          f"failed {attempt + 1} attempts "
+                          f"({type(e).__name__}: {e}) — skipped "
+                          f"({self.skipped} total)")
+                    if (self.max_skipped is not None
+                            and self.skipped > self.max_skipped):
+                        raise RuntimeError(
+                            f"data pipeline skipped {self.skipped} records "
+                            f"(> max_skipped={self.max_skipped}); the source "
+                            "is broken, not flaky"
+                        ) from e
+            # skipped: fall through and fetch the next record. In callable
+            # form the step's batch is unrecoverable by definition here, so
+            # serve the next index's batch for it — logged above, and the
+            # skipped counter keeps the divergence visible.
+            if self._fn is not None:
+                step = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self._fetch()
+
+    def __call__(self, step: int):
+        return self._fetch(step)
+
+
+def resilient_batches(source, **kwargs) -> ResilientBatches:
+    """Convenience constructor, the documented data-pipeline hook point
+    (see reliability.faults): `resilient_batches(it, injector=...)`."""
+    return ResilientBatches(source, **kwargs)
+
+
 def bucket_batches(
     items: Iterator[tuple],
     cfg: DataConfig,

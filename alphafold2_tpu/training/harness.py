@@ -25,6 +25,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from alphafold2_tpu.models import Alphafold2Config, alphafold2_apply, alphafold2_init
@@ -187,6 +188,31 @@ def make_train_step(
         return new_state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
 
     return train_step
+
+
+# --- fault-injection hook (reliability layer) --------------------------------
+
+
+def with_fault_injection(step_fn, injector):
+    """Wrap a (jitted) step function with the chaos-injection hook point.
+
+    The wrapper runs HOST-side, around the device program: before the
+    step, the injector can raise (step-N exception, the path
+    `run_resilient` recovers) or trip a preemption flag; after it, a
+    `nan_grads` fault poisons the reported metrics (so StepGuard's
+    non-finite watchdog must detect and roll back). `injector=None`
+    returns `step_fn` unchanged — the production path pays nothing.
+    """
+    if injector is None:
+        return step_fn
+
+    def wrapped(state, batch, rng=None):
+        step = int(np.asarray(jax.device_get(state["step"])))
+        batch = injector.before_train_step(step, batch)
+        new_state, metrics = step_fn(state, batch, rng)
+        return injector.after_train_step(step, new_state, metrics)
+
+    return wrapped
 
 
 # --- shared trainer CLI surface ---------------------------------------------

@@ -20,7 +20,7 @@ the recovery layer our checkpoint subsystem makes possible:
 from __future__ import annotations
 
 import math
-from typing import Callable, Iterator, Optional
+from typing import Callable, Optional
 
 import jax
 import numpy as np
@@ -92,7 +92,7 @@ class StepGuard:
 def run_resilient(
     step_fn: Callable,
     state,
-    batches: Iterator,
+    batches,
     *,
     steps: int,
     make_rng: Callable[[int], object],
@@ -100,40 +100,85 @@ def run_resilient(
     on_metrics: Optional[Callable[[int, dict], None]] = None,
     max_restarts: int = 3,
     max_consecutive_bad: int = 3,
+    logger=None,
+    preemption=None,
 ):
     """Supervised training loop with rollback and checkpoint-restore retry.
 
     Args:
       step_fn: jitted (state, batch, rng) -> (state, metrics).
       state: initial TrainState (its "step" entry drives numbering).
-      batches: batch iterator (consumed once per attempted step).
+      batches: batch iterator (consumed once per attempted step) OR a
+        step-indexed callable `fetch(step) -> batch` (e.g.
+        data.synthetic_microbatch_fn / a callable ResilientBatches). The
+        callable form makes recovery REPLAY-EXACT: a restarted or
+        rolled-back step refetches the identical batch, so a faulted run
+        reconverges bit-exact with a fault-free one (the chaos-suite
+        invariant). The iterator form keeps the old semantics — a retried
+        step consumes the next batch.
       steps: number of steps to run from the CURRENT state step.
       make_rng: step index -> PRNG key (use jax.random.fold_in for
         resume-stable schedules).
-      mgr: optional CheckpointManager; saves ride its save_interval_steps
-        cadence and recovery restores from it.
+      mgr: optional CheckpointManager / VerifiedCheckpointManager; saves
+        ride its save_interval_steps cadence and recovery restores from it.
       on_metrics: callback(step, metrics) for logging.
-      max_restarts: exception-recovery budget.
+      max_restarts: consecutive exception-recovery budget. When exceeded,
+        the abort carries the WHOLE restart cause chain in its message —
+        "what killed the run" must not require scrolling back through days
+        of logs.
+      logger: optional utils.MetricsLogger; every restart is recorded as a
+        structured `restart` event ((exception type, step, restart count))
+        and the run ends with a `resilience_summary` event.
+      preemption: optional reliability.PreemptionHandler; polled at each
+        step boundary. On SIGTERM the loop force-saves the current state,
+        drains the manager, and raises `Preempted` — the next run resumes
+        bit-exact from that checkpoint.
 
     Returns the final state.
     """
     start = int(np.asarray(jax.device_get(state["step"])))
     target = start + steps
     restarts = 0
+    causes = []  # (step, exception type, message head) per restart, lifetime
     guard = StepGuard(state, max_consecutive_bad=max_consecutive_bad)
+    # a callable source is used step-indexed even when it also iterates
+    # (ResilientBatches is both): replay-exactness must win whenever the
+    # source can provide it
+    step_indexed = callable(batches)
+
+    def fetch(step):
+        try:
+            return batches(step) if step_indexed else next(batches)
+        except StopIteration:
+            raise RuntimeError(
+                f"data exhausted at step {step} (before target {target}); "
+                "not a recoverable fault"
+            ) from None
+
+    def record_restart(step, exc, where):
+        causes.append((step, type(exc).__name__, str(exc).splitlines()[0][:200]))
+        if logger is not None:
+            logger.event(step, "restart", error=type(exc).__name__,
+                         message=str(exc)[:500], restart=restarts,
+                         max_restarts=max_restarts, restored_from=where)
 
     while True:
         step = int(np.asarray(jax.device_get(state["step"])))
+        if preemption is not None and preemption.check():
+            from alphafold2_tpu.reliability.preemption import Preempted
+
+            if mgr is not None:
+                mgr.save(state, force=True)
+                mgr.wait()
+                mgr.close()
+            if logger is not None:
+                logger.event(step, "preempted", signum=preemption.signum,
+                             checkpointed=mgr is not None)
+            raise Preempted(step, checkpointed=mgr is not None)
         if step >= target:
             break
         try:
-            try:
-                batch = next(batches)
-            except StopIteration:
-                raise RuntimeError(
-                    f"data exhausted at step {step} (before target {target}); "
-                    "not a recoverable fault"
-                ) from None
+            batch = fetch(step)
             new_state, metrics = step_fn(state, batch, make_rng(step))
             state, ok = guard.check(new_state, metrics)
             if ok:
@@ -151,7 +196,14 @@ def run_resilient(
         except Exception as e:  # crash-recovery path
             restarts += 1
             if restarts > max_restarts:
-                raise
+                record_restart(step, e, "ABORT (budget exhausted)")
+                chain = "; ".join(
+                    f"{name}({msg!r}) at step {s}" for s, name, msg in causes
+                )
+                raise RuntimeError(
+                    f"restart budget exhausted (max_restarts="
+                    f"{max_restarts}) at step {step}; cause chain: {chain}"
+                ) from e
             if mgr is not None and mgr.latest_step() is not None:
                 from alphafold2_tpu.training.checkpoint import abstract_like
 
@@ -164,12 +216,71 @@ def run_resilient(
             guard.good_state = state
             guard.bad_streak = 0  # restored state is clean; stale NaN counts
             # from before the crash must not count against it
+            record_restart(step, e, where)
             print(
                 f"step {step}: {type(e).__name__}: {e} — "
                 f"restart {restarts}/{max_restarts} from {where}"
             )
+    if logger is not None:
+        logger.event(target, "resilience_summary",
+                     restarts_total=len(causes),
+                     rollbacks_total=guard.bad_total,
+                     causes=[{"step": s, "error": n, "message": m}
+                             for s, n, m in causes])
     if mgr is not None:
         from alphafold2_tpu.training.checkpoint import finish
 
         finish(mgr, state)
     return state
+
+
+# --- shared trainer CLI surface ---------------------------------------------
+
+
+def add_resilience_args(ap):
+    """The recovery/chaos argparse block shared by train_pre.py and
+    train_end2end.py — the flags that let the chaos harness drive the REAL
+    entrypoints instead of unit fixtures."""
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="run under the run_resilient supervisor with this "
+                         "consecutive crash-restart budget (0 = plain loop; "
+                         "the supervisor needs a non-donating step, ~2x live "
+                         "state footprint)")
+    ap.add_argument("--ckpt-verify", action="store_true",
+                    help="crash-consistent checkpoints: atomic tmp-then-"
+                         "replace writes + per-step sha256 manifest; restore "
+                         "falls back past corrupt/truncated steps to the "
+                         "newest verified one")
+    ap.add_argument("--fault-plan", default=None, metavar="PATH",
+                    help="JSON fault schedule (reliability.FaultPlan) "
+                         "injected into the run's step/data/checkpoint hook "
+                         "points; implies the resilient loop")
+
+
+def resilient_mode(args) -> bool:
+    """True when the trainer should run under the run_resilient supervisor
+    (either flag opts in; a fault plan without a restart budget gets a
+    default budget of 3 so scheduled crashes are survivable)."""
+    return args.max_restarts > 0 or args.fault_plan is not None
+
+
+def chaos_from_args(args):
+    """(injector, ckpt_fault_hook, effective_max_restarts) from the shared
+    resilience flags. The checkpoint hook only exists under --ckpt-verify
+    (the orbax manager has no injection seam); a plan that schedules
+    ckpt_corrupt without it gets a loud warning, not silence."""
+    injector, ckpt_hook = None, None
+    if args.fault_plan is not None:
+        from alphafold2_tpu.reliability import FaultPlan
+
+        injector = FaultPlan.from_file(args.fault_plan).injector()
+        has_ckpt_faults = any(
+            f.kind == "ckpt_corrupt" for f in injector.plan.faults
+        )
+        if args.ckpt_verify:
+            ckpt_hook = injector.checkpoint_hook()
+        elif has_ckpt_faults:
+            print("warning: --fault-plan schedules ckpt_corrupt but "
+                  "--ckpt-verify is off; checkpoint faults will NOT fire")
+    max_restarts = args.max_restarts or (3 if args.fault_plan else 0)
+    return injector, ckpt_hook, max_restarts

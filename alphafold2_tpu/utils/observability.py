@@ -67,6 +67,21 @@ class MetricsLogger:
             print(f"step {step}  {parts}")
         return vals
 
+    def event(self, step: int, kind: str, **fields):
+        """Structured non-scalar record (restart causes, preemptions,
+        config changes): JSON-serializable fields pass through verbatim —
+        no float coercion — into the same JSONL stream, tagged with
+        `"event"` so curve-plotting consumers can filter them out.
+        Always printed: events are rare and operationally load-bearing.
+        """
+        record = {"step": step, "event": kind, **fields}
+        if self._file is not None:
+            self._file.write(json.dumps(record) + "\n")
+            self._file.flush()
+        parts = "  ".join(f"{k}={v}" for k, v in fields.items())
+        print(f"step {step}  [{kind}]  {parts}")
+        return record
+
     def close(self):
         # idempotent: context-manager exit followed by an explicit close()
         # (or two owners sharing one logger) must not hit a closed file

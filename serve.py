@@ -118,6 +118,17 @@ def main():
                     default="classical")
     ap.add_argument("--precompile", action="store_true",
                     help="AOT-compile every bucket before taking traffic")
+    ap.add_argument("--breaker-threshold", type=int, default=0,
+                    help="open the circuit after this many consecutive "
+                         "dispatch failures (fast-reject until the reset "
+                         "window; 0 = breaker off)")
+    ap.add_argument("--breaker-reset", type=float, default=30.0,
+                    help="seconds the circuit stays open before the "
+                         "half-open probe")
+    ap.add_argument("--watchdog-timeout", type=float, default=None,
+                    help="fail a batch whose model call exceeds this many "
+                         "seconds instead of wedging the worker (off by "
+                         "default)")
     ap.add_argument("--passes", type=int, default=1,
                     help="replay the request stream this many times; "
                          "passes after the first exercise the result cache")
@@ -194,6 +205,9 @@ def main():
             seed=args.seed,
             precompile=args.precompile,
             params_tag=params_tag,
+            breaker_threshold=args.breaker_threshold,
+            breaker_reset_s=args.breaker_reset,
+            watchdog_timeout_s=args.watchdog_timeout,
         ),
         metrics_logger=logger,
     )

@@ -1,0 +1,480 @@
+"""Chaos test matrix: every fault kind, machine-verified recovery.
+
+The recovery invariant, asserted per fault type: the guarded run COMPLETES
+and matches the fault-free run's final state/outputs within its declared
+tolerance — bit-exact for step exceptions, NaN rollback, transient data
+errors, checkpoint corruption, and preemption-resume (step-indexed batch
+fetch makes replay exact); completion + correct bookkeeping for the
+skip/shed paths whose whole point is to diverge (skipped records, shed
+requests). And no scenario may hang: every blocking wait carries an
+explicit timeout, and whole scenarios run under the `bounded` watchdog.
+
+Training scenarios drive the REAL `run_resilient` supervisor over the real
+jitted step; serving scenarios drive the real scheduler with the model
+call stubbed at the documented `_call_executable` seam (zero XLA compiles,
+milliseconds per test — same stance as tests/test_serving.py).
+"""
+
+import functools
+import json
+import signal
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from alphafold2_tpu.models import Alphafold2Config
+from alphafold2_tpu.reliability import (
+    CircuitBreaker,
+    CircuitState,
+    Fault,
+    FaultPlan,
+    InjectedFault,
+    Preempted,
+    PreemptionHandler,
+)
+from alphafold2_tpu.serving import (
+    CircuitOpenError,
+    HungBatchError,
+    PredictionError,
+    ServingConfig,
+    ServingEngine,
+)
+from alphafold2_tpu.training import (
+    DataConfig,
+    TrainConfig,
+    VerifiedCheckpointManager,
+    make_train_step,
+    resilient_batches,
+    run_resilient,
+    synthetic_microbatch_fn,
+    train_state_init,
+    with_fault_injection,
+)
+
+pytestmark = pytest.mark.chaos
+
+CFG = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8, max_seq_len=64)
+TCFG = TrainConfig(learning_rate=1e-3, grad_accum=1)
+DCFG = DataConfig(batch_size=1, max_len=8)
+
+
+def bounded(seconds):
+    """Explicit per-test hang bound: the scenario runs on a watchdogged
+    thread and the test FAILS (instead of wedging the suite) past the
+    deadline. Not usable for tests that install signal handlers (a
+    main-thread-only operation) — those bound themselves by construction.
+    """
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            box = {}
+            done = threading.Event()
+
+            def run():
+                try:
+                    box["ok"] = fn(*args, **kwargs)
+                except BaseException as e:  # noqa: BLE001 — relayed below
+                    box["exc"] = e
+                finally:
+                    done.set()
+
+            threading.Thread(target=run, daemon=True).start()
+            if not done.wait(seconds):
+                pytest.fail(
+                    f"chaos scenario exceeded its {seconds}s bound — hang"
+                )
+            if "exc" in box:
+                raise box["exc"]
+        return wrapper
+    return deco
+
+
+@pytest.fixture(scope="module")
+def step_fn():
+    # one compile for the whole matrix; NON-donating (the supervisor keeps
+    # a rollback reference to the pre-step state)
+    return jax.jit(make_train_step(CFG, TCFG))
+
+
+def fresh_state():
+    return train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+
+
+def make_rng(i):
+    return jax.random.fold_in(jax.random.PRNGKey(1), i)
+
+
+def run_guarded(step_fn, *, steps, injector=None, mgr=None, fetch=None,
+                preemption=None, max_restarts=3, state=None):
+    return run_resilient(
+        with_fault_injection(step_fn, injector),
+        fresh_state() if state is None else state,
+        fetch if fetch is not None else synthetic_microbatch_fn(DCFG, 1),
+        steps=steps, make_rng=make_rng, mgr=mgr,
+        max_restarts=max_restarts, preemption=preemption,
+    )
+
+
+def assert_trees_equal(a, b):
+    fa, fb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def plan(*faults):
+    return FaultPlan(faults=tuple(faults))
+
+
+# ------------------------------------------------------- plan plumbing
+
+
+def test_fault_plan_json_roundtrip_and_validation():
+    p = FaultPlan.from_json(json.dumps({
+        "seed": 3,
+        "faults": [
+            {"kind": "step_exception", "step": 2},
+            {"kind": "data_error", "index": 1, "count": 2},
+            {"kind": "ckpt_corrupt", "at": 3, "mode": "no_manifest"},
+        ],
+    }))
+    assert FaultPlan.from_json(p.to_json()) == p
+    assert p.faults[0].at == 2 and p.faults[1].at == 1  # alias keys
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        Fault(kind="meteor_strike")
+    with pytest.raises(ValueError, match="mode"):
+        Fault(kind="ckpt_corrupt", mode="gentle")
+    inj = p.injector()
+    assert not inj.exhausted()
+    with pytest.raises(InjectedFault):
+        inj.before_batch(1)
+    inj.before_batch(0)  # below `at`: silent
+
+
+# ------------------------------------------------- training fault matrix
+
+
+@bounded(300)
+def test_step_exception_recovers_bit_exact(step_fn, tmp_path):
+    """Crash at step 2 -> checkpoint restore -> replay -> the faulted run's
+    final state is BIT-EXACT the fault-free run's."""
+    baseline = run_guarded(step_fn, steps=4)
+    inj = plan(Fault("step_exception", at=2)).injector()
+    mgr = VerifiedCheckpointManager(str(tmp_path / "ckpt"))
+    final = run_guarded(step_fn, steps=4, injector=inj, mgr=mgr)
+    assert inj.exhausted()
+    assert int(np.asarray(final["step"])) == 4
+    assert_trees_equal(baseline, final)
+
+
+@bounded(300)
+def test_nan_grads_rolls_back_bit_exact(step_fn):
+    """A NaN-poisoned step is rolled back and retried (same step, same
+    batch, fault spent) -> bit-exact convergence, no checkpoint needed."""
+    baseline = run_guarded(step_fn, steps=3)
+    inj = plan(Fault("nan_grads", at=1)).injector()
+    final = run_guarded(step_fn, steps=3, injector=inj)
+    assert inj.exhausted()
+    assert_trees_equal(baseline, final)
+
+
+@bounded(300)
+def test_transient_data_error_retries_bit_exact(step_fn):
+    """A fetch that fails once is retried against the SAME step index —
+    no record is consumed by the failure, so recovery is bit-exact."""
+    baseline = run_guarded(step_fn, steps=3)
+    inj = plan(Fault("data_error", at=1)).injector()
+    fetch = resilient_batches(
+        synthetic_microbatch_fn(DCFG, 1),
+        injector=inj, max_retries=2, backoff_s=0.0,
+    )
+    final = run_guarded(step_fn, steps=3, fetch=fetch)
+    assert inj.exhausted()
+    assert fetch.retries == 1 and fetch.skipped == 0
+    assert_trees_equal(baseline, final)
+
+
+@bounded(300)
+def test_persistent_data_error_skips_and_completes(step_fn):
+    """A record that fails past the retry budget is SKIPPED (counted),
+    and the run still completes with finite loss — the declared-tolerance
+    case: divergence from the fault-free run is the feature."""
+    inj = plan(Fault("data_error", at=1, count=5)).injector()
+    fetch = resilient_batches(
+        synthetic_microbatch_fn(DCFG, 1),
+        injector=inj, max_retries=1, backoff_s=0.0,
+    )
+    seen = []
+    final = run_resilient(
+        step_fn, fresh_state(), fetch, steps=3, make_rng=make_rng,
+        on_metrics=lambda s, m: seen.append(float(np.asarray(m["loss"]))),
+    )
+    assert int(np.asarray(final["step"])) == 3
+    assert fetch.skipped >= 1
+    assert all(np.isfinite(x) for x in seen)
+
+
+@bounded(300)
+def test_skip_budget_aborts_on_broken_source():
+    """max_skipped bounds the skip policy: a source that fails EVERY
+    record aborts loudly instead of spinning forever."""
+    inj = plan(Fault("data_error", at=0, count=10_000)).injector()
+    fetch = resilient_batches(
+        synthetic_microbatch_fn(DCFG, 1),
+        injector=inj, max_retries=1, backoff_s=0.0, max_skipped=2,
+    )
+    with pytest.raises(RuntimeError, match="max_skipped"):
+        for _ in range(50):
+            fetch(0)
+
+
+@bounded(300)
+def test_ckpt_corruption_falls_back_and_recovers_bit_exact(step_fn, tmp_path, capsys):
+    """The newest checkpoint is torn mid-write; the NEXT crash restores
+    from the previous verified step, replays, and reconverges bit-exact."""
+    baseline = run_guarded(step_fn, steps=4)
+    inj = plan(
+        Fault("ckpt_corrupt", at=3, mode="truncate"),
+        Fault("step_exception", at=3),
+    ).injector()
+    mgr = VerifiedCheckpointManager(
+        str(tmp_path / "ckpt"), fault_hook=inj.checkpoint_hook()
+    )
+    final = run_guarded(step_fn, steps=4, injector=inj, mgr=mgr)
+    assert inj.exhausted()
+    assert "failed verification" in capsys.readouterr().out
+    assert_trees_equal(baseline, final)
+
+
+@bounded(300)
+def test_preemption_then_resume_is_bit_exact(step_fn, tmp_path):
+    """SIGTERM-style preemption: the run checkpoints and raises Preempted;
+    a FRESH run restores and finishes; the two-run total is bit-exact one
+    uninterrupted run."""
+    from alphafold2_tpu.training import abstract_like, restore_or_init
+
+    baseline = run_guarded(step_fn, steps=5)
+
+    handler = PreemptionHandler()  # uninstalled: injector delivers in-process
+    inj = plan(Fault("preempt", at=3)).injector().bind_preemption(handler)
+    path = str(tmp_path / "ckpt")
+    with pytest.raises(Preempted) as exc_info:
+        run_guarded(step_fn, steps=5, injector=inj,
+                    mgr=VerifiedCheckpointManager(path), preemption=handler)
+    # fault fires before step 3 runs; the flag is polled at the NEXT step
+    # boundary, so the final checkpoint holds the post-step-3 state
+    assert exc_info.value.step == 4
+
+    mgr2 = VerifiedCheckpointManager(path)
+    state, resumed = restore_or_init(
+        mgr2, train_state_init, jax.random.PRNGKey(0), CFG, TCFG
+    )
+    assert resumed and int(np.asarray(state["step"])) == 4
+    final = run_guarded(step_fn, steps=1, state=state, mgr=mgr2)
+    assert_trees_equal(baseline, final)
+
+
+@bounded(300)
+def test_preemption_without_manager_is_honest(step_fn):
+    """No checkpoint manager: the Preempted message must say progress was
+    NOT saved — an operator must never be told to 'rerun to resume' a run
+    that will restart from scratch."""
+    handler = PreemptionHandler()
+    inj = plan(Fault("preempt", at=1)).injector().bind_preemption(handler)
+    with pytest.raises(Preempted) as exc_info:
+        run_guarded(step_fn, steps=3, injector=inj, preemption=handler)
+    assert not exc_info.value.checkpointed
+    assert "not saved" in str(exc_info.value)
+    assert "rerun with the same --ckpt-dir" not in str(exc_info.value)
+
+
+def test_real_sigterm_delivery_and_handler_restore():
+    """The actual signal path (main-thread test, bounded by construction:
+    no blocking waits): SIGTERM latches the flag, callbacks fire exactly
+    once, uninstall restores the previous handler."""
+    fired = []
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionHandler() as handler:
+        handler.add_callback(lambda: fired.append(1))
+        assert not handler.check()
+        signal.raise_signal(signal.SIGTERM)
+        assert handler.preempted and handler.signum == signal.SIGTERM
+        assert handler.check() and handler.check()  # latched
+        assert fired == [1]  # once, not per-check
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+# ------------------------------------------------- serving fault matrix
+
+
+from alphafold2_tpu.constants import AA_ORDER  # noqa: E402
+
+TINY = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8, max_seq_len=16)
+
+
+def seq_of(length, offset=0):
+    return "".join(AA_ORDER[(offset + i) % len(AA_ORDER)] for i in range(length))
+
+
+class FakeEngine(ServingEngine):
+    """Model call stubbed at the documented seam (tests/test_serving.py
+    stance); the chaos fault hook runs in front of it via _dispatch."""
+
+    def _call_executable(self, bucket, tokens, mask, msa=None, msa_mask=None):
+        B, Lb = tokens.shape
+        return {
+            "coords": np.zeros((B, Lb, 3), np.float32),
+            "confidence": np.full((B, Lb), 0.5, np.float32),
+            "stress": np.zeros((B,), np.float32),
+        }
+
+
+def fake_engine(injector=None, **overrides):
+    base = dict(buckets=(8, 16), max_batch=1, max_queue=8, max_wait_s=0.0,
+                request_timeout_s=30.0, cache_capacity=0)
+    base.update(overrides)
+    return FakeEngine(
+        {}, TINY, ServingConfig(**base),
+        fault_hook=injector.serving_hook() if injector is not None else None,
+    )
+
+
+@bounded(60)
+def test_hung_batch_watchdog_fails_batch_not_worker():
+    """A wedged dispatch trips the watchdog: its requests FAIL (with the
+    stable hung_batch code) while the worker keeps serving — the engine
+    never hangs."""
+    inj = plan(Fault("hung_request", at=0, hang_s=15.0)).injector()
+    eng = fake_engine(inj, watchdog_timeout_s=0.25)
+    try:
+        victim = eng.submit(seq_of(4))
+        with pytest.raises(HungBatchError, match="watchdog"):
+            victim.result(timeout=10)
+        # the worker thread survived the hung call: fresh traffic serves
+        assert eng.submit(seq_of(5)).result(timeout=10).coords.shape == (5, 3)
+        stats = eng.stats()
+        assert stats["errors"]["hung_batch"] == 1
+        assert stats["requests"]["completed"] == 1
+        assert inj.exhausted()
+    finally:
+        eng.shutdown(timeout=10)
+
+
+@bounded(60)
+def test_slow_request_completes_under_watchdog():
+    """Slow-but-alive dispatches are NOT the watchdog's business."""
+    inj = plan(Fault("slow_request", at=0, delay_s=0.05)).injector()
+    eng = fake_engine(inj, watchdog_timeout_s=5.0)
+    try:
+        res = eng.submit(seq_of(4)).result(timeout=10)
+        assert res.coords.shape == (4, 3)
+        assert inj.exhausted()
+        assert "hung_batch" not in eng.stats()["errors"]
+    finally:
+        eng.shutdown(timeout=10)
+
+
+@bounded(60)
+def test_circuit_opens_fast_rejects_and_recovers_via_probe():
+    """The acceptance scenario: an always-failing model opens the circuit
+    within the threshold, submit() fast-rejects while open, and one
+    half-open probe closes it once the model heals — with every error
+    visible by code in stats()."""
+    THRESHOLD = 3
+    inj = plan(Fault("request_error", at=0, count=THRESHOLD)).injector()
+    eng = fake_engine(inj, breaker_threshold=THRESHOLD, breaker_reset_s=0.2)
+    try:
+        for i in range(THRESHOLD):
+            with pytest.raises(PredictionError):
+                eng.submit(seq_of(4, offset=i)).result(timeout=10)
+        assert eng.stats()["breaker"]["state"] == "open"
+
+        t0 = time.monotonic()
+        with pytest.raises(CircuitOpenError):
+            eng.submit(seq_of(4, offset=9))
+        assert time.monotonic() - t0 < 1.0  # fast-reject, no queue time
+
+        time.sleep(0.25)  # past breaker_reset_s: half-open admits a probe
+        probe = eng.submit(seq_of(4, offset=10))  # faults exhausted: heals
+        assert probe.result(timeout=10).coords.shape == (4, 3)
+        snap = eng.stats()
+        assert snap["breaker"]["state"] == "closed"
+        assert snap["breaker"]["trips"] == 1
+        assert snap["errors"]["prediction_failed"] == THRESHOLD
+        assert snap["errors"]["circuit_open"] == 1
+        # healed circuit serves normally
+        assert eng.submit(seq_of(6)).result(timeout=10).coords.shape == (6, 3)
+        assert inj.exhausted()
+    finally:
+        eng.shutdown(timeout=10)
+
+
+@bounded(60)
+def test_breaker_half_open_failure_reopens():
+    inj = plan(Fault("request_error", at=0, count=3)).injector()
+    eng = fake_engine(inj, breaker_threshold=2, breaker_reset_s=0.1)
+    try:
+        for i in range(2):
+            with pytest.raises(PredictionError):
+                eng.submit(seq_of(4, offset=i)).result(timeout=10)
+        assert eng.stats()["breaker"]["state"] == "open"
+        time.sleep(0.15)
+        with pytest.raises(PredictionError):  # probe fails (3rd fault)
+            eng.submit(seq_of(4, offset=5)).result(timeout=10)
+        assert eng.stats()["breaker"]["state"] == "open"
+        assert eng.stats()["breaker"]["trips"] == 2
+        time.sleep(0.15)
+        assert eng.submit(seq_of(7)).result(timeout=10).coords.shape == (7, 3)
+        assert eng.stats()["breaker"]["state"] == "closed"
+    finally:
+        eng.shutdown(timeout=10)
+
+
+def test_breaker_state_machine_deterministic_clock():
+    """Pure state-machine coverage with an injected clock (no sleeps)."""
+    t = [0.0]
+    b = CircuitBreaker(threshold=2, reset_s=10.0, clock=lambda: t[0])
+    assert b.allow() and b.state is CircuitState.CLOSED
+    b.record_failure()
+    assert b.allow()  # one failure: still closed
+    b.record_failure()
+    assert b.state is CircuitState.OPEN and not b.allow()
+    t[0] = 9.9
+    assert not b.allow()  # window not elapsed
+    t[0] = 10.0
+    assert b.allow()      # half-open probe claimed
+    assert b.state is CircuitState.HALF_OPEN and not b.allow()
+    b.abandon_probe()     # probe never dispatched (queue full / expiry)
+    assert b.state is CircuitState.OPEN
+    assert b.allow()      # immediately reclaimable — window NOT restarted
+    b.record_failure()    # probe failed: reopen, fresh window
+    assert b.state is CircuitState.OPEN and not b.allow()
+    t[0] = 20.0
+    assert b.allow()
+    b.record_success()
+    assert b.state is CircuitState.CLOSED and b.snapshot()["trips"] == 2
+
+
+@pytest.mark.slow
+@bounded(120)
+def test_abandoned_hung_dispatch_cannot_corrupt_later_results():
+    """Real-sleep scenario: the orphaned dispatch thread wakes up AFTER
+    its batch was failed and later traffic was served — its late write
+    must be invisible (fresh result container per dispatch)."""
+    inj = plan(Fault("hung_request", at=0, hang_s=1.5)).injector()
+    eng = fake_engine(inj, watchdog_timeout_s=0.2)
+    try:
+        with pytest.raises(HungBatchError):
+            eng.submit(seq_of(4)).result(timeout=10)
+        later = [eng.submit(seq_of(5, offset=i)).result(timeout=10)
+                 for i in range(3)]
+        time.sleep(1.8)  # let the orphan finish its sleep and return
+        after = eng.submit(seq_of(6)).result(timeout=10)
+        for r in later + [after]:
+            assert np.isfinite(r.coords).all()
+        assert eng.stats()["errors"]["hung_batch"] == 1
+    finally:
+        eng.shutdown(timeout=10)

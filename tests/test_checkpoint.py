@@ -8,6 +8,7 @@ resume-or-init, and sharded restore onto an 8-device CPU mesh.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from alphafold2_tpu.models import Alphafold2Config
 from alphafold2_tpu.parallel import make_mesh
@@ -91,6 +92,146 @@ def test_sharded_restore(tmp_path):
     assert any(
         r.sharding.is_equivalent_to(s, r.ndim) for r, s in zip(flat_r, flat_s)
     )
+
+
+# ---------------------------------------------------------- edge cases
+# (reliability PR satellites: empty-dir restore, retention vs corruption,
+# lifecycle idempotence — for BOTH manager families)
+
+
+def test_restore_from_empty_directory_raises(tmp_path):
+    from alphafold2_tpu.training import VerifiedCheckpointManager
+
+    with CheckpointManager(str(tmp_path / "a")) as mgr:
+        assert mgr.latest_step() is None
+        with pytest.raises(FileNotFoundError, match="no checkpoint"):
+            mgr.restore()
+    vmgr = VerifiedCheckpointManager(str(tmp_path / "b"))
+    assert vmgr.latest_step() is None
+    with pytest.raises(FileNotFoundError, match="no checkpoint"):
+        vmgr.restore()
+
+
+def test_finish_after_close_is_a_noop(tmp_path):
+    """The preemption path saves and closes the manager itself; the entry
+    script's unconditional finish() afterwards must not crash the clean
+    exit — for either manager family. close() itself is idempotent too."""
+    from alphafold2_tpu.training import VerifiedCheckpointManager, finish
+
+    state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+    for mgr in (CheckpointManager(str(tmp_path / "a")),
+                VerifiedCheckpointManager(str(tmp_path / "b"))):
+        mgr.save(state, step=0, force=True)
+        mgr.close()
+        mgr.close()          # idempotent
+        finish(mgr, state)   # no-op, no crash
+        assert mgr.closed
+
+
+def test_verified_roundtrip_and_sharded_restore(tmp_path):
+    """The verified manager honors the same abstract-template contract as
+    the orbax wrapper, shardings included."""
+    mesh = make_mesh({"data": 4, "model": 2})
+    state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+    shardings = state_shardings(mesh, state, tp=True)
+    from alphafold2_tpu.training import VerifiedCheckpointManager
+
+    with VerifiedCheckpointManager(str(tmp_path / "ckpt")) as mgr:
+        mgr.save(state, step=0, force=True)
+        plain = mgr.restore()                        # no template: host tree
+        restored = mgr.restore(abstract_like(state, shardings))
+    _assert_tree_equal(state, plain)
+    _assert_tree_equal(state, restored)
+    flat_r = jax.tree_util.tree_leaves(restored)
+    flat_s = jax.tree_util.tree_leaves(shardings, is_leaf=lambda x: hasattr(x, "spec"))
+    assert any(
+        r.sharding.is_equivalent_to(s, r.ndim) for r, s in zip(flat_r, flat_s)
+    )
+
+
+def test_verified_roundtrips_bfloat16(tmp_path):
+    """npz silently degrades ml_dtypes extension dtypes to raw void; the
+    manifest's per-leaf dtype metadata must bring a --bf16 train state
+    back bit-exact (a checkpoint that verifies on save but cannot restore
+    is the exact failure mode the verified manager exists to close)."""
+    from alphafold2_tpu.training import VerifiedCheckpointManager
+
+    state = {
+        "params": {"w": jnp.arange(6, dtype=jnp.bfloat16).reshape(2, 3) / 3},
+        "scalar": jnp.asarray(1.5, jnp.bfloat16),
+        "step": jnp.asarray(1, jnp.int32),
+    }
+    with VerifiedCheckpointManager(str(tmp_path / "ckpt")) as mgr:
+        mgr.save(state, force=True)
+        plain = mgr.restore()
+        templated = mgr.restore(jax.eval_shape(lambda: state))
+    for restored in (plain, templated):
+        for got, want in zip(jax.tree_util.tree_leaves(restored),
+                             jax.tree_util.tree_leaves(state)):
+            assert np.asarray(got).dtype == np.asarray(want).dtype
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_verified_truncated_newest_falls_back(tmp_path):
+    """THE crash-consistency acceptance test: a checkpoint directory whose
+    newest step was truncated mid-write restores from the previous
+    verified step, flagged by the sha256 manifest check."""
+    import os
+
+    from alphafold2_tpu.training import VerifiedCheckpointManager
+
+    path = str(tmp_path / "ckpt")
+    states = {}
+    with VerifiedCheckpointManager(path) as mgr:
+        state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+        for s in (1, 2, 3):
+            state = dict(state, step=jnp.asarray(s, jnp.int32))
+            states[s] = state
+            mgr.save(state, force=True)
+    # torn write: the step-3 file loses its tail after the manifest landed
+    newest = str(tmp_path / "ckpt" / "step_00000003.npz")
+    with open(newest, "r+b") as f:
+        f.truncate(os.path.getsize(newest) // 2)
+
+    mgr2 = VerifiedCheckpointManager(path)
+    assert mgr2.all_steps() == [1, 2, 3]
+    assert not mgr2.verify(3) and mgr2.verify(2)
+    assert mgr2.latest_step() == 2
+    restored = mgr2.restore()
+    assert int(np.asarray(restored["step"])) == 2
+    _assert_tree_equal(states[2], restored)
+    with pytest.raises(FileNotFoundError, match="verification"):
+        mgr2.restore(step=3)  # explicit requests never silently fall back
+
+
+def test_verified_pruning_never_deletes_newest_verified(tmp_path):
+    """max_to_keep retention must not widen a corruption event into total
+    loss: with the newest write corrupt, the newest VERIFIED step survives
+    pruning even as older steps rotate out."""
+    from alphafold2_tpu.reliability import Fault, FaultPlan
+    from alphafold2_tpu.training import VerifiedCheckpointManager
+
+    inj = FaultPlan(faults=(
+        Fault("ckpt_corrupt", at=2, count=99, mode="truncate"),
+    )).injector()
+    mgr = VerifiedCheckpointManager(
+        str(tmp_path / "ckpt"), max_to_keep=1,
+        fault_hook=inj.checkpoint_hook(),
+    )
+    state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+    mgr.save(dict(state, step=jnp.asarray(1, jnp.int32)), force=True)
+    assert mgr.latest_step() == 1
+    # every later save is torn by the injector; step 1 must survive all of
+    # them despite max_to_keep=1
+    for s in (2, 3, 4):
+        mgr.save(dict(state, step=jnp.asarray(s, jnp.int32)), force=True)
+        assert mgr.latest_step() == 1, s
+    assert int(np.asarray(mgr.restore()["step"])) == 1
+    # healthy rotation still prunes: a fresh dir keeps only the newest
+    mgr2 = VerifiedCheckpointManager(str(tmp_path / "ok"), max_to_keep=1)
+    for s in (1, 2, 3):
+        mgr2.save(dict(state, step=jnp.asarray(s, jnp.int32)), force=True)
+    assert mgr2.all_steps() == [3]
 
 
 def test_pp_stacked_state_restore(tmp_path):

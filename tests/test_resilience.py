@@ -109,6 +109,66 @@ def test_data_exhaustion_is_not_a_crash(tmp_path):
         )
 
 
+def test_restart_causes_recorded_and_summarized(tmp_path):
+    """Every restart lands in the MetricsLogger stream as a structured
+    event ((exception type, step, restart count)) and the run closes with
+    a resilience_summary carrying the full cause list — restart causes are
+    operational data, not lost stdout."""
+    import json
+
+    from alphafold2_tpu.utils import MetricsLogger
+
+    state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+    real = jax.jit(make_train_step(CFG, TCFG))
+    fired = []
+
+    def flaky(s, b, r):
+        if int(np.asarray(s["step"])) == 1 and not fired:
+            fired.append(1)
+            raise ValueError("simulated device loss")
+        return real(s, b, r)
+
+    path = str(tmp_path / "metrics.jsonl")
+    with MetricsLogger(path) as logger:
+        state = run_resilient(
+            flaky, state, _batches(), steps=3,
+            make_rng=lambda i: jax.random.fold_in(jax.random.PRNGKey(1), i),
+            logger=logger,
+        )
+    assert int(state["step"]) == 3
+    records = [json.loads(line) for line in open(path)]
+    restarts = [r for r in records if r.get("event") == "restart"]
+    assert len(restarts) == 1
+    assert restarts[0]["error"] == "ValueError"
+    assert restarts[0]["step"] == 1 and restarts[0]["restart"] == 1
+    summary = [r for r in records if r.get("event") == "resilience_summary"]
+    assert len(summary) == 1
+    assert summary[0]["restarts_total"] == 1
+    assert summary[0]["causes"] == [
+        {"step": 1, "error": "ValueError", "message": "simulated device loss"}
+    ]
+
+
+def test_abort_message_lists_cause_chain():
+    """Exhausting the restart budget reports WHAT kept failing, chained in
+    order — not just the last traceback."""
+    state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)
+    calls = [0]
+
+    def always_crash(state, batch, rng):
+        calls[0] += 1
+        raise RuntimeError(f"hard failure #{calls[0]}")
+
+    with pytest.raises(RuntimeError, match="cause chain") as exc_info:
+        run_resilient(
+            always_crash, state, _batches(), steps=2,
+            make_rng=lambda i: jax.random.PRNGKey(i), max_restarts=2,
+        )
+    msg = str(exc_info.value)
+    assert "hard failure #1" in msg and "hard failure #3" in msg
+    assert exc_info.value.__cause__ is not None  # original still chained
+
+
 def test_restart_budget_is_consecutive():
     """Failures separated by successful steps don't accumulate."""
     state = train_state_init(jax.random.PRNGKey(0), CFG, TCFG)

@@ -509,10 +509,61 @@ def test_stats_snapshot_is_json_ready(tiny_params):
         eng.predict(seq_of(5))
         snap = eng.stats()
         parsed = json.loads(json.dumps(snap))
-        for key in ("requests", "batches", "compiles", "latency", "queue",
-                    "cache", "buckets"):
+        for key in ("requests", "batches", "compiles", "errors", "latency",
+                    "queue", "cache", "buckets"):
             assert key in parsed, key
         assert parsed["latency"]["count"] == 1
         assert parsed["queue"]["capacity"] == 8
     finally:
         eng.shutdown()
+
+
+# ------------------------------------------------- error codes (wire format)
+
+
+def test_error_codes_are_stable_and_serializable():
+    """Every ServingError carries a distinct stable `code` and a JSON wire
+    form — dashboards and client retry policies key on these strings, so
+    this test is the compatibility pin."""
+    import json
+
+    from alphafold2_tpu.serving import CircuitOpenError, HungBatchError
+
+    expected = {
+        ServingError: "serving_error",
+        InvalidSequenceError: "invalid_sequence",
+        RequestTooLongError: "request_too_long",
+        QueueFullError: "queue_full",
+        RequestTimeoutError: "request_timeout",
+        PredictionError: "prediction_failed",
+        EngineClosedError: "engine_closed",
+        CircuitOpenError: "circuit_open",
+        HungBatchError: "hung_batch",
+    }
+    assert len(set(expected.values())) == len(expected)  # codes distinct
+    for cls, code in expected.items():
+        exc = cls("boom")
+        assert exc.code == code
+        payload = json.loads(json.dumps(exc.to_json()))
+        assert payload == {
+            "code": code, "error": cls.__name__, "message": "boom",
+        }
+
+
+def test_per_code_error_counts_surface_in_stats():
+    eng = fake_engine()
+    try:
+        with pytest.raises(InvalidSequenceError):
+            eng.submit("ACXZ")
+        with pytest.raises(RequestTooLongError):
+            eng.submit(seq_of(17))
+        with pytest.raises(InvalidSequenceError):
+            eng.submit("")
+        errors = eng.stats()["errors"]
+        assert errors["invalid_sequence"] == 2
+        assert errors["request_too_long"] == 1
+    finally:
+        eng.shutdown()
+    with pytest.raises(EngineClosedError):
+        eng.submit(seq_of(4))
+    assert eng.stats()["errors"]["engine_closed"] == 1

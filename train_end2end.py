@@ -27,15 +27,22 @@ from alphafold2_tpu.training import (
     DataConfig,
     E2EConfig,
     TrainConfig,
+    add_resilience_args,
     add_train_args,
+    chaos_from_args,
     tcfg_from_args,
     e2e_loss_fn,
     e2e_train_state_init,
     finish,
     make_train_step,
     open_or_init,
+    resilient_batches,
+    resilient_mode,
+    run_resilient,
     stack_microbatches,
+    synthetic_microbatch_fn,
     synthetic_structure_batches,
+    with_fault_injection,
 )
 
 
@@ -94,6 +101,7 @@ def main():
                     default="synthetic")
     ap.add_argument("--ckpt-dir", default=None, help="checkpoint/resume directory")
     ap.add_argument("--ckpt-every", type=int, default=25)
+    add_resilience_args(ap)  # --max-restarts / --ckpt-verify / --fault-plan
     ap.add_argument("--eval-every", type=int, default=0, help="0 = no eval")
     ap.add_argument("--metrics-jsonl", default=None, help="JSONL metrics stream")
     ap.add_argument("--profile-dir", default=None, help="jax.profiler trace dir")
@@ -147,9 +155,12 @@ def main():
         seed=args.seed,
     )
 
+    resilient = resilient_mode(args)
+    injector, ckpt_fault_hook, max_restarts = chaos_from_args(args)
     mgr, state, resumed = open_or_init(
         args.ckpt_dir, e2e_train_state_init, jax.random.PRNGKey(args.seed), ecfg, tcfg,
-        save_every=args.ckpt_every,
+        save_every=args.ckpt_every, verify=args.ckpt_verify,
+        fault_hook=ckpt_fault_hook,
     )
 
     it = None
@@ -229,12 +240,20 @@ def main():
     if args.trunk_segments and not args.reversible:
         raise SystemExit("--trunk-segments requires --reversible (segment "
                          "backward IS reversible reconstruction)")
+    if resilient and args.trunk_segments:
+        raise SystemExit("--max-restarts/--fault-plan and --trunk-segments "
+                         "are exclusive: the segmented chain donates state "
+                         "internally, which invalidates the supervisor's "
+                         "rollback reference")
     if args.sp_shards:
         from alphafold2_tpu.parallel import make_mesh, make_sp_train_step, sp_e2e_loss_fn
 
         mesh = make_mesh({"seq": args.sp_shards})
+        # the resilient supervisor keeps a rollback reference to the
+        # pre-step state, so donation must be off under it
         train_step = make_sp_train_step(
-            ecfg, tcfg, mesh, loss_fn=sp_e2e_loss_fn(mesh)
+            ecfg, tcfg, mesh, loss_fn=sp_e2e_loss_fn(mesh),
+            donate_state=not resilient,
         )
     elif args.trunk_segments:
         # multi-execution step: each piece jits itself; the chain donates
@@ -245,8 +264,9 @@ def main():
                                                args.trunk_segments)
     else:
         # donated state: see train_pre.py — halves the live state footprint
+        # (the resilient supervisor needs the non-donating step)
         train_step = jax.jit(make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn),
-                             donate_argnums=(0,))
+                             donate_argnums=() if resilient else (0,))
 
     from alphafold2_tpu.training import predict_structure
     from alphafold2_tpu.utils import MetricsLogger, structure_eval
@@ -274,6 +294,52 @@ def main():
     profiling = False
 
     logger = MetricsLogger(jsonl_path=args.metrics_jsonl, print_every=10)
+
+    if resilient:
+        # supervised loop: StepGuard rollback + checkpoint-restore restarts
+        # + preemption-safe shutdown (+ the --fault-plan chaos hooks)
+        from alphafold2_tpu.reliability import Preempted, PreemptionHandler
+
+        if args.eval_every:
+            print("note: --eval-every is ignored under the resilient loop")
+        if args.profile_dir:
+            print("note: --profile-dir is ignored under the resilient loop")
+        if args.data == "synthetic" and args.features != "esm":
+            # step-indexed fetch: a retried/resumed step refetches the
+            # IDENTICAL batch, making recovery replay-exact (the esm
+            # feature wrapper is iterator-shaped, so it keeps `next`
+            # semantics)
+            source = synthetic_microbatch_fn(
+                dcfg, tcfg.grad_accum, source=synthetic_structure_batches
+            )
+        else:
+            source = batches
+        fetch = resilient_batches(source, injector=injector)
+        step_fn = with_fault_injection(train_step, injector)
+        handler = PreemptionHandler().install()
+        if injector is not None:
+            injector.bind_preemption(handler)
+        try:
+            state = run_resilient(
+                step_fn, state, fetch, steps=args.steps,
+                make_rng=lambda i: jax.random.fold_in(base_rng, i),
+                mgr=mgr, on_metrics=logger.log,
+                max_restarts=max_restarts, logger=logger,
+                preemption=handler,
+            )
+        except Preempted as e:
+            # checkpointed + closed by the loop; exit 0 — not a failure
+            print(e)
+            return
+        finally:
+            handler.uninstall()
+            logger.close()
+        if injector is not None and not injector.exhausted():
+            print(f"warning: fault plan only partially delivered: "
+                  f"{injector.delivered}")
+        print("done")
+        return
+
     try:
         for step in range(start, start + args.steps):
             if args.profile_dir and step == prof_beg and not profiling:

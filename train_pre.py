@@ -25,15 +25,22 @@ from alphafold2_tpu.utils import MetricsLogger
 from alphafold2_tpu.training import (
     DataConfig,
     TrainConfig,
+    add_resilience_args,
     add_train_args,
+    chaos_from_args,
     tcfg_from_args,
     finish,
     make_train_step,
     open_or_init,
+    resilient_batches,
+    resilient_mode,
+    run_resilient,
     sidechainnet_batches,
     stack_microbatches,
     synthetic_batches,
+    synthetic_microbatch_fn,
     train_state_init,
+    with_fault_injection,
 )
 
 
@@ -54,6 +61,7 @@ def main():
     )
     ap.add_argument("--ckpt-dir", default=None, help="checkpoint/resume directory")
     ap.add_argument("--ckpt-every", type=int, default=50)
+    add_resilience_args(ap)  # --max-restarts / --ckpt-verify / --fault-plan
     ap.add_argument("--metrics-log", default=None, help="JSONL metrics file")
     ap.add_argument("--eval-every", type=int, default=0,
                     help="evaluate held-out distogram loss every N steps "
@@ -101,9 +109,12 @@ def main():
     dcfg = DataConfig(batch_size=args.batch, max_len=args.max_len,
                       seed=args.seed)
 
+    resilient = resilient_mode(args)
+    injector, ckpt_fault_hook, max_restarts = chaos_from_args(args)
     mgr, state, resumed = open_or_init(
         args.ckpt_dir, train_state_init, jax.random.PRNGKey(args.seed), cfg, tcfg,
-        save_every=args.ckpt_every,
+        save_every=args.ckpt_every, verify=args.ckpt_verify,
+        fault_hook=ckpt_fault_hook,
     )
     start = int(state["step"])
 
@@ -195,14 +206,67 @@ def main():
         from alphafold2_tpu.parallel import make_mesh, make_sp_train_step
 
         mesh = make_mesh({"seq": args.sp_shards})
-        train_step = make_sp_train_step(cfg, tcfg, mesh)
+        # the resilient supervisor keeps a rollback reference to the
+        # pre-step state, so donation must be off under it
+        train_step = make_sp_train_step(cfg, tcfg, mesh,
+                                        donate_state=not resilient)
     else:
         # donate the input state: without donation both the input and output
         # copies of (params + optimizer state) are live across every step
         # (~2x the state footprint; bench.py does the same). run_resilient
-        # would need a non-donating step — the CLI loop does not roll back.
-        train_step = jax.jit(make_train_step(cfg, tcfg), donate_argnums=(0,))
+        # needs the non-donating step — it keeps the rollback state alive.
+        train_step = jax.jit(
+            make_train_step(cfg, tcfg),
+            donate_argnums=() if resilient else (0,),
+        )
     logger = MetricsLogger(args.metrics_log)
+
+    if resilient:
+        # supervised loop: StepGuard rollback + checkpoint-restore restarts
+        # + preemption-safe shutdown (+ the --fault-plan chaos hooks)
+        from alphafold2_tpu.reliability import Preempted, PreemptionHandler
+
+        if args.eval_every:
+            print("note: --eval-every is ignored under the resilient loop")
+        if args.data == "synthetic":
+            # step-indexed fetch: a retried/resumed step refetches the
+            # IDENTICAL batch, making recovery replay-exact
+            source = synthetic_microbatch_fn(dcfg, tcfg.grad_accum)
+        else:
+            def stream():
+                for b in batches:
+                    b.pop("bucket", None)  # shape bookkeeping, not input
+                    yield b
+
+            source = stream()
+        fetch = resilient_batches(source, injector=injector)
+        base_rng = jax.random.fold_in(jax.random.PRNGKey(args.seed), 1)
+        step_fn = with_fault_injection(train_step, injector)
+        handler = PreemptionHandler().install()
+        if injector is not None:
+            injector.bind_preemption(handler)
+        if resumed:
+            print(f"resumed from step {start} in {args.ckpt_dir}")
+        try:
+            state = run_resilient(
+                step_fn, state, fetch, steps=args.steps,
+                make_rng=lambda i: jax.random.fold_in(base_rng, i),
+                mgr=mgr, on_metrics=logger.log,
+                max_restarts=max_restarts, logger=logger,
+                preemption=handler,
+            )
+        except Preempted as e:
+            # checkpointed + closed by the loop; exit 0 — not a failure
+            print(e)
+            return
+        finally:
+            handler.uninstall()
+            logger.close()
+        if injector is not None and not injector.exhausted():
+            print(f"warning: fault plan only partially delivered: "
+                  f"{injector.delivered}")
+        print("done")
+        return
 
     eval_batch, eval_loss_fn, eval_key = None, None, "eval_loss"
     if args.eval_every:
