@@ -17,6 +17,9 @@ finding carrying the exception head. Registered targets:
   reliability.* fault-plan parse/roundtrip, circuit-breaker transitions,
                verified-checkpoint save/restore (host-side construction
                checks — same gate, no shapes involved)
+  telemetry.*  span tracer + chrome export, metric registry + Prometheus
+               round-trip, regression-gate verdicts (host-side, like
+               reliability.*)
   presets.*    e2e train-state init for every tier; full e2e loss (fwd +
               structure module) at smoke shapes
 
@@ -264,6 +267,48 @@ def _targets() -> Dict[str, Callable[[], None]]:
             assert mgr.latest_step() == 1
             out = mgr.restore()
             np.testing.assert_array_equal(out["params"]["w"], state["params"]["w"])
+
+    # --- telemetry ----------------------------------------------------------
+    # host-side like the reliability targets: an import- or construction-
+    # time break in the observability layer must surface in the cheap gate
+    @register("telemetry.trace")
+    def _telemetry_trace():
+        from alphafold2_tpu.telemetry import NULL_TRACER, Tracer
+
+        t = Tracer()
+        with t.span("outer", cat="smoke", k=1):
+            with t.span("inner"):
+                pass
+        events = t.chrome_trace()["traceEvents"]
+        assert any(e["ph"] == "X" and e["name"] == "outer" for e in events)
+        assert t.summary()["inner"]["count"] == 1
+        # disabled fast path returns the shared no-op singleton
+        assert NULL_TRACER.span("a") is NULL_TRACER.span("b")
+
+    @register("telemetry.registry")
+    def _telemetry_registry():
+        from alphafold2_tpu.telemetry import (
+            MetricRegistry,
+            parse_prometheus_text,
+        )
+
+        r = MetricRegistry()
+        r.counter("smoke_total", outcome="ok").inc(2)
+        r.gauge("smoke_depth").set(3)
+        r.histogram("smoke_seconds").observe(0.5)
+        parsed = parse_prometheus_text(r.to_prometheus())
+        assert parsed[("smoke_total", (("outcome", "ok"),))] == 2.0
+
+    @register("telemetry.check")
+    def _telemetry_check():
+        from alphafold2_tpu.telemetry.check import check
+
+        ok, _ = check({"metric": "smoke_steps_per_sec", "value": 1.0},
+                      {"metric": "smoke_steps_per_sec", "value": 1.0})
+        assert ok
+        bad, rows = check({"metric": "smoke_steps_per_sec", "value": 0.5},
+                          {"metric": "smoke_steps_per_sec", "value": 1.0})
+        assert not bad and rows[0]["status"] == "regressed"
 
     # --- training presets ---------------------------------------------------
     def _preset_init(tier):

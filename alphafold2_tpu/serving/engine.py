@@ -70,6 +70,7 @@ from alphafold2_tpu.serving.errors import (
 )
 from alphafold2_tpu.serving.metrics import ServingMetrics
 from alphafold2_tpu.serving.pipeline import predict_structure
+from alphafold2_tpu.telemetry import NULL_TRACER
 
 
 @dataclasses.dataclass(frozen=True)
@@ -222,10 +223,16 @@ class ServingEngine:
         of every model dispatch, INSIDE the watchdog and failure-isolation
         guards — an injected fault travels the exact path an organic one
         would. None (production) costs nothing.
+      tracer: optional `telemetry.Tracer` recording the request lifecycle
+        as spans — serving.enqueue (client thread), serving.queue_wait,
+        serving.batch / serving_compile / serving.execute /
+        serving.respond (worker thread). None (production default) wires
+        the no-op NULL_TRACER: one boolean test per phase, no records.
     """
 
     def __init__(self, params, model_cfg, cfg: ServingConfig = ServingConfig(),
-                 *, model_apply_fn=None, metrics_logger=None, fault_hook=None):
+                 *, model_apply_fn=None, metrics_logger=None, fault_hook=None,
+                 tracer=None):
         self._ladder = BucketLadder(cfg.buckets)
         if self._ladder.max_len > model_cfg.max_seq_len:
             raise ValueError(
@@ -268,8 +275,10 @@ class ServingEngine:
         # thundering herd of identical queries shares ONE computation
         self._inflight = {}
         self._inflight_lock = threading.Lock()
+        self._tracer = tracer if tracer is not None else NULL_TRACER
         self.metrics = ServingMetrics(
-            latency_window=cfg.latency_window, logger=metrics_logger
+            latency_window=cfg.latency_window, logger=metrics_logger,
+            tracer=self._tracer,
         )
 
         self._closed = False
@@ -296,6 +305,18 @@ class ServingEngine:
         RequestTooLongError / QueueFullError / CircuitOpenError
         synchronously — a rejected request never occupies queue capacity.
         """
+        # the span wraps validation + cache/coalesce lookup + enqueue; a
+        # rejection exits it with an `error` attribute, so the trace shows
+        # rejected submissions as first-class lifecycle events
+        with self._tracer.span("serving.enqueue", cat="serving",
+                               length=len(seq)) as sp:
+            req = self._submit(seq, msa=msa, msa_mask=msa_mask,
+                               timeout=timeout)
+            sp.set("bucket", req.bucket)
+            return req
+
+    def _submit(self, seq: str, *, msa=None, msa_mask=None,
+                timeout: Optional[float] = None) -> ServingRequest:
         if self._closed:
             self._reject(EngineClosedError("engine is shut down"))
         seq = seq.strip().upper()
@@ -445,6 +466,14 @@ class ServingEngine:
         snap["closed"] = self._closed
         if self._breaker is not None:
             snap["breaker"] = self._breaker.snapshot()
+        # the unified telemetry view: every registry metric (per-bucket
+        # compile count/seconds gauges included) plus per-phase span
+        # aggregates; empty-but-present under the no-op tracer so stats
+        # consumers need no feature detection
+        snap["telemetry"] = {
+            "metrics": self.metrics.registry.snapshot(),
+            "spans": self._tracer.summary(),
+        }
         return snap
 
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
@@ -522,22 +551,26 @@ class ServingEngine:
             s_key = jax.ShapeDtypeStruct(
                 self._base_key.shape, self._base_key.dtype
             )
-            t0 = time.perf_counter()
-            if rows:
-                s_msa = jax.ShapeDtypeStruct((B, rows, bucket), np.int32)
-                s_msam = jax.ShapeDtypeStruct((B, rows, bucket), np.bool_)
-                exe = (
-                    jax.jit(run)
-                    .lower(self._params, s_tok, s_mask, s_key, s_msa, s_msam)
-                    .compile()
-                )
-            else:
-                exe = (
-                    jax.jit(run)
-                    .lower(self._params, s_tok, s_mask, s_key)
-                    .compile()
-                )
-            self.metrics.record_compile(bucket, time.perf_counter() - t0)
+            # compile_span: per-bucket compile counter + wall-seconds
+            # gauges in the registry, and one `serving_compile` span
+            with self.metrics.compile_span(bucket):
+                if rows:
+                    s_msa = jax.ShapeDtypeStruct((B, rows, bucket), np.int32)
+                    s_msam = jax.ShapeDtypeStruct(
+                        (B, rows, bucket), np.bool_
+                    )
+                    exe = (
+                        jax.jit(run)
+                        .lower(self._params, s_tok, s_mask, s_key, s_msa,
+                               s_msam)
+                        .compile()
+                    )
+                else:
+                    exe = (
+                        jax.jit(run)
+                        .lower(self._params, s_tok, s_mask, s_key)
+                        .compile()
+                    )
             self._executables[bucket] = exe
             return exe
 
@@ -570,7 +603,15 @@ class ServingEngine:
         def call():
             if self._fault_hook is not None:
                 self._fault_hook(idx, bucket)
-            return self._call_executable(bucket, tokens, mask, msa, msa_mask)
+            # the execute span covers device dispatch + (first-call)
+            # compile; compile time is separately visible under the
+            # nested `serving_compile` span, so execute-minus-compile is
+            # readable straight off the trace
+            with self._tracer.span("serving.execute", cat="serving",
+                                   bucket=bucket, dispatch=idx):
+                return self._call_executable(
+                    bucket, tokens, mask, msa, msa_mask
+                )
 
         timeout = self.cfg.watchdog_timeout_s
         if timeout is None:
@@ -719,7 +760,25 @@ class ServingEngine:
             self._breaker.abandon_probe()
         if not live:
             return
+        if not allow_split:
+            # per-request poison-isolation retry: it re-enters here from
+            # INSIDE the parent batch's serving.batch span — recording a
+            # second queue_wait/batch span per request would double-count
+            # the phase aggregates this subsystem exists to report
+            self._run_live(bucket, live, allow_split)
+            return
+        if self._tracer.enabled:
+            # queue phase, measured from each member's submit timestamp
+            # (monotonic deltas; recorded as ending now on the tracer clock)
+            for req in live:
+                self._tracer.add("serving.queue_wait",
+                                 now - req.submitted_at, cat="serving",
+                                 bucket=bucket)
+        with self._tracer.span("serving.batch", cat="serving", bucket=bucket,
+                               n=len(live)):
+            self._run_live(bucket, live, allow_split)
 
+    def _run_live(self, bucket: int, live, allow_split: bool):
         try:
             # batch assembly sits INSIDE the guard: a request that breaks
             # host-side padding must fail like one that breaks the model
@@ -766,6 +825,12 @@ class ServingEngine:
         if self._breaker is not None:
             self._breaker.record_success()
         done_at = time.monotonic()
+        with self._tracer.span("serving.respond", cat="serving",
+                               bucket=bucket, n=len(live)):
+            self._respond(bucket, live, coords, conf, stress, n_real,
+                          done_at)
+
+    def _respond(self, bucket, live, coords, conf, stress, n_real, done_at):
         for i, req in enumerate(live):
             L = req.length
             # copies, not views: a view would both pin the whole

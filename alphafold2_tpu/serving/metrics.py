@@ -1,20 +1,41 @@
 """Serving metrics: counters, batch occupancy, latency quantiles, compiles.
 
-Built on `utils.observability` — `LatencyHistogram` provides the
-sliding-window p50/p95/p99, and an optional `MetricsLogger` streams one
-record per dispatched batch to stdout/JSONL with the same cadence
-contract training uses. `snapshot()` returns a plain-JSON dict, which is
-the engine's health-check payload (`ServingEngine.stats()`).
+Rebuilt on the telemetry subsystem (`alphafold2_tpu.telemetry`): every
+count lives in a `MetricRegistry` — Prometheus-exposable, uniformly
+named — instead of the ad-hoc dicts this module used to keep:
+
+  requests:  counter `serving_requests_total{outcome=...}`
+  errors:    counter `serving_errors_total{code=...}`
+  batches:   counters `serving_batches_total` /
+             `serving_batch_requests_total`
+  compiles:  counter `serving_compile_total{bucket=...}` + gauges
+             `serving_compile_seconds_total` / `serving_compile_last_seconds`
+             (via `telemetry.CompileTracker`)
+  latency:   histogram `serving_request_latency_seconds`
+             (sliding-window p50/p95/p99)
+
+`snapshot()` keeps its pre-registry JSON shape — it is the engine's
+health-check payload (`ServingEngine.stats()`) and the chaos suite
+asserts on it — and additionally exposes the registry under
+`stats()["telemetry"]` (engine-side). An optional `MetricsLogger`
+streams one record per dispatched batch, same cadence contract as
+training.
 """
 
 from __future__ import annotations
 
 import collections
+import contextlib
 import threading
 import time
 from typing import Optional
 
-from alphafold2_tpu.utils.observability import LatencyHistogram, MetricsLogger
+from alphafold2_tpu.telemetry import (
+    NULL_TRACER,
+    CompileTracker,
+    MetricRegistry,
+    MetricsLogger,
+)
 
 # request-terminal counter names; everything submitted eventually lands in
 # exactly one of these (or stays in flight)
@@ -33,21 +54,51 @@ class ServingMetrics:
     """Thread-safe counters + histograms for one engine instance."""
 
     def __init__(self, latency_window: int = 2048,
-                 logger: Optional[MetricsLogger] = None):
-        self._lock = threading.Lock()
-        self._counts = {name: 0 for name in _COUNTERS}
-        self.latency = LatencyHistogram(window=latency_window)
-        self._batches = 0
-        self._batch_requests = 0
+                 logger: Optional[MetricsLogger] = None,
+                 registry: Optional[MetricRegistry] = None,
+                 tracer=NULL_TRACER):
+        self.registry = registry if registry is not None else MetricRegistry(
+            histogram_window=latency_window
+        )
+        # one lock over the terminal counters: a stats() reader must see a
+        # CONSISTENT view (submit() counts `submitted` before enqueue so
+        # in_flight can never read negative — per-counter locks alone
+        # would reopen that window between two reads)
+        self._counts_lock = threading.Lock()
+        self._counts = {
+            name: self.registry.counter(
+                "serving_requests_total",
+                help="request-terminal outcomes", outcome=name)
+            for name in _COUNTERS
+        }
+        self._errors_lock = threading.Lock()
+        self._errors = {}  # stable error code -> Counter (serving/errors.py)
+        self.latency = self.registry.histogram(
+            "serving_request_latency_seconds",
+            help="submit->complete latency, sliding window",
+        )
+        self._batches = self.registry.counter(
+            "serving_batches_total", help="dispatched batches")
+        self._batch_requests = self.registry.counter(
+            "serving_batch_requests_total",
+            help="real requests across dispatched batches")
+        self._recent_lock = threading.Lock()
         self._recent_batch_sizes = collections.deque(maxlen=256)
-        self._compiles = {}  # bucket -> seconds spent compiling
-        self._errors = {}    # stable error code -> count (serving/errors.py)
+        self._compiles_lock = threading.Lock()
+        self._compile_seconds = {}  # bucket -> seconds gauge (snapshot view)
+        # prefix "serving_compile": the tracker's `<prefix>_seconds_total`
+        # gauge is the SAME registry object compile_span registers in
+        # `_compile_seconds` (identity = name + labels), so the snapshot's
+        # per-bucket seconds view and the exposition never diverge
+        self.compile_tracker = CompileTracker(
+            self.registry, tracer=tracer, prefix="serving_compile"
+        )
         self._logger = logger
         self._t0 = time.monotonic()
 
     def inc(self, name: str, n: int = 1):
-        with self._lock:
-            self._counts[name] += n
+        with self._counts_lock:
+            self._counts[name].inc(n)
 
     def inc_error(self, code_or_exc, n: int = 1):
         """Count one error by its stable code. Accepts a code string or a
@@ -55,17 +106,24 @@ class ServingMetrics:
         terminal failure and submit-time rejection lands here, keyed the
         way ops dashboards and the circuit breaker see the world."""
         code = getattr(code_or_exc, "code", code_or_exc)
-        with self._lock:
-            self._errors[code] = self._errors.get(code, 0) + n
+        with self._errors_lock:
+            counter = self._errors.get(code)
+            if counter is None:
+                counter = self.registry.counter(
+                    "serving_errors_total",
+                    help="terminal failures and rejections by stable code",
+                    code=code)
+                self._errors[code] = counter
+        counter.inc(n)
 
     def observe_batch(self, n_real: int, max_batch: int, latency_s: float):
         """One dispatched batch: n_real real requests of max_batch slots;
         latency_s is the oldest member's submit->complete latency."""
-        with self._lock:
-            self._batches += 1
-            self._batch_requests += n_real
+        self._batches.inc()
+        self._batch_requests.inc(n_real)
+        with self._recent_lock:
             self._recent_batch_sizes.append(n_real)
-            step = self._batches
+        step = int(self._batches.value)
         if self._logger is not None:
             self._logger.log(step, {
                 "batch_requests": n_real,
@@ -73,28 +131,59 @@ class ServingMetrics:
                 "batch_latency_s": latency_s,
             })
 
+    @contextlib.contextmanager
+    def compile_span(self, bucket: int):
+        """Context manager around one bucket compile: registry counters +
+        gauges + a `serving_compile` span, and the per-bucket seconds
+        view `snapshot()` reports. The bucket is registered in that view
+        only AFTER the compile succeeds — a failed or still-in-flight
+        compile must not read as a compiled bucket (`compile_count` backs
+        the <= len(buckets) invariant)."""
+        with self.compile_tracker.track(bucket=str(bucket)):
+            yield
+        gauge = self.registry.gauge(
+            "serving_compile_seconds_total",
+            help="cumulative compile wall seconds", bucket=str(bucket))
+        with self._compiles_lock:
+            self._compile_seconds[bucket] = gauge
+
     def record_compile(self, bucket: int, seconds: float):
-        with self._lock:
-            self._compiles[bucket] = self._compiles.get(bucket, 0.0) + seconds
+        """Back-compat direct recording (pre-tracker callers/tests)."""
+        gauge = self.registry.gauge(
+            "serving_compile_seconds_total",
+            help="cumulative compile wall seconds", bucket=str(bucket))
+        gauge.inc(seconds)
+        self.registry.counter(
+            "serving_compile_total", help="compile events",
+            bucket=str(bucket)).inc()
+        with self._compiles_lock:
+            self._compile_seconds[bucket] = gauge
 
     @property
     def compile_count(self) -> int:
-        with self._lock:
-            return len(self._compiles)
+        """Distinct compiled buckets (the <= len(buckets) invariant)."""
+        with self._compiles_lock:
+            return len(self._compile_seconds)
 
     def snapshot(self, max_batch: int) -> dict:
-        with self._lock:
-            counts = dict(self._counts)
-            batches = self._batches
-            batch_requests = self._batch_requests
+        with self._counts_lock:
+            counts = {name: int(c.value) for name, c in self._counts.items()}
+        batches = int(self._batches.value)
+        batch_requests = int(self._batch_requests.value)
+        with self._recent_lock:
             recent = list(self._recent_batch_sizes)
-            compiles = dict(self._compiles)
-            errors = dict(self._errors)
-            uptime = time.monotonic() - self._t0
+        with self._compiles_lock:
+            compiles = {b: g.value for b, g in self._compile_seconds.items()}
+        with self._errors_lock:
+            errors = {code: int(c.value) for code, c in self._errors.items()}
+        uptime = time.monotonic() - self._t0
         in_flight = (
             counts["submitted"] - counts["completed"]
             - counts["failed"] - counts["timed_out"]
         )
+        latency = self.latency.snapshot()
+        latency.pop("sum", None)  # lifetime sum is exposition detail, not
+        #                           part of the health-check payload shape
         return {
             "uptime_s": uptime,
             "requests": {**counts, "in_flight": in_flight},
@@ -113,5 +202,5 @@ class ServingMetrics:
                 "seconds_by_bucket": {str(k): v for k, v in compiles.items()},
             },
             "errors": errors,
-            "latency": self.latency.snapshot(),
+            "latency": latency,
         }

@@ -1,0 +1,98 @@
+"""Telemetry subsystem: tracing, metrics, profiling hooks, regression gate.
+
+One unified observability layer (ISSUE 4; ScaleFold arxiv 2404.11068 and
+ParaFold arxiv 2111.06340 both credit phase-level measurement for their
+scaling results):
+
+  * `trace`     — span-based tracer; Chrome trace-event / Perfetto JSON
+                  and JSONL exporters; `NULL_TRACER` no-op default.
+  * `registry`  — counters / gauges / histograms with Prometheus text
+                  exposition and JSON snapshots; `LatencyHistogram` lives
+                  here now.
+  * `logger`    — `MetricsLogger`, the step-cadence JSONL stream
+                  (migrated from utils/observability.py).
+  * `profiling` — compile-event tracking, device-memory / analytic-FLOPs
+                  gauges, the jax.profiler `profile_trace` wrapper.
+  * `check`     — perf-regression gate CLI
+                  (`python -m alphafold2_tpu.telemetry.check`).
+
+Everything is disabled-by-default at the call sites: an engine or
+trainer built without a tracer/registry runs the shared no-op singletons
+and pays one boolean test per instrumentation point.
+
+docs/OBSERVABILITY.md is the operator guide (span taxonomy, metric
+names, how to open traces, how the gate reads baselines).
+"""
+
+from alphafold2_tpu.telemetry.logger import MetricsLogger
+from alphafold2_tpu.telemetry.profiling import (
+    CompileTracker,
+    device_memory_gauges,
+    flops_gauges,
+    profile_trace,
+)
+from alphafold2_tpu.telemetry.registry import (
+    NULL_REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    LatencyHistogram,
+    MetricRegistry,
+    flatten_snapshot,
+    parse_prometheus_text,
+)
+from alphafold2_tpu.telemetry.trace import NULL_TRACER, Tracer
+
+
+def add_telemetry_args(ap):
+    """The telemetry argparse block shared by train_pre.py,
+    train_end2end.py, serve.py, and predict.py — one place to add the
+    next knob."""
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write a Chrome trace-event JSON of this run's "
+                         "phase spans here (open in Perfetto / "
+                         "chrome://tracing); tracing is off (near-zero "
+                         "cost) when unset")
+    ap.add_argument("--trace-max-spans", type=int, default=100_000,
+                    help="span retention bound; overflow is counted, "
+                         "not silently discarded")
+
+
+def tracer_from_args(args) -> Tracer:
+    """A live tracer when --trace-out was given, NULL_TRACER otherwise."""
+    if getattr(args, "trace_out", None):
+        return Tracer(enabled=True, max_spans=args.trace_max_spans)
+    return NULL_TRACER
+
+
+def finish_trace(tracer: Tracer, args):
+    """Export the trace at the end of a CLI run (no-op without
+    --trace-out)."""
+    if getattr(args, "trace_out", None) and tracer.enabled:
+        tracer.export_chrome(args.trace_out)
+        n = tracer.span_count
+        print(f"wrote {args.trace_out} ({n} span(s)"
+              + (f", {tracer.dropped} dropped" if tracer.dropped else "")
+              + ")")
+
+
+__all__ = [
+    "CompileTracker",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "LatencyHistogram",
+    "MetricRegistry",
+    "MetricsLogger",
+    "NULL_REGISTRY",
+    "NULL_TRACER",
+    "Tracer",
+    "add_telemetry_args",
+    "device_memory_gauges",
+    "finish_trace",
+    "flatten_snapshot",
+    "flops_gauges",
+    "parse_prometheus_text",
+    "profile_trace",
+    "tracer_from_args",
+]

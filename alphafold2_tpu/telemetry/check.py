@@ -1,0 +1,232 @@
+"""Perf-regression gate: compare a bench snapshot against a baseline.
+
+``python -m alphafold2_tpu.telemetry.check --current BENCH_r05.json \
+      --baseline BENCH_r04.json``
+
+The repo accumulates perf artifacts (`BENCH_*.json` from the bench
+driver, `BASELINE.json`, `serve.py --stats-json` snapshots, raw
+`bench.py` stdout lines) but nothing ever FAILED when a hot path got
+slower. This gate turns those artifacts into an enforced contract:
+every metric present in both current and baseline is compared under a
+per-metric tolerance rule, and a regression beyond tolerance exits
+nonzero — CI-gateable, like `analysis --strict`.
+
+Accepted snapshot formats (auto-detected, see `load_metrics`):
+  * bench-driver artifact: {"n", "cmd", "parsed": {...}} — the `parsed`
+    result line is used;
+  * raw bench.py result line: {"metric": name, "value": v, ...extras};
+  * BASELINE.json: {"metric": ..., "published": {...}} — the `published`
+    table (may be empty: a baseline with nothing published gates
+    nothing and passes, loudly);
+  * any nested dict of numerics (engine stats / registry snapshots),
+    flattened to dotted paths.
+
+Direction is inferred from the metric name (`_RULES`, first match wins;
+override per-run with --rule); metrics with no inferable direction are
+reported informationally, never gated — a gate that guesses directions
+would fail builds on improvements.
+
+Exit codes: 0 = no regression (including "nothing comparable"),
+1 = at least one regression beyond tolerance, 2 = usage/artifact error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from alphafold2_tpu.telemetry.registry import flatten_snapshot
+
+#: (name glob, direction, default relative tolerance). First match wins.
+#: "higher" = bigger is better (a drop beyond tol is a regression);
+#: "lower" = smaller is better (a rise beyond tol is a regression);
+#: "ignore" = informational only. The ignore block comes FIRST: absolute
+#: volume counts (request/compile/observation counts, window sizes,
+#: lifetime sums, uptime) scale with how much traffic the snapshot saw,
+#: not with how fast the system was — gating them would fail comparisons
+#: between runs of different length at identical performance.
+_RULES: Tuple[Tuple[str, str, float], ...] = (
+    ("*count*", "ignore", 0.0),
+    ("*window*", "ignore", 0.0),
+    ("*.sum", "ignore", 0.0),
+    ("*total*", "ignore", 0.0),
+    ("*uptime*", "ignore", 0.0),
+    ("*steps_per_sec*", "higher", 0.10),
+    ("*per_sec*", "higher", 0.10),
+    ("*mfu*", "higher", 0.10),
+    ("*tflops*", "higher", 0.10),
+    ("*hit_rate*", "higher", 0.10),
+    ("*occupancy*", "higher", 0.10),
+    ("*vs_baseline*", "higher", 0.10),
+    ("*sec_per_step*", "lower", 0.15),
+    ("*sec_per_protein*", "lower", 0.15),
+    ("*latency*", "lower", 0.15),
+    ("*_seconds*", "lower", 0.15),
+    ("*.p50", "lower", 0.15),
+    ("*.p95", "lower", 0.25),
+    ("*.p99", "lower", 0.25),
+)
+
+
+def rule_for(name: str, rules=_RULES) -> Optional[Tuple[str, float]]:
+    low = name.lower()
+    for pattern, direction, tol in rules:
+        if fnmatch.fnmatch(low, pattern):
+            return direction, tol
+    return None
+
+
+def load_metrics(path_or_dict) -> Dict[str, float]:
+    """One snapshot (path or already-parsed dict) -> flat {name: float}."""
+    if isinstance(path_or_dict, dict):
+        d = path_or_dict
+    else:
+        with open(path_or_dict) as fh:
+            d = json.load(fh)
+        if not isinstance(d, dict):
+            raise ValueError(f"{path_or_dict}: expected a JSON object, got "
+                             f"{type(d).__name__}")
+    if isinstance(d.get("parsed"), dict):  # bench-driver artifact
+        d = d["parsed"]
+    if isinstance(d.get("published"), dict):  # BASELINE.json
+        d = d["published"]
+    if isinstance(d.get("metric"), str) and "value" in d:
+        # raw bench.py line: the headline value keys under its metric
+        # name; numeric extras (sec_per_step, mfu, ...) keep their keys
+        flat = {k: float(v) for k, v in d.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+                and k != "value"}
+        if isinstance(d["value"], (int, float)):
+            flat[d["metric"]] = float(d["value"])
+        return flat
+    return flatten_snapshot(d)
+
+
+def compare(current: Dict[str, float], baseline: Dict[str, float],
+            tolerance: Optional[float] = None,
+            rules=_RULES) -> List[dict]:
+    """Per-metric verdicts over the intersection of the two snapshots.
+
+    Each row: {metric, baseline, current, change (signed relative),
+    direction, tolerance, status} with status one of "ok" (within
+    tolerance or improved), "regressed", "ungated" (no direction rule).
+    Metrics present on one side only are omitted — the gate enforces
+    metrics, it does not enforce coverage (use --require-overlap for
+    that).
+    """
+    rows = []
+    for name in sorted(set(current) & set(baseline)):
+        base, cur = baseline[name], current[name]
+        rule = rule_for(name, rules)
+        if rule is not None and rule[0] == "ignore":
+            rule = None
+        change = (cur - base) / abs(base) if base else (
+            0.0 if cur == base else float("inf") if cur > base
+            else float("-inf")
+        )
+        if rule is None:
+            rows.append({"metric": name, "baseline": base, "current": cur,
+                         "change": change, "direction": None,
+                         "tolerance": None, "status": "ungated"})
+            continue
+        direction, tol = rule
+        if tolerance is not None:
+            tol = tolerance
+        # signed "badness": positive when moving the wrong way
+        bad = -change if direction == "higher" else change
+        status = "regressed" if bad > tol else "ok"
+        rows.append({"metric": name, "baseline": base, "current": cur,
+                     "change": change, "direction": direction,
+                     "tolerance": tol, "status": status})
+    return rows
+
+
+def check(current, baseline, tolerance: Optional[float] = None,
+          rules=_RULES) -> Tuple[bool, List[dict]]:
+    """Python API: (passed, rows). `current`/`baseline` are paths or
+    dicts in any accepted format."""
+    rows = compare(load_metrics(current), load_metrics(baseline),
+                   tolerance=tolerance, rules=rules)
+    return not any(r["status"] == "regressed" for r in rows), rows
+
+
+def _parse_rule(spec: str) -> Tuple[str, str, float]:
+    # "pattern=direction:tolerance", e.g. "*latency*=lower:0.2"
+    try:
+        pattern, rest = spec.split("=", 1)
+        direction, tol = rest.split(":", 1)
+        if direction not in ("higher", "lower", "ignore"):
+            raise ValueError
+        return pattern.lower(), direction, float(tol)
+    except ValueError:
+        raise SystemExit(
+            f"--rule {spec!r}: expected PATTERN=higher|lower|ignore:TOLERANCE"
+        ) from None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m alphafold2_tpu.telemetry.check",
+        description="perf-regression gate over bench/stats snapshots",
+    )
+    ap.add_argument("--current", required=True,
+                    help="snapshot under test (bench artifact, raw bench "
+                         "line, stats-json, ...)")
+    ap.add_argument("--baseline", required=True,
+                    help="reference snapshot (BASELINE.json / BENCH_*.json "
+                         "/ a previous stats-json)")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="override every rule's relative tolerance")
+    ap.add_argument("--rule", action="append", default=[],
+                    metavar="PATTERN=DIR:TOL",
+                    help="prepend a direction rule (first match wins), "
+                         "e.g. '*latency*=lower:0.2'; repeatable")
+    ap.add_argument("--require-overlap", action="store_true",
+                    help="fail (exit 1) when the snapshots share no gated "
+                         "metric — for CI lanes where silence means the "
+                         "bench broke")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    rules = tuple(_parse_rule(s) for s in args.rule) + _RULES
+    try:
+        passed, rows = check(args.current, args.baseline,
+                             tolerance=args.tolerance, rules=rules)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"telemetry.check: cannot load snapshots: {e}",
+              file=sys.stderr)
+        return 2
+
+    gated = [r for r in rows if r["direction"] is not None]
+    if args.format == "json":
+        print(json.dumps({"passed": passed, "results": rows}, indent=2))
+    else:
+        for r in rows:
+            mark = {"ok": "ok  ", "regressed": "FAIL", "ungated": "info"}
+            print(f"[{mark[r['status']]}] {r['metric']}: "
+                  f"{r['baseline']:g} -> {r['current']:g} "
+                  f"({r['change']:+.1%})"
+                  + (f" [{r['direction']} better, tol "
+                     f"{r['tolerance']:.0%}]" if r["direction"] else ""))
+        if not rows:
+            print("telemetry.check: no metric present in both snapshots; "
+                  "nothing gated")
+        elif not gated:
+            print("telemetry.check: no direction rule matched any shared "
+                  "metric; nothing gated")
+        print(f"telemetry.check: {'PASS' if passed else 'REGRESSION'} "
+              f"({len(gated)} gated, "
+              f"{sum(r['status'] == 'regressed' for r in rows)} regressed, "
+              f"{len(rows) - len(gated)} informational)")
+    if args.require_overlap and not gated:
+        print("telemetry.check: --require-overlap set and no gated overlap",
+              file=sys.stderr)
+        return 1
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,102 @@
+"""Step-cadence scalar logging (migrated from utils/observability.py).
+
+`MetricsLogger` is the training/serving JSONL stream: windowed steps/sec
+plus scalar metrics, one device fetch per log call. It predates the
+telemetry subsystem and keeps its exact stream format (curve-plotting
+scripts under scripts/ consume it); the registry/tracer carry the
+structured side.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import warnings
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+class MetricsLogger:
+    """Step-cadence scalar logging with throughput tracking."""
+
+    def __init__(self, jsonl_path: Optional[str] = None, print_every: int = 10):
+        self.jsonl_path = jsonl_path
+        self.print_every = print_every
+        self._file = open(jsonl_path, "a") if jsonl_path else None
+        self._t_last = time.perf_counter()
+        self._step_last: Optional[int] = None
+
+    @staticmethod
+    def _scalar(key: str, v) -> float:
+        """One metric value -> float. Non-scalar arrays are reduced to
+        their mean WITH a warning naming the key (historically this was a
+        bare `float(np.asarray(v))`, which raises an opaque TypeError on
+        any size>1 array); an empty array has no defensible scalar and
+        raises a clear error instead."""
+        arr = np.asarray(jax.device_get(v))
+        if arr.size == 1:
+            return float(arr.reshape(()))
+        if arr.size == 0:
+            raise ValueError(
+                f"metric {key!r} is an empty array (shape {arr.shape}); "
+                "log a scalar or a non-empty array"
+            )
+        warnings.warn(
+            f"metric {key!r} has shape {arr.shape}; logging its mean — "
+            "pass a scalar (or reduce explicitly) to silence this",
+            stacklevel=3,
+        )
+        return float(arr.mean())
+
+    def log(self, step: int, metrics: dict):
+        """Record metrics for `step`. Values may be jax arrays (fetched here,
+        one device sync per call) or plain numbers."""
+        now = time.perf_counter()
+        vals = {k: self._scalar(k, v) for k, v in metrics.items()}
+        # throughput only when the step actually advanced (a second log call
+        # at the same step — e.g. eval scores — must not zero it out)
+        if self._step_last is not None and step > self._step_last and now > self._t_last:
+            vals["steps_per_sec"] = (step - self._step_last) / (now - self._t_last)
+            self._t_last, self._step_last = now, step
+        elif self._step_last is None or step > self._step_last:
+            self._t_last, self._step_last = now, step
+
+        record = {"step": step, **{k: round(v, 6) for k, v in vals.items()}}
+        if self._file is not None:
+            self._file.write(json.dumps(record) + "\n")
+            self._file.flush()
+        if step % self.print_every == 0:
+            parts = "  ".join(f"{k} {v:.4f}" for k, v in vals.items())
+            print(f"step {step}  {parts}")
+        return vals
+
+    def event(self, step: int, kind: str, **fields):
+        """Structured non-scalar record (restart causes, preemptions,
+        config changes): JSON-serializable fields pass through verbatim —
+        no float coercion — into the same JSONL stream, tagged with
+        `"event"` so curve-plotting consumers can filter them out.
+        Always printed: events are rare and operationally load-bearing.
+        """
+        record = {"step": step, "event": kind, **fields}
+        if self._file is not None:
+            self._file.write(json.dumps(record) + "\n")
+            self._file.flush()
+        parts = "  ".join(f"{k}={v}" for k, v in fields.items())
+        print(f"step {step}  [{kind}]  {parts}")
+        return record
+
+    def close(self):
+        # idempotent: context-manager exit followed by an explicit close()
+        # (or two owners sharing one logger) must not hit a closed file
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,130 @@
+"""Profiling hooks: compile-event tracking, device-memory and FLOPs gauges.
+
+ScaleFold (arxiv 2404.11068) got its 10-hour AlphaFold training largely
+by measuring and then deleting per-step overheads; the biggest invisible
+overheads in this stack are XLA compiles (30+ s per serving bucket, once
+per shape) and device-memory pressure. This module makes both visible
+through the metric registry and the span tracer:
+
+  * `CompileTracker` — a context manager around any compile site
+    (the serving AOT cache, a trainer's warmup step): per-key compile
+    count + wall seconds as registry metrics, plus a `compile` span.
+  * `device_memory_gauges` — `device.memory_stats()` (TPU/GPU backends;
+    returns None on CPU) into `device_memory_bytes{kind=...}` gauges.
+  * `flops_gauges` — the analytic model-FLOP count from `utils/flops.py`
+    (XLA's own cost analysis undercounts scanned trunks ~100x) as gauges,
+    so MFU can be derived from any metrics scrape.
+  * `profile_trace` — the jax.profiler context manager (migrated from
+    utils/observability.py; re-exported there for back-compat).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax
+
+from alphafold2_tpu.telemetry.registry import MetricRegistry
+from alphafold2_tpu.telemetry.trace import NULL_TRACER, Tracer
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str, enabled: bool = True):
+    """Capture a jax.profiler trace (XLA device timelines included) into
+    `log_dir` for the enclosed step window; view with TensorBoard's profile
+    plugin or Perfetto."""
+    if not enabled:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class CompileTracker:
+    """Compile-event accounting around an AOT cache or a jit warmup.
+
+    ``with tracker.track(bucket=256): exe = jit(f).lower(...).compile()``
+    lands, per label set:
+      * counter  `<prefix>_total`          — COMPLETED compile events
+      * gauge    `<prefix>_seconds_total`  — cumulative wall seconds
+      * gauge    `<prefix>_last_seconds`   — most recent compile
+      * counter  `<prefix>_failed_total`   — compiles that raised
+    and one `compile` span (cat="compile") on the tracer. A failed
+    compile (XLA OOM, lowering error) must not read as a completed one —
+    only the failure counter moves, and the span carries the `error`
+    attribute; the exception propagates unchanged.
+    """
+
+    def __init__(self, registry: MetricRegistry, tracer: Tracer = NULL_TRACER,
+                 prefix: str = "compile"):
+        self.registry = registry
+        self.tracer = tracer
+        self.prefix = prefix
+
+    @contextlib.contextmanager
+    def track(self, **labels):
+        with self.tracer.span(self.prefix, cat="compile", **labels):
+            t0 = time.perf_counter()
+            try:
+                yield
+            except BaseException:
+                self.registry.counter(
+                    f"{self.prefix}_failed_total",
+                    help="compile attempts that raised", **labels).inc()
+                raise
+            dt = time.perf_counter() - t0
+            self.registry.counter(
+                f"{self.prefix}_total",
+                help="completed compile events", **labels).inc()
+            self.registry.gauge(
+                f"{self.prefix}_seconds_total",
+                help="cumulative compile wall seconds", **labels).inc(dt)
+            self.registry.gauge(
+                f"{self.prefix}_last_seconds",
+                help="wall seconds of the most recent compile",
+                **labels).set(dt)
+
+
+def device_memory_gauges(registry: MetricRegistry,
+                         device=None) -> Optional[dict]:
+    """Record `device.memory_stats()` into gauges; returns the raw stats
+    dict, or None when the backend exposes none (CPU) — callers must not
+    treat absence as zero memory."""
+    dev = device if device is not None else jax.local_devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    if not stats:
+        return None
+    for kind, value in stats.items():
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            registry.gauge(
+                "device_memory_bytes",
+                help="device.memory_stats() fields",
+                device=str(dev.id), kind=str(kind),
+            ).set(float(value))
+    return dict(stats)
+
+
+def flops_gauges(registry: MetricRegistry, model_cfg, n: int, r: int, c: int,
+                 grad_accum: int = 1) -> dict:
+    """Analytic per-step FLOP gauges for the configured model workload
+    (pair side n, MSA r x c): `model_train_step_flops` and
+    `model_forward_flops`. Paired with a measured steps/sec these give
+    MFU without trusting XLA's scan-blind cost analysis."""
+    from alphafold2_tpu.utils.flops import model_fwd_flops, train_step_flops
+
+    fwd = model_fwd_flops(model_cfg, n, r, c)
+    step = train_step_flops(model_cfg, n, r, c, grad_accum=grad_accum)
+    registry.gauge(
+        "model_forward_flops",
+        help="analytic matmul FLOPs of one forward (utils/flops.py)",
+    ).set(fwd)
+    registry.gauge(
+        "model_train_step_flops",
+        help="analytic matmul FLOPs of one optimizer step",
+    ).set(step)
+    return {"forward_flops": fwd, "train_step_flops": step}

@@ -25,6 +25,8 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 
+from alphafold2_tpu.telemetry import NULL_TRACER
+
 
 class BadStepError(RuntimeError):
     """Raised when non-finite steps persist beyond the tolerated window."""
@@ -102,6 +104,7 @@ def run_resilient(
     max_consecutive_bad: int = 3,
     logger=None,
     preemption=None,
+    tracer=None,
 ):
     """Supervised training loop with rollback and checkpoint-restore retry.
 
@@ -133,9 +136,17 @@ def run_resilient(
         step boundary. On SIGTERM the loop force-saves the current state,
         drains the manager, and raises `Preempted` — the next run resumes
         bit-exact from that checkpoint.
+      tracer: optional telemetry.Tracer; each step becomes four phase
+        spans (train.fetch / train.step / train.metrics_fetch /
+        train.checkpoint) and every recovery episode a train.restore
+        span. NOTE on the split: the jitted step dispatches
+        asynchronously, so train.step measures dispatch and
+        train.metrics_fetch absorbs the device execution it waits on —
+        together they are the true step wall time.
 
     Returns the final state.
     """
+    tracer = tracer if tracer is not None else NULL_TRACER
     start = int(np.asarray(jax.device_get(state["step"])))
     target = start + steps
     restarts = 0
@@ -168,9 +179,11 @@ def run_resilient(
             from alphafold2_tpu.reliability.preemption import Preempted
 
             if mgr is not None:
-                mgr.save(state, force=True)
-                mgr.wait()
-                mgr.close()
+                with tracer.span("train.preempt_checkpoint",
+                                 cat="reliability", step=step):
+                    mgr.save(state, force=True)
+                    mgr.wait()
+                    mgr.close()
             if logger is not None:
                 logger.event(step, "preempted", signum=preemption.signum,
                              checkpointed=mgr is not None)
@@ -178,9 +191,13 @@ def run_resilient(
         if step >= target:
             break
         try:
-            batch = fetch(step)
-            new_state, metrics = step_fn(state, batch, make_rng(step))
-            state, ok = guard.check(new_state, metrics)
+            with tracer.span("train.fetch", cat="train", step=step):
+                batch = fetch(step)
+            with tracer.span("train.step", cat="train", step=step):
+                new_state, metrics = step_fn(state, batch, make_rng(step))
+            # the guard's finiteness check is the step's one device sync
+            with tracer.span("train.metrics_fetch", cat="train", step=step):
+                state, ok = guard.check(new_state, metrics)
             if ok:
                 # a successful step clears the restart budget: the limit is
                 # on CONSECUTIVE failures, not failures over the run's life
@@ -188,7 +205,9 @@ def run_resilient(
                 if on_metrics is not None:
                     on_metrics(step, metrics)
                 if mgr is not None:
-                    mgr.save(state)
+                    with tracer.span("train.checkpoint", cat="train",
+                                     step=step):
+                        mgr.save(state)
             else:
                 print(f"step {step}: non-finite loss — rolled back, retrying")
         except (BadStepError, KeyboardInterrupt):
@@ -204,15 +223,21 @@ def run_resilient(
                     f"restart budget exhausted (max_restarts="
                     f"{max_restarts}) at step {step}; cause chain: {chain}"
                 ) from e
-            if mgr is not None and mgr.latest_step() is not None:
-                from alphafold2_tpu.training.checkpoint import abstract_like
+            # the whole recovery episode is one reliability span: what
+            # killed the step, where the state came back from, how long
+            # the restore cost
+            with tracer.span("train.restore", cat="reliability", step=step,
+                             cause=type(e).__name__) as rsp:
+                if mgr is not None and mgr.latest_step() is not None:
+                    from alphafold2_tpu.training.checkpoint import abstract_like
 
-                state = mgr.restore(abstract_like(guard.good_state))
-                where = f"checkpoint step {int(np.asarray(state['step']))}"
-            else:
-                _assert_live(guard.good_state, "in-memory recovery state")
-                state = guard.good_state
-                where = "last good in-memory state"
+                    state = mgr.restore(abstract_like(guard.good_state))
+                    where = f"checkpoint step {int(np.asarray(state['step']))}"
+                else:
+                    _assert_live(guard.good_state, "in-memory recovery state")
+                    state = guard.good_state
+                    where = "last good in-memory state"
+                rsp.set("restored_from", where)
             guard.good_state = state
             guard.bad_streak = 0  # restored state is clean; stale NaN counts
             # from before the crash must not count against it

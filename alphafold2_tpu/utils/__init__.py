@@ -1,7 +1,10 @@
 """Utility layer: observability (metrics logging, profiling, eval).
 
 All new framework surface — the reference has no tracing, metrics, or eval
-wiring at all (SURVEY.md §5).
+wiring at all (SURVEY.md §5). The metrics/tracing primitives now live in
+`alphafold2_tpu.telemetry` (span tracer, metric registry, profiling
+hooks, regression gate); `utils.observability` re-exports the migrated
+names so existing imports keep working.
 """
 
 from alphafold2_tpu.utils.observability import (

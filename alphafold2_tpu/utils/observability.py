@@ -1,176 +1,31 @@
-"""Observability: metrics logging, step timing, profiler tracing, eval.
+"""Back-compat shim: metrics/tracing primitives moved to `telemetry/`.
 
-The reference has none of this — loss reaches the user through bare `print`
-once per optimizer step (reference train_pre.py:99, train_end2end.py:180),
-the structure-quality metrics exist only as library functions that no loop
-ever calls (reference utils.py:563-624), and there is no profiler hook
-anywhere (SURVEY.md §5). This module makes all three first-class:
+`MetricsLogger`, `LatencyHistogram`, and `profile_trace` grew into the
+unified telemetry subsystem (`alphafold2_tpu.telemetry`: span tracer,
+metric registry, profiling hooks, regression gate) and now live there;
+this module re-exports them so every existing
+`from alphafold2_tpu.utils.observability import ...` keeps working.
 
-  * `MetricsLogger` — windowed steps/sec + scalar metrics, streamed to
-    stdout and optionally a JSONL file (host-side, async-friendly: pass
-    jax arrays and they are fetched once per log call).
-  * `profile_trace` — context manager over `jax.profiler` emitting a
-    TensorBoard-loadable trace directory for a chosen step window.
-  * `structure_eval` — the reference's own quality metrics (RMSD, GDT-TS,
-    GDT-HA, TM-score) wired into an eval step over predicted vs true
-    coordinate clouds, Kabsch-aligned first.
+`structure_eval` stays here: it is structure-quality evaluation
+(geometry), not telemetry plumbing.
 """
 
 from __future__ import annotations
 
-import collections
-import contextlib
-import json
-import threading
-import time
-from typing import Optional
-
-import jax
 import jax.numpy as jnp
-import numpy as np
 
 from alphafold2_tpu.geometry import kabsch
 from alphafold2_tpu.geometry.metrics import GDT_HA_CUTOFFS, GDT_TS_CUTOFFS, gdt, rmsd, tmscore
+from alphafold2_tpu.telemetry.logger import MetricsLogger
+from alphafold2_tpu.telemetry.profiling import profile_trace
+from alphafold2_tpu.telemetry.registry import LatencyHistogram
 
-
-class MetricsLogger:
-    """Step-cadence scalar logging with throughput tracking."""
-
-    def __init__(self, jsonl_path: Optional[str] = None, print_every: int = 10):
-        self.jsonl_path = jsonl_path
-        self.print_every = print_every
-        self._file = open(jsonl_path, "a") if jsonl_path else None
-        self._t_last = time.perf_counter()
-        self._step_last: Optional[int] = None
-
-    def log(self, step: int, metrics: dict):
-        """Record metrics for `step`. Values may be jax arrays (fetched here,
-        one device sync per call) or plain numbers."""
-        now = time.perf_counter()
-        vals = {
-            k: float(np.asarray(jax.device_get(v))) for k, v in metrics.items()
-        }
-        # throughput only when the step actually advanced (a second log call
-        # at the same step — e.g. eval scores — must not zero it out)
-        if self._step_last is not None and step > self._step_last and now > self._t_last:
-            vals["steps_per_sec"] = (step - self._step_last) / (now - self._t_last)
-            self._t_last, self._step_last = now, step
-        elif self._step_last is None or step > self._step_last:
-            self._t_last, self._step_last = now, step
-
-        record = {"step": step, **{k: round(v, 6) for k, v in vals.items()}}
-        if self._file is not None:
-            self._file.write(json.dumps(record) + "\n")
-            self._file.flush()
-        if step % self.print_every == 0:
-            parts = "  ".join(f"{k} {v:.4f}" for k, v in vals.items())
-            print(f"step {step}  {parts}")
-        return vals
-
-    def event(self, step: int, kind: str, **fields):
-        """Structured non-scalar record (restart causes, preemptions,
-        config changes): JSON-serializable fields pass through verbatim —
-        no float coercion — into the same JSONL stream, tagged with
-        `"event"` so curve-plotting consumers can filter them out.
-        Always printed: events are rare and operationally load-bearing.
-        """
-        record = {"step": step, "event": kind, **fields}
-        if self._file is not None:
-            self._file.write(json.dumps(record) + "\n")
-            self._file.flush()
-        parts = "  ".join(f"{k}={v}" for k, v in fields.items())
-        print(f"step {step}  [{kind}]  {parts}")
-        return record
-
-    def close(self):
-        # idempotent: context-manager exit followed by an explicit close()
-        # (or two owners sharing one logger) must not hit a closed file
-        if self._file is not None:
-            self._file.close()
-            self._file = None
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
-        return False
-
-
-class LatencyHistogram:
-    """Streaming latency percentiles over a sliding window.
-
-    The serving engine (serving/metrics.py) needs request-latency
-    quantiles that (a) track the RECENT traffic mix, not the lifetime mix
-    — a bucket-ladder warmup with two 30 s compiles must age out of p99
-    once steady-state batches flow — and (b) cost O(window) memory
-    regardless of how many requests pass through. A bounded deque of the
-    last `window` observations gives both; percentiles are computed by
-    nearest-rank over a sorted snapshot (window is small, sorting at
-    snapshot time beats maintaining an order statistic per observe()).
-
-    Thread-safe: `observe` is called from the scheduler worker thread
-    while `snapshot` is called from health-check/stats readers.
-    """
-
-    def __init__(self, window: int = 2048):
-        if window <= 0:
-            raise ValueError(f"window must be positive, got {window}")
-        self._values = collections.deque(maxlen=window)
-        self._lock = threading.Lock()
-        self._count = 0  # lifetime observations (window evicts, this doesn't)
-        self._max = 0.0
-
-    def observe(self, value: float):
-        v = float(value)
-        with self._lock:
-            self._values.append(v)
-            self._count += 1
-            if v > self._max:
-                self._max = v
-
-    @staticmethod
-    def _percentile(ordered, q: float) -> float:
-        # nearest-rank on a pre-sorted list; q in [0, 100]
-        if not ordered:
-            return 0.0
-        idx = min(len(ordered) - 1, int(round(q / 100.0 * (len(ordered) - 1))))
-        return ordered[idx]
-
-    def percentile(self, q: float) -> float:
-        with self._lock:
-            ordered = sorted(self._values)
-        return self._percentile(ordered, q)
-
-    def snapshot(self) -> dict:
-        """Plain-float summary: count (lifetime), window stats, p50/p95/p99."""
-        with self._lock:
-            ordered = sorted(self._values)
-            count, vmax = self._count, self._max
-        return {
-            "count": count,
-            "window": len(ordered),
-            "mean": (sum(ordered) / len(ordered)) if ordered else 0.0,
-            "p50": self._percentile(ordered, 50.0),
-            "p95": self._percentile(ordered, 95.0),
-            "p99": self._percentile(ordered, 99.0),
-            "max": vmax,
-        }
-
-
-@contextlib.contextmanager
-def profile_trace(log_dir: str, enabled: bool = True):
-    """Capture a jax.profiler trace (XLA device timelines included) into
-    `log_dir` for the enclosed step window; view with TensorBoard's profile
-    plugin or Perfetto."""
-    if not enabled:
-        yield
-        return
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+__all__ = [
+    "LatencyHistogram",
+    "MetricsLogger",
+    "profile_trace",
+    "structure_eval",
+]
 
 
 def structure_eval(pred, true, mask=None):

@@ -5,7 +5,11 @@ chip — plus inference sec/protein (BASELINE.md operational target).
 
 Prints JSON lines {"metric", "value", "unit", "vs_baseline", ...extras};
 the LAST line is the result (the driver takes the last parseable stdout
-line). Lines are printed incrementally — cheap CPU smoke first, then each
+line). These lines (and the driver's BENCH_*.json wrappers) are the
+input format of the perf-regression gate — `python -m
+alphafold2_tpu.telemetry.check --current <new> --baseline <BENCH_rNN>`
+exits nonzero when a hot-path metric regressed beyond tolerance
+(docs/OBSERVABILITY.md). Lines are printed incrementally — cheap CPU smoke first, then each
 on-chip upgrade the moment it lands — so killing the process at any
 instant after ~90 s still leaves a parseable metric (round-3 postmortem:
 the artifact must be null-proof by construction). Total wall is clamped

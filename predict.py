@@ -86,6 +86,13 @@ def main():
                     help="run the trunk sequence-parallel over this many "
                          "devices (sequence length must be a multiple of "
                          "it; 0 = single-device)")
+    from alphafold2_tpu.telemetry import (
+        add_telemetry_args,
+        finish_trace,
+        tracer_from_args,
+    )
+
+    add_telemetry_args(ap)  # --trace-out / --trace-max-spans
     args = ap.parse_args()
 
     # single-client tunnel discipline AFTER argparse (--help must not
@@ -195,11 +202,29 @@ def main():
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
     )
 
-    if args.full_atom:
-        _predict_full_atom(args, cfg, tokens, seq_str, msa_tokens, msa_mask,
-                           embedds, templates, templates_mask)
-        return
+    tracer = tracer_from_args(args)  # NULL_TRACER unless --trace-out
 
+    # export-in-finally: a crashed prediction keeps its trace (same
+    # stance as the trainer loops)
+    try:
+        if args.full_atom:
+            _predict_full_atom(args, cfg, tokens, seq_str, msa_tokens,
+                               msa_mask, embedds, templates,
+                               templates_mask, tracer=tracer)
+            return
+        _predict_ca(args, cfg, tokens, seq_str, msa_tokens, msa_mask,
+                    embedds, templates, templates_mask, tracer)
+    finally:
+        finish_trace(tracer, args)
+
+
+def _predict_ca(args, cfg, tokens, seq_str, msa_tokens, msa_mask,
+                embedds, templates, templates_mask, tracer):
+    """sequence -> CA trace PDB (the reference README flow)."""
+    from alphafold2_tpu.geometry.pdb import coords_to_pdb
+    from alphafold2_tpu.training import TrainConfig, train_state_init
+
+    L = tokens.shape[1]
     from alphafold2_tpu.models import alphafold2_init
     from alphafold2_tpu.training import restore_params_for_inference
 
@@ -240,9 +265,12 @@ def main():
         # below reads them (same stance as serving/engine.py)
         return {k: out[k] for k in ("coords", "confidence", "stress")}
 
-    out = jax.jit(run)(params, tokens, msa_tokens, msa_mask, embedds,
-                       templates, templates_mask)
-    trace = np.asarray(out["coords"][0])  # (L, 3)
+    # one span per one-shot phase: compile+forward dominates, and the
+    # fetch (np.asarray) is what actually waits on the device
+    with tracer.span("predict.forward", cat="predict", length=L):
+        out = jax.jit(run)(params, tokens, msa_tokens, msa_mask, embedds,
+                           templates, templates_mask)
+        trace = np.asarray(out["coords"][0])  # (L, 3)
     print(f"MDS final stress: {float(out['stress'][0]):.4f}")
 
     # per-residue confidence from distogram entropy, written as B-factors
@@ -252,16 +280,21 @@ def main():
 
     # NOTE: geometric relaxation (scripts/refinement.py) operates on full
     # N/CA/C backbones; a CA-only trace has no bond structure to relax
-    coords_to_pdb(args.out, trace, sequence=seq_str, atom_names=("CA",),
-                  bfactors=100.0 * conf)
+    with tracer.span("predict.write_pdb", cat="predict", length=L):
+        coords_to_pdb(args.out, trace, sequence=seq_str, atom_names=("CA",),
+                      bfactors=100.0 * conf)
     print(f"wrote {args.out} ({L} residues)")
 
 
 def _predict_full_atom(args, cfg, tokens, seq_str, msa_tokens=None,
                        msa_mask=None, embedds=None, templates=None,
-                       templates_mask=None):
+                       templates_mask=None, tracer=None):
     """sequence -> refined 14-atom cloud -> N/CA/C/O backbone PDB."""
     import jax.numpy as jnp
+
+    from alphafold2_tpu.telemetry import NULL_TRACER
+
+    tracer = tracer if tracer is not None else NULL_TRACER
 
     from alphafold2_tpu.geometry.pdb import coords_to_pdb
     from alphafold2_tpu.models import RefinerConfig
@@ -298,15 +331,17 @@ def _predict_full_atom(args, cfg, tokens, seq_str, msa_tokens=None,
         # same host-side repeat training applies (train_end2end.py)
         embedds = np.repeat(np.asarray(embedds), 3, axis=1)
 
-    out = jax.jit(
-        lambda p, t, m, mm, e, tp, tpm: predict_structure(
-            p, ecfg, t, rng=jax.random.PRNGKey(args.seed),
-            msa=m, msa_mask=mm, embedds=e, templates=tp, templates_mask=tpm,
-            model_apply_fn=model_apply_fn,
-        )
-    )(params, tokens, msa_tokens, msa_mask, embedds, templates,
-      templates_mask)
-    backbone = np.asarray(out["refined"])[0, :, :4]  # N, CA, C, O slots
+    with tracer.span("predict.forward", cat="predict",
+                     length=int(tokens.shape[1]), full_atom=True):
+        out = jax.jit(
+            lambda p, t, m, mm, e, tp, tpm: predict_structure(
+                p, ecfg, t, rng=jax.random.PRNGKey(args.seed),
+                msa=m, msa_mask=mm, embedds=e, templates=tp,
+                templates_mask=tpm, model_apply_fn=model_apply_fn,
+            )
+        )(params, tokens, msa_tokens, msa_mask, embedds, templates,
+          templates_mask)
+        backbone = np.asarray(out["refined"])[0, :, :4]  # N, CA, C, O slots
 
     # per-residue confidence from distogram entropy -> B-factors (x100,
     # pLDDT-style). The distogram is over the 3x-elongated backbone-atom

@@ -134,9 +134,18 @@ def main():
                          "passes after the first exercise the result cache")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--stats-json", default=None,
-                    help="write the final stats snapshot here")
+                    help="write the final stats snapshot here (includes "
+                         "the telemetry section: registry metrics + "
+                         "per-phase span summaries)")
     ap.add_argument("--metrics-jsonl", default=None,
                     help="stream one record per dispatched batch here")
+    from alphafold2_tpu.telemetry import (
+        add_telemetry_args,
+        finish_trace,
+        tracer_from_args,
+    )
+
+    add_telemetry_args(ap)  # --trace-out / --trace-max-spans
     args = ap.parse_args()
 
     # single-client tunnel discipline AFTER argparse (--help must not
@@ -191,6 +200,7 @@ def main():
         if args.metrics_jsonl
         else None
     )
+    tracer = tracer_from_args(args)  # NULL_TRACER unless --trace-out
     engine = ServingEngine(
         params, cfg,
         ServingConfig(
@@ -210,6 +220,7 @@ def main():
             watchdog_timeout_s=args.watchdog_timeout,
         ),
         metrics_logger=logger,
+        tracer=tracer,
     )
 
     # --- replay: submit everything, honoring backpressure explicitly ----
@@ -276,6 +287,7 @@ def main():
     engine.shutdown(drain=True)
     if logger is not None:
         logger.close()
+    finish_trace(tracer, args)
     wall = time.time() - t0
 
     stats = engine.stats()

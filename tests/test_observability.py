@@ -68,7 +68,40 @@ def test_latency_histogram_empty():
     assert hist.percentile(99) == 0.0
     snap = hist.snapshot()
     assert snap == {"count": 0, "window": 0, "mean": 0.0, "p50": 0.0,
-                    "p95": 0.0, "p99": 0.0, "max": 0.0}
+                    "p95": 0.0, "p99": 0.0, "max": 0.0, "sum": 0.0}
+
+
+def test_metrics_logger_nonscalar_value_reduces_with_warning(tmp_path):
+    """A (batch,)-shaped metric used to die with an opaque TypeError deep
+    in float(); now it logs the mean and warns, naming the key."""
+    import pytest
+
+    path = str(tmp_path / "metrics.jsonl")
+    with MetricsLogger(jsonl_path=path, print_every=1) as logger:
+        with pytest.warns(UserWarning, match="per_item_loss"):
+            vals = logger.log(0, {"per_item_loss": np.array([1.0, 3.0])})
+    assert vals["per_item_loss"] == 2.0
+    assert json.loads(open(path).readline())["per_item_loss"] == 2.0
+
+
+def test_metrics_logger_empty_array_raises_naming_key():
+    import pytest
+
+    logger = MetricsLogger()
+    with pytest.raises(ValueError, match="empty_metric"):
+        logger.log(0, {"empty_metric": np.zeros((0,))})
+    logger.close()
+
+
+def test_observability_shim_reexports_telemetry():
+    """The migrated classes are the SAME objects under both import paths
+    (back-compat contract of the utils.observability shim)."""
+    from alphafold2_tpu import telemetry
+    from alphafold2_tpu.utils import observability
+
+    assert observability.MetricsLogger is telemetry.MetricsLogger
+    assert observability.LatencyHistogram is telemetry.LatencyHistogram
+    assert observability.profile_trace is telemetry.profile_trace
 
 
 def test_profile_trace_writes(tmp_path):

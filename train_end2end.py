@@ -23,6 +23,11 @@ import hostenv  # noqa: E402
 import jax  # noqa: E402
 
 from alphafold2_tpu.models import Alphafold2Config, RefinerConfig
+from alphafold2_tpu.telemetry import (
+    add_telemetry_args,
+    finish_trace,
+    tracer_from_args,
+)
 from alphafold2_tpu.training import (
     DataConfig,
     E2EConfig,
@@ -102,6 +107,7 @@ def main():
     ap.add_argument("--ckpt-dir", default=None, help="checkpoint/resume directory")
     ap.add_argument("--ckpt-every", type=int, default=25)
     add_resilience_args(ap)  # --max-restarts / --ckpt-verify / --fault-plan
+    add_telemetry_args(ap)   # --trace-out / --trace-max-spans
     ap.add_argument("--eval-every", type=int, default=0, help="0 = no eval")
     ap.add_argument("--metrics-jsonl", default=None, help="JSONL metrics stream")
     ap.add_argument("--profile-dir", default=None, help="jax.profiler trace dir")
@@ -294,6 +300,7 @@ def main():
     profiling = False
 
     logger = MetricsLogger(jsonl_path=args.metrics_jsonl, print_every=10)
+    tracer = tracer_from_args(args)  # NULL_TRACER unless --trace-out
 
     if resilient:
         # supervised loop: StepGuard rollback + checkpoint-restore restarts
@@ -325,7 +332,7 @@ def main():
                 make_rng=lambda i: jax.random.fold_in(base_rng, i),
                 mgr=mgr, on_metrics=logger.log,
                 max_restarts=max_restarts, logger=logger,
-                preemption=handler,
+                preemption=handler, tracer=tracer,
             )
         except Preempted as e:
             # checkpointed + closed by the loop; exit 0 — not a failure
@@ -334,6 +341,7 @@ def main():
         finally:
             handler.uninstall()
             logger.close()
+            finish_trace(tracer, args)  # a preempted run keeps its trace
         if injector is not None and not injector.exhausted():
             print(f"warning: fault plan only partially delivered: "
                   f"{injector.delivered}")
@@ -348,33 +356,43 @@ def main():
             # per-step key derived from the step index: identical schedule
             # whether the run is fresh or resumed
             step_rng = jax.random.fold_in(base_rng, step)
-            batch = next(batches)
-            state, metrics = train_step(state, batch, step_rng)
-            logger.log(step, metrics)
+            with tracer.span("train.fetch", cat="train", step=step):
+                batch = next(batches)
+            with tracer.span("train.step", cat="train", step=step):
+                state, metrics = train_step(state, batch, step_rng)
+            # logger.log is the step's device sync: this span absorbs the
+            # async-dispatched execution train.step only launched
+            with tracer.span("train.metrics_fetch", cat="train", step=step):
+                logger.log(step, metrics)
             if args.eval_every and (step + 1) % args.eval_every == 0:
                 # structure quality on the last microbatch (the reference's
                 # metrics library, finally wired into a loop)
-                mb = {k: v[-1] for k, v in batch.items()}
-                out = eval_fwd(
-                    state["params"], mb["seq"], mb["mask"], step_rng,
-                    mb.get("msa"), mb.get("msa_mask"), mb.get("embedds"),
-                )
-                b = mb["seq"].shape[0]
-                scores = structure_eval(
-                    out["refined"].reshape(b, -1, 3),
-                    mb["coords"].reshape(b, -1, 3),
-                    mask=out["cloud_mask"].reshape(b, -1),
-                )
+                with tracer.span("train.eval", cat="train", step=step):
+                    mb = {k: v[-1] for k, v in batch.items()}
+                    out = eval_fwd(
+                        state["params"], mb["seq"], mb["mask"], step_rng,
+                        mb.get("msa"), mb.get("msa_mask"), mb.get("embedds"),
+                    )
+                    b = mb["seq"].shape[0]
+                    scores = structure_eval(
+                        out["refined"].reshape(b, -1, 3),
+                        mb["coords"].reshape(b, -1, 3),
+                        mask=out["cloud_mask"].reshape(b, -1),
+                    )
                 logger.log(step, scores)  # into the JSONL stream too
                 print("eval  " + "  ".join(f"{k} {v:.4f}" for k, v in scores.items()))
             if mgr is not None:
-                mgr.save(state)  # orbax save_interval_steps gates the cadence
+                with tracer.span("train.checkpoint", cat="train", step=step):
+                    mgr.save(state)  # save_interval_steps gates the cadence
             if profiling and step + 1 >= prof_end:
                 jax.profiler.stop_trace()
                 profiling = False
     finally:
         if profiling:
             jax.profiler.stop_trace()
+        # a crashed or interrupted run keeps its trace — the moment it is
+        # most wanted (same stance as the resilient branch)
+        finish_trace(tracer, args)
     logger.close()
     finish(mgr, state)
     print("done")
