@@ -1,7 +1,7 @@
 """af2lint: in-repo static analysis for a JAX codebase that cannot afford
 runtime discovery of statically detectable breakage.
 
-Four passes, each a module in this package:
+Five passes, each a module in this package:
 
   * ``compat``   — AST linter: no `jax.experimental.*` access and no
                    drift-table symbol outside `alphafold2_tpu/compat.py`
@@ -16,7 +16,13 @@ Four passes, each a module in this package:
   * ``smoke``    — abstract interpretation: `jax.eval_shape` every public
                    op and training preset under abstract inputs — import-
                    and trace-time errors surface in seconds, zero FLOPs
-                   (abstract_smoke.py).
+                   (abstract_smoke.py);
+  * ``overlap``  — collective-schedule verification: lowers the
+                   overlapped multi-chip programs (double-buffered ring
+                   attention, SP trunk, backward-overlapped DP step) via
+                   `jax.export` and structurally asserts collectives
+                   interleave with compute instead of fencing it
+                   (overlap_lint.py).
 
 CLI: ``python -m alphafold2_tpu.analysis --strict`` (docs/STATIC_ANALYSIS.md).
 """
@@ -58,27 +64,40 @@ def _run_smoke(root, **_):
     return run()
 
 
+def _run_overlap(root, files=None, **_):
+    from alphafold2_tpu.analysis.overlap_lint import run
+
+    return run(root, files=files)
+
+
 # name -> runner(root, files=..., axes=...) -> list[Finding]
 PASSES = {
     "compat": _run_compat,
     "trace": _run_trace,
     "sharding": _run_sharding,
     "smoke": _run_smoke,
+    "overlap": _run_overlap,
 }
+
+# passes that verify whole programs rather than the given files: dropped
+# from file-scoped invocations unless explicitly selected
+_REPO_WIDE = ("smoke", "overlap")
 
 
 def run_passes(root, select=None, files=None, axes=None):
     """Run the selected passes (all by default) over `root`; returns the
     combined finding list, stable-sorted by (path, line, code).
 
-    With an explicit `files` list and no explicit `select`, the smoke pass
-    is dropped: it traces the whole public surface regardless of files, so
-    a "lint this one file" invocation would pay the full model-tracing cost
-    and could fail on findings unrelated to the requested file. Selecting
-    it explicitly (select=... including "smoke") still runs it."""
+    With an explicit `files` list and no explicit `select`, the
+    repo-wide passes (smoke, overlap) are dropped: they trace/lower the
+    whole public surface regardless of files, so a "lint this one file"
+    invocation would pay the full model-tracing cost and could fail on
+    findings unrelated to the requested file. Selecting one explicitly
+    (select=... including it) still runs it."""
     if select is None:
         names = [
-            n for n in PASSES if not (files is not None and n == "smoke")
+            n for n in PASSES
+            if not (files is not None and n in _REPO_WIDE)
         ]
     else:
         names = list(select)

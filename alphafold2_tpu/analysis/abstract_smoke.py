@@ -310,6 +310,81 @@ def _targets() -> Dict[str, Callable[[], None]]:
                           {"metric": "smoke_steps_per_sec", "value": 1.0})
         assert not bad and rows[0]["status"] == "regressed"
 
+    # --- parallel / overlap -------------------------------------------------
+    @register("parallel.overlap_bucketing")
+    def _overlap_bucketing():
+        import numpy as np  # module-level np is deleted after registration
+
+        from alphafold2_tpu.parallel.overlap import (
+            flatten_buckets,
+            plan_buckets,
+            unflatten_buckets,
+        )
+
+        tree = {
+            "a": np.arange(6.0, dtype=np.float32).reshape(2, 3),
+            "b": {"w": np.ones(17, np.float32),
+                  "n": np.arange(4, dtype=np.int32)},
+        }
+        treedef, buckets = plan_buckets(tree, bucket_elems=8)
+        covered = sorted(i for ix in buckets for i in ix)
+        assert covered == list(range(3)), buckets
+        out = unflatten_buckets(
+            flatten_buckets(tree, buckets), tree, treedef, buckets
+        )
+        np.testing.assert_array_equal(np.asarray(out["a"]), tree["a"])
+        np.testing.assert_array_equal(np.asarray(out["b"]["n"]),
+                                      tree["b"]["n"])
+
+    @register("parallel.axis_accum_step")
+    def _axis_accum_step():
+        # the DP-overlap step body traces under eval_shape with a dummy
+        # axis env — catches pytree/bucket plumbing breaks without
+        # needing the 8-device platform (the overlap pass covers the
+        # lowered schedule itself)
+        from alphafold2_tpu.models import Alphafold2Config
+        from alphafold2_tpu.training.harness import (
+            TrainConfig,
+            make_axis_accum_train_step,
+            train_state_init,
+        )
+
+        cfg = Alphafold2Config(dim=32, depth=1, heads=4, dim_head=8,
+                               max_seq_len=32)
+        tcfg = TrainConfig(grad_accum=2)
+        step = make_axis_accum_train_step(cfg, tcfg,
+                                          loss_fn=_distogram_loss(),
+                                          axis_name="data")
+        batch = {
+            "seq": abstract((2, 1, 16), jnp.int32),
+            "mask": abstract((2, 1, 16), jnp.bool_),
+            "coords": abstract((2, 1, 16, 3)),
+        }
+        state = jax.eval_shape(
+            lambda k: train_state_init(k, cfg, tcfg), key
+        )
+
+        def under_axis(state, batch):
+            return step(state, batch, None)
+
+        import functools
+
+        jax.eval_shape(
+            functools.partial(_with_dummy_axis, under_axis, "data"),
+            state, batch,
+        )
+
+    def _distogram_loss():
+        from alphafold2_tpu.training.harness import distogram_loss_fn
+
+        return distogram_loss_fn
+
+    def _with_dummy_axis(fn, axis_name, *args):
+        # a single-shard vmapped axis gives lax.psum a bound axis name
+        return jax.vmap(lambda _, a, b: fn(a, b), axis_name=axis_name,
+                        in_axes=(0, None, None), out_axes=None)(
+            jnp.zeros((1,)), *args)
+
     # --- training presets ---------------------------------------------------
     def _preset_init(tier):
         def thunk():

@@ -38,8 +38,10 @@ def _point_weights(mask, X):
 def _check_norm_len(norm_len, mask, X):
     """Precondition: norm_len covers every scored point (it is the FULL
     reference length). A smaller value silently inflates GDT/TM-score
-    above 1.0. Enforced when inputs are concrete; under a jit trace the
-    mask sum is unavailable and the precondition is documented-only."""
+    above 1.0. Enforced eagerly when inputs are concrete; under a jit
+    trace the mask sum is unavailable, so the callers ALSO clamp the
+    normalizer at compute time (`_norm_len_clamped`) — jitted scores stay
+    bounded even when this guard no-ops on tracers (ADVICE r5)."""
     if mask is None:
         valid = X.shape[-1]
     else:
@@ -52,6 +54,15 @@ def _check_norm_len(norm_len, mask, X):
             f"norm_len={norm_len} is smaller than the scored point count "
             f"{valid}; the score would exceed 1.0. norm_len is the full "
             f"reference length and must cover every valid point.")
+
+
+def _norm_len_clamped(norm_len, valid_count, X):
+    """Trace-safe normalizer: norm_len, never below the per-structure
+    scored point count. Eager misuse raises in `_check_norm_len`; under
+    jit this clamp keeps GDT/TM <= 1.0 instead of silently exceeding it
+    (the scores then normalize by the actual count — the defensible
+    reading of an undersized norm_len)."""
+    return jnp.maximum(jnp.asarray(float(norm_len), X.dtype), valid_count)
 
 
 def rmsd(X, Y, mask=None):
@@ -80,7 +91,7 @@ def gdt(X, Y, cutoffs=GDT_TS_CUTOFFS, weights=None, mask=None,
     pw, n = _point_weights(mask, X)
     if norm_len is not None:
         _check_norm_len(norm_len, mask, X)
-        n = jnp.asarray(float(norm_len), X.dtype)
+        n = _norm_len_clamped(norm_len, n, X)
     dist = jnp.sqrt(jnp.sum((X - Y) ** 2, axis=-2))  # (batch, N)
     # fraction of valid residues within each cutoff, weighted mean over cutoffs
     within = (dist[..., None, :] <= cutoffs[:, None]).astype(X.dtype)
@@ -103,7 +114,7 @@ def tmscore(X, Y, mask=None, norm_len=None):
     w, n = _point_weights(mask, X)
     if norm_len is not None:
         _check_norm_len(norm_len, mask, X)
-        n = jnp.asarray(float(norm_len), X.dtype)
+        n = _norm_len_clamped(norm_len, n, X)
         d0 = jnp.asarray(
             max(1.24 * np.cbrt(norm_len - 15) - 1.8, 0.5)
             if norm_len > 15 else 0.5,

@@ -65,6 +65,39 @@ def stream_block(q, k_blk, v_blk, bias_blk, m, l, acc, scale,
     return m_new, l_new, acc_new
 
 
+def merge_lse(out_a, lse_a, out_b, lse_b):
+    """Log-space merge of two NORMALIZED partial softmax results.
+
+    The hop interface of kernel-path ring attention
+    (parallel/sequence.py): each hop produces its block's normalized
+    output plus the log-sum-exp of its logits (ops/flash_kernel.py
+    `flash_attention_lse`), and blocks combine associatively:
+
+        new_out = (e^lse_a * out_a + e^lse_b * out_b) / (e^lse_a + e^lse_b)
+        new_lse = log(e^lse_a + e^lse_b)
+
+    computed with the usual running-max stabilization. Zero-mass blocks
+    (a fully-masked hop) must carry lse = -inf so they weigh ZERO — the
+    kernel's +inf zero-mass convention is flipped before merging
+    (parallel/sequence.py hop()). Both-empty rows return (0, -inf).
+
+    out_*: (..., d) float32; lse_*: (...) float32. Returns (out, lse).
+    """
+    m = jnp.maximum(lse_a, lse_b)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)  # both-empty rows
+    w_a = jnp.exp(lse_a - m_safe)
+    w_b = jnp.exp(lse_b - m_safe)
+    tot = w_a + w_b
+    safe_tot = jnp.where(tot > 0, tot, 1.0)
+    out = jnp.where(
+        (tot > 0)[..., None],
+        (out_a * w_a[..., None] + out_b * w_b[..., None]) / safe_tot[..., None],
+        0.0,
+    )
+    lse = jnp.where(tot > 0, m_safe + jnp.log(safe_tot), _NEG_INF)
+    return out, lse
+
+
 def _largest_divisor_leq(n: int, cap: int) -> int:
     cap = max(1, min(n, cap))
     for c in range(cap, 0, -1):

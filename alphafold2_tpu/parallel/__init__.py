@@ -13,7 +13,14 @@ from alphafold2_tpu.parallel.sharding import (
     replicated,
     state_shardings,
 )
+from alphafold2_tpu.parallel.overlap import (
+    flatten_buckets,
+    overlap_enabled,
+    plan_buckets,
+    unflatten_buckets,
+)
 from alphafold2_tpu.parallel.train import (
+    make_dp_overlap_train_step,
     make_sharded_train_step,
     make_sp_train_step,
     make_pp_train_step,
@@ -64,6 +71,11 @@ __all__ = [
     "state_shardings",
     "batch_shardings",
     "replicated",
+    "flatten_buckets",
+    "overlap_enabled",
+    "plan_buckets",
+    "unflatten_buckets",
+    "make_dp_overlap_train_step",
     "make_sharded_train_step",
     "make_sp_train_step",
     "make_pp_train_step",

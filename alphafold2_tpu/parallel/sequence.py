@@ -38,13 +38,25 @@ from alphafold2_tpu import compat
 from alphafold2_tpu.ops.flash import (
     flash_attention as _flash_attention,
     kernel_dispatch as _kernel_dispatch,
+    merge_lse as _merge_lse,
     stream_block as _stream_block,
 )
+from alphafold2_tpu.parallel.overlap import overlap_enabled
 
 _NEG_INF = float("-inf")
 
 
-def ring_attention(q, k, v, axis_name: str, mask=None, use_kernel="auto"):
+def _hop(k_blk, v_blk, bias_blk, axis_name, perm):
+    """One ring hop: the neighbor copy of the K/V shard and its bias."""
+    return (
+        jax.lax.ppermute(k_blk, axis_name, perm),
+        jax.lax.ppermute(v_blk, axis_name, perm),
+        jax.lax.ppermute(bias_blk, axis_name, perm),
+    )
+
+
+def ring_attention(q, k, v, axis_name: str, mask=None, use_kernel="auto",
+                   overlap=None):
     """Exact ring attention over a sharded sequence axis.
 
     Call inside `shard_map` with the sequence axis sharded over `axis_name`.
@@ -63,6 +75,14 @@ def ring_attention(q, k, v, axis_name: str, mask=None, use_kernel="auto"):
         measured on single-device e2e shapes (PERF.md session 4), not on
         ring hops, so force with True (interpret mode off-TPU, for tests)
         or AF2_FLASH_AUTO_MIN_J=0 to get the kernel on short shards.
+      overlap: schedule selection. True = double-buffered (issue hop
+        i+1's ppermute BEFORE computing hop i's block, so the ICI
+        transfer hides under the current block's compute); False = the
+        synchronous rotate-then-compute schedule; None (default) reads
+        `AF2_COMM_OVERLAP` (parallel/overlap.py, default on). Both
+        schedules visit the blocks in the same order with the same
+        arithmetic — exact parity (tests/test_overlap.py), verified
+        structurally by analysis/overlap_lint.py.
 
     Returns: (b, n_local, h, d) attention output for the local Q shard.
     """
@@ -70,6 +90,7 @@ def ring_attention(q, k, v, axis_name: str, mask=None, use_kernel="auto"):
     nk_local = k.shape[1]  # may differ from n_local for cross-attention
     scale = d ** -0.5
     num_shards = jax.lax.psum(1, axis_name)
+    overlap = overlap_enabled(overlap)
 
     # mark constant-built carries as device-varying over the ring axis so
     # the fori_loop carry types match after the first ppermute
@@ -87,41 +108,68 @@ def ring_attention(q, k, v, axis_name: str, mask=None, use_kernel="auto"):
     # raises loudly when forcing an unsupported shape
     if _kernel_dispatch(n_local, nk_local, d, use_kernel):
         return _ring_attention_kernel(
-            q, k, v, bias, axis_name, scale, num_shards, perm
+            q, k, v, bias, axis_name, scale, num_shards, perm, overlap
         )
 
     m0 = varying(jnp.full((b, h, n_local), _NEG_INF, jnp.float32))
     l0 = varying(jnp.zeros((b, h, n_local), jnp.float32))
     acc0 = varying(jnp.zeros((b, h, n_local, d), jnp.float32))
 
-    # resident block first, then rotate-before-compute for the remaining
-    # num_shards-1 blocks: exactly P-1 neighbor copies, no discarded final
-    # rotation (XLA cannot DCE a collective inside the loop body)
-    m, l, acc = _stream_block(q, k, v, bias, m0, l0, acc0, scale)
+    if overlap and num_shards > 1:
+        # DOUBLE-BUFFERED schedule: hop 1's ppermute is issued before the
+        # resident block's compute, and each loop body issues hop i+1's
+        # ppermute before computing hop i's (already-arrived) block — the
+        # neighbor copy rides the ICI while the MXU runs the current
+        # block, instead of fencing it. Still exactly P-1 copies: the
+        # loop runs hops 1..P-2 and the last arrival computes outside.
+        k_nxt, v_nxt, b_nxt = _hop(k, v, bias, axis_name, perm)
+        m, l, acc = _stream_block(q, k, v, bias, m0, l0, acc0, scale)
 
-    def body(_, carry):
-        m, l, acc, k_blk, v_blk, bias_blk = carry
-        # one hop around the ring (ICI neighbor copy); XLA overlaps this
-        # with the block compute
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        bias_blk = jax.lax.ppermute(bias_blk, axis_name, perm)
-        m, l, acc = _stream_block(q, k_blk, v_blk, bias_blk, m, l, acc, scale)
-        return m, l, acc, k_blk, v_blk, bias_blk
+        def body(_, carry):
+            m, l, acc, k_blk, v_blk, bias_blk = carry
+            k_n, v_n, b_n = _hop(k_blk, v_blk, bias_blk, axis_name, perm)
+            m, l, acc = _stream_block(
+                q, k_blk, v_blk, bias_blk, m, l, acc, scale
+            )
+            return m, l, acc, k_n, v_n, b_n
 
-    m, l, acc, _, _, _ = jax.lax.fori_loop(
-        1, num_shards, body, (m, l, acc, k, v, bias)
-    )
+        m, l, acc, k_last, v_last, b_last = jax.lax.fori_loop(
+            1, num_shards - 1, body, (m, l, acc, k_nxt, v_nxt, b_nxt)
+        )
+        m, l, acc = _stream_block(q, k_last, v_last, b_last, m, l, acc, scale)
+    else:
+        # SYNCHRONOUS schedule: resident block first, then
+        # rotate-before-compute for the remaining num_shards-1 blocks —
+        # exactly P-1 neighbor copies, each fencing its block's compute.
+        # Kept as the overlap-off reference arm (A/B legs, overlap-lint
+        # fixtures) and the num_shards == 1 degenerate case.
+        m, l, acc = _stream_block(q, k, v, bias, m0, l0, acc0, scale)
+
+        def body(_, carry):
+            m, l, acc, k_blk, v_blk, bias_blk = carry
+            k_blk, v_blk, bias_blk = _hop(
+                k_blk, v_blk, bias_blk, axis_name, perm
+            )
+            m, l, acc = _stream_block(
+                q, k_blk, v_blk, bias_blk, m, l, acc, scale
+            )
+            return m, l, acc, k_blk, v_blk, bias_blk
+
+        m, l, acc, _, _, _ = jax.lax.fori_loop(
+            1, num_shards, body, (m, l, acc, k, v, bias)
+        )
     out = acc / jnp.where(l > 0, l, 1.0)[..., None]  # zeros for fully-masked q
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
-def _ring_attention_kernel(q, k, v, bias, axis_name, scale, num_shards, perm):
+def _ring_attention_kernel(q, k, v, bias, axis_name, scale, num_shards, perm,
+                           overlap=False):
     """Ring hops through the Pallas flash kernel: each hop produces its
     local (out, lse) fused in VMEM (ops/flash_kernel.flash_attention_lse),
-    and hops merge by log-space weighting — the communication pattern is
-    identical to the XLA path (P-1 neighbor ppermutes), only the per-hop
-    compute is fused."""
+    and hops merge in log space (ops/flash.py merge_lse — the shared hop
+    interface). The communication pattern is identical to the XLA path
+    (P-1 neighbor ppermutes, double-buffered when `overlap`), only the
+    per-hop compute is fused."""
     from alphafold2_tpu.ops.flash_kernel import flash_attention_lse
 
     b, n_local, h, d = q.shape
@@ -131,44 +179,51 @@ def _ring_attention_kernel(q, k, v, bias, axis_name, scale, num_shards, perm):
 
     qf = fold(q)
 
-    def hop(kf, vf, bias_blk):
+    def hop_compute(kf, vf, bias_blk):
         out_h, lse_h = flash_attention_lse(
             qf, kf, vf, jnp.repeat(bias_blk, h, axis=0), scale
         )
         # the kernel marks zero-mass rows with +inf lse (backward
         # convention); for cross-hop combination zero mass must weigh
-        # ZERO — flip to -inf
+        # ZERO — flip to -inf (the merge_lse contract)
         lse_h = jnp.where(jnp.isposinf(lse_h), _NEG_INF, lse_h)
         return out_h.astype(jnp.float32), lse_h
 
-    out, lse = hop(fold(k), fold(v), bias)
+    kf0, vf0 = fold(k), fold(v)
 
-    def body(_, carry):
-        out, lse, k_blk, v_blk, bias_blk = carry
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        bias_blk = jax.lax.ppermute(bias_blk, axis_name, perm)
-        out_h, lse_h = hop(k_blk, v_blk, bias_blk)
+    if overlap and num_shards > 1:
+        # double-buffered: hop i+1's ppermute issues before hop i's
+        # kernel launch (see the XLA-path schedule above)
+        k_nxt, v_nxt, b_nxt = _hop(kf0, vf0, bias, axis_name, perm)
+        out, lse = hop_compute(kf0, vf0, bias)
 
-        # log-space merge of two normalized partial softmaxes:
-        # new_out = (e^lse*out + e^lse_h*out_h) / (e^lse + e^lse_h)
-        m = jnp.maximum(lse, lse_h)
-        m_safe = jnp.where(jnp.isneginf(m), 0.0, m)  # both-empty rows
-        w_a = jnp.exp(lse - m_safe)
-        w_b = jnp.exp(lse_h - m_safe)
-        tot = w_a + w_b
-        safe_tot = jnp.where(tot > 0, tot, 1.0)
-        out = jnp.where(
-            (tot > 0)[..., None],
-            (out * w_a[..., None] + out_h * w_b[..., None]) / safe_tot[..., None],
-            0.0,
+        def body(_, carry):
+            out, lse, k_blk, v_blk, bias_blk = carry
+            k_n, v_n, b_n = _hop(k_blk, v_blk, bias_blk, axis_name, perm)
+            out_h, lse_h = hop_compute(k_blk, v_blk, bias_blk)
+            out, lse = _merge_lse(out, lse, out_h, lse_h)
+            return out, lse, k_n, v_n, b_n
+
+        out, lse, k_last, v_last, b_last = jax.lax.fori_loop(
+            1, num_shards - 1, body, (out, lse, k_nxt, v_nxt, b_nxt)
         )
-        lse = jnp.where(tot > 0, m_safe + jnp.log(safe_tot), _NEG_INF)
-        return out, lse, k_blk, v_blk, bias_blk
+        out_h, lse_h = hop_compute(k_last, v_last, b_last)
+        out, _ = _merge_lse(out, lse, out_h, lse_h)
+    else:
+        out, lse = hop_compute(kf0, vf0, bias)
 
-    out, lse, _, _, _ = jax.lax.fori_loop(
-        1, num_shards, body, (out, lse, fold(k), fold(v), bias)
-    )
+        def body(_, carry):
+            out, lse, k_blk, v_blk, bias_blk = carry
+            k_blk, v_blk, bias_blk = _hop(
+                k_blk, v_blk, bias_blk, axis_name, perm
+            )
+            out_h, lse_h = hop_compute(k_blk, v_blk, bias_blk)
+            out, lse = _merge_lse(out, lse, out_h, lse_h)
+            return out, lse, k_blk, v_blk, bias_blk
+
+        out, lse, _, _, _ = jax.lax.fori_loop(
+            1, num_shards, body, (out, lse, kf0, vf0, bias)
+        )
     return out.reshape(b, h, n_local, d).transpose(0, 2, 1, 3).astype(q.dtype)
 
 

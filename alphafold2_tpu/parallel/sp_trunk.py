@@ -205,7 +205,7 @@ def _compress_kv_sharded(params, cfg, k, v, context_mask, axis_name):
     return k_c, v_c, pooled & owned[None, :]
 
 
-def _ring_cross_tokens(params, cfg: Alphafold2Config, q_tokens, ctx_tokens_local, ctx_mask_local, axis_name):
+def _ring_cross_tokens(params, cfg: Alphafold2Config, q_tokens, ctx_tokens_local, ctx_mask_local, axis_name, *, overlap=None):
     """Cross-attention with resident queries and ring-streamed K/V shards.
 
     q_tokens: (B, nq, d) resident queries; ctx_tokens_local: (B, nk_local, d)
@@ -213,8 +213,11 @@ def _ring_cross_tokens(params, cfg: Alphafold2Config, q_tokens, ctx_tokens_local
     the ring; the full key stream never materializes on one chip. KV
     compression applies per shard via `_compress_kv_sharded` (halo
     exchange reproduces the global window grid for ANY local length >=
-    ratio-1). Key-side masking only (ops/flash.py contract): query-side
-    masks are intentionally not applied, like the dense path.
+    ratio-1; the halo ppermute stays synchronous — it is a tiny
+    latency-bound prologue, not a per-hop transfer). Key-side masking
+    only (ops/flash.py contract): query-side masks are intentionally not
+    applied, like the dense path. `overlap` selects the ring schedule
+    (parallel/sequence.py ring_attention; None = AF2_COMM_OVERLAP).
     """
     cross_cfg = cfg.cross_attn_config()
     h, dh = cross_cfg.heads, cross_cfg.dim_head
@@ -233,16 +236,18 @@ def _ring_cross_tokens(params, cfg: Alphafold2Config, q_tokens, ctx_tokens_local
     k = _split_heads(k, h, dh)
     v = _split_heads(v, h, dh)
 
-    out = ring_attention(q, k, v, axis_name, mask=ctx_mask_local)
+    out = ring_attention(q, k, v, axis_name, mask=ctx_mask_local,
+                         overlap=overlap)
     out = out.reshape(out.shape[0], out.shape[1], h * dh)
     return linear(params["attn"]["to_out"], out, dtype=dtype)
 
 
-def _ring_cross(params, cfg: Alphafold2Config, q_flat, ctx_flat_local, q_mask, ctx_mask_local, axis_name):
+def _ring_cross(params, cfg: Alphafold2Config, q_flat, ctx_flat_local, q_mask, ctx_mask_local, axis_name, *, overlap=None):
     """MSA<-pair flat cross-attention via ring K/V streaming."""
     del q_mask  # key-side masking only (ops/flash.py contract)
     return _ring_cross_tokens(
-        params, cfg, q_flat, ctx_flat_local, ctx_mask_local, axis_name
+        params, cfg, q_flat, ctx_flat_local, ctx_mask_local, axis_name,
+        overlap=overlap,
     )
 
 
@@ -312,7 +317,7 @@ def _aligned_gathered_cross(params, cfg: Alphafold2Config, x_local, m_local, x_m
     )
 
 
-def _aligned_ring_cross(params, cfg: Alphafold2Config, m_local, x_local, msa_mask, x_mask, axis_name):
+def _aligned_ring_cross(params, cfg: Alphafold2Config, m_local, x_local, msa_mask, x_mask, axis_name, *, overlap=None):
     """MSA<-pair ALIGNED cross-attention, rows sharded.
 
     Each MSA token attends only its column's pair-grid block. Queries are
@@ -328,11 +333,12 @@ def _aligned_ring_cross(params, cfg: Alphafold2Config, m_local, x_local, msa_mas
     mg = jnp.swapaxes(m_local, 1, 2).reshape(b * c, r_loc, d)
     xg, xg_mask, _ = _fold_pair_local(x_local, c, x_mask)
 
-    out = _ring_cross_tokens(params, cfg, mg, xg, xg_mask, axis_name)
+    out = _ring_cross_tokens(params, cfg, mg, xg, xg_mask, axis_name,
+                             overlap=overlap)
     return jnp.swapaxes(out.reshape(b, c, r_loc, d), 1, 2)
 
 
-def sp_layer_apply(layer, cfg: Alphafold2Config, x, m, x_mask, msa_mask, axis_name):
+def sp_layer_apply(layer, cfg: Alphafold2Config, x, m, x_mask, msa_mask, axis_name, *, overlap=None):
     """One trunk layer on resident shards (deterministic path).
 
     Public within the package: the pipeline trunk (parallel/pipeline.py)
@@ -341,6 +347,12 @@ def sp_layer_apply(layer, cfg: Alphafold2Config, x, m, x_mask, msa_mask, axis_na
     x: (b, n_local, n, d) pair rows; m: (b, r_local, c, d) MSA rows.
     Mirrors models/trunk.py sequential order: pair self -> msa self ->
     pair<-msa cross -> msa<-pair cross -> FFs, every op residual.
+
+    `overlap` selects the ring-cross-attention schedule (double-buffered
+    vs synchronous hops, parallel/sequence.py ring_attention); None
+    defaults to AF2_COMM_OVERLAP. The axial/tied collectives
+    (all_to_all, logit psum) are single semantic barriers, not per-hop
+    streams — there is nothing to double-buffer there.
     """
     from alphafold2_tpu.models.trunk import prenorm_ff_apply
 
@@ -369,7 +381,8 @@ def sp_layer_apply(layer, cfg: Alphafold2Config, x, m, x_mask, msa_mask, axis_na
                 layer["seq_cross"], cfg, x, m, x_mask, msa_mask, axis_name
             )
             m = m + _aligned_ring_cross(
-                layer["msa_cross"], cfg, m, x, msa_mask, x_mask, axis_name
+                layer["msa_cross"], cfg, m, x, msa_mask, x_mask, axis_name,
+                overlap=overlap,
             )
         else:
             xf = x.reshape(b, n_local * n, d)
@@ -382,7 +395,8 @@ def sp_layer_apply(layer, cfg: Alphafold2Config, x, m, x_mask, msa_mask, axis_na
 
             mf = m.reshape(b, -1, d)
             mf = mf + _ring_cross(
-                layer["msa_cross"], cfg, mf, xf, mm_flat, xm_flat, axis_name
+                layer["msa_cross"], cfg, mf, xf, mm_flat, xm_flat, axis_name,
+                overlap=overlap,
             )
             m = mf.reshape(m.shape)
 
@@ -402,6 +416,7 @@ def sp_trunk_apply(
     axis_name: str = "seq",
     x_mask=None,
     msa_mask=None,
+    overlap=None,
 ):
     """Run the sequential trunk sequence-parallel over `mesh[axis_name]`.
 
@@ -416,6 +431,11 @@ def sp_trunk_apply(
     streams) and "aligned" (the O(n^2 * r) column-aligned redesign — the
     mode the north-star workload uses — with the same gather/ring split
     applied per column group).
+
+    `overlap` selects the ring cross-attention schedule (double-buffered
+    when on; parallel/sequence.py ring_attention); None defaults to
+    AF2_COMM_OVERLAP. Overlapped and synchronous schedules are
+    exact-parity (tests/test_overlap.py pins the full trunk both ways).
 
     Returns (x, m) in global layouts.
     """
@@ -460,7 +480,9 @@ def sp_trunk_apply(
     )
     def run(x, m, x_mask, msa_mask):
         for layer in layers:
-            x, m = sp_layer_apply(layer, cfg, x, m, x_mask, msa_mask, axis_name)
+            x, m = sp_layer_apply(
+                layer, cfg, x, m, x_mask, msa_mask, axis_name, overlap=overlap
+            )
         return x, m
 
     return run(x, m, x_mask, msa_mask)
@@ -478,6 +500,7 @@ def alphafold2_apply_sp(
     msa_mask=None,
     templates=None,
     templates_mask=None,
+    overlap=None,
 ):
     """FULL-model forward with the trunk sequence-parallel over the mesh.
 
@@ -506,6 +529,7 @@ def alphafold2_apply_sp(
         return sp_trunk_apply(
             layers, cfg_, x, m, mesh,
             axis_name=axis_name, x_mask=x_mask, msa_mask=m_mask,
+            overlap=overlap,
         )
 
     return alphafold2_apply(

@@ -14,14 +14,17 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 import jax
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from alphafold2_tpu import compat
 from alphafold2_tpu.training.harness import (
     TrainConfig,
     distogram_loss_fn,
+    make_axis_accum_train_step,
     make_train_step,
     train_state_init,
 )
+from alphafold2_tpu.parallel.overlap import overlap_enabled
 from alphafold2_tpu.parallel.sharding import (
     batch_shardings,
     replicated,
@@ -87,6 +90,98 @@ def make_sharded_train_step(
         in_shardings=(st_shardings, b_shardings, replicated(mesh)),
         out_shardings=(st_shardings, replicated(mesh)),
         donate_argnums=(0,) if donate_state else (),
+    )
+    return jitted, st_shardings
+
+
+def make_dp_overlap_train_step(
+    cfg,
+    tcfg: TrainConfig,
+    mesh: Mesh,
+    example_batch,
+    *,
+    axis_name: str = "data",
+    loss_fn: Callable = distogram_loss_fn,
+    overlap=None,
+    bucket_elems: Optional[int] = None,
+    donate_state: bool = True,
+    state_init: Callable = train_state_init,
+):
+    """The backward-overlapped data-parallel train step.
+
+    Same signature family as `make_sharded_train_step`, but the step runs
+    under `shard_map` over `mesh[axis_name]` with the gradient reduction
+    placed EXPLICITLY (training/harness.py `make_axis_accum_train_step`):
+    gradients coalesce into a few large buckets and, with overlap on
+    (default: AF2_COMM_OVERLAP), the psum of microbatch i-1 is issued
+    inside the scan body before microbatch i's forward/backward — the
+    all-reduce rides the interconnect under compute instead of fencing
+    the optimizer. `overlap=False` is the synchronous reference arm
+    (one bucketed psum after the scan).
+
+    Composition: params (and optimizer state) stay replicated — this is
+    the pure-DP configuration, so `loss_fn` may be any shard_map-safe
+    loss over the replicated model (the distogram default, the full
+    `e2e_loss_fn` structure loss). The SP/PP steps keep their GSPMD jit
+    wrappers and get THEIR overlap from the double-buffered ring
+    schedules inside the trunk (parallel/sequence.py); DP-overlap x TP
+    is not supported — a manual data axis precludes GSPMD auto-sharding
+    of the model inside the same program (use `make_sharded_train_step`
+    for DP+TP).
+
+    Args:
+      example_batch: a batch pytree (or ShapeDtypeStructs) with leading
+        (grad_accum, global_per_step_batch, ...) axes; the per-step batch
+        axis is sharded over `axis_name` and must divide by it.
+
+    Returns: (jitted_step, state_shardings). The step signature is
+    unchanged: (state, batch, rng) -> (state, metrics); donation-safe
+    (state buffers are donated unless donate_state=False).
+    """
+    state_shape = jax.eval_shape(
+        lambda k: state_init(k, cfg, tcfg), jax.random.PRNGKey(0)
+    )
+    step = make_axis_accum_train_step(
+        cfg, tcfg, loss_fn, axis_name,
+        overlap=overlap_enabled(overlap),
+        bucket_elems=bucket_elems,
+        state_init=state_init,
+        state_shape=state_shape,
+    )
+
+    rep = PartitionSpec()
+    st_specs = jax.tree_util.tree_map(lambda _: rep, state_shape)
+    b_specs = jax.tree_util.tree_map(
+        lambda _: PartitionSpec(None, axis_name), example_batch
+    )
+    sharded = compat.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(st_specs, b_specs, rep),
+        out_specs=(st_specs, rep),
+        check_vma=False,
+    )
+    sharded_norng = compat.shard_map(
+        lambda state, batch: step(state, batch, None),
+        mesh=mesh,
+        in_specs=(st_specs, b_specs),
+        out_specs=(st_specs, rep),
+        check_vma=False,
+    )
+
+    def step_with_optional_rng(state, batch, rng=None):
+        # shard_map needs a concrete input pytree, so rng=None (the
+        # deterministic path) dispatches to its own program at trace time
+        if rng is None:
+            return sharded_norng(state, batch)
+        return sharded(state, batch, rng)
+
+    jitted = jax.jit(
+        step_with_optional_rng,
+        donate_argnums=(0,) if donate_state else (),
+    )
+    st_shardings = jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, rep), state_shape
     )
     return jitted, st_shardings
 

@@ -190,6 +190,140 @@ def make_train_step(
     return train_step
 
 
+def make_axis_accum_train_step(
+    cfg: Alphafold2Config,
+    tcfg: TrainConfig,
+    loss_fn: Callable[..., Any],
+    axis_name: str,
+    *,
+    overlap: bool = True,
+    bucket_elems: Optional[int] = None,
+    state_init: Callable = train_state_init,
+    state_shape=None,
+):
+    """The microbatch-accumulating train step with an EXPLICIT gradient
+    reduction over `axis_name` — the axis-level body of the DP-overlap
+    step (parallel/train.py `make_dp_overlap_train_step` wraps it in
+    shard_map over the mesh's data axis; this builder is mesh-free so it
+    stays testable and composable).
+
+    Where `make_train_step` leaves the data-parallel all-reduce to
+    GSPMD — ONE gradient psum after the whole accumulation scan, fencing
+    the optimizer — this step places the collectives itself:
+
+      * gradients flatten into a few large dtype-homogeneous buckets
+        (parallel/overlap.py) so hundreds of small param leaves ride a
+        handful of bandwidth-bound all-reduces instead of hundreds of
+        latency-bound ones;
+      * with `overlap` (default), the scan body ISSUES the psum of
+        microbatch i-1's buckets before computing microbatch i's
+        forward/backward — the reduction rides the interconnect under
+        the next microbatch's compute, and only the LAST microbatch's
+        psum remains on the critical path;
+      * with `overlap=False` it accumulates locally and issues one
+        bucketed psum after the scan — the synchronous reference arm
+        (same arithmetic modulo psum/add reassociation; the A/B pair for
+        the dryrun, bench legs, and overlap-lint fixtures).
+
+    Loss semantics: each shard's loss_fn normalizes over ITS microbatch
+    (e.g. distogram_cross_entropy's valid-pair count), and shard results
+    average with equal weight. This equals the GSPMD global-batch step
+    exactly when per-shard normalizers match (uniform masks / padded
+    synthetic batches) and differs only in mean-of-means weighting when
+    they don't — documented divergence, same convention as the
+    microbatch mean `make_train_step` already takes.
+
+    The returned step MUST run inside `shard_map` (it calls
+    jax.lax.psum over `axis_name`): signature (state, batch, rng) ->
+    (state, metrics) with batch leaves carrying (grad_accum,
+    per_shard_batch, ...) leading axes.
+    """
+    from alphafold2_tpu.parallel.overlap import (
+        DEFAULT_BUCKET_ELEMS,
+        flatten_buckets,
+        plan_buckets,
+        unflatten_buckets,
+    )
+
+    opt = make_optimizer(tcfg)
+    n = tcfg.grad_accum
+    if state_shape is None:
+        # abstract trace of the init — callers that already have the
+        # state shape (make_dp_overlap_train_step computes it for its
+        # sharding specs) pass it in so the model is not traced twice
+        state_shape = jax.eval_shape(
+            lambda k: state_init(k, cfg, tcfg), jax.random.PRNGKey(0)
+        )
+    params_shape = state_shape["params"]
+    treedef, buckets = plan_buckets(
+        params_shape, bucket_elems or DEFAULT_BUCKET_ELEMS
+    )
+
+    def train_step(state, batch, rng=None):
+        params = state["params"]
+        num_shards = jax.lax.psum(1, axis_name)
+
+        def bucketed_grads(mb, i):
+            mb_rng = jax.random.fold_in(rng, i) if rng is not None else None
+            loss, grads = jax.value_and_grad(loss_fn)(params, cfg, mb, mb_rng)
+            return loss, flatten_buckets(grads, buckets)
+
+        # microbatch 0 runs before the scan so the overlapped body always
+        # has a previous microbatch's buckets in flight — no zero-filled
+        # warmup psum
+        loss0, bkts0 = bucketed_grads(
+            jax.tree_util.tree_map(lambda x: x[0], batch), 0
+        )
+        zeros = [jnp.zeros_like(b) for b in bkts0]
+
+        if n > 1:
+
+            def accum(carry, inp):
+                loss_sum, red, prev = carry
+                mb, i = inp
+                if overlap:
+                    # ISSUE the psum of microbatch i-1 first: its
+                    # transfer hides under this microbatch's fwd/bwd
+                    # (the dots below do not depend on it —
+                    # analysis/overlap_lint.py asserts exactly that)
+                    reduced = [jax.lax.psum(b, axis_name) for b in prev]
+                    loss, bkts = bucketed_grads(mb, i)
+                    red = [a + r for a, r in zip(red, reduced)]
+                else:
+                    # synchronous arm: accumulate locally, reduce once
+                    # after the scan
+                    loss, bkts = bucketed_grads(mb, i)
+                    bkts = [a + b for a, b in zip(prev, bkts)]
+                return (loss_sum + loss, red, bkts), None
+
+            rest = jax.tree_util.tree_map(lambda x: x[1:], batch)
+            (loss_sum, red, last), _ = jax.lax.scan(
+                accum, (loss0, zeros, bkts0), (rest, jnp.arange(1, n))
+            )
+        else:
+            loss_sum, red, last = loss0, zeros, bkts0
+
+        # flush: the last microbatch's (or, synchronous, the whole
+        # accumulated) reduction — the only psum left on the critical path
+        red = [a + jax.lax.psum(b, axis_name) for a, b in zip(red, last)]
+        denom = n * num_shards
+        loss = jax.lax.psum(loss_sum, axis_name) / denom
+        grads = unflatten_buckets(
+            [b / denom for b in red], params_shape, treedef, buckets
+        )
+
+        updates, opt_state = opt.update(grads, state["opt_state"], params)
+        new_params = optax.apply_updates(params, updates)
+        new_state = {
+            "params": new_params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }
+        return new_state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
+
+    return train_step
+
+
 # --- fault-injection hook (reliability layer) --------------------------------
 
 

@@ -106,6 +106,85 @@ print(json.dumps({"sec_per_step": round(dt, 2), "loss": round(loss, 4)}))
 """
 
 
+# Communication-compute overlap A/B (the multi-chip distribution story,
+# ISSUE 5): times the double-buffered vs synchronous schedules of the two
+# overlapped paths — ring attention and the backward-overlapped DP-accum
+# step — over ALL devices the probe exposes. On the current single-chip
+# tunnel this records a structured skip (a mesh of 1 has no transfers to
+# hide); the first healthy MULTI-chip probe quantifies the win
+# automatically. The schedule is baked at trace time from
+# AF2_COMM_OVERLAP, set per-arm below before any tracing.
+OVERLAP_WORKER = r"""
+import json, sys, time, os
+spec = json.loads(sys.argv[1])
+os.environ["AF2_COMM_OVERLAP"] = "1" if spec["overlap"] else "0"
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+n_dev = len(jax.devices())
+if n_dev < 2:
+    print(json.dumps({"skipped": "single-device probe: overlap needs a "
+                      "multi-chip mesh", "devices": n_dev}))
+    sys.exit(0)
+
+from jax.sharding import PartitionSpec as P
+from alphafold2_tpu import compat
+from alphafold2_tpu.models import Alphafold2Config
+from alphafold2_tpu.parallel import (
+    make_dp_overlap_train_step, make_mesh, ring_attention,
+)
+from alphafold2_tpu.training import (
+    DataConfig, TrainConfig, distogram_loss_fn, stack_microbatches,
+    synthetic_batches,
+)
+from alphafold2_tpu.training.harness import train_state_init
+
+iters = spec.get("iters", 10)
+out = {"devices": n_dev, "overlap": spec["overlap"]}
+
+# ring attention: per-shard 512 keys x 8 heads x 64 dh — big enough that
+# the per-hop transfer is bandwidth-bound, P-1 hops around the full ring
+mesh = make_mesh({"seq": n_dev})
+sp = P(None, "seq", None, None)
+key = jax.random.PRNGKey(0)
+q, k, v = (jax.random.normal(kk, (1, 512 * n_dev, 8, 64), jnp.bfloat16)
+           for kk in jax.random.split(key, 3))
+ring = jax.jit(compat.shard_map(
+    lambda q, k, v: ring_attention(q, k, v, "seq"),
+    mesh=mesh, in_specs=(sp, sp, sp), out_specs=sp))
+np.asarray(ring(q, k, v))  # compile + warmup
+t0 = time.perf_counter()
+for _ in range(iters):
+    r = ring(q, k, v)
+r.block_until_ready()
+out["ring_sec"] = round((time.perf_counter() - t0) / iters, 5)
+
+# DP-accum step: small trunk, grad_accum 4 — the psum/backward overlap
+cfg = Alphafold2Config(dim=64, depth=2, heads=4, dim_head=16,
+                       max_seq_len=64)
+tcfg = TrainConfig(learning_rate=1e-3, grad_accum=4)
+dcfg = DataConfig(batch_size=n_dev, max_len=48, seed=0)
+batch = jax.device_put(
+    next(stack_microbatches(synthetic_batches(dcfg), tcfg.grad_accum)))
+dp_mesh = make_mesh({"data": n_dev})
+state = train_state_init(jax.random.PRNGKey(1), cfg, tcfg)
+step, _ = make_dp_overlap_train_step(
+    cfg, tcfg, dp_mesh, batch, loss_fn=distogram_loss_fn,
+    donate_state=False)
+s2, m = step(state, batch)
+float(m["loss"])  # compile + warmup fetch
+t0 = time.perf_counter()
+for _ in range(iters):
+    s2, m = step(state, batch)
+loss = float(m["loss"])
+out["dp_sec"] = round((time.perf_counter() - t0) / iters, 5)
+assert np.isfinite(loss), loss
+out["loss"] = round(loss, 4)
+print(json.dumps(out))
+"""
+
+
 def err_tail(stderr: str, returncode: int) -> str:
     """Diagnostic-bearing error summary of a failed subprocess.
 
@@ -265,8 +344,16 @@ def main():
             # bf16-rounding probability error (tests/test_flash.py). If
             # the traffic theory is right this is a direct ~2x on the
             # ~60%-of-layer pair attention; if it is noise, the sink is
-            # elsewhere — decisive either way.
-            ("e2e_logit_bf16", {**base, "logit_bf16": True}),
+            # elsewhere — decisive either way. PINNED kernel-off
+            # (AF2_DISABLE_FLASH_KERNEL): logit_dtype applies only to the
+            # streaming path and ops/flash.py raises loudly if any shape
+            # reaches the Pallas dispatch — under kernel='auto' a flat
+            # cross mode, qb-target tuning, or an AF2_FLASH_AUTO_MIN_J
+            # override would turn this A/B into a trace-time ValueError
+            # row instead of a measurement (ADVICE r5). The loud error
+            # stays for user configs; only the sweep leg pins.
+            ("e2e_logit_bf16", {**base, "logit_bf16": True,
+                                "kernel": "off"}),
             ("e2e_mdsbwd25", {**base, "mds_bwd_iters": 25}),
             # MDS scan unroll: amortizes the 200 sequential small-kernel
             # iterations' dispatch overhead (PERF.md "MDS latency")
@@ -283,6 +370,21 @@ def main():
             continue
         if not run_and_record(name, E2E_WORKER, [json.dumps(spec)],
                               timeout=2100, extra={"spec": spec}):
+            sys.exit(3)  # wedged-tunnel code: watchers retry later
+
+    # 1b) communication-overlap A/B pair (multi-chip only; single-chip
+    # probes record a structured skip and cost seconds). Both arms run
+    # the SAME programs — only AF2_COMM_OVERLAP differs, baked at trace
+    # time inside each worker.
+    for name, spec in (
+        ("overlap_on", {"overlap": True}),
+        ("overlap_off", {"overlap": False}),
+    ):
+        if done_key(name, spec) in done:
+            print(f"skip {name}: already recorded in {OUT}", flush=True)
+            continue
+        if not run_and_record(name, OVERLAP_WORKER, [json.dumps(spec)],
+                              timeout=1200, extra={"spec": spec}):
             sys.exit(3)  # wedged-tunnel code: watchers retry later
 
     # 2) kernel microbench + block-size tuning at the chunk shape the model

@@ -30,7 +30,12 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from tpu_lock import LOCK_HELD_ENV, LOCK_PATH, tpu_lock  # noqa: E402,F401
+from tpu_lock import (  # noqa: E402,F401
+    LOCK_HELD_ENV,
+    LOCK_PATH,
+    held_marker_valid,
+    tpu_lock,
+)
 
 _guard_stack: contextlib.ExitStack | None = None
 
@@ -61,8 +66,12 @@ def tunnel_guard(timeout: float | None = None) -> bool:
     behind a measurement leg, not corrupt it).
     """
     global _guard_stack
-    if os.environ.get(LOCK_HELD_ENV):
-        return True  # parent holds it; our subprocess-tree is one client
+    if held_marker_valid():
+        # a live ancestor holds it; our subprocess-tree is one client.
+        # (An inherited marker whose holder is gone — the orphaned-child
+        # reentrancy hole, ADVICE r5 — fails the validity check and
+        # falls through to a real acquisition below.)
+        return True
     if _guard_stack is not None:
         return True
     if (

@@ -28,6 +28,7 @@ import contextlib
 import errno
 import fcntl
 import os
+import re
 import sys
 import time
 
@@ -45,8 +46,157 @@ LOCK_BUSY = "tpu-lock-busy"
 # set in the environment while the lock is held so measurement
 # subprocesses spawned UNDER the lock don't deadlock re-acquiring it
 # (the whole subprocess tree is one tunnel client); hostenv.tunnel_guard
-# checks it
+# checks it. Format "<pid>:<starttime>" identifies the HOLDER (pid plus
+# /proc starttime so a recycled pid cannot impersonate it): the marker is
+# honored only while that holder STILL HOLDS the flock (lock-file pid
+# match + flock probe) and is this process or a live ancestor — a
+# backgrounded child that outlives the parent's release (or a marker
+# leaked into an unrelated daemon's environment) falls back to the real
+# flock instead of silently bypassing it (ADVICE r5; see
+# held_marker_valid for the three conjunctive conditions).
 LOCK_HELD_ENV = "AF2_TPU_LOCK_HELD"
+
+
+def _proc_start(pid: int):
+    """The kernel starttime ticks for `pid` (None when unreadable —
+    process gone, or no /proc on this platform)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read().decode("ascii", "replace")
+        # comm may contain spaces/parens: fields resume after the LAST ')'
+        fields = stat.rsplit(")", 1)[1].split()
+        return fields[19]  # starttime, field 22 of stat(5)
+    except (OSError, IndexError):
+        return None
+
+
+def _self_marker() -> str:
+    pid = os.getpid()
+    return f"{pid}:{_proc_start(pid) or ''}"
+
+
+def _ancestor_markers():
+    """{(pid, starttime)} for this process and its live ancestors."""
+    out = set()
+    pid = os.getpid()
+    for _ in range(128):  # bound: no real process tree is deeper
+        start = _proc_start(pid)
+        if start is None:
+            break
+        out.add((pid, start))
+        try:
+            with open(f"/proc/{pid}/stat", "rb") as f:
+                stat = f.read().decode("ascii", "replace")
+            ppid = int(stat.rsplit(")", 1)[1].split()[1])
+        except (OSError, IndexError, ValueError):
+            break
+        if ppid <= 0 or ppid == pid:
+            break
+        pid = ppid
+    return out
+
+
+def _flock_held() -> bool:
+    """True if ANY process currently holds the flock.
+
+    Probes READ-ONLY via /proc/locks where available: a probe must not
+    itself take the lock, or a racing fail-fast client (`timeout=0`, the
+    watcher path) would see a phantom holder during the probe window.
+    Falls back to a momentary try-acquire only where /proc/locks does
+    not exist.
+    """
+    try:
+        st = os.stat(LOCK_PATH)
+    except OSError:
+        return False  # lock file never created: nobody ever held it
+    try:
+        with open("/proc/locks", "r") as f:
+            want = (os.major(st.st_dev), os.minor(st.st_dev), st.st_ino)
+            for line in f:
+                parts = line.split()
+                if "FLOCK" not in parts:
+                    continue
+                for p in parts:
+                    bits = p.split(":")
+                    if len(bits) == 3:
+                        try:
+                            dev_ino = (int(bits[0], 16), int(bits[1], 16),
+                                       int(bits[2]))
+                        except ValueError:
+                            continue
+                        if dev_ino == want:
+                            return True
+            return False
+    except OSError:
+        pass
+    # no /proc/locks: momentary try-acquire (can race a concurrent
+    # fail-fast probe into one spurious busy — unavoidable off-Linux)
+    try:
+        fd = os.open(LOCK_PATH, os.O_CREAT | os.O_RDWR, 0o644)
+    except OSError:
+        return False
+    try:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            return True  # held by someone
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        return False
+    finally:
+        os.close(fd)
+
+
+def _lock_file_pid():
+    """The pid the current/last holder wrote into the lock file (None
+    when unreadable or never written)."""
+    try:
+        with open(LOCK_PATH, "rb") as f:
+            data = f.read(64).decode("ascii", "replace")
+    except OSError:
+        return None
+    m = re.search(r"pid=(\d+)", data)
+    return int(m.group(1)) if m else None
+
+
+def held_marker_valid() -> bool:
+    """Is the AF2_TPU_LOCK_HELD marker trustworthy in THIS process?
+
+    Three conjunctive conditions, so the marker is honored exactly while
+    the subprocess tree genuinely is one tunnel client:
+
+      1. the flock is CURRENTLY held by somebody (a holder that released
+         — even one still alive — no longer covers its children);
+      2. the pid recorded in the lock file matches the marker's holder
+         (a third party holding the lock must not be mistaken for our
+         ancestor);
+      3. the holder (pid:starttime) is this process or a live ancestor
+         (a recycled pid or a marker leaked into an unrelated daemon
+         fails here; on platforms without /proc this ancestry check is
+         skipped — conditions 1-2 still hold).
+
+    Anything else (stale/inherited/garbled/legacy-"1" marker) is ignored
+    so the kernel-owned flock decides.
+    """
+    raw = os.environ.get(LOCK_HELD_ENV)
+    if not raw:
+        return False
+    pid_s, _, start = raw.partition(":")
+    try:
+        pid = int(pid_s)
+    except ValueError:
+        return False  # legacy/garbled marker: never bypass the flock
+    # cheap no-flock checks first; the flock probe runs last so it only
+    # ever fires for markers that already name a plausible holder
+    file_pid = _lock_file_pid()
+    if file_pid is not None and file_pid != pid:
+        return False  # somebody ELSE holds (or last held) the lock
+    if _proc_start(os.getpid()) is not None and (
+        (pid, start) not in _ancestor_markers()
+    ):
+        return False  # holder is not this process or a live ancestor
+    if not _flock_held():
+        return False  # the recorded holder released (or died): stale
+    return True
 
 
 @contextlib.contextmanager
@@ -57,7 +207,7 @@ def tpu_lock(timeout: float = 0.0, poll: float = 2.0):
     which must never queue behind a long measurement (the watcher retries
     on its own schedule anyway).
     """
-    if os.environ.get(LOCK_HELD_ENV):
+    if held_marker_valid():
         # this process tree already holds the lock (hostenv.tunnel_guard
         # or an enclosing tpu_lock CLI/with-body): one client, reentrant
         yield
@@ -81,12 +231,14 @@ def tpu_lock(timeout: float = 0.0, poll: float = 2.0):
             os.ftruncate(fd, 0)
             os.write(fd, f"pid={os.getpid()}\n".encode())
             had = os.environ.get(LOCK_HELD_ENV)
-            os.environ[LOCK_HELD_ENV] = "1"
+            os.environ[LOCK_HELD_ENV] = _self_marker()
             try:
                 yield
             finally:
                 if had is None:
                     os.environ.pop(LOCK_HELD_ENV, None)
+                else:
+                    os.environ[LOCK_HELD_ENV] = had
         finally:
             fcntl.flock(fd, fcntl.LOCK_UN)
     finally:

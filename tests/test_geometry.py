@@ -148,6 +148,32 @@ def test_metrics_norm_len_guard():
     assert float(gdt(X, X, norm_len=20)[0]) <= 1.0 + 1e-6
 
 
+def test_metrics_norm_len_clamped_under_jit():
+    """Jitted GDT/TM with an undersized norm_len: the eager guard no-ops
+    on tracers, so the compute-time clamp must keep scores <= 1.0
+    (ADVICE r5 — the >1.0 failure just moved behind jit otherwise)."""
+    import jax
+
+    from alphafold2_tpu.geometry import gdt, tmscore
+
+    rs = np.random.RandomState(1)
+    X = jnp.asarray(rs.randn(1, 3, 20))
+    mask = jnp.arange(20)[None] < 15
+
+    # identical structures: unclamped undersized norm_len would give
+    # 15/10 = 1.5; the clamp pins the normalizer to the scored count
+    tm = jax.jit(lambda x, m: tmscore(x, x, mask=m, norm_len=10))(X, mask)
+    gd = jax.jit(lambda x, m: gdt(x, x, mask=m, norm_len=10))(X, mask)
+    assert float(tm[0]) <= 1.0 + 1e-6
+    assert float(gd[0]) <= 1.0 + 1e-6
+    # a COVERING norm_len under jit is unaffected by the clamp
+    tm_ok = jax.jit(lambda x, m: tmscore(x, x, mask=m, norm_len=20))(X, mask)
+    np.testing.assert_allclose(
+        float(tm_ok[0]), float(tmscore(X, X, mask=mask, norm_len=20)[0]),
+        rtol=1e-6,
+    )
+
+
 def test_pdb_bfactor_roundtrip(tmp_path):
     from alphafold2_tpu.geometry.pdb import coords_to_pdb, parse_pdb
 

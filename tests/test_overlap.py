@@ -1,0 +1,387 @@
+"""Communication–compute overlap layer (ISSUE 5).
+
+Three claims, each pinned here on the 8-device virtual CPU mesh:
+
+  * PARITY — the double-buffered ring schedules (XLA streaming and
+    kernel-lse hops), the overlapped SP trunk, and the
+    backward-overlapped DP-accum step each compute the same thing as
+    their synchronous twins (outputs AND gradients, bit-close: same
+    block order, same arithmetic, only psum/add reassociation differs);
+  * STRUCTURE — the overlap-lint checkers (analysis/overlap_lint.py)
+    pass the overlapped lowerings and CATCH a deliberately re-serialized
+    schedule (the fixture the pass's self-check relies on);
+  * PLUMBING — bucketing round-trips arbitrary pytrees, and the
+    AF2_COMM_OVERLAP knob resolves the way the A/B harnesses assume.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from alphafold2_tpu.compat import shard_map
+from alphafold2_tpu.parallel import make_mesh, ring_attention
+from alphafold2_tpu.parallel.overlap import (
+    OVERLAP_ENV,
+    flatten_buckets,
+    overlap_enabled,
+    plan_buckets,
+    unflatten_buckets,
+)
+
+
+def _ring_data(seed=0, b=2, n=32, h=4, d=8):
+    rs = np.random.RandomState(seed)
+    q, k, v = (
+        jnp.asarray(rs.randn(b, n, h, d).astype(np.float32)) for _ in range(3)
+    )
+    mask = jnp.asarray(rs.rand(b, n) > 0.25)
+    return q, k, v, mask
+
+
+def _ring_fn(mesh, overlap, use_kernel=False):
+    spec = P(None, "sp", None, None)
+    return jax.jit(shard_map(
+        lambda q, k, v, m: ring_attention(
+            q, k, v, "sp", mask=m, use_kernel=use_kernel, overlap=overlap
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, P(None, "sp")),
+        out_specs=spec,
+        check_vma=False,  # interpret-mode kernel workaround (test_sequence_parallel)
+    ))
+
+
+# --------------------------------------------------------------------------
+# parity: overlapped vs synchronous schedules
+# --------------------------------------------------------------------------
+
+
+def test_ring_overlap_matches_sync():
+    mesh = make_mesh({"sp": 8})
+    q, k, v, mask = _ring_data(seed=1)
+    got = _ring_fn(mesh, True)(q, k, v, mask)
+    want = _ring_fn(mesh, False)(q, k, v, mask)
+    # same block order, same arithmetic — bit-close
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+def test_ring_overlap_two_shards_degenerate():
+    """P=2: the double-buffered loop body runs ZERO times (prefetch +
+    final block only) — the edge the fori_loop(1, P-1) bounds must get
+    right."""
+    mesh = make_mesh({"sp": 2})
+    q, k, v, mask = _ring_data(seed=2)
+    got = _ring_fn(mesh, True)(q, k, v, mask)
+    want = _ring_fn(mesh, False)(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+def test_ring_overlap_grads_match_sync():
+    mesh = make_mesh({"sp": 8})
+    q, k, v, mask = _ring_data(seed=3)
+    fo, fs = _ring_fn(mesh, True), _ring_fn(mesh, False)
+    g_o = jax.grad(lambda q, k, v: jnp.sum(fo(q, k, v, mask) ** 2),
+                   argnums=(0, 1, 2))(q, k, v)
+    g_s = jax.grad(lambda q, k, v: jnp.sum(fs(q, k, v, mask) ** 2),
+                   argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_o, g_s):
+        assert np.isfinite(np.asarray(a)).all()
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_ring_kernel_overlap_matches_sync():
+    """The kernel-lse hop path (flash_attention_lse + merge_lse), both
+    schedules, including a fully-masked shard's zero-mass handoff.
+    use_kernel=True runs the Pallas kernel in interpret mode on CPU."""
+    mesh = make_mesh({"sp": 4})
+    q, k, v, _ = _ring_data(seed=4, b=1, h=2)
+    mask = jnp.ones((1, 32), bool).at[:, 8:16].set(False).at[:, 3].set(False)
+    got = _ring_fn(mesh, True, use_kernel=True)(q, k, v, mask)
+    want = _ring_fn(mesh, False, use_kernel=True)(q, k, v, mask)
+    assert np.isfinite(np.asarray(got)).all()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+@pytest.mark.slow
+def test_sp_trunk_overlap_matches_sync():
+    """The full SP trunk layer (tied-row MSA, ring cross-attention) under
+    both ring schedules — outputs and parameter gradients."""
+    from alphafold2_tpu.models import Alphafold2Config
+    from alphafold2_tpu.models.trunk import trunk_layer_init
+    from alphafold2_tpu.parallel import sp_trunk_apply
+
+    mesh = make_mesh({"seq": 8})
+    cfg = Alphafold2Config(
+        dim=16, depth=1, heads=2, dim_head=8, max_seq_len=32,
+        msa_tie_row_attn=True,
+    )
+    layers = [trunk_layer_init(jax.random.PRNGKey(0), cfg)]
+    rs = np.random.RandomState(5)
+    x = jnp.asarray(rs.randn(1, 16, 16, 16).astype(np.float32))
+    m = jnp.asarray(rs.randn(1, 8, 8, 16).astype(np.float32))
+
+    outs = {}
+    for overlap in (True, False):
+        xo, mo = sp_trunk_apply(layers, cfg, x, m, mesh, overlap=overlap)
+        outs[overlap] = (np.asarray(xo), np.asarray(mo))
+    np.testing.assert_allclose(outs[True][0], outs[False][0], atol=1e-6)
+    np.testing.assert_allclose(outs[True][1], outs[False][1], atol=1e-6)
+
+    def loss(ls, overlap):
+        xo, mo = sp_trunk_apply(ls, cfg, x, m, mesh, overlap=overlap)
+        return jnp.sum(xo ** 2) + jnp.sum(mo ** 2)
+
+    g_o = jax.grad(lambda ls: loss(ls, True))(layers)
+    g_s = jax.grad(lambda ls: loss(ls, False))(layers)
+    for a, b in zip(jax.tree_util.tree_leaves(g_o),
+                    jax.tree_util.tree_leaves(g_s)):
+        assert np.isfinite(np.asarray(a)).all()
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def _dp_pieces(grad_accum=3, uniform_mask=True, seed=0):
+    from alphafold2_tpu.models import Alphafold2Config
+    from alphafold2_tpu.training.harness import TrainConfig, train_state_init
+
+    cfg = Alphafold2Config(dim=32, depth=1, heads=4, dim_head=8,
+                           max_seq_len=32)
+    tcfg = TrainConfig(learning_rate=1e-3, grad_accum=grad_accum)
+    rs = np.random.RandomState(seed)
+    mask = (np.ones((grad_accum, 8, 16), bool) if uniform_mask
+            else rs.rand(grad_accum, 8, 16) > 0.2)
+    batch = {
+        "seq": jnp.asarray(rs.randint(0, 21, (grad_accum, 8, 16))),
+        "mask": jnp.asarray(mask),
+        "coords": jnp.asarray(rs.randn(grad_accum, 8, 16, 3).astype(np.float32)),
+    }
+    state = train_state_init(jax.random.PRNGKey(0), cfg, tcfg)
+    return cfg, tcfg, batch, state
+
+
+def test_dp_overlap_step_matches_sync_schedule():
+    """Overlapped vs synchronous DP-accum step: loss, grad norm, and the
+    post-step params agree bit-close (psum-of-sums vs sum-of-psums is the
+    only reassociation). Masks non-uniform on purpose — the two SCHEDULES
+    must agree regardless."""
+    from alphafold2_tpu.parallel import make_dp_overlap_train_step
+
+    mesh = make_mesh({"data": 8})
+    cfg, tcfg, batch, state = _dp_pieces(uniform_mask=False)
+    out = {}
+    for overlap in (True, False):
+        step, _ = make_dp_overlap_train_step(
+            cfg, tcfg, mesh, batch, overlap=overlap, donate_state=False
+        )
+        s2, m = step(state, batch, jax.random.PRNGKey(1))
+        out[overlap] = (s2, m)
+    np.testing.assert_allclose(float(out[True][1]["loss"]),
+                               float(out[False][1]["loss"]), rtol=1e-6)
+    np.testing.assert_allclose(float(out[True][1]["grad_norm"]),
+                               float(out[False][1]["grad_norm"]), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(out[True][0]["params"]),
+                    jax.tree_util.tree_leaves(out[False][0]["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_dp_overlap_step_matches_gspmd_step():
+    """With uniform per-shard loss normalizers (all-valid masks), the
+    explicit-collective step reproduces the GSPMD-partitioned
+    make_sharded_train_step exactly (params and metrics)."""
+    from alphafold2_tpu.parallel import (
+        make_dp_overlap_train_step,
+        make_sharded_train_step,
+    )
+
+    mesh = make_mesh({"data": 8})
+    cfg, tcfg, batch, state = _dp_pieces(uniform_mask=True)
+    step_g, _ = make_sharded_train_step(
+        cfg, tcfg, mesh, batch, tp=False, donate_state=False
+    )
+    s_g, m_g = step_g(state, batch, jax.random.PRNGKey(1))
+    step_o, _ = make_dp_overlap_train_step(
+        cfg, tcfg, mesh, batch, overlap=True, donate_state=False
+    )
+    s_o, m_o = step_o(state, batch, jax.random.PRNGKey(1))
+    np.testing.assert_allclose(float(m_o["loss"]), float(m_g["loss"]),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(s_o["params"]),
+                    jax.tree_util.tree_leaves(s_g["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_dp_overlap_step_donation_and_norng():
+    """The deterministic (rng=None) path traces its own program and state
+    donation holds (the production calling convention)."""
+    from alphafold2_tpu.parallel import make_dp_overlap_train_step
+
+    mesh = make_mesh({"data": 8})
+    cfg, tcfg, batch, state = _dp_pieces(grad_accum=1)
+    step, _ = make_dp_overlap_train_step(cfg, tcfg, mesh, batch)
+    s2, m = step(state, batch)
+    assert np.isfinite(float(m["loss"]))
+    assert int(s2["step"]) == 1
+
+
+# --------------------------------------------------------------------------
+# bucketing + knob plumbing
+# --------------------------------------------------------------------------
+
+
+def test_bucketing_roundtrip():
+    rs = np.random.RandomState(7)
+    tree = {
+        "a": jnp.asarray(rs.randn(5, 3).astype(np.float32)),
+        "b": {
+            "w": jnp.asarray(rs.randn(17).astype(np.float32)),
+            "n": jnp.asarray(rs.randint(0, 9, (4,)), jnp.int32),
+        },
+        "c": jnp.asarray(rs.randn(2, 2, 2).astype(np.float32)),
+    }
+    # tiny cap forces splits; the int leaf forces a dtype boundary
+    treedef, buckets = plan_buckets(tree, bucket_elems=16)
+    leaves = jax.tree_util.tree_leaves(tree)
+    covered = sorted(i for ix in buckets for i in ix)
+    assert covered == list(range(len(leaves)))  # every leaf exactly once
+    for ix in buckets:  # dtype-homogeneous buckets
+        assert len({leaves[i].dtype for i in ix}) == 1
+    flats = flatten_buckets(tree, buckets)
+    assert all(f.ndim == 1 for f in flats)
+    out = unflatten_buckets(flats, tree, treedef, buckets)
+    for a, b in zip(jax.tree_util.tree_leaves(out), leaves):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_overlap_env_gate(monkeypatch):
+    assert overlap_enabled(True) is True
+    assert overlap_enabled(False) is False
+    monkeypatch.delenv(OVERLAP_ENV, raising=False)
+    assert overlap_enabled(None) is True  # default on
+    for off in ("0", "false", "off"):
+        monkeypatch.setenv(OVERLAP_ENV, off)
+        assert overlap_enabled(None) is False
+    monkeypatch.setenv(OVERLAP_ENV, "1")
+    assert overlap_enabled(None) is True
+
+
+# --------------------------------------------------------------------------
+# overlap-lint: the schedule checkers and the re-serialized fixture
+# --------------------------------------------------------------------------
+
+
+def _export_text(fn, *args):
+    from jax import export as jexport
+
+    return jexport.export(jax.jit(fn), platforms=["tpu"])(*args).mlir_module()
+
+
+def _ring_export(overlap):
+    mesh = make_mesh({"sp": 8})
+    spec = P(None, "sp", None, None)
+    sm = shard_map(
+        lambda q, k, v, m: ring_attention(
+            q, k, v, "sp", mask=m, use_kernel=False, overlap=overlap
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, P(None, "sp")),
+        out_specs=spec,
+    )
+    sh = jax.ShapeDtypeStruct((1, 32, 2, 8), jnp.float32)
+    ms = jax.ShapeDtypeStruct((1, 32), jnp.bool_)
+    return _export_text(sm, sh, sh, sh, ms)
+
+
+def test_overlap_lint_passes_overlapped_ring():
+    from alphafold2_tpu.analysis.overlap_lint import (
+        analyze_schedule,
+        check_overlapped_ring,
+    )
+
+    stats = analyze_schedule(_ring_export(True))
+    assert check_overlapped_ring(stats, expected_permutes=6) == []
+    assert stats.fenced.get("collective_permute", 0) == 0
+
+
+def test_overlap_lint_catches_serialized_ring():
+    """THE fixture: a deliberately re-serialized schedule (the
+    synchronous arm) must be flagged by the overlap checker — and the
+    detector self-check must agree it fired."""
+    from alphafold2_tpu.analysis.overlap_lint import (
+        analyze_schedule,
+        check_overlapped_ring,
+        check_serialized_ring_detected,
+    )
+
+    stats = analyze_schedule(_ring_export(False))
+    problems = check_overlapped_ring(stats, expected_permutes=6)
+    assert problems, "serialized ring schedule was not flagged"
+    assert any("fence" in p or "serialized" in p for p in problems)
+    assert stats.fenced.get("collective_permute", 0) > 0
+    assert check_serialized_ring_detected(stats) == []
+
+
+@pytest.mark.slow
+def test_overlap_lint_dp_schedules():
+    from alphafold2_tpu.analysis.overlap_lint import (
+        analyze_schedule,
+        check_overlapped_dp,
+        check_serialized_dp_detected,
+    )
+    from alphafold2_tpu.parallel import make_dp_overlap_train_step, plan_buckets
+    from jax import export as jexport
+
+    mesh = make_mesh({"data": 8})
+    cfg, tcfg, batch, state = _dp_pieces()
+    batch_shape = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch
+    )
+    state_shape = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype), state
+    )
+    n_buckets = len(plan_buckets(state_shape["params"])[1])
+
+    step, _ = make_dp_overlap_train_step(
+        cfg, tcfg, mesh, batch_shape, overlap=True, donate_state=False
+    )
+    stats = analyze_schedule(
+        jexport.export(step, platforms=["tpu"])(state_shape, batch_shape)
+        .mlir_module()
+    )
+    assert check_overlapped_dp(stats, n_buckets) == []
+    assert stats.loop_counts["all_reduce"] >= n_buckets
+
+    step_s, _ = make_dp_overlap_train_step(
+        cfg, tcfg, mesh, batch_shape, overlap=False, donate_state=False
+    )
+    stats_s = analyze_schedule(
+        jexport.export(step_s, platforms=["tpu"])(state_shape, batch_shape)
+        .mlir_module()
+    )
+    assert check_serialized_dp_detected(stats_s, n_buckets) == []
+    # and the overlapped checker flags the serialized schedule
+    assert check_overlapped_dp(stats_s, n_buckets) != []
+
+
+def test_overlap_pass_registered():
+    """The pass is wired into the registry, runs under --strict, and is
+    dropped (like smoke) for file-scoped invocations."""
+    from alphafold2_tpu import analysis as an
+
+    assert "overlap" in an.PASSES
+    called = []
+    orig = an.PASSES["overlap"]
+    an.PASSES["overlap"] = lambda *a, **k: called.append(1) or []
+    try:
+        an.run_passes(os.path.dirname(__file__), files=[__file__],
+                      select=("compat",))
+        assert not called  # not selected
+        an.run_passes(os.path.dirname(__file__), files=[__file__])
+        assert not called  # file-scoped default drops repo-wide passes
+        an.run_passes(os.path.dirname(__file__), select=("overlap",))
+        assert called  # explicit selection always runs it
+    finally:
+        an.PASSES["overlap"] = orig

@@ -99,3 +99,122 @@ def test_crashed_holder_releases():
 def test_lock_busy_sentinel_is_stable():
     # orchestrators compare by equality; a rename breaks their back-off path
     assert LOCK_BUSY == "tpu-lock-busy"
+
+
+def test_held_marker_validation():
+    """The marker is honored ONLY while a live-ancestor holder actually
+    holds the flock: legacy "1", garbled, dead-pid, recycled-pid, and
+    released-holder markers all fall back to the real flock (the
+    inherited-marker reentrancy hole, ADVICE r5)."""
+    from tpu_lock import _self_marker, held_marker_valid
+
+    saved = os.environ.pop(LOCK_HELD_ENV, None)
+    try:
+        with tpu_lock():
+            # while the lock IS held by this process, its own marker is
+            # valid (the one-client-per-tree reentrancy)...
+            assert held_marker_valid()
+            genuine = os.environ[LOCK_HELD_ENV]
+            # ...but wrong holders are still rejected
+            os.environ[LOCK_HELD_ENV] = "1"  # legacy: unverifiable
+            assert not held_marker_valid()
+            os.environ[LOCK_HELD_ENV] = "not-a-pid:xyz"
+            assert not held_marker_valid()
+            os.environ[LOCK_HELD_ENV] = "99999999:123"  # impossible pid
+            assert not held_marker_valid()
+            # own pid, wrong starttime = a recycled pid
+            os.environ[LOCK_HELD_ENV] = f"{os.getpid()}:0"
+            assert not held_marker_valid()
+            os.environ[LOCK_HELD_ENV] = genuine
+        # after RELEASE, the same marker (as a child would still carry in
+        # its inherited env) is stale even though the holder is alive —
+        # the post-release bypass the flock-held condition closes
+        os.environ[LOCK_HELD_ENV] = genuine
+        assert not held_marker_valid()
+    finally:
+        if saved is None:
+            os.environ.pop(LOCK_HELD_ENV, None)
+        else:
+            os.environ[LOCK_HELD_ENV] = saved
+
+
+def test_marker_of_nonholder_does_not_cover_third_party_lock():
+    """A live would-be holder that RELEASED while a third party now holds
+    the lock: the inherited marker must not ride the third party's flock
+    (lock-file pid mismatch)."""
+    import pytest
+    from tpu_lock import _self_marker, held_marker_valid
+
+    with tpu_lock():
+        genuine = os.environ[LOCK_HELD_ENV]
+    holder = subprocess.Popen(
+        [sys.executable, os.path.join(SCRIPTS, "tpu_lock.py"),
+         "--", sys.executable, "-c",
+         "import sys, time; print('held', flush=True); time.sleep(60)"],
+        stdout=subprocess.PIPE, text=True, env=_independent_env(),
+    )
+    try:
+        assert holder.stdout.readline().strip() == "held"
+        saved = os.environ.pop(LOCK_HELD_ENV, None)
+        os.environ[LOCK_HELD_ENV] = genuine  # alive ancestor, but not the holder
+        try:
+            assert not held_marker_valid()
+            with pytest.raises(TimeoutError):
+                with tpu_lock(timeout=0):
+                    pass
+        finally:
+            if saved is None:
+                os.environ.pop(LOCK_HELD_ENV, None)
+            else:
+                os.environ[LOCK_HELD_ENV] = saved
+    finally:
+        holder.kill()
+        holder.wait()
+
+
+def test_held_marker_valid_in_child_of_holder():
+    """A subprocess spawned UNDER the lock sees the parent as a live
+    ancestor — the one-client-per-tree reentrancy that must keep
+    working."""
+    with tpu_lock():
+        rc = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; sys.path.insert(0, sys.argv[1]); "
+             "from tpu_lock import held_marker_valid; "
+             "raise SystemExit(0 if held_marker_valid() else 1)",
+             SCRIPTS],
+            capture_output=True,
+        ).returncode
+        assert rc == 0
+
+
+def test_orphaned_marker_does_not_bypass_flock():
+    """The exact ADVICE r5 scenario: a process carrying a marker whose
+    holder is DEAD must contend for the flock like anyone else — here an
+    independent client holds it, so acquisition must fail instead of
+    silently bypassing into a two-client collision."""
+    import pytest
+
+    holder = subprocess.Popen(
+        [sys.executable, os.path.join(SCRIPTS, "tpu_lock.py"),
+         "--", sys.executable, "-c",
+         "import sys, time; print('held', flush=True); time.sleep(60)"],
+        stdout=subprocess.PIPE, text=True, env=_independent_env(),
+    )
+    try:
+        assert holder.stdout.readline().strip() == "held"
+        # fabricate an inherited-but-orphaned marker (dead holder pid)
+        saved = os.environ.pop(LOCK_HELD_ENV, None)
+        os.environ[LOCK_HELD_ENV] = "99999999:123"
+        try:
+            with pytest.raises(TimeoutError):
+                with tpu_lock(timeout=0):
+                    pass
+        finally:
+            if saved is None:
+                os.environ.pop(LOCK_HELD_ENV, None)
+            else:
+                os.environ[LOCK_HELD_ENV] = saved
+    finally:
+        holder.kill()
+        holder.wait()
