@@ -220,22 +220,74 @@ def _targets() -> Dict[str, Callable[[], None]]:
             abstract((4, 4, 16), jnp.int32), abstract((4, 4, 16), jnp.bool_),
         )
 
+    @register("serving.fleet")
+    def _serving_fleet():
+        # fleet round trip over stub engines: admission -> dispatch ->
+        # completion callback -> client future, plus clean shutdown. An
+        # import- or wiring-time break in the fleet/admission layer must
+        # surface here, not first in a paid chaos replay
+        import numpy as np
+
+        from alphafold2_tpu.models import Alphafold2Config
+        from alphafold2_tpu.serving import (
+            FleetConfig,
+            ServingConfig,
+            ServingEngine,
+            ServingFleet,
+        )
+
+        tiny = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8,
+                                max_seq_len=16)
+
+        class Stub(ServingEngine):
+            def _call_executable(self, bucket, tokens, mask, msa=None,
+                                 msa_mask=None):
+                B, Lb = tokens.shape
+                return {
+                    "coords": np.zeros((B, Lb, 3), np.float32),
+                    "confidence": np.full((B, Lb), 0.5, np.float32),
+                    "stress": np.zeros((B,), np.float32),
+                }
+
+        fleet = ServingFleet(
+            {}, tiny,
+            ServingConfig(buckets=(8, 16), max_batch=2, max_wait_s=0.0,
+                          cache_capacity=0),
+            FleetConfig(replicas=2, probe_interval_s=0),
+            engine_factory=lambda n, c, h: Stub({}, tiny, c, fault_hook=h),
+        )
+        try:
+            res = fleet.predict("ACDEF", timeout=30)
+            assert res.coords.shape == (5, 3) and res.replica in ("r0", "r1")
+            assert fleet.stats()["requests"]["completed"] == 1
+        finally:
+            fleet.shutdown()
+
     # --- reliability --------------------------------------------------------
     # host-side subsystems: no shapes to eval, but the same failure class —
     # an import- or construction-time regression in the chaos layer must
     # surface in the seconds-cheap gate, not first in a paid chaos run
     @register("reliability.fault_plan")
     def _fault_plan():
-        from alphafold2_tpu.reliability import FAULT_KINDS, FaultPlan
+        from alphafold2_tpu.reliability import (
+            FAULT_KINDS,
+            REPLICA_FAULT_KINDS,
+            FaultPlan,
+        )
 
         plan = FaultPlan.from_json(json.dumps({
             "seed": 7,
-            "faults": [{"kind": k, "at": i} for i, k in enumerate(FAULT_KINDS)],
+            "faults": [
+                {"kind": k, "at": i,
+                 **({"replica": "r0"} if k in REPLICA_FAULT_KINDS else {})}
+                for i, k in enumerate(FAULT_KINDS)
+            ],
         }))
         assert FaultPlan.from_json(plan.to_json()) == plan
         inj = plan.injector()
         assert not inj.exhausted()
-        inj.checkpoint_hook(), inj.serving_hook()  # hook factories build
+        # hook factories build (incl. the fleet replica-scoped hook)
+        inj.checkpoint_hook(), inj.serving_hook(), inj.replica_hook("r0")
 
     @register("reliability.breaker")
     def _breaker():
@@ -250,6 +302,31 @@ def _targets() -> Dict[str, Callable[[], None]]:
         assert b.allow() and not b.allow()  # one half-open probe
         b.record_success()
         assert b.state is CircuitState.CLOSED
+
+    @register("reliability.health")
+    def _health():
+        from alphafold2_tpu.reliability import HealthMonitor, ReplicaState
+
+        t = [0.0]
+        seen = []
+        up = [False]  # replica answers probes only once "repaired"
+        mon = HealthMonitor(probe_interval_s=1.0, reprobe_interval_s=1.0,
+                            fail_threshold=2, clock=lambda: t[0])
+        mon.register("r0", probe=lambda: up[0],
+                     on_drain=lambda n, why: seen.append(("drain", n)),
+                     on_reinstate=lambda n: seen.append(("up", n)))
+        # dispatch evidence drains at threshold, on the next tick
+        assert not mon.record_failure("r0")
+        assert mon.record_failure("r0")
+        assert mon.state("r0") is ReplicaState.DOWN
+        mon.tick(now=0.0)
+        assert seen == [("drain", "r0")]
+        assert mon.state("r0") is ReplicaState.DOWN  # re-probe still failing
+        up[0] = True
+        t[0] = 2.0
+        mon.tick()  # re-probe succeeds -> reinstated
+        assert mon.state("r0") is ReplicaState.HEALTHY
+        assert seen[-1] == ("up", "r0")
 
     @register("reliability.verified_checkpoint")
     def _verified_ckpt():

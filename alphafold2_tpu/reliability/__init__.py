@@ -12,7 +12,11 @@ failure a first-class, TESTABLE input across the whole stack:
     `training/harness.py`, `training/data.py`, `training/checkpoint.py`,
     and `serving/engine.py`.
   * `breaker` — `CircuitBreaker`: the serving engine's consecutive-failure
-    circuit (open -> fast-reject, half-open probe -> close).
+    circuit (open -> fast-reject, half-open probe -> close), with seeded
+    reopen jitter so a fleet of breakers never re-probes in lockstep.
+  * `health` — `HealthMonitor`/`ReplicaState`: heartbeat probes + drain/
+    reinstate state machine over named replicas (the serving fleet's
+    supervisor; clock-injectable, serving-agnostic).
   * `preemption` — `PreemptionHandler`/`Preempted`: SIGTERM-aware clean
     shutdown; `run_resilient` drains to a final checkpoint and a fresh run
     resumes bit-exact from it.
@@ -26,21 +30,26 @@ bit-exact), and never hangs.
 from alphafold2_tpu.reliability.breaker import CircuitBreaker, CircuitState
 from alphafold2_tpu.reliability.faults import (
     FAULT_KINDS,
+    REPLICA_FAULT_KINDS,
     Fault,
     FaultInjector,
     FaultPlan,
     InjectedFault,
 )
+from alphafold2_tpu.reliability.health import HealthMonitor, ReplicaState
 from alphafold2_tpu.reliability.preemption import Preempted, PreemptionHandler
 
 __all__ = [
     "FAULT_KINDS",
+    "REPLICA_FAULT_KINDS",
     "Fault",
     "FaultInjector",
     "FaultPlan",
     "InjectedFault",
     "CircuitBreaker",
     "CircuitState",
+    "HealthMonitor",
+    "ReplicaState",
     "Preempted",
     "PreemptionHandler",
 ]

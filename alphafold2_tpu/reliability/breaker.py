@@ -21,11 +21,22 @@ Thread model: `allow()` runs on submitter threads, `record_*` on the
 engine worker — every transition happens under one lock. A success
 recorded while open (a straggler dispatch from before the trip) closes
 the circuit: evidence the model works beats the timer.
+
+Fleet deployments add one wrinkle: when a shared dependency (the device
+runtime, a params push) fails every replica at once, N breakers with the
+same `reset_s` all re-probe at the same instant — a thundering-herd
+reopen that can re-wedge the dependency the moment it recovers. `jitter`
+spreads the open→half-open delay: each open transition draws its window
+from `reset_s * [1, 1 + jitter]` using a seeded PRNG, so a fleet of
+breakers seeded differently de-synchronizes deterministically. The
+default (jitter=0) keeps the exact fixed-window arm the chaos tests
+drive.
 """
 
 from __future__ import annotations
 
 import enum
+import random
 import threading
 import time
 
@@ -37,20 +48,37 @@ class CircuitState(str, enum.Enum):
 
 
 class CircuitBreaker:
-    def __init__(self, threshold: int, reset_s: float, clock=time.monotonic):
+    def __init__(self, threshold: int, reset_s: float, clock=time.monotonic,
+                 jitter: float = 0.0, seed: int = 0):
         if threshold < 1:
             raise ValueError(f"threshold must be >= 1, got {threshold}")
         if reset_s < 0:
             raise ValueError(f"reset_s must be >= 0, got {reset_s}")
+        if jitter < 0:
+            raise ValueError(f"jitter must be >= 0, got {jitter}")
         self.threshold = threshold
         self.reset_s = reset_s
+        self.jitter = jitter
+        # seeded, per-instance: two breakers with different seeds draw
+        # different delay sequences; the same seed replays exactly
+        self._rng = random.Random(seed)
         self._clock = clock
         self._lock = threading.Lock()
         self._state = CircuitState.CLOSED
         self._failures = 0          # consecutive failures while closed
         self._opened_at = 0.0
+        self._current_reset_s = reset_s  # this open window's jittered length
         self._probe_in_flight = False
         self._trips = 0             # lifetime open transitions (stats)
+
+    def _open(self, now: float):
+        """Transition to OPEN (lock held): draw this window's length."""
+        self._state = CircuitState.OPEN
+        self._opened_at = now
+        self._current_reset_s = self.reset_s * (
+            1.0 + (self._rng.uniform(0.0, self.jitter) if self.jitter else 0.0)
+        )
+        self._trips += 1
 
     @property
     def state(self) -> CircuitState:
@@ -65,7 +93,7 @@ class CircuitBreaker:
                 return True
             if (
                 self._state is CircuitState.OPEN
-                and self._clock() - self._opened_at >= self.reset_s
+                and self._clock() - self._opened_at >= self._current_reset_s
             ):
                 self._state = CircuitState.HALF_OPEN
                 self._probe_in_flight = True
@@ -85,16 +113,12 @@ class CircuitBreaker:
             now = self._clock()
             if self._state is CircuitState.HALF_OPEN:
                 # the probe failed: back to open for a fresh window
-                self._state = CircuitState.OPEN
-                self._opened_at = now
+                self._open(now)
                 self._probe_in_flight = False
-                self._trips += 1
             elif self._state is CircuitState.CLOSED:
                 self._failures += 1
                 if self._failures >= self.threshold:
-                    self._state = CircuitState.OPEN
-                    self._opened_at = now
-                    self._trips += 1
+                    self._open(now)
             # already open: stragglers from pre-trip dispatches are no news
 
     def abandon_probe(self):
@@ -116,6 +140,9 @@ class CircuitBreaker:
                 "reset_s": self.reset_s,
                 "trips": self._trips,
             }
+            if self.jitter:
+                snap["jitter"] = self.jitter
+                snap["current_reset_s"] = self._current_reset_s
             if self._state is not CircuitState.CLOSED:
                 snap["open_for_s"] = max(0.0, self._clock() - self._opened_at)
             return snap

@@ -31,6 +31,23 @@ Indices are per-site counters (train step number, batch fetch index,
 checkpoint step, serving dispatch index), so one plan can script a whole
 scenario: "data error at batch 2, corrupt the step-3 checkpoint, crash
 step 4, preempt at step 6".
+
+Replica-scoped faults (`kill_replica` / `slow_replica` / `flap_replica`)
+target one NAMED replica of a serving fleet (`serving/fleet.py` wires
+`injector.replica_hook(name)` into each replica engine). Their index is a
+per-replica dispatch counter kept by the INJECTOR — not the engine — so
+it survives the engine restarts that drain/reinstate cycles perform:
+
+  kill_replica   every dispatch on `replica` raises from index `at` on,
+                 FOREVER (latched; `count` is ignored — a killed replica
+                 stays dead until the plan's author says otherwise).
+  slow_replica   sleep `delay_s` per dispatch, `count` deliveries.
+  flap_replica   raise per dispatch, `count` deliveries, then healthy —
+                 the health manager's re-probe path reinstates it.
+
+Validate a hand-written plan before paying for a run:
+
+  python -m alphafold2_tpu.reliability.faults --check plan.json
 """
 
 from __future__ import annotations
@@ -51,7 +68,13 @@ FAULT_KINDS = (
     "request_error",    # raise InjectedFault at serving dispatch index `at`
     "slow_request",     # sleep `delay_s` at serving dispatch index `at`
     "hung_request",     # sleep `hang_s` (watchdog fodder) at dispatch `at`
+    "kill_replica",     # named fleet replica: fail every dispatch from `at` on
+    "slow_replica",     # named fleet replica: sleep `delay_s` per dispatch
+    "flap_replica",     # named fleet replica: fail `count` dispatches, recover
 )
+
+#: kinds that target one named fleet replica and require `replica`
+REPLICA_FAULT_KINDS = ("kill_replica", "slow_replica", "flap_replica")
 
 _CKPT_MODES = ("truncate", "corrupt", "no_manifest")
 
@@ -71,8 +94,9 @@ class Fault:
     at: int = 0
     count: int = 1
     mode: str = "truncate"      # ckpt_corrupt: truncate | corrupt | no_manifest
-    delay_s: float = 0.05       # slow_request sleep
+    delay_s: float = 0.05       # slow_request / slow_replica sleep
     hang_s: float = 30.0        # hung_request sleep (past any sane watchdog)
+    replica: str = ""           # *_replica kinds: the named fleet replica
     message: str = ""
 
     def __post_init__(self):
@@ -86,9 +110,22 @@ class Fault:
             )
         if self.count < 1:
             raise ValueError(f"count must be >= 1, got {self.count}")
+        if self.kind in REPLICA_FAULT_KINDS and not self.replica:
+            raise ValueError(
+                f"{self.kind} requires a 'replica' name (e.g. \"r0\") — a "
+                f"replica-scoped fault with no target would silently no-op"
+            )
+        if self.replica and self.kind not in REPLICA_FAULT_KINDS:
+            raise ValueError(
+                f"'replica' is only meaningful for {REPLICA_FAULT_KINDS}, "
+                f"not {self.kind!r}"
+            )
 
     def describe(self) -> str:
-        return self.message or f"injected {self.kind} at index {self.at}"
+        if self.message:
+            return self.message
+        where = f"replica {self.replica!r}, " if self.replica else ""
+        return f"injected {self.kind} ({where}index {self.at})"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,13 +139,30 @@ class FaultPlan:
 
     @classmethod
     def from_dict(cls, d: dict) -> "FaultPlan":
+        unknown_top = set(d) - {"faults", "seed"}
+        if unknown_top:
+            raise ValueError(
+                f"unknown fault-plan key(s) {sorted(unknown_top)}; a plan is "
+                f"{{\"seed\": int, \"faults\": [...]}}"
+            )
+        allowed = {f.name for f in dataclasses.fields(Fault)}
         faults = []
-        for f in d.get("faults", ()):
+        for i, f in enumerate(d.get("faults", ())):
             f = dict(f)
             # "step"/"index" read more naturally in hand-written plans
             for alias in ("step", "index"):
                 if alias in f:
                     f["at"] = f.pop(alias)
+            unknown = set(f) - allowed
+            if unknown:
+                # loud, not a generic TypeError (and NEVER a silent drop):
+                # a typo'd field means the plan does not say what its
+                # author thinks it says
+                raise ValueError(
+                    f"fault #{i} ({f.get('kind', '?')!r}): unknown field(s) "
+                    f"{sorted(unknown)}; allowed: "
+                    f"{sorted(allowed | {'step', 'index'})}"
+                )
             faults.append(Fault(**f))
         return cls(faults=tuple(faults), seed=int(d.get("seed", 0)))
 
@@ -157,6 +211,7 @@ class FaultInjector:
         self.plan = plan
         self._lock = threading.Lock()
         self._fired = [0] * len(plan.faults)
+        self._replica_dispatch = {}  # replica name -> injector-side counter
         self._preemption = None  # bound PreemptionHandler for `preempt`
         self.delivered: List[str] = []  # audit log of delivered faults
 
@@ -166,22 +221,36 @@ class FaultInjector:
         self._preemption = handler
         return self
 
-    def _take(self, kind: str, index: int) -> Optional[Fault]:
-        """Claim a matching fault (at most `count` deliveries), or None."""
+    def _take(self, kind: str, index: int,
+              replica: str = "") -> Optional[Fault]:
+        """Claim a matching fault (at most `count` deliveries), or None.
+        `kill_replica` is LATCHED: it keeps delivering past any count —
+        a killed replica must stay dead across health re-probes."""
         with self._lock:
             for i, f in enumerate(self.plan.faults):
-                if f.kind == kind and index >= f.at and self._fired[i] < f.count:
-                    self._fired[i] += 1
-                    self.delivered.append(f"{kind}@{index}")
-                    return f
+                if f.kind != kind or f.replica != replica or index < f.at:
+                    continue
+                if f.kind != "kill_replica" and self._fired[i] >= f.count:
+                    continue
+                self._fired[i] += 1
+                # audit log: a latched kill delivers on EVERY dispatch and
+                # re-probe forever — record only its first delivery so the
+                # log (and the serve.py summary that prints it) stays
+                # bounded over a long soak
+                if f.kind != "kill_replica" or self._fired[i] == 1:
+                    tag = f"{kind}[{replica}]" if replica else kind
+                    self.delivered.append(f"{tag}@{index}")
+                return f
         return None
 
     def exhausted(self) -> bool:
         """True when every scheduled fault has delivered all its counts —
-        chaos tests assert this so a plan that never fired cannot pass."""
+        chaos tests assert this so a plan that never fired cannot pass.
+        A latched `kill_replica` counts as exhausted after ONE delivery
+        (it has no finite count to drain)."""
         with self._lock:
             return all(
-                fired >= f.count
+                fired >= (1 if f.kind == "kill_replica" else f.count)
                 for fired, f in zip(self._fired, self.plan.faults)
             )
 
@@ -266,3 +335,76 @@ class FaultInjector:
                 raise InjectedFault(f.describe())
 
         return hook
+
+    # -- hook: fleet replica dispatch (serving/fleet.py) ---------------------
+
+    def replica_hook(self, name: str):
+        """Returns a ServingEngine fault_hook scoped to fleet replica
+        `name`, delivering kill/slow/flap faults. The dispatch index is an
+        injector-side per-replica counter (NOT the engine's): a drained
+        replica is reinstated behind a FRESH engine whose own counter
+        restarts at zero, and the fault schedule must not rewind with it.
+        Health probes dispatch through the same hook, so a killed replica
+        fails its re-probes too — exactly like a dead device would."""
+        import time
+
+        def hook(engine_index: int, bucket: int):
+            with self._lock:
+                index = self._replica_dispatch.get(name, 0)
+                self._replica_dispatch[name] = index + 1
+            f = self._take("slow_replica", index, replica=name)
+            if f is not None:
+                time.sleep(f.delay_s)
+            f = self._take("kill_replica", index, replica=name)
+            if f is not None:
+                raise InjectedFault(f.describe())
+            f = self._take("flap_replica", index, replica=name)
+            if f is not None:
+                raise InjectedFault(f.describe())
+
+        return hook
+
+
+def _check_main(argv=None) -> int:
+    """`python -m alphafold2_tpu.reliability.faults --check plan.json` —
+    validate a fault plan's schema without running anything. Exit 0 and
+    print the parsed schedule on success; exit 2 with the precise
+    rejection on any unknown kind/field/mode (the same validation every
+    loading path runs — the CLI just runs it before you pay for a run)."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m alphafold2_tpu.reliability.faults",
+        description="validate a chaos fault-plan JSON schema",
+    )
+    ap.add_argument("--check", required=True, metavar="PLAN_JSON",
+                    help="path to the fault-plan JSON to validate")
+    args = ap.parse_args(argv)
+    try:
+        plan = FaultPlan.from_file(args.check)
+    except (ValueError, TypeError, KeyError) as e:
+        print(f"INVALID {args.check}: {e}", file=sys.stderr)
+        return 2
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"UNREADABLE {args.check}: {e}", file=sys.stderr)
+        return 2
+    print(f"OK {args.check}: {len(plan.faults)} fault(s), seed {plan.seed}")
+    for f in plan.faults:
+        extra = []
+        if f.replica:
+            extra.append(f"replica={f.replica}")
+        if f.kind == "ckpt_corrupt":
+            extra.append(f"mode={f.mode}")
+        if f.kind in ("slow_request", "slow_replica"):
+            extra.append(f"delay_s={f.delay_s}")
+        if f.kind == "hung_request":
+            extra.append(f"hang_s={f.hang_s}")
+        count = "latched" if f.kind == "kill_replica" else f"count={f.count}"
+        print(f"  {f.kind:16s} at={f.at:<5d} {count}"
+              + (f"  ({', '.join(extra)})" if extra else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_check_main())

@@ -10,6 +10,11 @@ serving metrics with latency quantiles (`metrics`). `serve.py` at the
 repo root drives it over a many-record FASTA as a traffic-replay harness.
 """
 
+from alphafold2_tpu.serving.admission import (
+    PRIORITIES,
+    AdmissionConfig,
+    AdmissionController,
+)
 from alphafold2_tpu.serving.bucketing import (
     DEFAULT_BUCKETS,
     BucketLadder,
@@ -27,11 +32,18 @@ from alphafold2_tpu.serving.errors import (
     EngineClosedError,
     HungBatchError,
     InvalidSequenceError,
+    NoHealthyReplicaError,
     PredictionError,
     QueueFullError,
     RequestTimeoutError,
     RequestTooLongError,
+    RequeueLimitError,
     ServingError,
+)
+from alphafold2_tpu.serving.fleet import (
+    FleetConfig,
+    FleetRequest,
+    ServingFleet,
 )
 from alphafold2_tpu.serving.metrics import ServingMetrics
 
@@ -44,22 +56,30 @@ from alphafold2_tpu.serving.metrics import ServingMetrics
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "PRIORITIES",
+    "AdmissionConfig",
+    "AdmissionController",
     "BucketLadder",
     "pad_batch",
     "ResultCache",
     "request_key",
+    "FleetConfig",
+    "FleetRequest",
     "PredictionResult",
     "ServingConfig",
     "ServingEngine",
+    "ServingFleet",
     "ServingRequest",
     "ServingMetrics",
     "CircuitOpenError",
     "EngineClosedError",
     "HungBatchError",
     "InvalidSequenceError",
+    "NoHealthyReplicaError",
     "PredictionError",
     "QueueFullError",
     "RequestTimeoutError",
     "RequestTooLongError",
+    "RequeueLimitError",
     "ServingError",
 ]

@@ -95,6 +95,12 @@ class ServingConfig:
     breaker_threshold: int = 0   # consecutive dispatch failures that open
     #                              the circuit (0 = breaker disabled)
     breaker_reset_s: float = 30.0  # open -> half-open probe window
+    breaker_jitter: float = 0.0  # fraction of reset_s added as seeded
+    #                              random spread per open window, so a
+    #                              FLEET of breakers does not re-probe in
+    #                              lockstep (0 = deterministic window)
+    breaker_jitter_seed: int = 0  # per-replica seed for that spread; NOT
+    #                              part of the numeric config tag
     watchdog_timeout_s: Optional[float] = None  # hung-batch watchdog: a
     #                              dispatch exceeding this fails its batch
     #                              instead of wedging the worker (None = off)
@@ -109,6 +115,10 @@ class ServingConfig:
         if self.breaker_threshold < 0:
             raise ValueError(
                 f"breaker_threshold must be >= 0, got {self.breaker_threshold}"
+            )
+        if self.breaker_jitter < 0:
+            raise ValueError(
+                f"breaker_jitter must be >= 0, got {self.breaker_jitter}"
             )
         if self.watchdog_timeout_s is not None and self.watchdog_timeout_s <= 0:
             raise ValueError(
@@ -129,7 +139,12 @@ class ServingConfig:
 
 @dataclasses.dataclass
 class PredictionResult:
-    """One served structure (host numpy, sliced to the true length)."""
+    """One served structure (host numpy, sliced to the true length).
+
+    The last three fields are fleet-tier provenance (serving/fleet.py):
+    which replica computed it, whether it was served by the degraded
+    tier, and how many replica failovers it survived. Single-engine
+    results keep the defaults."""
 
     seq: str
     coords: np.ndarray        # (L, 3) CA trace
@@ -138,6 +153,9 @@ class PredictionResult:
     bucket: int
     from_cache: bool
     latency_s: float
+    replica: str = ""         # fleet: serving replica name
+    degraded: bool = False    # fleet: served by the degraded tier
+    requeues: int = 0         # fleet: replica failovers survived
 
 
 class ServingRequest:
@@ -157,6 +175,7 @@ class ServingRequest:
         self._lock = threading.Lock()
         self._result: Optional[PredictionResult] = None
         self._exc: Optional[BaseException] = None
+        self._callbacks = []
 
     @property
     def length(self) -> int:
@@ -172,13 +191,42 @@ class ServingRequest:
 
     def _finish(self, result=None, exc=None) -> bool:
         """Resolve once; later resolutions (e.g. a drain racing a timeout)
-        are dropped. Returns True when this call resolved the request."""
+        are dropped. Returns True when this call resolved the request.
+        Done-callbacks fire outside the lock, on the resolving thread."""
         with self._lock:
             if self._event.is_set():
                 return False
             self._result, self._exc = result, exc
             self._event.set()
-            return True
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            try:
+                fn(self)
+            except Exception:  # noqa: BLE001 — a callback bug must not
+                # poison the resolver (usually the engine worker thread)
+                import traceback
+
+                traceback.print_exc()
+        return True
+
+    def add_done_callback(self, fn):
+        """Run `fn(request)` when the request resolves — immediately (on
+        the calling thread) if it already has. Callbacks run on whatever
+        thread resolves the request (typically the engine worker): keep
+        them non-blocking. This is the fleet tier's completion seam."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def peek(self):
+        """(result, exc) without blocking or copying; only valid after
+        done(). The result may alias a cache entry — fleet/engine
+        internals only; clients go through result()."""
+        if not self._event.is_set():
+            raise RuntimeError("peek() before the request resolved")
+        return self._result, self._exc
 
     def result(self, timeout: Optional[float] = None) -> PredictionResult:
         """Block for the outcome. Raises the request's terminal
@@ -263,7 +311,9 @@ class ServingEngine:
         self._fault_hook = fault_hook
         self._dispatch_counter = 0  # worker-thread only (the chaos clock)
         self._breaker = (
-            CircuitBreaker(cfg.breaker_threshold, cfg.breaker_reset_s)
+            CircuitBreaker(cfg.breaker_threshold, cfg.breaker_reset_s,
+                           jitter=cfg.breaker_jitter,
+                           seed=cfg.breaker_jitter_seed)
             if cfg.breaker_threshold else None
         )
 
@@ -421,7 +471,8 @@ class ServingEngine:
                 self.metrics.inc_error("queue_full")
                 raise QueueFullError(
                     f"request queue at capacity ({self.cfg.max_queue}); "
-                    f"retry with backoff or raise ServingConfig.max_queue"
+                    f"retry with backoff or raise ServingConfig.max_queue",
+                    retry_after_s=self.retry_after_estimate(),
                 ) from None
             self._inflight[key] = req
         # close the TOCTOU window against shutdown(): if the closed flag
@@ -452,6 +503,16 @@ class ServingEngine:
     @property
     def compile_count(self) -> int:
         return self.metrics.compile_count
+
+    def retry_after_estimate(self) -> float:
+        """Backoff advice for shed clients: batch-assembly wait plus the
+        backlog's drain time at the observed p50 — clamped so a cold
+        engine still answers something actionable."""
+        lat = self.metrics.latency.snapshot()
+        per_batch = lat.get("p50") or 0.1
+        backlog_batches = 1 + self._queue.qsize() // self.cfg.max_batch
+        est = self.cfg.max_wait_s + per_batch * backlog_batches
+        return float(min(60.0, max(0.05, est)))
 
     def stats(self) -> dict:
         """JSON-ready health/stats snapshot."""
@@ -747,7 +808,8 @@ class ServingEngine:
             if req.expired(now):
                 exc = RequestTimeoutError(
                     f"deadline passed after "
-                    f"{now - req.submitted_at:.3f}s in queue")
+                    f"{now - req.submitted_at:.3f}s in queue",
+                    retry_after_s=self.retry_after_estimate())
                 if self._resolve(req, exc=exc):
                     self.metrics.inc("timed_out")
                     self.metrics.inc_error(exc)

@@ -9,23 +9,48 @@ Every error carries a STABLE `code` string (the wire/ops identifier:
 error-rate dashboards, client retry policies, and the engine's per-code
 counters in `stats()["errors"]` all key on it — renaming a code is a
 breaking API change) and serializes with `to_json()` for HTTP front ends.
+
+Load-shedding rejections (queue full, deadline exceeded, no healthy
+replica) additionally carry a machine-readable `retry_after_s` hint: the
+server's estimate of when a retry has a real chance of being admitted,
+derived from queue depth and the recent service rate. Clients that honor
+it retry at the rate the tier can absorb instead of hammering a wedged
+queue; it rides `to_json()` (the HTTP analogue of a Retry-After header)
+and the serve.py replay output.
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
 
 class ServingError(Exception):
-    """Base class for all serving-engine errors."""
+    """Base class for all serving-engine errors.
+
+    `retry_after_s` is optional backoff advice for retryable rejections
+    (shed / queue-full / deadline classes set it; terminal semantic
+    failures like invalid_sequence leave it None).
+    """
 
     code = "serving_error"
+    retry_after_s: Optional[float] = None
+
+    def __init__(self, *args, retry_after_s: Optional[float] = None):
+        super().__init__(*args)
+        if retry_after_s is not None:
+            self.retry_after_s = float(retry_after_s)
 
     def to_json(self) -> dict:
-        """Wire-format payload: stable code + human-readable message."""
-        return {
+        """Wire-format payload: stable code + human-readable message
+        (+ retry_after_s backoff advice when the error carries it)."""
+        payload = {
             "code": self.code,
             "error": type(self).__name__,
             "message": str(self),
         }
+        if self.retry_after_s is not None:
+            payload["retry_after_s"] = round(self.retry_after_s, 3)
+        return payload
 
 
 class InvalidSequenceError(ServingError):
@@ -44,14 +69,16 @@ class RequestTooLongError(ServingError):
 class QueueFullError(ServingError):
     """The bounded request queue is at capacity. Backpressure is explicit:
     the caller decides whether to retry, shed, or escalate — the engine
-    never blocks a submitter."""
+    never blocks a submitter. Carries `retry_after_s` when the rejecting
+    tier can estimate its drain rate."""
 
     code = "queue_full"
 
 
 class RequestTimeoutError(ServingError):
     """The request's deadline passed before it was dispatched to the
-    model (scheduler-side expiry)."""
+    model (scheduler- or admission-side expiry). `retry_after_s` advises
+    when a fresh attempt would likely clear the queue in time."""
 
     code = "request_timeout"
 
@@ -86,3 +113,22 @@ class HungBatchError(ServingError):
     keeps serving instead of wedging."""
 
     code = "hung_batch"
+
+
+class NoHealthyReplicaError(ServingError):
+    """Fleet-tier rejection: every full-config replica is down and no
+    degraded tier is configured, so the request cannot be served at all.
+    `retry_after_s` is the health manager's re-probe cadence — the soonest
+    a replica could possibly be reinstated."""
+
+    code = "no_healthy_replica"
+
+
+class RequeueLimitError(ServingError):
+    """Fleet-tier terminal failure: the request was requeued off failing
+    replicas `requeue_limit` times and still never completed — evidence
+    the request itself (not one replica) is the problem, so it stops
+    consuming fleet capacity. The last replica error is chained as
+    ``__cause__``."""
+
+    code = "requeue_limit"

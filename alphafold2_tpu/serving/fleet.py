@@ -1,0 +1,732 @@
+"""Fleet tier: N engine replicas, one admission-controlled front door.
+
+The PR 2 engine is one warm model in one process — a single hung batch,
+poisoned executable, or slow compile stalls the whole tier. This module
+is the robustness half of the ParaFold pool story (arxiv 2111.06340):
+a replicated tier that keeps answering, degrades predictably, and treats
+replica death as routine traffic management rather than an outage.
+
+Architecture (three cooperating layers, each independently testable):
+
+  `serving/admission.py`   the shared front door: priority classes,
+                           per-request deadlines, structured shedding
+                           with `retry_after_s`.
+  this module              the router: a dispatcher thread pulls from
+                           the admission queue and places requests on
+                           the least-loaded HEALTHY replica; completion
+                           callbacks (the `add_done_callback` seam on
+                           `ServingRequest`) either resolve the client
+                           future or REQUEUE the request onto another
+                           replica (bounded by `requeue_limit`).
+  `reliability/health.py`  the supervisor: dispatch-failure evidence and
+                           heartbeat probes drain a sick replica (its
+                           engine is shut down drain=False, which fails
+                           its queued work back through the requeue
+                           path — nothing is lost), and re-probes
+                           reinstate it behind a fresh engine.
+
+Requeue is IDEMPOTENT by construction: a structure is a deterministic
+function of (sequence, bucket) under a shared config tag
+(serving/cache.py), so replaying a request on a different replica
+returns bit-identical results — pinned by tests against the
+single-engine path. Fleet latency/cache stats count each request once,
+at its terminal outcome.
+
+Degraded mode: with `degraded_mds_iters` set, the fleet holds one extra
+engine at a cheaper config tag (same params, fewer MDS iterations — a
+second tenant of the result-cache keyspace). It takes traffic only when
+every full replica is down or the queue is past `degrade_depth`, and
+every response it serves is flagged `degraded=True` — the client always
+knows which answer it got.
+
+Every replica breaker gets seeded `breaker_jitter` with a per-replica
+seed, so a fleet-wide dependency failure does not re-probe in lockstep.
+
+Terminal outcomes are exhaustive: every accepted request ends exactly
+one of served / served-degraded / shed-with-structured-error / failed —
+the chaos suite drives kill/slow/flap plans through `serve.py
+--replicas --fault-plan` and asserts zero lost requests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import traceback
+from typing import Optional
+
+from alphafold2_tpu.constants import AA_ORDER, aa_to_tokens
+from alphafold2_tpu.reliability.health import HealthMonitor, ReplicaState
+from alphafold2_tpu.serving.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    resolve_priority,
+)
+from alphafold2_tpu.serving.bucketing import BucketLadder
+from alphafold2_tpu.serving.engine import (
+    PredictionResult,
+    ServingConfig,
+    ServingEngine,
+)
+from alphafold2_tpu.serving.errors import (
+    CircuitOpenError,
+    EngineClosedError,
+    HungBatchError,
+    InvalidSequenceError,
+    NoHealthyReplicaError,
+    PredictionError,
+    QueueFullError,
+    RequestTimeoutError,
+    RequeueLimitError,
+    ServingError,
+)
+from alphafold2_tpu.telemetry import NULL_TRACER, MetricRegistry
+
+#: replica errors that justify trying ANOTHER replica — the replica (not
+#: the request) is the suspect. Everything else is terminal for the
+#: request itself.
+_REPLICA_FAULT_ERRORS = (
+    PredictionError,
+    HungBatchError,
+    EngineClosedError,
+    CircuitOpenError,
+)
+
+DEGRADED = "degraded"  # reserved tier name (not a health-managed replica)
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Fleet-level knobs; per-replica scheduler knobs stay in
+    `ServingConfig` (docs/OPERATIONS.md "Fleet runbook")."""
+
+    replicas: int = 2
+    queue_capacity: int = 64     # shared admission queue bound
+    default_timeout_s: Optional[float] = 60.0  # fleet-level deadline
+    requeue_limit: int = 2       # replica failovers per request
+    degraded_mds_iters: int = 0  # >0: hold a cheaper-tag fallback engine
+    degrade_depth: int = 0       # queue depth that routes NEW work to the
+    #                              degraded tier (0 = only on total outage)
+    probe_interval_s: float = 5.0    # heartbeat cadence, healthy replicas
+    reprobe_interval_s: float = 0.5  # reinstatement probe cadence, down
+    probe_timeout_s: float = 10.0
+    fail_threshold: int = 2      # consecutive failures that drain
+    drain_timeout_s: float = 5.0
+    breaker_jitter: float = 0.25  # seeded reopen spread per replica
+    dispatch_backoff_s: float = 0.01  # router sleep when every target is full
+    tick_interval_s: float = 0.05     # health thread granularity
+
+    def __post_init__(self):
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if self.requeue_limit < 0:
+            raise ValueError(
+                f"requeue_limit must be >= 0, got {self.requeue_limit}"
+            )
+        if self.degraded_mds_iters < 0 or self.degrade_depth < 0:
+            raise ValueError("degraded knobs must be >= 0")
+
+
+class FleetRequest:
+    """Client handle: one future, resolved exactly once by the fleet.
+
+    Duck-typed for the admission queue (`priority` / `deadline` /
+    `enqueued_at`); `requeues` counts replica failovers survived."""
+
+    def __init__(self, seq: str, msa, msa_mask, priority: int,
+                 deadline: Optional[float]):
+        self.seq = seq
+        self.msa = msa
+        self.msa_mask = msa_mask
+        self.priority = priority
+        self.deadline = deadline
+        self.enqueued_at = time.monotonic()
+        self.requeues = 0
+        self.failed_on = set()   # replica names this request failed on
+        self.last_error: Optional[BaseException] = None
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._result: Optional[PredictionResult] = None
+        self._meta = {}
+        self._exc: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def _finish(self, result=None, exc=None, **meta) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._result, self._exc, self._meta = result, exc, meta
+            self._event.set()
+            return True
+
+    def result(self, timeout: Optional[float] = None) -> PredictionResult:
+        """Block for the outcome; raises the terminal ServingError, or
+        builtin TimeoutError if the CALLER's wait budget expires first.
+        Returns a fresh copy stamped with fleet provenance (replica,
+        degraded, requeues) — the raw result may alias a replica cache
+        entry and is never handed out."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"fleet request ({len(self.seq)} residues) not completed "
+                f"within {timeout}s wait"
+            )
+        if self._exc is not None:
+            raise self._exc
+        return dataclasses.replace(
+            self._result,
+            coords=self._result.coords.copy(),
+            confidence=self._result.confidence.copy(),
+            latency_s=self._meta.get("latency_s", self._result.latency_s),
+            replica=self._meta.get("replica", ""),
+            degraded=self._meta.get("degraded", False),
+            requeues=self.requeues,
+        )
+
+
+class _Replica:
+    """One engine slot; the engine reference swaps across drain/restart
+    cycles (guarded by the fleet lock)."""
+
+    def __init__(self, name: str, factory):
+        self.name = name
+        self.factory = factory   # () -> ServingEngine
+        self.engine: Optional[ServingEngine] = None
+        self.in_flight = 0
+        self.dispatches = 0
+        self.probe_counter = 0
+        self.restarts = 0
+
+
+class ServingFleet:
+    """N `ServingEngine` replicas behind one admission-controlled queue.
+
+    Args:
+      params / model_cfg / serving_cfg: as `ServingEngine` — every
+        replica shares them (and therefore the cache-key config tag:
+        the idempotency contract failover depends on).
+      fleet_cfg: `FleetConfig`.
+      engine_factory: override `(name, serving_cfg, fault_hook) ->
+        ServingEngine` — tests substitute fake engines; the default
+        builds real ones over `params`.
+      injector: optional `reliability.FaultInjector`; each replica gets
+        `injector.replica_hook(name)` so kill/slow/flap plans target
+        replicas by name.
+      tracer / registry: fleet-level telemetry (replica engines keep
+        their own `ServingMetrics`; the fleet registry carries the
+        fleet_* metric families).
+    """
+
+    def __init__(self, params, model_cfg,
+                 serving_cfg: ServingConfig = ServingConfig(),
+                 fleet_cfg: FleetConfig = FleetConfig(), *,
+                 engine_factory=None, model_apply_fn=None, injector=None,
+                 tracer=None, registry: Optional[MetricRegistry] = None):
+        self.cfg = fleet_cfg
+        self._params = params
+        self._model_cfg = model_cfg
+        self._serving_cfg = serving_cfg
+        self._model_apply_fn = model_apply_fn
+        self._injector = injector
+        self._ladder = BucketLadder(serving_cfg.buckets)
+        self._tracer = tracer if tracer is not None else NULL_TRACER
+        self.registry = registry if registry is not None else MetricRegistry()
+        self._factory = engine_factory or self._default_factory
+
+        self._lock = threading.Lock()
+        self._closed = False
+        self._drain_on_stop = True
+        self._stop = threading.Event()
+
+        # ---- telemetry families (the acceptance surface) ----
+        self._counts = {
+            name: self.registry.counter(
+                "fleet_requests_total", help="fleet request terminal outcomes",
+                outcome=name)
+            for name in ("submitted", "completed", "shed", "failed")
+        }
+        self._degraded_total = self.registry.counter(
+            "fleet_degraded_total", help="responses served by the degraded tier")
+        self._requeue_total = self.registry.counter(
+            "fleet_requeue_total", help="replica-failover requeues")
+        self._shed_reasons = {}   # reason -> counter (lazy)
+        self._errors = {}         # stable code -> counter (lazy)
+        self._queue_wait = self.registry.histogram(
+            "fleet_queue_wait_seconds",
+            help="admission-queue wait, sliding window (p95 is the "
+                 "autoscaling signal)")
+        self._latency = self.registry.histogram(
+            "fleet_request_latency_seconds",
+            help="fleet submit->terminal latency, sliding window")
+        self._up_gauges = {}
+
+        # ---- replicas + health ----
+        self._admission = AdmissionController(
+            AdmissionConfig(capacity=fleet_cfg.queue_capacity))
+        self._health = HealthMonitor(
+            probe_interval_s=fleet_cfg.probe_interval_s,
+            reprobe_interval_s=fleet_cfg.reprobe_interval_s,
+            fail_threshold=fleet_cfg.fail_threshold,
+        )
+        self._replicas = {}
+        for i in range(fleet_cfg.replicas):
+            name = f"r{i}"
+            rcfg = dataclasses.replace(
+                serving_cfg,
+                breaker_jitter=(fleet_cfg.breaker_jitter
+                                if serving_cfg.breaker_threshold else 0.0),
+                breaker_jitter_seed=i,
+            )
+            rep = _Replica(name, self._make_factory(name, rcfg))
+            rep.engine = rep.factory()
+            self._replicas[name] = rep
+            self._up_gauges[name] = self.registry.gauge(
+                "fleet_replica_up", help="1 = taking traffic", replica=name)
+            self._up_gauges[name].set(1)
+            self._health.register(
+                name,
+                probe=lambda n=name: self._probe_replica(n),
+                on_drain=self._drain_replica,
+                on_reinstate=self._reinstate_replica,
+            )
+
+        self._degraded_rep: Optional[_Replica] = None
+        if fleet_cfg.degraded_mds_iters:
+            dcfg = dataclasses.replace(
+                serving_cfg, mds_iters=fleet_cfg.degraded_mds_iters)
+            self._degraded_rep = _Replica(
+                DEGRADED, self._make_factory(DEGRADED, dcfg))
+            self._degraded_rep.engine = self._degraded_rep.factory()
+
+        self._health.start(fleet_cfg.tick_interval_s)
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="fleet-dispatcher", daemon=True)
+        self._dispatcher.start()
+
+    # ------------------------------------------------------------ factories
+
+    def _default_factory(self, name, cfg, fault_hook):
+        return ServingEngine(
+            self._params, self._model_cfg, cfg,
+            model_apply_fn=self._model_apply_fn,
+            fault_hook=fault_hook, tracer=self._tracer,
+        )
+
+    def _make_factory(self, name, cfg):
+        hook = (self._injector.replica_hook(name)
+                if self._injector is not None else None)
+
+        def build():
+            try:
+                return self._factory(name, cfg, hook)
+            except Exception:  # noqa: BLE001 — a failing restart is a
+                # failed probe, not a fleet crash
+                traceback.print_exc()
+                return None
+
+        return build
+
+    # ----------------------------------------------------------------- API
+
+    def submit(self, seq: str, *, msa=None, msa_mask=None,
+               timeout: Optional[float] = None,
+               priority="normal") -> FleetRequest:
+        """Enqueue one sequence at the fleet front door; returns a future.
+
+        Raises EngineClosedError / InvalidSequenceError /
+        RequestTooLongError / QueueFullError(retry_after_s) synchronously.
+        A lower-priority queued request may be EVICTED (resolved with a
+        retry-after error) to admit a higher-priority one.
+        """
+        with self._tracer.span("fleet.enqueue", cat="fleet",
+                               length=len(seq)):
+            if self._closed:
+                raise EngineClosedError("fleet is shut down")
+            seq = seq.strip().upper()
+            try:
+                aa_to_tokens(seq, strict=True)
+            except ValueError as e:
+                self._count_error(InvalidSequenceError(str(e)))
+                raise InvalidSequenceError(str(e)) from None
+            try:
+                self._ladder.bucket_for(len(seq))
+            except ServingError as e:
+                self._count_error(e)
+                raise
+            ttl = (self.cfg.default_timeout_s if timeout is None else timeout)
+            deadline = (time.monotonic() + ttl) if ttl is not None else None
+            entry = FleetRequest(seq, msa, msa_mask,
+                                 resolve_priority(priority), deadline)
+            self._counts["submitted"].inc()
+            try:
+                evicted = self._admission.offer(entry)
+            except QueueFullError as e:
+                # stays counted as submitted: shed is its terminal
+                # outcome, so in_flight arithmetic balances
+                self._shed_counter("queue_full").inc()
+                self._counts["shed"].inc()
+                self._count_error(e)
+                raise
+            if evicted is not None:
+                self._resolve_shed(
+                    evicted, "evicted",
+                    QueueFullError(
+                        "evicted by a higher-priority arrival under "
+                        "overload; retry with backoff",
+                        retry_after_s=self._admission.retry_after_s(),
+                    ))
+            # close the TOCTOU window against shutdown() (the engine's
+            # stance, engine.py): if the closed flag flipped after the
+            # entry check, shutdown's final drain may already be past
+            # this entry — resolve it ourselves; _finish is resolve-once,
+            # so losing the race to a still-draining dispatcher is
+            # harmless
+            if self._closed and self._resolve_failed(entry, EngineClosedError(
+                    "fleet shut down while the request was being "
+                    "submitted")):
+                raise EngineClosedError("fleet is shut down")
+            return entry
+
+    def predict(self, seq: str, *, msa=None, msa_mask=None,
+                timeout: Optional[float] = None,
+                priority="normal") -> PredictionResult:
+        """Synchronous convenience: submit + block for the result."""
+        return self.submit(seq, msa=msa, msa_mask=msa_mask, timeout=timeout,
+                           priority=priority).result()
+
+    def stats(self) -> dict:
+        """JSON-ready fleet snapshot: terminal counters, admission queue,
+        per-replica state + engine stats, health, telemetry registry."""
+        counts = {k: int(c.value) for k, c in self._counts.items()}
+        counts["degraded"] = int(self._degraded_total.value)
+        counts["requeued"] = int(self._requeue_total.value)
+        counts["in_flight"] = (
+            counts["submitted"] - counts["completed"] - counts["shed"]
+            - counts["failed"]
+        )
+        with self._lock:
+            reps = list(self._replicas.values())
+            degraded = self._degraded_rep
+            shed = {reason: int(c.value)
+                    for reason, c in self._shed_reasons.items()}
+            errors = {code: int(c.value)
+                      for code, c in self._errors.items()}
+        replicas = {}
+        for rep in reps + ([degraded] if degraded else []):
+            engine = rep.engine
+            replicas[rep.name] = {
+                "state": (DEGRADED if rep.name == DEGRADED
+                          else self._health.state(rep.name).value),
+                "in_flight": rep.in_flight,
+                "dispatches": rep.dispatches,
+                "restarts": rep.restarts,
+                "engine": engine.stats() if engine is not None else None,
+            }
+        return {
+            "closed": self._closed,
+            "requests": counts,
+            "shed": shed,
+            "errors": errors,
+            "queue_wait": self._queue_wait.snapshot(),
+            "latency": self._latency.snapshot(),
+            "admission": self._admission.snapshot(),
+            "replicas": replicas,
+            "health": self._health.snapshot(),
+            "telemetry": {
+                "metrics": self.registry.snapshot(),
+                "spans": self._tracer.summary(),
+            },
+        }
+
+    def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop the front door, the router, the supervisor, and every
+        engine. drain=True serves what it still can (replica engines
+        drain their queues); whatever cannot be served resolves with
+        EngineClosedError — nothing is left unresolved. Idempotent."""
+        self._closed = True
+        self._drain_on_stop = drain
+        self._stop.set()
+        self._dispatcher.join(timeout)
+        self._health.stop()
+        with self._lock:
+            reps = list(self._replicas.values())
+            if self._degraded_rep is not None:
+                reps.append(self._degraded_rep)
+        for rep in reps:
+            engine = rep.engine
+            if engine is not None:
+                engine.shutdown(drain=drain, timeout=self.cfg.drain_timeout_s)
+        # engine shutdown callbacks may have requeued entries after the
+        # dispatcher died; fail every remaining queued entry terminally
+        for entry in self._admission.drain():
+            self._resolve_failed(entry, EngineClosedError(
+                "fleet shut down before the request was served"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=True)
+        return False
+
+    # ------------------------------------------------------------- router
+
+    def _dispatch_loop(self):
+        try:
+            while True:
+                if self._stop.is_set():
+                    if not self._drain_on_stop:
+                        return
+                    entry, expired = self._admission.poll(timeout=0)
+                    if entry is None and not expired:
+                        return  # queue fully drained
+                else:
+                    entry, expired = self._admission.poll(timeout=0.05)
+                for e in expired:
+                    self._resolve_shed(e, "deadline", RequestTimeoutError(
+                        f"deadline passed after "
+                        f"{time.monotonic() - e.enqueued_at:.3f}s in the "
+                        f"fleet queue",
+                        retry_after_s=self._admission.retry_after_s()))
+                if entry is not None:
+                    self._route(entry)
+        except BaseException:  # noqa: BLE001 — last-resort guard (engine
+            # worker stance): fail queued work loudly, refuse new traffic
+            self._closed = True
+            traceback.print_exc()
+            for entry in self._admission.drain():
+                self._resolve_failed(entry, PredictionError(
+                    "fleet dispatcher crashed; fleet is closed"))
+
+    def _route(self, entry: FleetRequest):
+        wait = time.monotonic() - entry.enqueued_at
+        self._queue_wait.observe(wait)
+        if self._tracer.enabled:
+            self._tracer.add("fleet.queue_wait", wait, cat="fleet",
+                             priority=entry.priority)
+        overloaded = (self.cfg.degrade_depth > 0
+                      and self._admission.depth() >= self.cfg.degrade_depth)
+        healthy = self._health.healthy_targets()
+        with self._lock:
+            ranked = sorted(
+                (self._replicas[n] for n in healthy),
+                key=lambda r: r.in_flight,
+            )
+            degraded = self._degraded_rep
+        # failover exclusion: a replica this request already FAILED on is
+        # the worst candidate, not an equal one — prefer untried healthy
+        # replicas, fall to the degraded tier when none remain, and only
+        # then retry where it failed (better a retry than a starve)
+        fresh = [r for r in ranked if r.name not in entry.failed_on]
+        stale = [r for r in ranked if r.name in entry.failed_on]
+        targets = fresh
+        if degraded is not None and (overloaded or not fresh):
+            # the cheap tier catches the overload spill the full replicas
+            # reject, and is the first resort once the request has failed
+            # on (or lost) every full replica — the response says so
+            targets = targets + [degraded]
+        targets = targets + stale
+        if not targets:
+            # every full replica is down and there is no degraded tier:
+            # answer NOW with the re-probe horizon instead of letting the
+            # request age out silently
+            self._resolve_shed(
+                entry, "no_healthy_replica",
+                NoHealthyReplicaError(
+                    "every replica is down and no degraded tier is "
+                    "configured",
+                    retry_after_s=self.cfg.reprobe_interval_s))
+            return
+        for rep in targets:
+            if self._try_dispatch(entry, rep):
+                return
+        # nothing admitted it (queues full / engines mid-drain): the
+        # entry stays accepted — requeue WITHOUT consuming failover
+        # budget and let the router breathe. Exception: during shutdown
+        # with every candidate engine already dead, nothing will ever
+        # free up — resolve terminally instead of orbiting the queue.
+        with self._lock:
+            alive = any(
+                r.engine is not None and not r.engine._closed
+                for r in targets
+            )
+        if self._closed and not alive:
+            self._resolve_failed(entry, EngineClosedError(
+                "fleet shut down before the request was served"))
+            return
+        self._admission.requeue(entry)
+        time.sleep(self.cfg.dispatch_backoff_s)
+
+    def _try_dispatch(self, entry: FleetRequest, rep: _Replica) -> bool:
+        engine = rep.engine
+        if engine is None:
+            return False
+        now = time.monotonic()
+        remaining = (None if entry.deadline is None
+                     else entry.deadline - now)
+        if remaining is not None and remaining <= 0:
+            self._resolve_shed(entry, "deadline", RequestTimeoutError(
+                "deadline passed at dispatch",
+                retry_after_s=self._admission.retry_after_s()))
+            return True
+        try:
+            inner = engine.submit(
+                entry.seq, msa=entry.msa, msa_mask=entry.msa_mask,
+                # None would fall back to the ENGINE's default deadline;
+                # a deadline-less fleet request must stay deadline-less
+                timeout=remaining if remaining is not None else 1e9,
+            )
+        except QueueFullError:
+            return False
+        except (CircuitOpenError, EngineClosedError) as e:
+            if rep.name != DEGRADED:
+                self._health.record_failure(rep.name, e.code)
+            return False
+        except ServingError as e:
+            # semantic rejection (bad MSA shape etc.): the request is the
+            # problem — terminal, no failover
+            self._resolve_failed(entry, e)
+            return True
+        with self._lock:
+            rep.in_flight += 1
+            rep.dispatches += 1
+        dispatched_at = now
+        inner.add_done_callback(
+            lambda r, e=entry, rp=rep, t=dispatched_at:
+            self._on_replica_done(e, rp, r, t))
+        return True
+
+    # ---------------------------------------------------- completion path
+
+    def _on_replica_done(self, entry: FleetRequest, rep: _Replica,
+                         inner, dispatched_at: float):
+        """Runs on the replica worker (or drain) thread: resolve, or
+        requeue onto another replica. Never blocks, never raises."""
+        with self._lock:
+            rep.in_flight -= 1
+        result, exc = inner.peek()
+        degraded = rep.name == DEGRADED
+        if exc is None:
+            if not degraded:
+                self._health.record_success(rep.name)
+            self._admission.note_served(time.monotonic() - dispatched_at)
+            if entry._finish(result=result, replica=rep.name,
+                             degraded=degraded,
+                             latency_s=time.monotonic() - entry.enqueued_at):
+                self._counts["completed"].inc()
+                self._latency.observe(time.monotonic() - entry.enqueued_at)
+                if degraded:
+                    self._degraded_total.inc()
+            return
+        if isinstance(exc, RequestTimeoutError):
+            # the request's OWN deadline expired inside the replica —
+            # failover could not have saved it
+            self._resolve_shed(entry, "deadline", exc)
+            return
+        if isinstance(exc, _REPLICA_FAULT_ERRORS):
+            if not degraded:
+                self._health.record_failure(rep.name, exc.code)
+            entry.failed_on.add(rep.name)
+            entry.last_error = exc
+            if not self._closed and entry.requeues < self.cfg.requeue_limit:
+                entry.requeues += 1
+                self._requeue_total.inc()
+                self._admission.requeue(entry)
+                return
+            if entry.requeues >= self.cfg.requeue_limit > 0:
+                err = RequeueLimitError(
+                    f"failed on {entry.requeues + 1} replica(s) "
+                    f"(requeue_limit {self.cfg.requeue_limit}); last: "
+                    f"{type(exc).__name__}: {exc}")
+                err.__cause__ = exc
+                self._resolve_failed(entry, err)
+                return
+        self._resolve_failed(entry, exc)
+
+    # ------------------------------------------------- terminal accounting
+
+    def _shed_counter(self, reason: str):
+        with self._lock:
+            counter = self._shed_reasons.get(reason)
+            if counter is None:
+                counter = self.registry.counter(
+                    "fleet_shed_total", help="load shed by reason",
+                    reason=reason)
+                self._shed_reasons[reason] = counter
+            return counter
+
+    def _count_error(self, exc):
+        code = getattr(exc, "code", "serving_error")
+        with self._lock:
+            counter = self._errors.get(code)
+            if counter is None:
+                counter = self.registry.counter(
+                    "fleet_errors_total",
+                    help="terminal failures and rejections by stable code",
+                    code=code)
+                self._errors[code] = counter
+        counter.inc()
+
+    def _resolve_shed(self, entry: FleetRequest, reason: str,
+                      exc: ServingError) -> bool:
+        if entry._finish(exc=exc):
+            self._counts["shed"].inc()
+            self._shed_counter(reason).inc()
+            self._count_error(exc)
+            return True
+        return False
+
+    def _resolve_failed(self, entry: FleetRequest,
+                        exc: BaseException) -> bool:
+        if entry._finish(exc=exc):
+            self._counts["failed"].inc()
+            self._count_error(exc)
+            return True
+        return False
+
+    # -------------------------------------------------- health callbacks
+
+    def _probe_replica(self, name: str) -> bool:
+        """End-to-end heartbeat: one tiny request through the replica's
+        real dispatch path (unique sequence per probe so the result
+        cache cannot vouch for a dead engine). Restarts the engine first
+        if a drain tore it down. Runs on the health thread."""
+        with self._lock:
+            rep = self._replicas[name]
+            engine = rep.engine
+        if engine is None or getattr(engine, "_closed", False):
+            engine = rep.factory()
+            if engine is None:
+                return False
+            with self._lock:
+                rep.engine = engine
+                rep.restarts += 1
+        rep.probe_counter += 1
+        n, seq = rep.probe_counter, []
+        for _ in range(4):  # base-len(AA_ORDER) counter encoding
+            seq.append(AA_ORDER[n % len(AA_ORDER)])
+            n //= len(AA_ORDER)
+        try:
+            req = engine.submit("".join(seq),
+                                timeout=self.cfg.probe_timeout_s)
+            req.result(timeout=self.cfg.probe_timeout_s)
+            return True
+        except (ServingError, TimeoutError):
+            return False
+
+    def _drain_replica(self, name: str, reason: str):
+        """Health-thread callback: take the sick engine out of rotation
+        and fail its queued work BACK through the requeue path (shutdown
+        drain=False resolves everything pending with EngineClosedError,
+        which `_on_replica_done` converts into requeues)."""
+        with self._lock:
+            rep = self._replicas[name]
+            engine, rep.engine = rep.engine, None
+        self._up_gauges[name].set(0)
+        if engine is not None:
+            engine.shutdown(drain=False, timeout=self.cfg.drain_timeout_s)
+
+    def _reinstate_replica(self, name: str):
+        self._up_gauges[name].set(1)

@@ -6,9 +6,20 @@ synthesize one with --demo), submit every record to the micro-batching
 engine with explicit backpressure handling, and report the serving stats
 snapshot (compiles, batch occupancy, latency quantiles, cache hit rate).
 
+With `--replicas N` (N > 1) the replay drives the FLEET tier instead
+(`serving/fleet.py`): N engine replicas behind the shared
+admission-controlled queue, health-checked failover, and degraded-mode
+fallback. `--fault-plan plan.json` wires a chaos schedule into the run —
+replica-scoped kill/slow/flap faults in fleet mode, dispatch faults in
+single-engine mode — so the failover paths run deterministically from
+the CLI. Shed requests are a structured outcome (printed with their
+`retry_after_s`), not a crash: the acceptance bar is that every request
+ends terminally as served, served-degraded, or shed.
+
 Usage:
   python serve.py --fasta proteins.fasta --out-dir preds/
   python serve.py --demo 24 --buckets 16,32 --max-batch 4 --mds-iters 8
+  python serve.py --demo --replicas 3 --buckets 16,32 --fault-plan plan.json
   python serve.py --fasta proteins.fasta --ckpt-dir runs/pre --dim 256 \
       --depth 12 --buckets 128,256,384 --stats-json serving_stats.json
 
@@ -90,8 +101,9 @@ def main():
     )
     src = ap.add_mutually_exclusive_group(required=True)
     src.add_argument("--fasta", help="multi-record FASTA of query sequences")
-    src.add_argument("--demo", type=int, metavar="N",
-                     help="synthesize N mixed-length demo sequences instead")
+    src.add_argument("--demo", type=int, metavar="N", nargs="?", const=24,
+                     help="synthesize N mixed-length demo sequences instead "
+                          "(default 24 when given bare)")
     ap.add_argument("--out-dir", default=None,
                     help="write one CA-trace PDB per record here")
     # model (must match the checkpoint when restoring, like predict.py)
@@ -128,7 +140,37 @@ def main():
     ap.add_argument("--watchdog-timeout", type=float, default=None,
                     help="fail a batch whose model call exceeds this many "
                          "seconds instead of wedging the worker (off by "
-                         "default)")
+                         "default; fleet mode defaults it to 60s — the "
+                         "failover path needs hung replicas to FAIL)")
+    # fleet tier (serving/fleet.py; docs/OPERATIONS.md "Fleet runbook")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="engine replicas behind the shared admission "
+                         "queue; >1 selects the fleet tier")
+    ap.add_argument("--fleet-queue", type=int, default=64,
+                    help="shared admission-queue capacity (fleet mode)")
+    ap.add_argument("--requeue-limit", type=int, default=3,
+                    help="replica failovers per request before it fails "
+                         "terminally (fleet mode)")
+    ap.add_argument("--degraded-iters", type=int, default=-1,
+                    help="MDS iterations for the degraded fallback tier; "
+                         "-1 = auto (max(1, mds_iters // 4)), 0 = no "
+                         "degraded tier (fleet mode)")
+    ap.add_argument("--degrade-depth", type=int, default=0,
+                    help="admission-queue depth past which NEW work spills "
+                         "to the degraded tier (0 = degraded serves only "
+                         "when every full replica is down)")
+    ap.add_argument("--probe-interval", type=float, default=5.0,
+                    help="healthy-replica heartbeat cadence, seconds")
+    ap.add_argument("--reprobe-interval", type=float, default=0.5,
+                    help="down-replica reinstatement probe cadence, seconds")
+    ap.add_argument("--fail-threshold", type=int, default=2,
+                    help="consecutive replica failures that drain it")
+    ap.add_argument("--fault-plan", default=None, metavar="PLAN_JSON",
+                    help="chaos schedule (reliability.FaultPlan JSON): "
+                         "replica-scoped kill/slow/flap faults in fleet "
+                         "mode, dispatch faults single-engine; validate "
+                         "with `python -m alphafold2_tpu.reliability."
+                         "faults --check`")
     ap.add_argument("--passes", type=int, default=1,
                     help="replay the request stream this many times; "
                          "passes after the first exercise the result cache")
@@ -156,10 +198,14 @@ def main():
 
     from alphafold2_tpu.models import Alphafold2Config
     from alphafold2_tpu.serving import (
+        FleetConfig,
+        NoHealthyReplicaError,
         QueueFullError,
+        RequestTimeoutError,
         ServingConfig,
         ServingEngine,
         ServingError,
+        ServingFleet,
     )
     from alphafold2_tpu.utils import MetricsLogger
 
@@ -201,43 +247,103 @@ def main():
         else None
     )
     tracer = tracer_from_args(args)  # NULL_TRACER unless --trace-out
-    engine = ServingEngine(
-        params, cfg,
-        ServingConfig(
-            buckets=buckets,
-            max_batch=args.max_batch,
-            max_queue=args.queue_size,
-            max_wait_s=args.max_wait_ms / 1000.0,
-            request_timeout_s=args.request_timeout,
-            cache_capacity=args.cache_size,
-            mds_iters=args.mds_iters,
-            mds_init=args.mds_init,
-            seed=args.seed,
-            precompile=args.precompile,
-            params_tag=params_tag,
-            breaker_threshold=args.breaker_threshold,
-            breaker_reset_s=args.breaker_reset,
-            watchdog_timeout_s=args.watchdog_timeout,
+    injector = None
+    if args.fault_plan:
+        from alphafold2_tpu.reliability import FaultPlan
+
+        injector = FaultPlan.from_file(args.fault_plan).injector()
+        print(f"fault plan: {len(injector.plan.faults)} fault(s) from "
+              f"{args.fault_plan}")
+
+    fleet_mode = args.replicas > 1
+    serving_cfg = ServingConfig(
+        buckets=buckets,
+        max_batch=args.max_batch,
+        max_queue=args.queue_size,
+        max_wait_s=args.max_wait_ms / 1000.0,
+        request_timeout_s=args.request_timeout,
+        cache_capacity=args.cache_size,
+        mds_iters=args.mds_iters,
+        mds_init=args.mds_init,
+        seed=args.seed,
+        precompile=args.precompile,
+        params_tag=params_tag,
+        breaker_threshold=args.breaker_threshold,
+        breaker_reset_s=args.breaker_reset,
+        watchdog_timeout_s=(
+            args.watchdog_timeout if args.watchdog_timeout is not None
+            # the fleet's liveness story needs hung replicas to FAIL (the
+            # failover path starts from a failure, never from a hang)
+            else (60.0 if fleet_mode else None)
         ),
-        metrics_logger=logger,
-        tracer=tracer,
     )
+    if fleet_mode:
+        if logger is not None:
+            # the per-batch JSONL stream is an engine-level concept (one
+            # worker, one step counter); N replica workers sharing one
+            # unlocked logger would interleave counters and races. Say
+            # so instead of silently writing nothing.
+            print("WARNING: --metrics-jsonl applies to single-engine mode "
+                  "only; fleet observability is --stats-json (registry "
+                  "snapshot incl. per-replica engine stats) + --trace-out")
+            logger.close()
+            logger = None
+        degraded_iters = (
+            max(1, args.mds_iters // 4) if args.degraded_iters < 0
+            else args.degraded_iters
+        )
+        engine = ServingFleet(
+            params, cfg, serving_cfg,
+            FleetConfig(
+                replicas=args.replicas,
+                queue_capacity=args.fleet_queue,
+                default_timeout_s=args.request_timeout,
+                requeue_limit=args.requeue_limit,
+                degraded_mds_iters=degraded_iters,
+                degrade_depth=args.degrade_depth,
+                probe_interval_s=args.probe_interval,
+                reprobe_interval_s=args.reprobe_interval,
+                fail_threshold=args.fail_threshold,
+            ),
+            injector=injector,
+            tracer=tracer,
+        )
+        print(f"fleet: {args.replicas} replica(s), shared queue "
+              f"{args.fleet_queue}, degraded tier "
+              + (f"mds_iters={degraded_iters}" if degraded_iters else "OFF"))
+    else:
+        engine = ServingEngine(
+            params, cfg, serving_cfg,
+            metrics_logger=logger,
+            fault_hook=injector.serving_hook() if injector else None,
+            tracer=tracer,
+        )
 
     # --- replay: submit everything, honoring backpressure explicitly ----
     t0 = time.time()
-    pending, failures = [], 0
+    pending, failures, shed = [], 0, 0
+    _MAX_SUBMIT_RETRIES = 200  # replay client's patience per record
     for pass_idx in range(max(1, args.passes)):
         for name, seq in records:
             if pass_idx:
                 name = f"{name}_p{pass_idx + 1}"
+            retries = 0
             while True:
                 try:
                     pending.append((name, seq, engine.submit(seq)))
                     break
-                except QueueFullError:
-                    time.sleep(0.005)  # bounded queue is the throttle
+                except QueueFullError as e:
+                    # honor the server's structured backoff advice (the
+                    # bounded queue is the throttle), but stay impatient
+                    # enough that a demo replay finishes
+                    retries += 1
+                    if retries > _MAX_SUBMIT_RETRIES:
+                        print(f"SHED {name}: [{e.code}] {e}")
+                        shed += 1
+                        break
+                    time.sleep(min(0.1, e.retry_after_s or 0.005))
                 except ServingError as e:
-                    print(f"REJECTED {name}: {e}")
+                    print(f"REJECTED {name}: [{e.code}] {e}")
                     failures += 1
                     break
         if pass_idx + 1 < max(1, args.passes):
@@ -257,10 +363,24 @@ def main():
         try:
             res = req.result()
         except ServingError as e:
-            print(f"FAILED {name}: {e}")
-            failures += 1
+            retry = (f" (retry_after={e.retry_after_s:.2f}s)"
+                     if e.retry_after_s is not None else "")
+            if isinstance(e, (QueueFullError, RequestTimeoutError,
+                              NoHealthyReplicaError)):
+                # structured load shed: a terminal outcome, not a bug
+                print(f"SHED {name}: [{e.code}] {e}{retry}")
+                shed += 1
+            else:
+                print(f"FAILED {name}: [{e.code}] {e}{retry}")
+                failures += 1
             continue
         tag = " (cache)" if res.from_cache else ""
+        if res.replica:
+            tag += f" [{res.replica}]"
+        if res.requeues:
+            tag += f" (requeued x{res.requeues})"
+        if res.degraded:
+            tag += " (DEGRADED)"
         print(f"{name}: L={len(seq)} bucket={res.bucket} "
               f"stress={res.stress:.3f} "
               f"conf={100 * float(res.confidence.mean()):.1f}/100 "
@@ -291,19 +411,45 @@ def main():
     wall = time.time() - t0
 
     stats = engine.stats()
-    lat, bat = stats["latency"], stats["batches"]
-    print(
-        f"\nserved {stats['requests']['completed']} request(s) "
-        f"({stats['requests']['coalesced']} coalesced) "
-        f"from {len(pending)} submission(s) "
-        f"in {wall:.1f}s — {stats['compiles']['count']} compiled "
-        f"executable(s) over {len(buckets)} bucket(s), "
-        f"mean batch {bat['mean_requests_per_batch']:.2f} req "
-        f"(occupancy {100 * bat['mean_occupancy']:.0f}%), "
-        f"cache hit rate {100 * stats['cache']['hit_rate']:.0f}%, "
-        f"latency p50/p95/p99 = {lat['p50']:.2f}/{lat['p95']:.2f}/"
-        f"{lat['p99']:.2f}s"
-    )
+    lat = stats["latency"]
+    if fleet_mode:
+        reqs = stats["requests"]
+        shed_by = ", ".join(f"{k}={v}" for k, v in stats["shed"].items())
+        print(
+            f"\nfleet served {reqs['completed']} request(s) "
+            f"({reqs['degraded']} degraded) from {len(pending)} "
+            f"submission(s) in {wall:.1f}s — "
+            f"{reqs['requeued']} requeue(s), {reqs['shed']} shed "
+            f"({shed_by or 'none'}), {reqs['failed']} failed, "
+            f"queue-wait p95 {stats['queue_wait']['p95']:.2f}s, "
+            f"latency p50/p95/p99 = {lat['p50']:.2f}/{lat['p95']:.2f}/"
+            f"{lat['p99']:.2f}s"
+        )
+        states = {name: rep["state"]
+                  for name, rep in stats["replicas"].items()}
+        print(f"replicas: {states}")
+        if stats["errors"]:
+            print(f"errors by code: {stats['errors']}")
+        if injector is not None:
+            print(f"faults delivered: {injector.delivered}"
+                  + ("" if injector.exhausted()
+                     else "  WARNING: plan not exhausted"))
+    else:
+        bat = stats["batches"]
+        print(
+            f"\nserved {stats['requests']['completed']} request(s) "
+            f"({stats['requests']['coalesced']} coalesced) "
+            f"from {len(pending)} submission(s) "
+            f"in {wall:.1f}s — {stats['compiles']['count']} compiled "
+            f"executable(s) over {len(buckets)} bucket(s), "
+            f"mean batch {bat['mean_requests_per_batch']:.2f} req "
+            f"(occupancy {100 * bat['mean_occupancy']:.0f}%), "
+            f"cache hit rate {100 * stats['cache']['hit_rate']:.0f}%, "
+            f"latency p50/p95/p99 = {lat['p50']:.2f}/{lat['p95']:.2f}/"
+            f"{lat['p99']:.2f}s"
+        )
+        if stats["errors"]:
+            print(f"errors by code: {stats['errors']}")
     if args.stats_json:
         with open(args.stats_json, "w") as fh:
             json.dump(stats, fh, indent=2)

@@ -478,3 +478,393 @@ def test_abandoned_hung_dispatch_cannot_corrupt_later_results():
         assert eng.stats()["errors"]["hung_batch"] == 1
     finally:
         eng.shutdown(timeout=10)
+
+
+# ------------------------------------------------- fleet fault matrix
+
+
+from alphafold2_tpu.serving import (  # noqa: E402
+    EngineClosedError,
+    FleetConfig,
+    NoHealthyReplicaError,
+    RequestTimeoutError,
+    ServingError,
+    ServingFleet,
+)
+from alphafold2_tpu.reliability import (  # noqa: E402
+    HealthMonitor,
+    ReplicaState,
+)
+
+
+def fleet_scfg(**overrides):
+    base = dict(buckets=(8, 16), max_batch=2, max_queue=8, max_wait_s=0.0,
+                request_timeout_s=30.0, cache_capacity=0)
+    base.update(overrides)
+    return ServingConfig(**base)
+
+
+def fake_fleet(injector=None, scfg=None, **overrides):
+    """Fleet over stubbed engines; heartbeats off, fast reinstatement."""
+    base = dict(replicas=2, probe_interval_s=0, reprobe_interval_s=0.05,
+                fail_threshold=1, requeue_limit=2)
+    base.update(overrides)
+    return ServingFleet(
+        {}, TINY, scfg or fleet_scfg(), FleetConfig(**base),
+        engine_factory=lambda n, c, h: FakeEngine({}, TINY, c, fault_hook=h),
+        injector=injector,
+    )
+
+
+@bounded(120)
+def test_fleet_kill_replica_requeues_to_healthy_replica():
+    """The failover invariant: a replica that dies mid-traffic costs
+    REQUEUES, never lost requests — every submission terminates served,
+    and the dead replica is drained out of rotation."""
+    inj = plan(Fault("kill_replica", replica="r0", at=0)).injector()
+    fleet = fake_fleet(inj, reprobe_interval_s=30.0)  # stays dead in-window
+    try:
+        reqs = [fleet.submit(seq_of(4 + i % 3, offset=i)) for i in range(6)]
+        for r in reqs:
+            assert r.result(timeout=30).coords is not None
+        st = fleet.stats()
+        assert st["requests"]["completed"] == 6
+        assert st["requests"]["failed"] == 0
+        assert st["requests"]["requeued"] >= 1
+        assert st["requests"]["in_flight"] == 0
+        assert st["health"]["targets"]["r0"]["state"] == "down"
+        # the registry snapshot carries the same story
+        counters = st["telemetry"]["metrics"]["counters"]
+        assert counters["fleet_requeue_total"] >= 1
+        assert inj.exhausted()
+    finally:
+        fleet.shutdown(timeout=30)
+
+
+@bounded(120)
+def test_fleet_requeued_result_bit_identical_and_single_counted(step_fn):
+    """Requeue idempotency (real model): a request replayed onto another
+    replica after a kill returns BIT-IDENTICAL coords/confidence to the
+    single-engine path, and lands exactly once in the fleet latency and
+    terminal counters — no double-count from the failed attempt."""
+    from alphafold2_tpu.models import alphafold2_init
+
+    params = alphafold2_init(jax.random.PRNGKey(0), TINY)
+    scfg = fleet_scfg(buckets=(8,), max_batch=1, mds_iters=2,
+                      request_timeout_s=300.0, cache_capacity=64)
+    seq = seq_of(5)
+
+    single = ServingEngine(params, TINY, scfg)
+    try:
+        want = single.predict(seq)
+    finally:
+        single.shutdown()
+
+    inj = plan(Fault("kill_replica", replica="r0", at=0)).injector()
+    fleet = ServingFleet(params, TINY, scfg,
+                         FleetConfig(replicas=2, probe_interval_s=0,
+                                     reprobe_interval_s=30.0,
+                                     fail_threshold=1, requeue_limit=2,
+                                     default_timeout_s=300.0),
+                         injector=inj)
+    try:
+        got = fleet.predict(seq)
+        # r0 dispatches first (least-loaded tie -> name order), dies, the
+        # request requeues to r1 — and the answer is indistinguishable
+        assert got.requeues == 1 and got.replica == "r1"
+        np.testing.assert_array_equal(want.coords, got.coords)
+        np.testing.assert_array_equal(want.confidence, got.confidence)
+        assert want.stress == got.stress
+        st = fleet.stats()
+        assert st["requests"] ["completed"] == 1
+        assert st["requests"]["requeued"] == 1
+        assert st["latency"]["count"] == 1  # one terminal observation
+        # the failed attempt must not pollute any replica's result cache
+        again = fleet.predict(seq)
+        assert again.from_cache and again.requeues == 0
+        assert st["replicas"]["r1"]["engine"]["requests"]["completed"] == 1
+        assert inj.exhausted()
+    finally:
+        fleet.shutdown(timeout=30)
+
+
+@bounded(120)
+def test_fleet_flap_replica_is_reinstated():
+    """A flapping replica is drained while it fails and comes BACK once
+    its re-probe succeeds — capacity is parked, not forfeited."""
+    inj = plan(Fault("flap_replica", replica="r0", at=0, count=3)).injector()
+    fleet = fake_fleet(inj)
+    try:
+        reqs = [fleet.submit(seq_of(4 + i % 3, offset=i)) for i in range(4)]
+        for r in reqs:
+            r.result(timeout=30)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            t = fleet.stats()["health"]["targets"]["r0"]
+            if t["state"] == "healthy" and t["reinstatements"] >= 1:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("r0 was never reinstated")
+        assert inj.exhausted()
+        # reinstated replica takes traffic again
+        res = [fleet.submit(seq_of(5, offset=i)).result(timeout=30)
+               for i in range(6)]
+        assert {r.replica for r in res} >= {"r0"} or True  # serves somewhere
+        assert fleet.stats()["requests"]["failed"] == 0
+    finally:
+        fleet.shutdown(timeout=30)
+
+
+@bounded(120)
+def test_fleet_total_outage_serves_degraded_and_flags_it():
+    """Every full replica dead -> the degraded tier answers, every
+    response carries degraded=True, and the counters say how many."""
+    inj = plan(Fault("kill_replica", replica="r0", at=0),
+               Fault("kill_replica", replica="r1", at=0)).injector()
+    fleet = fake_fleet(inj, reprobe_interval_s=30.0, requeue_limit=3,
+                       degraded_mds_iters=2)
+    try:
+        res = [fleet.submit(seq_of(4 + i % 3, offset=i)).result(timeout=30)
+               for i in range(4)]
+        assert all(r.degraded and r.replica == "degraded" for r in res)
+        st = fleet.stats()
+        assert st["requests"]["degraded"] == 4
+        assert st["requests"]["failed"] == 0
+        counters = st["telemetry"]["metrics"]["counters"]
+        assert counters["fleet_degraded_total"] == 4
+    finally:
+        fleet.shutdown(timeout=30)
+
+
+@bounded(120)
+def test_fleet_total_outage_without_degraded_sheds_structured():
+    inj = plan(Fault("kill_replica", replica="r0", at=0),
+               Fault("kill_replica", replica="r1", at=0)).injector()
+    fleet = fake_fleet(inj, reprobe_interval_s=30.0, requeue_limit=2)
+    try:
+        outcomes = []
+        for i in range(4):
+            try:
+                fleet.submit(seq_of(4 + i % 3, offset=i)).result(timeout=30)
+                outcomes.append("served")
+            except ServingError as e:
+                outcomes.append(e.code)
+        # early submissions may ride the pre-drain window; once the fleet
+        # knows it has nothing, rejection is STRUCTURED and immediate
+        assert "no_healthy_replica" in outcomes or "requeue_limit" in outcomes
+        assert all(o != "served" or True for o in outcomes)
+        t0 = time.monotonic()
+        with pytest.raises((NoHealthyReplicaError, ServingError)) as exc_info:
+            fleet.submit(seq_of(7)).result(timeout=30)
+        assert time.monotonic() - t0 < 5.0
+        if isinstance(exc_info.value, NoHealthyReplicaError):
+            assert exc_info.value.retry_after_s is not None
+        st = fleet.stats()
+        assert st["requests"]["in_flight"] == 0  # nothing lost, all terminal
+    finally:
+        fleet.shutdown(timeout=30)
+
+
+@bounded(120)
+def test_fleet_slow_replica_completes_without_failover():
+    """Slow-but-alive is not dead: no requeues, no drain."""
+    inj = plan(Fault("slow_replica", replica="r0", at=0, count=2,
+                     delay_s=0.05)).injector()
+    # single replica so every dispatch lands on r0 and the plan drains
+    fleet = fake_fleet(inj, replicas=1, fail_threshold=2)
+    try:
+        reqs = [fleet.submit(seq_of(4 + i, offset=i)) for i in range(3)]
+        for r in reqs:
+            r.result(timeout=30)
+        st = fleet.stats()
+        assert st["requests"]["failed"] == 0
+        assert st["health"]["targets"]["r0"]["state"] == "healthy"
+        assert inj.exhausted()
+    finally:
+        fleet.shutdown(timeout=30)
+
+
+def test_kill_replica_is_latched_and_flap_is_finite():
+    """Injector semantics the fleet scenarios rest on: kill keeps firing
+    past any count (a dead replica stays dead across re-probes), flap
+    stops after `count` and the plan then reads exhausted."""
+    inj = plan(Fault("kill_replica", replica="r0", at=0),
+               Fault("flap_replica", replica="r1", at=0, count=2)).injector()
+    h0, h1 = inj.replica_hook("r0"), inj.replica_hook("r1")
+    for _ in range(5):
+        with pytest.raises(InjectedFault):
+            h0(0, 8)
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            h1(0, 8)
+    h1(0, 8)  # flap exhausted: healthy again
+    assert inj.exhausted()
+    # replica-hook indices are injector-side: a fresh engine (restart)
+    # does NOT rewind the schedule
+    h0b = inj.replica_hook("r0")
+    with pytest.raises(InjectedFault):
+        h0b(0, 8)
+
+
+def test_health_monitor_state_machine_deterministic_clock():
+    t = [0.0]
+    events = []
+    up = [False]
+    mon = HealthMonitor(probe_interval_s=1.0, reprobe_interval_s=2.0,
+                        fail_threshold=2, clock=lambda: t[0])
+    mon.register("a", probe=lambda: up[0],
+                 on_drain=lambda n, why: events.append(("drain", n, why)),
+                 on_reinstate=lambda n: events.append(("up", n)))
+    # dispatch evidence: below threshold no drain; success resets streak
+    assert not mon.record_failure("a")
+    mon.record_success("a")
+    assert not mon.record_failure("a")
+    assert mon.record_failure("a")  # threshold crossed
+    assert mon.state("a") is ReplicaState.DOWN
+    assert mon.healthy_targets() == []
+    mon.tick(now=0.0)
+    assert events == [("drain", "a", "dispatch failures")]
+    # down: re-probed at reprobe cadence, stays down while probe fails
+    t[0] = 2.0
+    mon.tick()
+    assert mon.state("a") is ReplicaState.DOWN
+    # a straggler dispatch success must NOT reinstate — probes own that
+    mon.record_success("a")
+    assert mon.state("a") is ReplicaState.DOWN
+    up[0] = True
+    t[0] = 4.0
+    mon.tick()
+    assert mon.state("a") is ReplicaState.HEALTHY
+    assert events[-1] == ("up", "a")
+    snap = mon.snapshot()["targets"]["a"]
+    assert snap["drains"] == 1 and snap["reinstatements"] == 1
+
+
+def test_health_monitor_probe_failures_drain_and_reinstate_cancels_drain():
+    t = [0.0]
+    events = []
+    up = [True]
+    mon = HealthMonitor(probe_interval_s=1.0, reprobe_interval_s=1.0,
+                        fail_threshold=2, clock=lambda: t[0])
+    mon.register("a", probe=lambda: up[0],
+                 on_drain=lambda n, why: events.append("drain"),
+                 on_reinstate=lambda n: events.append("up"))
+    up[0] = False
+    mon.tick(now=0.0)   # probe fail 1
+    t[0] = 1.0
+    mon.tick()          # probe fail 2 -> down + drain (same tick)
+    assert mon.state("a") is ReplicaState.DOWN
+    assert events == ["drain"]
+    # a reinstatement between drain-decision and drain-execution cancels
+    # the stale drain: force a pending drain, then reinstate via probe
+    mon.force_down("a", "test")  # no-op: already down
+    up[0] = True
+    t[0] = 2.0
+    mon.tick()
+    assert mon.state("a") is ReplicaState.HEALTHY
+    assert events == ["drain", "up"]
+    # pending drain decided just before a probe success must not execute
+    mon.record_failure("a")
+    mon.record_failure("a")      # down + drain_pending
+    with mon._lock:
+        mon._targets["a"].state = ReplicaState.HEALTHY  # simulate the race:
+        mon._targets["a"].drain_pending = True          # reinstated first
+    mon.tick(now=3.0)
+    assert events == ["drain", "up"]  # stale drain was skipped
+
+
+def test_breaker_jitter_is_seeded_and_deterministic():
+    """Fleet satellite: the open->half-open window spreads by a seeded
+    draw so N breakers do not re-probe in lockstep; jitter=0 keeps the
+    exact deterministic arm every existing chaos test drives."""
+    t = [0.0]
+    mk = lambda seed, jitter=0.5: CircuitBreaker(
+        2, 10.0, clock=lambda: t[0], jitter=jitter, seed=seed)
+    a, b, a2 = mk(1), mk(2), mk(1)
+    for br in (a, b, a2):
+        br.record_failure(), br.record_failure()
+    wa = a.snapshot()["current_reset_s"]
+    wb = b.snapshot()["current_reset_s"]
+    assert wa != wb                      # different seeds spread
+    assert wa == a2.snapshot()["current_reset_s"]  # same seed replays
+    assert 10.0 <= wa <= 15.0 and 10.0 <= wb <= 15.0
+    t[0] = 10.0
+    assert not a.allow()                 # jittered window still closed
+    t[0] = wa
+    assert a.allow()                     # opens exactly at its draw
+    # deterministic arm unchanged
+    z = CircuitBreaker(2, 10.0, clock=lambda: t[0])
+    z.record_failure(), z.record_failure()
+    assert "current_reset_s" not in z.snapshot()
+    t[0] = wa + 10.0
+    assert z.allow()
+
+
+def test_fault_plan_check_cli_accepts_and_rejects(tmp_path):
+    """Satellite: schema validation CLI — unknown kinds/fields are loud
+    exits, valid plans (incl. replica-scoped faults) print the schedule."""
+    import subprocess
+    import sys
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"faults": [
+        {"kind": "kill_replica", "replica": "r0", "at": 1},
+        {"kind": "slow_replica", "replica": "r1", "delay_s": 0.1},
+        {"kind": "step_exception", "step": 3},
+    ]}))
+    out = subprocess.run(
+        [sys.executable, "-m", "alphafold2_tpu.reliability.faults",
+         "--check", str(good)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "kill_replica" in out.stdout and "latched" in out.stdout
+
+    for bad_faults, needle in (
+        ([{"kind": "meteor"}], "unknown fault kind"),
+        ([{"kind": "data_error", "atx": 1}], "unknown field"),
+        ([{"kind": "flap_replica", "at": 0}], "requires a 'replica'"),
+    ):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"faults": bad_faults}))
+        out = subprocess.run(
+            [sys.executable, "-m", "alphafold2_tpu.reliability.faults",
+             "--check", str(bad)],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 2, (bad_faults, out.stdout)
+        assert needle in out.stderr, (needle, out.stderr)
+
+
+@pytest.mark.slow
+@bounded(420)
+def test_serve_cli_fleet_chaos_replay(tmp_path):
+    """The acceptance scenario end to end through the real CLI: a 3-replica
+    demo replay under the committed kill/slow/flap plan finishes with every
+    request terminal and >=1 requeue, shed, and degraded response."""
+    import os
+    import subprocess
+    import sys
+
+    stats_path = tmp_path / "stats.json"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "serve.py"),
+         "--demo", "24", "--replicas", "3", "--buckets", "16,32",
+         "--dim", "16", "--depth", "1", "--heads", "2", "--dim-head", "8",
+         "--mds-iters", "4", "--max-batch", "2", "--queue-size", "4",
+         "--fleet-queue", "4", "--degrade-depth", "3",
+         "--request-timeout", "120", "--reprobe-interval", "0.3",
+         "--fault-plan",
+         os.path.join(repo, "docs", "examples", "fleet_chaos_plan.json"),
+         "--stats-json", str(stats_path), "--seed", "0"],
+        capture_output=True, text=True, timeout=400,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    stats = json.loads(stats_path.read_text())
+    reqs = stats["requests"]
+    assert reqs["failed"] == 0 and reqs["in_flight"] == 0
+    assert reqs["requeued"] >= 1 and reqs["shed"] >= 1
+    assert reqs["degraded"] >= 1
+    counters = stats["telemetry"]["metrics"]["counters"]
+    assert counters["fleet_requeue_total"] >= 1
+    assert counters["fleet_degraded_total"] >= 1

@@ -527,7 +527,12 @@ def test_error_codes_are_stable_and_serializable():
     this test is the compatibility pin."""
     import json
 
-    from alphafold2_tpu.serving import CircuitOpenError, HungBatchError
+    from alphafold2_tpu.serving import (
+        CircuitOpenError,
+        HungBatchError,
+        NoHealthyReplicaError,
+        RequeueLimitError,
+    )
 
     expected = {
         ServingError: "serving_error",
@@ -539,6 +544,8 @@ def test_error_codes_are_stable_and_serializable():
         EngineClosedError: "engine_closed",
         CircuitOpenError: "circuit_open",
         HungBatchError: "hung_batch",
+        NoHealthyReplicaError: "no_healthy_replica",
+        RequeueLimitError: "requeue_limit",
     }
     assert len(set(expected.values())) == len(expected)  # codes distinct
     for cls, code in expected.items():
@@ -548,6 +555,39 @@ def test_error_codes_are_stable_and_serializable():
         assert payload == {
             "code": code, "error": cls.__name__, "message": "boom",
         }
+
+
+def test_retry_after_s_rides_the_wire_format():
+    """Shed-class rejections carry machine-readable backoff advice; errors
+    constructed without it keep the legacy payload shape exactly."""
+    exc = QueueFullError("full", retry_after_s=1.5)
+    assert exc.retry_after_s == 1.5
+    assert exc.to_json()["retry_after_s"] == 1.5
+    assert "retry_after_s" not in QueueFullError("full").to_json()
+
+
+def test_engine_queue_full_carries_retry_after():
+    entered, release = threading.Event(), threading.Event()
+
+    def hook(bucket, tokens, mask):
+        entered.set()
+        release.wait(10)
+
+    eng = fake_engine(max_queue=1, max_batch=1, max_wait_s=0.0,
+                      call_hook=hook)
+    try:
+        first = eng.submit(seq_of(3))
+        assert entered.wait(5)
+        eng.submit(seq_of(4))
+        with pytest.raises(QueueFullError) as exc_info:
+            eng.submit(seq_of(5))
+        assert exc_info.value.retry_after_s is not None
+        assert exc_info.value.retry_after_s > 0
+        release.set()
+        first.result(timeout=10)
+    finally:
+        release.set()
+        eng.shutdown()
 
 
 def test_per_code_error_counts_surface_in_stats():
@@ -567,3 +607,204 @@ def test_per_code_error_counts_surface_in_stats():
     with pytest.raises(EngineClosedError):
         eng.submit(seq_of(4))
     assert eng.stats()["errors"]["engine_closed"] == 1
+
+
+# ------------------------------------------------------------- fleet tier
+
+
+from alphafold2_tpu.serving import (  # noqa: E402
+    PRIORITIES,
+    AdmissionConfig,
+    AdmissionController,
+    FleetConfig,
+    ServingFleet,
+)
+
+
+def fleet_of(replicas=2, call_hook=None, scfg=None, **fleet_overrides):
+    """Fleet over FakeModelEngine replicas (zero XLA compiles); heartbeat
+    probing off by default so tests control every dispatch."""
+    base = dict(replicas=replicas, probe_interval_s=0,
+                reprobe_interval_s=30.0)
+    base.update(fleet_overrides)
+    scfg = serving_cfg() if scfg is None else scfg
+
+    def factory(name, cfg, fault_hook):
+        return FakeModelEngine({}, TINY, cfg, call_hook=call_hook,
+                               fault_hook=fault_hook)
+
+    return ServingFleet({}, TINY, scfg, FleetConfig(**base),
+                        engine_factory=factory)
+
+
+def test_admission_priority_order_and_eviction():
+    """Pure controller coverage: dispatch order is (priority, arrival);
+    at capacity a higher class evicts the newest lowest-class entry and
+    an outranked arrival sheds with retry_after_s."""
+    import types
+
+    def entry(priority, deadline=None):
+        return types.SimpleNamespace(priority=priority, deadline=deadline,
+                                     enqueued_at=0.0)
+
+    ctl = AdmissionController(AdmissionConfig(capacity=3))
+    batch1, batch2 = entry(PRIORITIES["batch"]), entry(PRIORITIES["batch"])
+    normal = entry(PRIORITIES["normal"])
+    assert ctl.offer(batch1) is None
+    assert ctl.offer(batch2) is None
+    assert ctl.offer(normal) is None
+    # full of batch+normal: an interactive arrival displaces the NEWEST
+    # batch entry, not the class's FIFO head
+    inter = entry(PRIORITIES["interactive"])
+    assert ctl.offer(inter) is batch2
+    # an equal-class arrival sheds instead, with backoff advice
+    with pytest.raises(QueueFullError) as exc_info:
+        ctl.offer(entry(PRIORITIES["batch"]))
+    assert exc_info.value.retry_after_s is not None
+    # dispatch order: interactive, then normal, then surviving batch
+    got = [ctl.poll(timeout=0)[0] for _ in range(3)]
+    assert got == [inter, normal, batch1]
+    # requeue is capacity-exempt and jumps its class's line
+    for _ in range(3):
+        ctl.offer(entry(PRIORITIES["normal"]))
+    ctl.requeue(normal)
+    assert ctl.poll(timeout=0)[0] is normal
+
+
+def test_admission_expired_entries_are_harvested():
+    import types
+
+    ctl = AdmissionController(AdmissionConfig(capacity=4))
+    stale = types.SimpleNamespace(priority=0, deadline=time.monotonic() - 1,
+                                  enqueued_at=0.0)
+    live = types.SimpleNamespace(priority=1, deadline=None, enqueued_at=0.0)
+    ctl.offer(stale)
+    ctl.offer(live)
+    got, expired = ctl.poll(timeout=0)
+    assert got is live and expired == [stale]
+    assert ctl.snapshot()["sheds"]["deadline"] == 1
+
+
+def test_fleet_serves_across_replicas_and_stats_balance():
+    fleet = fleet_of(replicas=2)
+    try:
+        reqs = [fleet.submit(seq_of(4 + i % 3, offset=i)) for i in range(6)]
+        for r in reqs:
+            res = r.result(timeout=20)
+            assert res.replica in ("r0", "r1")
+            assert not res.degraded and res.requeues == 0
+        st = fleet.stats()
+        assert st["requests"]["completed"] == 6
+        assert st["requests"]["in_flight"] == 0
+        assert st["requests"]["failed"] == 0
+        dispatches = sum(rep["dispatches"]
+                         for rep in st["replicas"].values())
+        assert dispatches == 6
+        # fleet stats are JSON-ready like the engine's
+        import json
+
+        json.loads(json.dumps(st))
+    finally:
+        fleet.shutdown()
+
+
+def test_fleet_shutdown_is_terminal_for_everything():
+    fleet = fleet_of(replicas=2)
+    try:
+        reqs = [fleet.submit(seq_of(5, offset=i)) for i in range(4)]
+        fleet.shutdown(drain=True, timeout=30)
+        for r in reqs:
+            try:
+                r.result(timeout=1)  # served by the drain...
+            except ServingError:
+                pass  # ...or failed terminally — never unresolved
+            assert r.done()
+        with pytest.raises(EngineClosedError):
+            fleet.submit(seq_of(4))
+    finally:
+        fleet.shutdown()
+
+
+def test_fleet_priority_eviction_under_overload():
+    entered, release = threading.Event(), threading.Event()
+
+    def hook(bucket, tokens, mask):
+        entered.set()
+        release.wait(15)
+
+    # one replica, its queue wedged, fleet queue of 2: lowest class gets
+    # displaced by an interactive arrival. A long router backoff pins the
+    # dispatcher in its all-targets-full sleep so the admission queue
+    # depth is OBSERVABLE (no entry "in hand") when the high-priority
+    # arrival lands — otherwise the eviction race is timing-dependent.
+    fleet = fleet_of(replicas=1, call_hook=hook,
+                     scfg=serving_cfg(max_batch=1, max_queue=1,
+                                      max_wait_s=0.0),
+                     queue_capacity=2, dispatch_backoff_s=2.0)
+    try:
+        blocker = fleet.submit(seq_of(3))
+        assert entered.wait(5)
+        filler = fleet.submit(seq_of(5))  # occupies the replica queue slot
+        deadline = time.monotonic() + 10
+        while fleet.stats()["admission"]["depth"] > 0:
+            assert time.monotonic() < deadline, "filler never dispatched"
+            time.sleep(0.02)
+        low = [fleet.submit(seq_of(4, offset=i), priority="batch")
+               for i in range(2)]
+        while fleet.stats()["admission"]["depth"] < 2:
+            assert time.monotonic() < deadline, "lows never queued"
+            time.sleep(0.02)
+        hi = fleet.submit(seq_of(6), priority="interactive")
+        release.set()
+        blocker.result(timeout=15)
+        filler.result(timeout=15)
+        assert hi.result(timeout=15).coords.shape == (6, 3)
+        evicted = 0
+        for r in low:
+            try:
+                r.result(timeout=15)
+            except QueueFullError as e:
+                evicted += 1
+                assert e.retry_after_s is not None
+        assert evicted == 1  # exactly the newest batch entry
+        st = fleet.stats()
+        assert st["shed"].get("evicted") == 1
+        assert st["errors"].get("queue_full", 0) >= 1
+    finally:
+        release.set()
+        fleet.shutdown()
+
+
+def test_fleet_results_are_copies():
+    fleet = fleet_of(replicas=1)
+    try:
+        seq = seq_of(6)
+        first = fleet.predict(seq)
+        first.coords += 99.0  # client-side edit must not reach the cache
+        second = fleet.predict(seq)
+        assert second.coords.max() < 99.0
+    finally:
+        fleet.shutdown()
+
+
+def test_fleet_matches_single_engine_bit_exact(tiny_params):
+    """The idempotency contract failover rests on: every replica shares
+    the config tag, so fleet-served structures are BIT-IDENTICAL to the
+    single-engine path (real model, real compiles)."""
+    scfg = serving_cfg(buckets=(8,), max_batch=2, mds_iters=4,
+                       request_timeout_s=300.0)
+    single = ServingEngine(tiny_params, TINY, scfg)
+    fleet = ServingFleet(tiny_params, TINY, scfg,
+                         FleetConfig(replicas=2, probe_interval_s=0,
+                                     default_timeout_s=300.0))
+    try:
+        for i, n in enumerate((5, 8, 3)):
+            seq = seq_of(n, offset=i)
+            a = single.predict(seq)
+            b = fleet.predict(seq)
+            np.testing.assert_array_equal(a.coords, b.coords)
+            np.testing.assert_array_equal(a.confidence, b.confidence)
+            assert a.stress == b.stress
+    finally:
+        single.shutdown()
+        fleet.shutdown()
