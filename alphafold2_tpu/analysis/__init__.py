@@ -1,7 +1,7 @@
 """af2lint: in-repo static analysis for a JAX codebase that cannot afford
 runtime discovery of statically detectable breakage.
 
-Five passes, each a module in this package:
+Six passes, each a module in this package:
 
   * ``compat``   — AST linter: no `jax.experimental.*` access and no
                    drift-table symbol outside `alphafold2_tpu/compat.py`
@@ -22,7 +22,13 @@ Five passes, each a module in this package:
                    attention, SP trunk, backward-overlapped DP step) via
                    `jax.export` and structurally asserts collectives
                    interleave with compute instead of fencing it
-                   (overlap_lint.py).
+                   (overlap_lint.py);
+  * ``schedule`` — branch-parallel trunk-schedule verification: lowers
+                   the branch-parallel trunks (sequential, reversible,
+                   SP) via `jax.export` and structurally asserts each
+                   layer's pair/MSA branches are data-independent before
+                   their join marker, with a serialized-twin detector
+                   self-check (schedule_lint.py).
 
 CLI: ``python -m alphafold2_tpu.analysis --strict`` (docs/STATIC_ANALYSIS.md).
 """
@@ -70,6 +76,12 @@ def _run_overlap(root, files=None, **_):
     return run(root, files=files)
 
 
+def _run_schedule(root, files=None, **_):
+    from alphafold2_tpu.analysis.schedule_lint import run
+
+    return run(root, files=files)
+
+
 # name -> runner(root, files=..., axes=...) -> list[Finding]
 PASSES = {
     "compat": _run_compat,
@@ -77,11 +89,12 @@ PASSES = {
     "sharding": _run_sharding,
     "smoke": _run_smoke,
     "overlap": _run_overlap,
+    "schedule": _run_schedule,
 }
 
 # passes that verify whole programs rather than the given files: dropped
 # from file-scoped invocations unless explicitly selected
-_REPO_WIDE = ("smoke", "overlap")
+_REPO_WIDE = ("smoke", "overlap", "schedule")
 
 
 def run_passes(root, select=None, files=None, axes=None):

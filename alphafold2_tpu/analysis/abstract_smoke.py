@@ -95,6 +95,23 @@ def _targets() -> Dict[str, Callable[[], None]]:
             abstract((2, 16)),
         )
 
+    @register("ops.flash_attention_fused")
+    def _flash_fused():
+        from alphafold2_tpu.ops.flash_kernel import flash_attention_fused
+
+        # 2-D pair-bias tiles + in-kernel output gate, fwd and grads
+        # (incl. the real d_bias / d_gate cotangents)
+        jax.eval_shape(
+            jax.grad(
+                lambda q, k, v, b, g: flash_attention_fused(
+                    q, k, v, b, 0.35, gate=g, qb=128, kb=128
+                ).sum(),
+                argnums=(0, 1, 2, 3, 4),
+            ),
+            abstract((2, 16, 8)), abstract((2, 24, 8)), abstract((2, 24, 8)),
+            abstract((2, 16, 24)), abstract((2, 16, 8)),
+        )
+
     @register("ops.blockwise_attention")
     def _blockwise():
         from alphafold2_tpu.ops.flash import blockwise_attention
@@ -166,6 +183,31 @@ def _targets() -> Dict[str, Callable[[], None]]:
         params = jax.eval_shape(lambda k: alphafold2_init(k, cfg), key)
         seq = abstract((1, 12), jnp.int32)
         jax.eval_shape(lambda p, s: alphafold2_apply(p, cfg, s), params, seq)
+
+    @register("model.trunk_branch_parallel")
+    def _trunk_branch_parallel():
+        from alphafold2_tpu.models import Alphafold2Config
+        from alphafold2_tpu.models.trunk import (
+            sequential_trunk_apply,
+            trunk_layer_init,
+        )
+
+        # the branch-parallel schedule with a gated attention config —
+        # the two tentpole arms of PR 7 trace together
+        cfg = Alphafold2Config(
+            dim=32, depth=2, heads=4, dim_head=8, max_seq_len=64,
+            trunk_schedule="branch_parallel", attn_gate=True,
+        )
+        layers = jax.eval_shape(
+            lambda k: [
+                trunk_layer_init(kk, cfg) for kk in jax.random.split(k, 2)
+            ],
+            key,
+        )
+        jax.eval_shape(
+            lambda ls, x, m: sequential_trunk_apply(ls, cfg, x, m),
+            layers, abstract((1, 8, 8, 32)), abstract((1, 4, 8, 32)),
+        )
 
     # --- serving -------------------------------------------------------------
     @register("serving.pipeline")

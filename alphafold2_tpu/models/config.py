@@ -22,6 +22,34 @@ from alphafold2_tpu.constants import (
 from alphafold2_tpu.ops.attention import AttentionConfig
 
 
+# depth threshold below which the smaller parameter/optimizer state leaves
+# ~2 GB of HBM headroom on a 16G chip (PERF.md "where the next factors come
+# from" item 1): shallow trunks trade that headroom for fewer, larger
+# attention chunks and bigger streaming tiles
+_ATTN_HEADROOM_MAX_DEPTH = 24
+
+
+def depth_aware_attn_defaults(depth: int) -> dict:
+    """Measured-headroom attention-knob defaults for the north-star preset.
+
+    At depth <= 24 the trunk's parameter + optimizer state is small enough
+    that the memory-bounding chunks can be raised (PERF.md item 1):
+    `attn_batch_chunk` 32 -> 96 (3x fewer, 3x larger attention programs
+    per pass) and `attn_flash_tile_elems` 2^25 -> 2^26 (halves the
+    sequential tile count of the XLA streaming path). Depth 48 keeps the
+    proven-to-fit values — the deep config has no headroom to spend.
+
+    This is THE resolver for the two knobs: the training preset
+    (training/presets.py) routes through it, so the bench scripts that
+    inherit preset defaults (bench.py, scripts/bench_sweep.py legs without
+    explicit overrides) measure against it, and the `e2e_chunk32` /
+    `e2e_tile25` sweep legs A/B the old values against it on chip.
+    """
+    if depth <= _ATTN_HEADROOM_MAX_DEPTH:
+        return {"attn_batch_chunk": 96, "attn_flash_tile_elems": 1 << 26}
+    return {"attn_batch_chunk": 32, "attn_flash_tile_elems": 1 << 25}
+
+
 @dataclasses.dataclass(frozen=True)
 class Alphafold2Config:
     dim: int
@@ -96,6 +124,27 @@ class Alphafold2Config:
     # flash_compute_dtype_logits) — halves the streaming path's dominant
     # HBM traffic under bf16 at ~0.5% probability error
     attn_flash_compute_dtype_logits: bool = False
+    # sigmoid output gating on every attention op: out = sigmoid(W_g x) *
+    # attn(x) before the output projection (the AF2-style gate the
+    # reference omits). Gate weights init to (w=0, b=1) so a freshly
+    # gated model starts at sigmoid(1) ~ 0.73 * the ungated output. On
+    # the TPU kernel path the gate is applied INSIDE the Pallas flash
+    # kernel's finish step (ops/flash_kernel.py fused epilogue — no extra
+    # HBM round-trip); off-kernel paths apply it as an epilogue. Changes
+    # numerics and the parameter tree: part of the serving config tag.
+    attn_gate: bool = False
+    # intra-layer trunk schedule (models/trunk.py):
+    #   "serial"          — the reference op order, one op after another;
+    #   "branch_parallel" — the pair track (self-attn + FF) and MSA track
+    #     (self-attn + FF) are expressed as two data-independent branches
+    #     that JOIN only at the cross-attention exchange (Parallel
+    #     Evoformer, arXiv 2211.00235), marked by an optimization-barrier
+    #     join the scheduler (and analysis/schedule_lint.py) can see.
+    # Same math either way — branch_parallel only re-groups ops that were
+    # already independent — so the arms are allclose fwd + grads; still
+    # part of the serving config tag (schedules may differ in fusion-level
+    # float association, and bit-exactness pins must not alias).
+    trunk_schedule: str = "serial"
     # chunk feed-forward token axes into blocks of this many tokens (0 =
     # off): bounds the GEGLU 8*dim intermediate, which at crop 384 is the
     # largest single activation in the trunk
@@ -124,6 +173,20 @@ class Alphafold2Config:
             raise ValueError(
                 f"remat_policy must be None, 'dots', or 'dots_no_batch', "
                 f"got {self.remat_policy!r}"
+            )
+        if self.trunk_schedule not in ("serial", "branch_parallel"):
+            raise ValueError(
+                f"trunk_schedule must be 'serial' or 'branch_parallel', "
+                f"got {self.trunk_schedule!r}"
+            )
+        if self.attn_gate and (
+            self.sparse_self_attn is True
+            or (isinstance(self.sparse_self_attn, tuple)
+                and any(self.sparse_self_attn))
+        ):
+            raise ValueError(
+                "attn_gate is not supported with sparse self-attention "
+                "(the block-sparse path has no gate projection)"
             )
 
     @property
@@ -156,6 +219,7 @@ class Alphafold2Config:
             flash_kv_block=self.attn_flash_kv_block,
             flash_qb_target=self.attn_flash_qb_target,
             flash_compute_dtype_logits=self.attn_flash_compute_dtype_logits,
+            gate=self.attn_gate,
         )
 
     def cross_attn_config(self) -> AttentionConfig:
@@ -172,4 +236,5 @@ class Alphafold2Config:
             flash_kv_block=self.attn_flash_kv_block,
             flash_qb_target=self.attn_flash_qb_target,
             flash_compute_dtype_logits=self.attn_flash_compute_dtype_logits,
+            gate=self.attn_gate,
         )

@@ -132,11 +132,20 @@ def _layer_forward(cfg, lp, state, x_mask, msa_mask, rngs, sparse=False):
     x1, x2, m1, m2 = state
     (r_fs, r_gs, r_js, r_ks, r_fc, r_gc, r_jc, r_kc) = rngs
 
-    # self-attention block (reference reversible.py:68-83)
+    # self-attention block (reference reversible.py:68-83). The seq half
+    # (f, g) and msa half (j, k) touch only their own streams — under the
+    # branch-parallel schedule they are the layer's two pre-exchange
+    # branches, joined (models/trunk.py schedule_join) before the cross
+    # block; identical math either way, the reversible inversion below is
+    # untouched (the join is the identity)
     y1 = x1 + _f_seq(cfg, lp["seq_attn"], x2, x_mask, r_fs, sparse)
     y2 = x2 + _ff(cfg, lp["seq_ff"], y1, r_gs)
     n1 = m1 + _j_msa(cfg, lp["msa_attn"], m2, msa_mask, r_js)
     n2 = m2 + _ff(cfg, lp["msa_ff"], n1, r_ks)
+    if cfg.trunk_schedule == "branch_parallel":
+        from alphafold2_tpu.models.trunk import schedule_join
+
+        (y1, y2), (n1, n2) = schedule_join((y1, y2), (n1, n2))
 
     # cross-attention block (reference reversible.py:168-182); note the msa
     # cross attends the UPDATED seq half z2

@@ -13,6 +13,20 @@ Per layer, every op residual (reference alphafold2.py:309-324):
   pair FF -> msa FF.
 The MSA branch is skipped entirely when no MSA stream exists
 (reference alphafold2.py:311).
+
+Trunk schedules (cfg.trunk_schedule; docs/ARCHITECTURE.md "Trunk
+schedules"): the per-layer dataflow above has exactly one cross-track
+dependency — the cross-attention exchange. Everything before it (each
+track's self-attention) and after it (each track's feed-forward) touches
+only its own stream, so the Parallel-Evoformer observation (arXiv
+2211.00235) applies: the pair track and the MSA track are two independent
+BRANCHES that join only at the exchange. "serial" emits the reference
+op order; "branch_parallel" emits the SAME ops re-grouped as explicit
+branches whose results meet at a `schedule_join` marker (an
+optimization-barrier the compiler's latency-hiding scheduler — and
+analysis/schedule_lint.py — can see). Identical math, allclose fwd +
+grads; the join also pins the schedule: nothing from one branch may be
+interleaved past the join into the other.
 """
 
 from __future__ import annotations
@@ -37,6 +51,68 @@ _REMAT_POLICIES = {
     "dots": "dots_saveable",
     "dots_no_batch": "dots_with_no_batch_dims_saveable",
 }
+
+
+# --- the branch-parallel schedule join ---------------------------------------
+
+
+@jax.custom_vjp
+def _join_barrier(args):
+    return jax.lax.optimization_barrier(args)
+
+
+def _join_barrier_fwd(args):
+    return _join_barrier(args), None
+
+
+def _join_barrier_bwd(_, cts):
+    return (cts,)
+
+
+# identity with an explicit gradient rule: jax 0.4.x has no
+# differentiation rule for optimization_barrier, and the barrier is a
+# schedule marker, not math — cotangents pass straight through (the
+# backward program carries no barrier)
+_join_barrier.defvjp(_join_barrier_fwd, _join_barrier_bwd)
+
+
+def schedule_join(*branches):
+    """JOIN the branch-parallel schedule's independent branches.
+
+    Emits ONE multi-operand `stablehlo.optimization_barrier` over every
+    tensor of every branch. Semantically the identity (gradients pass
+    through untouched); structurally it is the schedule contract the
+    trunk claims and analysis/schedule_lint.py verifies:
+
+      * nothing downstream of the join can be hoisted into a branch, and
+        no branch op can sink past the join — the branches are
+        schedulable as whole concurrent units;
+      * the lint finds each join in the lowered StableHLO and asserts its
+        operands split into >= 2 groups with DISJOINT compute slices
+        (no shared dot/reduce/conv) — i.e. the branches really are
+        data-independent before the join. A serialized twin (one branch
+        coupled behind the other, `serialize_twin` below) must be
+        flagged by the same check.
+
+    Each branch is a tensor or tuple of tensors; returns them in the
+    same structure."""
+    flat, treedef = jax.tree_util.tree_flatten(branches)
+    out = _join_barrier(tuple(flat))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def schedule_fork(t):
+    """Mark the START of a new branch region after a cross-track exchange.
+
+    A SINGLE-operand barrier (identity, gradient passes through): the
+    schedule lint exempts it from join analysis (joins have >= 2
+    operands) but its slice walk stops here, so each join's pre-join
+    region covers exactly its own layer's branches — without the fork,
+    layer N+1's join would see layer N's (legitimately cross-track)
+    exchange in both branch slices and read as serialized. Schedule-wise
+    it pins the exchange ahead of the post-exchange branches."""
+    (out,) = _join_barrier((t,))
+    return out
 
 
 def _remat_policy(cfg: Alphafold2Config):
@@ -263,7 +339,19 @@ def trunk_layer_apply(
     rngs: six per-op dropout keys (None = deterministic). sparse_fn: inner
     block-sparse attention override for the pair self-attention pass, or
     None for dense.
+
+    cfg.trunk_schedule selects the intra-layer schedule: "serial" runs
+    the reference order below; "branch_parallel" runs the SAME ops with
+    the two tracks' self-attentions grouped as independent branches that
+    join (schedule_join) at the cross-attention exchange — identical
+    dataflow, explicit branch structure. Layers without an MSA stream
+    have a single track and always run serially.
     """
+    if cfg.trunk_schedule == "branch_parallel" and m is not None:
+        return branch_parallel_layer_apply(
+            layer, cfg, x, m,
+            x_mask=x_mask, msa_mask=msa_mask, rngs=rngs, sparse_fn=sparse_fn,
+        )
     self_cfg = cfg.self_attn_config()
     # pair axial self-attention (reference alphafold2.py:309), with the
     # block-sparse inner attention when sparse_fn is given — applied PER
@@ -306,6 +394,87 @@ def trunk_layer_apply(
     if m is not None:
         m = prenorm_ff_apply(layer["msa_ff"], cfg, m, rng=rngs[5]) + m
     return x, m
+
+
+def branch_parallel_layer_apply(
+    layer,
+    cfg: Alphafold2Config,
+    x,
+    m,
+    *,
+    x_mask=None,
+    msa_mask=None,
+    rngs=(None,) * 6,
+    sparse_fn=None,
+    serialize_twin: bool = False,
+):
+    """ONE trunk layer under the BRANCH-PARALLEL schedule.
+
+    The same six residual ops as the serial `trunk_layer_apply` — same
+    params, same rng slots, allclose fwd + grads — re-grouped into the
+    Parallel-Evoformer branch structure (arXiv 2211.00235):
+
+        pair branch:  x += pair_self_attn(x)     \\  independent,
+        msa  branch:  m += msa_self_attn(m)      /   schedulable together
+        ---------------- schedule_join ----------------
+        exchange:     x += cross(x, m); m += cross(m, x)
+        pair branch:  x += pair_ff(x)            \\  independent again
+        msa  branch:  m += msa_ff(m)             /   (joins at the NEXT
+                                                      layer's exchange)
+
+    Between consecutive exchanges each track's ops (this layer's FF, the
+    next layer's self-attention) form one contiguous data-independent
+    branch, so one join per layer — placed immediately before the
+    exchange — pins the whole schedule.
+
+    serialize_twin: the schedule-lint fixture (analysis/schedule_lint.py
+    self-check) — couples the MSA branch's input behind the pair branch's
+    output through an identity barrier, producing exactly the lowered
+    structure a re-serialized schedule would have. Numerics unchanged;
+    never set outside the lint/tests.
+    """
+    self_cfg = cfg.self_attn_config()
+
+    x1 = prenorm_axial_apply(
+        layer["seq_attn"], self_cfg, x,
+        mask=x_mask, rng=rngs[0], attention_fn=sparse_fn,
+    ) + x
+    if serialize_twin:
+        # deliberately thread the MSA branch behind the pair branch via an
+        # exact-identity arithmetic coupling (+ 0 * sum(pair branch)): the
+        # join below then has overlapping operand slices — the pair
+        # branch's dots reach the MSA operand — which the schedule lint
+        # must flag (detector self-check). A barrier could not serve here:
+        # the lint's slice walk deliberately stops at barriers (each join
+        # scopes its own pre-join region), so the coupling must flow
+        # through ordinary value ops.
+        m = m + (0.0 * jnp.sum(x1)).astype(m.dtype)
+    m1 = prenorm_axial_apply(
+        layer["msa_attn"], self_cfg, m,
+        mask=msa_mask, tie_row=cfg.msa_tie_row_attn, rng=rngs[1],
+    ) + m
+
+    x1, m1 = schedule_join(x1, m1)
+
+    # the exchange (reference alphafold2.py:316-317): the ONLY cross-track
+    # dataflow — msa<-pair reads the UPDATED pair stream, like serial
+    x2 = cross_apply_grids(
+        layer["seq_cross"], cfg, x1, m1, x_mask, msa_mask,
+        rngs[2], "pair_from_msa",
+    ) + x1
+    m2 = cross_apply_grids(
+        layer["msa_cross"], cfg, m1, x2, msa_mask, x_mask,
+        rngs[3], "msa_from_pair",
+    ) + m1
+
+    # post-exchange branches (they run up to the next layer's join); the
+    # forks close the exchange region so the NEXT join's branch slices
+    # start here instead of reaching back through the shared exchange
+    x2 = schedule_fork(x2)
+    m2 = schedule_fork(m2)
+    x3 = prenorm_ff_apply(layer["seq_ff"], cfg, x2, rng=rngs[4]) + x2
+    m3 = prenorm_ff_apply(layer["msa_ff"], cfg, m2, rng=rngs[5]) + m2
+    return x3, m3
 
 
 def sequential_trunk_apply(

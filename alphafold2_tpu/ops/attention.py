@@ -92,6 +92,12 @@ class AttentionConfig:
     # tie groups) and while attention dropout is active (per-chunk keys
     # would change the mask pattern).
     batch_chunk: int = 0
+    # sigmoid output gating (the AF2-style gate): out = sigmoid(W_g x + b_g)
+    # * attention(x) before the output projection, gate weights initialized
+    # (w=0, b=1) so a fresh gate starts nearly open. On the TPU kernel path
+    # the gate is fused into the Pallas flash kernel's finish step
+    # (ops/flash_kernel.py); elsewhere it is an exact epilogue.
+    gate: bool = False
 
     @property
     def inner_dim(self) -> int:
@@ -109,6 +115,14 @@ def attention_init(key, cfg: AttentionConfig):
         "to_kv": linear_init(kkv, cfg.dim, 2 * inner, bias=False),
         "to_out": linear_init(ko, inner, cfg.dim),
     }
+    if cfg.gate:
+        # near-open init (w=0, b=1 -> sigmoid(1) ~ 0.73): a freshly gated
+        # model starts close to its ungated twin, so enabling the gate is
+        # a benign fine-tune, not a re-initialization
+        params["to_gate"] = {
+            "w": jnp.zeros((cfg.dim, inner)),
+            "b": jnp.ones((inner,)),
+        }
     if cfg.compress_ratio > 1:
         # grouped strided conv over the key/value sequence, one group per head
         # (torch Conv1d(inner, inner, ratio, stride=ratio, groups=heads),
@@ -238,6 +252,13 @@ def attention_apply(
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
     i, j = q.shape[1], k.shape[1]
+    # pre-sigmoid output-gate logits from the QUERY stream (cfg.gate):
+    # fused into the Pallas kernel on the flash path, exact epilogue on
+    # the dense/tied paths — both multiply sigmoid(gate) into the head
+    # outputs before to_out
+    gate_logits = (
+        linear(params["to_gate"], x, dtype=dtype) if cfg.gate else None
+    )
 
     # blockwise streaming path: same math, bounded memory (see ops/flash.py).
     # Key-side masking only — masked query rows yield finite garbage masked
@@ -267,6 +288,10 @@ def attention_apply(
             qb = pick_block(i, target=cfg.flash_qb_target)
         out = flash_attention(
             q, k, v, key_bias, scale=scale,
+            gate=(
+                gate_logits.reshape(gate_logits.shape[0], i, h, dh)
+                if gate_logits is not None else None
+            ),
             tile_elems=cfg.flash_tile_elems, kv_block=cfg.flash_kv_block,
             kernel_qb=qb,
             logit_dtype=dtype if cfg.flash_compute_dtype_logits else None,
@@ -310,6 +335,10 @@ def attention_apply(
         out = jnp.einsum("bhij,bjhd->bihd", attn, v)
         out = out.reshape(out.shape[0], i, h * dh)
 
+    if gate_logits is not None:
+        from alphafold2_tpu.ops.flash import apply_output_gate
+
+        out = apply_output_gate(out, gate_logits)
     return linear(params["to_out"], out, dtype=dtype)
 
 

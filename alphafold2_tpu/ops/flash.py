@@ -26,11 +26,14 @@ _NEG_INF = float("-inf")
 
 
 def stream_block(q, k_blk, v_blk, bias_blk, m, l, acc, scale,
-                 logit_dtype=jnp.float32):
+                 logit_dtype=jnp.float32, bias2d_blk=None):
     """One flash-attention accumulation step against a K/V block.
 
     q: (b, nq, h, d); k_blk/v_blk: (b, nk, h, d); bias_blk: (b, nk) additive
     (-inf for masked keys). Running stats m, l: (b, h, nq); acc: (b, h, nq, d).
+    bias2d_blk: optional (b, h, nq, nk) full pair-bias block added to the
+    logits (the XLA twin of the fused kernel's streamed 2-D bias tiles);
+    bias_blk may be None when it is given (fold masks into the 2-D bias).
 
     logit_dtype: dtype the (b, h, nq, nk) score/probability tiles are
     MATERIALIZED in. These tiles dominate the path's HBM traffic (the
@@ -40,7 +43,10 @@ def stream_block(q, k_blk, v_blk, bias_blk, m, l, acc, scale,
     quantization the model already carries. Running max/sum stay f32.
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(logit_dtype) * scale
-    s = s + bias_blk[:, None, None, :].astype(logit_dtype)
+    if bias_blk is not None:
+        s = s + bias_blk[:, None, None, :].astype(logit_dtype)
+    if bias2d_blk is not None:
+        s = s + bias2d_blk.astype(logit_dtype)
 
     m_new = jnp.maximum(m, jnp.max(s, axis=-1).astype(jnp.float32))
     # alpha/p guards: -inf - -inf = nan. The exp ARGUMENT must be sanitized
@@ -224,6 +230,80 @@ def blockwise_attention(
     return out[:, :i] if pad_i else out
 
 
+def apply_output_gate(out, gate):
+    """The UNFUSED sigmoid output-gate epilogue: sigmoid in f32 on the
+    f32 output, one cast at the end — the exact math the fused kernel's
+    finish step runs in VMEM (ops/flash_kernel.py), so kernel-on and
+    kernel-off arms of a gated model differ only in rounding. out / gate:
+    (..., dh) matching shapes; gate holds pre-sigmoid logits."""
+    return (
+        out.astype(jnp.float32) * jax.nn.sigmoid(gate.astype(jnp.float32))
+    ).astype(out.dtype)
+
+
+def streamed_fused_attention(q, k, v, key_bias, pair_bias, gate, scale,
+                             kv_block: int = 2048, remat: bool = True,
+                             logit_dtype=None):
+    """XLA twin of the fused-epilogue kernel: 2-D pair bias + output gate.
+
+    q: (B, i, h, dh); k, v: (B, j, h, dh); pair_bias: (B, h, i, j) f32
+    additive; key_bias: optional (B, j) mask bias folded in; gate:
+    optional (B, i, h, dh) pre-sigmoid logits. K/V and bias stream in
+    `kv_block` chunks with the flash recurrence, so the live logit tile is
+    (B, h, i, kv_block) — bounded along j only (the 2-D bias itself is a
+    caller-materialized (B, h, i, j) input, so there is no q-tiling win to
+    chase here; the Pallas kernel is the production TPU path).
+    logit_dtype: dtype of the live score/probability tiles (None = f32) —
+    same knob as `blockwise_attention`, so the
+    attn_flash_compute_dtype_logits A/B stays honest on this path too.
+    Exact at f32; the parity oracle for the fused kernel's interpret-mode
+    tests."""
+    B, i, h, dh = q.shape
+    j = k.shape[1]
+    logit_dtype = jnp.float32 if logit_dtype is None else logit_dtype
+    bias = pair_bias.astype(jnp.float32)
+    if key_bias is not None:
+        bias = bias + key_bias[:, None, None, :].astype(jnp.float32)
+
+    def run(q, k, v, bias):
+        m0 = jnp.full((B, h, i), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, h, i), jnp.float32)
+        acc0 = jnp.zeros((B, h, i, dh), jnp.float32)
+        if j <= kv_block:
+            m, l, acc = stream_block(q, k, v, None, m0, l0, acc0, scale,
+                                     logit_dtype=logit_dtype,
+                                     bias2d_blk=bias)
+        else:
+            pad = (-j) % kv_block
+            if pad:
+                k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                bias = jnp.pad(bias, ((0, 0), (0, 0), (0, 0), (0, pad)),
+                               constant_values=_NEG_INF)
+            nb = (j + pad) // kv_block
+            ks = k.reshape(B, nb, kv_block, h, dh).transpose(1, 0, 2, 3, 4)
+            vs = v.reshape(B, nb, kv_block, h, dh).transpose(1, 0, 2, 3, 4)
+            bs = bias.reshape(B, h, i, nb, kv_block).transpose(3, 0, 1, 2, 4)
+
+            def body(carry, blk):
+                mm, ll, aa = carry
+                kb, vb, bb = blk
+                return stream_block(q, kb, vb, None, mm, ll, aa, scale,
+                                    logit_dtype=logit_dtype,
+                                    bias2d_blk=bb), None
+
+            (m, l, acc), _ = jax.lax.scan(body, (m0, l0, acc0), (ks, vs, bs))
+        out = acc / jnp.where(l > 0, l, 1.0)[..., None]
+        return jnp.transpose(out, (0, 2, 1, 3))  # (B, i, h, dh) f32
+
+    if remat:
+        run = jax.checkpoint(run)
+    out = run(q, k, v, bias)
+    if gate is not None:
+        out = out * jax.nn.sigmoid(gate.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def kernel_env_disabled() -> bool:
     """AF2_DISABLE_FLASH_KERNEL kill-switch, shared by BOTH Pallas kernels
     (dense flash here, block-sparse in ops/sparse.py): bench.py's
@@ -233,6 +313,24 @@ def kernel_env_disabled() -> bool:
 
     return os.environ.get(
         "AF2_DISABLE_FLASH_KERNEL", ""
+    ).lower() not in ("", "0", "false")
+
+
+def gate_epilogue_unfused() -> bool:
+    """AF2_UNFUSE_GATE_EPILOGUE: keep the Pallas kernel for the attention
+    CORE but apply the sigmoid output gate as a separate XLA epilogue
+    (restoring the out-read/multiply/write HBM pass the fused kernel
+    removes). This is the control arm that ISOLATES the epilogue fusion:
+    kernel-on-gated vs kernel-off-gated also carries the whole
+    kernel-core-vs-XLA-streaming delta (measured separately, PERF.md
+    session 4), so bench_sweep's fused_gate_off leg sets this instead of
+    the kill-switch. Trace-time read, like the kill-switch. Gate-only —
+    a 2-D pair bias cannot unfuse onto the plain kernel (the bias shapes
+    the softmax itself; the plain kernel only takes key-side bias)."""
+    import os
+
+    return os.environ.get(
+        "AF2_UNFUSE_GATE_EPILOGUE", ""
     ).lower() not in ("", "0", "false")
 
 
@@ -263,7 +361,8 @@ def auto_min_j() -> int:
         ) from None
 
 
-def kernel_dispatch(i: int, j: int, dh: int, use_kernel) -> bool:
+def kernel_dispatch(i: int, j: int, dh: int, use_kernel,
+                    fused: bool = False) -> bool:
     """Resolve the tri-state `use_kernel` into a concrete decision.
 
     THE single gate for the Pallas dense kernel — flash_attention and
@@ -273,13 +372,18 @@ def kernel_dispatch(i: int, j: int, dh: int, use_kernel) -> bool:
     unsupported shapes — forcing must not silently fall back), False
     forces XLA streaming, "auto" = kernel on TPU for supported shapes with
     j >= auto_min_j() (the measured short-j crossover — see _AUTO_MIN_J),
-    honoring the env kill-switch ("0"/"false" mean enabled).
+    honoring the env kill-switch ("0"/"false" mean enabled). `fused`
+    selects the fused-epilogue kernel's shape gate (supported_fused: 2-D
+    pair bias / in-kernel gating, ops/flash_kernel.py).
     """
     from alphafold2_tpu.ops import flash_kernel
 
+    shape_ok = (
+        flash_kernel.supported_fused if fused else flash_kernel.supported
+    )
     if kernel_env_disabled() and use_kernel == "auto":
         use_kernel = False
-    if use_kernel is True and not flash_kernel.supported(i, j, dh):
+    if use_kernel is True and not shape_ok(i, j, dh):
         raise ValueError(
             f"flash kernel does not support shapes i={i}, j={j}, dh={dh} "
             f"(row-vector VMEM bound / lane alignment, see "
@@ -290,11 +394,12 @@ def kernel_dispatch(i: int, j: int, dh: int, use_kernel) -> bool:
         use_kernel == "auto"
         and on_tpu
         and j >= auto_min_j()
-        and flash_kernel.supported(i, j, dh)
+        and shape_ok(i, j, dh)
     )
 
 
-def flash_attention(q, k, v, key_bias=None, *, scale=None, use_kernel="auto",
+def flash_attention(q, k, v, key_bias=None, *, pair_bias=None, gate=None,
+                    scale=None, use_kernel="auto",
                     kernel_qb=None, kernel_kb=None, **blockwise_kwargs):
     """Exact attention: fused Pallas kernel on TPU, XLA blockwise otherwise.
 
@@ -307,12 +412,85 @@ def flash_attention(q, k, v, key_bias=None, *, scale=None, use_kernel="auto",
     (PERF.md session 4), so "auto" prefers it there. kernel_qb/kernel_kb override the
     kernel's query/key block sizes (None = padding-aware pick_block) —
     kernel path only, used for block tuning (scripts/bench_kernels.py).
+
+    Fused epilogue: `pair_bias` (B, h, i, j) f32 full 2-D additive bias
+    tiles and/or `gate` (B, i, h, dh) pre-sigmoid output-gate logits.
+    On the kernel path both fuse INTO the Pallas kernel
+    (ops/flash_kernel.py `flash_attention_fused` — the bias-add and the
+    gate-multiply stop costing separate HBM logit/output passes); off
+    kernel, the gate applies as an exact epilogue over the blockwise
+    result and pair-bias streams through `streamed_fused_attention`.
     """
     from alphafold2_tpu.ops import flash_kernel
 
     B, i, h, dh = q.shape
     j = k.shape[1]
     scale = dh ** -0.5 if scale is None else scale
+    fused = pair_bias is not None or gate is not None
+
+    if gate is not None and pair_bias is None and gate_epilogue_unfused():
+        # control arm (AF2_UNFUSE_GATE_EPILOGUE): same use_kernel policy
+        # for the core, gate as an exact XLA epilogue — identical math to
+        # the fused path, one extra HBM out-read/multiply/write pass
+        out = flash_attention(
+            q, k, v, key_bias, scale=scale, use_kernel=use_kernel,
+            kernel_qb=kernel_qb, kernel_kb=kernel_kb, **blockwise_kwargs,
+        )
+        return apply_output_gate(out, gate)
+
+    if fused and kernel_dispatch(i, j, dh, use_kernel, fused=True):
+        ldt = blockwise_kwargs.get("logit_dtype")
+        if ldt is not None and ldt != jnp.float32:
+            raise ValueError(
+                "logit_dtype (flash_compute_dtype_logits) applies only "
+                "to the XLA streaming path, but the fused Pallas kernel "
+                f"dispatched here (i={i}, j={j}, use_kernel="
+                f"{use_kernel!r}); disable the kernel for this A/B"
+            )
+
+        def fold(t):
+            return t.transpose(0, 2, 1, 3).reshape(B * h, t.shape[1], dh)
+
+        if pair_bias is not None:
+            bias = pair_bias.astype(jnp.float32)
+            if key_bias is not None:
+                bias = bias + jnp.broadcast_to(
+                    key_bias, (B, j)
+                ).astype(jnp.float32)[:, None, None, :]
+            bias = jnp.broadcast_to(bias, (B, h, i, j)).reshape(B * h, i, j)
+        else:
+            bias = (
+                jnp.zeros((B, j), jnp.float32)
+                if key_bias is None
+                else jnp.broadcast_to(key_bias, (B, j)).astype(jnp.float32)
+            )
+            bias = jnp.repeat(bias, h, axis=0)
+        gate_folded = fold(gate) if gate is not None else None
+        out = flash_kernel.flash_attention_fused(
+            fold(q), fold(k), fold(v), bias, scale,
+            gate=gate_folded, qb=kernel_qb, kb=kernel_kb,
+        )
+        return out.reshape(B, h, i, dh).transpose(0, 2, 1, 3)
+
+    if pair_bias is not None:
+        # XLA twin of the 2-D-bias mode: j-streamed, exact at f32.
+        # logit_dtype threads through (the bf16-logits A/B must not
+        # silently record f32 math here — the kernel branch above raises
+        # for the same knob); tile_elems is structurally inapplicable
+        # (the 2-D bias is a caller-materialized (B, h, i, j) input, so
+        # there is no q-tiling win — see streamed_fused_attention).
+        return streamed_fused_attention(
+            q, k, v, key_bias, pair_bias, gate, scale,
+            kv_block=blockwise_kwargs.get("kv_block", 2048),
+            logit_dtype=blockwise_kwargs.get("logit_dtype"),
+        )
+    if gate is not None:
+        # gate-only: the plain blockwise path plus the exact epilogue
+        out = flash_attention(
+            q, k, v, key_bias, scale=scale, use_kernel=False,
+            **blockwise_kwargs,
+        )
+        return apply_output_gate(out, gate)
 
     if kernel_dispatch(i, j, dh, use_kernel):
         ldt = blockwise_kwargs.get("logit_dtype")

@@ -65,6 +65,17 @@ def supported(i: int, j: int, dh: int) -> bool:
     return resident <= _VMEM_BUDGET_BYTES and dh % 8 == 0 and dh <= 512
 
 
+def supported_fused(i: int, j: int, dh: int) -> bool:
+    """Shapes the FUSED-epilogue kernel handles (`flash_attention_fused`:
+    2-D pair-bias tiles and/or in-kernel sigmoid output gating).
+
+    The 2-D bias streams block-by-block like K/V (never row-resident) and
+    the gate streams with the query block, so the VMEM residency bound is
+    the same row-vector budget as the plain kernel — kept identical so
+    one `supported` story covers both dispatch gates."""
+    return supported(i, j, dh)
+
+
 def pick_block(n: int, target: int = 512, mult: int = 128, tol: float = 0.15) -> int:
     """Pick a Pallas block size for a length-n axis.
 
@@ -434,3 +445,383 @@ def _bwd_lse(scale, qb, kb, res, gs):
 
 
 _flash_core_lse.defvjp(_fwd_lse, _bwd_lse)
+
+
+# ---------------------------------------------------------------------------
+# fused-epilogue kernel: full 2-D pair-bias tiles + sigmoid output gating
+# ---------------------------------------------------------------------------
+#
+# The plain kernel above takes a key-side (BH, j) additive bias — a mask.
+# The fused family generalizes the contract two ways (static flags, so
+# each combination compiles its own minimal kernel):
+#
+#   * bias2d — the bias is a full (BH, i, j) f32 tile (pair bias + mask
+#     folded together). It streams through the grid's sequential dimension
+#     in (qb, kb) blocks exactly like K/V: the bias is never materialized
+#     as a separate XLA add over an HBM logit tensor — one of the two HBM
+#     round-trips the epilogue fusion removes. The bias cotangent is real
+#     (pair biases are projections of learned state, not masks): the dq
+#     kernel emits the per-tile ds as a d_bias output.
+#   * gated — a (BH, i, dh) pre-sigmoid gate streams with the query block
+#     and the finish step writes sigmoid(gate) * out directly, removing
+#     the separate out-read/gate-multiply/out-write HBM pass. The gate
+#     cotangent needs no kernel: d_gate = g * out_gated * (1 - sigmoid)
+#     and the q/k/v backward sees g_eff = g * sigmoid(gate) — all
+#     elementwise on tensors already in HBM (see _fused_bwd).
+#
+# The key-side-only contract stays the plain kernel's fast path; the
+# (bias2d=False, gated=False) combination is the plain kernel and callers
+# (ops/flash.py) dispatch it there.
+
+
+def _make_fused_fwd_kernel(nkb, scale, bias2d, gated):
+    def kernel(q_ref, k_ref, v_ref, bias_ref, *rest):
+        if gated:
+            gate_ref, out_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+        else:
+            out_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+
+        @pl.when(ki == 0)
+        def _init():
+            m_scr[...] = jnp.full(m_scr.shape, _M0, jnp.float32)
+            l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+            acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if bias2d:
+            s = s + bias_ref[0]             # (qb, kb) streamed tile
+        else:
+            s = s + bias_ref[0, ki][None, :]  # (kb,) resident row vector
+
+        m = m_scr[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        m_scr[...] = m_new
+
+        @pl.when(ki == nkb - 1)
+        def _finish():
+            l = l_scr[...]
+            safe = jnp.where(l > 0, l, 1.0)
+            out = jnp.where(l > 0, acc_scr[...] / safe, 0.0)
+            if gated:
+                # sigmoid in f32 on the f32 accumulator: ONE cast at the
+                # very end, matching the XLA epilogue's f32 math
+                out = out * jax.nn.sigmoid(gate_ref[0].astype(jnp.float32))
+            out_ref[0] = out.astype(out_ref.dtype)
+            lse = jnp.where(l > 0, m_scr[...] + jnp.log(safe), jnp.inf)
+            lse_ref[0, qi] = lse[:, 0]
+
+    return kernel
+
+
+def _pad_fused_args(q, k, v, bias, gate, qb, kb, bias2d, gated):
+    """Pad to block multiples: -inf bias on padded keys AND padded query
+    rows (2-D mode — padded rows become zero-mass, out 0 / lse +inf),
+    zero gate rows (sigmoid of anything times a zero row is zero)."""
+    BH, i, dh = q.shape
+    j = k.shape[1]
+    pad_i = (-i) % qb
+    pad_j = (-j) % kb
+    if pad_i:
+        q = jnp.pad(q, ((0, 0), (0, pad_i), (0, 0)))
+        if gated:
+            gate = jnp.pad(gate, ((0, 0), (0, pad_i), (0, 0)))
+    if pad_j:
+        k = jnp.pad(k, ((0, 0), (0, pad_j), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_j), (0, 0)))
+    if bias2d:
+        if pad_i or pad_j:
+            bias = jnp.pad(bias, ((0, 0), (0, pad_i), (0, pad_j)),
+                           constant_values=_NEG)
+    elif pad_j:
+        bias = jnp.pad(bias, ((0, 0), (0, pad_j)), constant_values=_NEG)
+    return q, k, v, bias, gate, i + pad_i, j + pad_j
+
+
+def _forward_fused(q, k, v, bias, gate, scale, qb, kb, bias2d, gated):
+    """q: (BH, i, dh); k, v: (BH, j, dh); bias: (BH, i, j) f32 when bias2d
+    else (BH, j) f32; gate: (BH, i, dh) pre-sigmoid logits (gated only)."""
+    BH, i0, dh = q.shape
+    j0 = k.shape[1]
+    q, k, v, bias, gate, i, j = _pad_fused_args(
+        q, k, v, bias, gate, qb, kb, bias2d, gated
+    )
+    nqb, nkb = i // qb, j // kb
+    biask = bias if bias2d else bias.reshape(BH, nkb, kb)
+
+    in_specs = [
+        pl.BlockSpec((1, qb, dh), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, kb, dh), lambda b, qi, ki: (b, ki, 0)),
+        pl.BlockSpec((1, kb, dh), lambda b, qi, ki: (b, ki, 0)),
+        pl.BlockSpec((1, qb, kb), lambda b, qi, ki: (b, qi, ki))
+        if bias2d
+        else pl.BlockSpec((1, nkb, kb), lambda b, qi, ki: (b, 0, 0)),
+    ]
+    operands = [q, k, v, biask]
+    if gated:
+        in_specs.append(pl.BlockSpec((1, qb, dh), lambda b, qi, ki: (b, qi, 0)))
+        operands.append(gate)
+
+    out, lse = pl.pallas_call(
+        _make_fused_fwd_kernel(nkb, scale, bias2d, gated),
+        out_shape=[
+            _out_struct((BH, i, dh), q.dtype, *operands),
+            _out_struct((BH, nqb, qb), jnp.float32, *operands),
+        ],
+        grid=(BH, nqb, nkb),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, qb, dh), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, nqb, qb), lambda b, qi, ki: (b, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((qb, 1), jnp.float32),
+            pltpu.VMEM((qb, 1), jnp.float32),
+            pltpu.VMEM((qb, dh), jnp.float32),
+        ],
+        compiler_params=_FWD_PARAMS,
+        interpret=_interpret(),
+    )(*operands)
+    return out[:, :i0], (q, k, v, biask, gate, lse, i0, j0)
+
+
+def _make_fused_dq_kernel(nkb, scale, bias2d):
+    def kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
+               *rest):
+        if bias2d:
+            dq_ref, db_ref, dq_scr = rest
+        else:
+            dq_ref, dq_scr = rest
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+
+        @pl.when(ki == 0)
+        def _init():
+            dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+        q = q_ref[0]
+        g = g_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        lse = lse_ref[0, qi][:, None]
+        delta = delta_ref[0, qi][:, None]
+
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = s + (bias_ref[0] if bias2d else bias_ref[0, ki][None, :])
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            g, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds_f32 = p * (dp - delta)
+        if bias2d:
+            # d s / d bias = 1: the unscaled ds tile IS the bias cotangent
+            db_ref[0] = ds_f32
+        ds = ds_f32.astype(k.dtype)
+        dq_scr[...] = dq_scr[...] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32
+        )
+
+        @pl.when(ki == nkb - 1)
+        def _finish():
+            dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+    return kernel
+
+
+def _make_fused_dkv_kernel(nqb, scale, bias2d):
+    def kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
+               dk_ref, dv_ref, dk_scr, dv_scr):
+        ki = pl.program_id(1)
+        qi = pl.program_id(2)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+            dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+        k = k_ref[0]
+        v = v_ref[0]
+        q = q_ref[0]
+        g = g_ref[0]
+        lse = lse_ref[0, qi][:, None]
+        delta = delta_ref[0, qi][:, None]
+
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = s + (bias_ref[0] if bias2d else bias_ref[0, ki][None, :])
+        p = jnp.exp(s - lse)
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+            p.astype(g.dtype), g, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            g, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+            ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+        @pl.when(qi == nqb - 1)
+        def _finish():
+            dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+            dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+    return kernel
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _fused_core(q, k, v, bias, gate, scale, qb, kb, bias2d, gated):
+    out, _ = _forward_fused(q, k, v, bias, gate, scale, qb, kb, bias2d, gated)
+    return out
+
+
+def _fused_fwd(q, k, v, bias, gate, scale, qb, kb, bias2d, gated):
+    out, res = _forward_fused(q, k, v, bias, gate, scale, qb, kb, bias2d, gated)
+    qp, kp, vp, biask, gatep, lse, i0, j0 = res
+    return out, (qp, kp, vp, biask, gatep, lse, out, i0, j0)
+
+
+def _fused_bwd(scale, qb, kb, bias2d, gated, res, g):
+    qp, kp, vp, biask, gatep, lse, out, i0, j0 = res
+    BH, i, dh = qp.shape
+    j = kp.shape[1]
+    nqb, nkb = i // qb, j // kb
+
+    pad_i = i - i0
+    if pad_i:
+        g = jnp.pad(g, ((0, 0), (0, pad_i), (0, 0)))
+        out = jnp.pad(out, ((0, 0), (0, pad_i), (0, 0)))
+
+    g32 = g.astype(jnp.float32)
+    out32 = out.astype(jnp.float32)
+    # delta = rowsum(dO_eff * O_pre). With gating, dO_eff = g * sig and
+    # O_pre = O_gated / sig, so the product collapses to g * O_gated —
+    # delta computes from the SAVED gated output with the RAW cotangent
+    delta = jnp.sum(g32 * out32, axis=-1).reshape(BH, nqb, qb)
+    d_gate = None
+    if gated:
+        sig = jax.nn.sigmoid(gatep.astype(jnp.float32))
+        # d gate = g * O_pre * sig' = g * O_gated * (1 - sig); elementwise
+        # on tensors already in HBM, so no backward kernel change
+        d_gate = (g32 * out32 * (1.0 - sig)).astype(gatep.dtype)[:, :i0]
+        g = (g32 * sig).astype(g.dtype)
+
+    blk_q = pl.BlockSpec((1, qb, dh), lambda b, x, y: (b, x, 0))
+    blk_q_inner = pl.BlockSpec((1, qb, dh), lambda b, x, y: (b, y, 0))
+    blk_k = pl.BlockSpec((1, kb, dh), lambda b, x, y: (b, x, 0))
+    blk_k_inner = pl.BlockSpec((1, kb, dh), lambda b, x, y: (b, y, 0))
+    rows_q = pl.BlockSpec((1, nqb, qb), lambda b, x, y: (b, 0, 0))
+    rows_k = pl.BlockSpec((1, nkb, kb), lambda b, x, y: (b, 0, 0))
+    bias_dq = (
+        pl.BlockSpec((1, qb, kb), lambda b, x, y: (b, x, y))
+        if bias2d else rows_k
+    )
+    bias_dkv = (
+        pl.BlockSpec((1, qb, kb), lambda b, x, y: (b, y, x))
+        if bias2d else rows_k
+    )
+
+    dq_outs = [_out_struct((BH, i, dh), qp.dtype, qp, kp, vp, g)]
+    dq_specs = [blk_q]
+    scratch = [pltpu.VMEM((qb, dh), jnp.float32)]
+    if bias2d:
+        dq_outs.append(_out_struct((BH, i, j), jnp.float32, qp, kp, vp, g))
+        dq_specs.append(pl.BlockSpec((1, qb, kb), lambda b, x, y: (b, x, y)))
+    dq_res = pl.pallas_call(
+        _make_fused_dq_kernel(nkb, scale, bias2d),
+        out_shape=dq_outs,
+        grid=(BH, nqb, nkb),
+        in_specs=[blk_q, blk_k_inner, blk_k_inner, bias_dq, blk_q,
+                  rows_q, rows_q],
+        out_specs=dq_specs,
+        scratch_shapes=scratch,
+        compiler_params=_BWD_PARAMS,
+        interpret=_interpret(),
+    )(qp, kp, vp, biask, g, lse, delta)
+    if bias2d:
+        dq, db = dq_res
+        d_bias = db[:, :i0, :j0]
+    else:
+        dq = dq_res[0] if isinstance(dq_res, (list, tuple)) else dq_res
+        # key-side bias is a mask, not a parameter: cotangent declared zero
+        d_bias = jnp.zeros((BH, j0), jnp.float32)
+
+    dk, dv = pl.pallas_call(
+        _make_fused_dkv_kernel(nqb, scale, bias2d),
+        out_shape=[
+            _out_struct((BH, j, dh), kp.dtype, qp, kp, vp, g),
+            _out_struct((BH, j, dh), vp.dtype, qp, kp, vp, g),
+        ],
+        grid=(BH, nkb, nqb),
+        in_specs=[blk_q_inner, blk_k, blk_k, bias_dkv, blk_q_inner,
+                  rows_q, rows_q],
+        out_specs=[blk_k, blk_k],
+        scratch_shapes=[
+            pltpu.VMEM((kb, dh), jnp.float32),
+            pltpu.VMEM((kb, dh), jnp.float32),
+        ],
+        compiler_params=_BWD_PARAMS,
+        interpret=_interpret(),
+    )(qp, kp, vp, biask, g, lse, delta)
+
+    if d_gate is None:
+        d_gate = jnp.zeros(
+            (BH, 1, dh), gatep.dtype if hasattr(gatep, "dtype") else jnp.float32
+        )
+    return (dq[:, :i0], dk[:, :j0], dv[:, :j0], d_bias, d_gate)
+
+
+_fused_core.defvjp(_fused_fwd, _fused_bwd)
+
+
+def flash_attention_fused(q, k, v, bias, scale, *, gate=None, qb=None,
+                          kb=None):
+    """Fused-epilogue dense flash attention.
+
+    q: (BH, i, dh); k, v: (BH, j, dh). bias: additive f32, either the
+    plain key-side (BH, j) contract or a full 2-D (BH, i, j) pair-bias
+    tile (masks folded in as -inf) — the 2-D tiles stream through the
+    kernel in (qb, kb) blocks, so the bias-add never costs a separate
+    HBM logit pass. gate: optional (BH, i, dh) pre-sigmoid output-gate
+    logits applied INSIDE the kernel's finish step
+    (out = sigmoid(gate) * softmax(s) V). Returns (BH, i, dh).
+
+    Differentiable in q/k/v, the 2-D bias (real cotangent — pair biases
+    are learned projections), and the gate; the key-side bias cotangent
+    stays declared-zero (masks are data). Shape support:
+    `supported_fused`."""
+    dh = q.shape[-1]
+    bias2d = bias.ndim == 3
+    gated = gate is not None
+    # the 2-D bias adds a streamed (qb, kb) f32 tile plus the backward's
+    # d_bias tile to each grid step's VMEM footprint: cap the block target
+    # so the double-buffered working set keeps headroom
+    target = min(256, _block_target(dh)) if bias2d else _block_target(dh)
+    qb = pick_block(q.shape[1], target=target) if qb is None else qb
+    kb = pick_block(k.shape[1], target=target) if kb is None else kb
+    if not gated:
+        gate = jnp.zeros((q.shape[0], 1, dh), q.dtype)
+    return _fused_core(q, k, v, bias, gate, scale, qb, kb, bias2d, gated)

@@ -370,6 +370,19 @@ def tied_row_attention_sharded(params, cfg, x, axis_name: str, mask=None, rng=No
     attn = _dropout(rng, attn, cfg.dropout)
 
     out = jnp.einsum("bhij,brjhd->brihd", attn, v).reshape(b, r_local, n, h * dh)
+    if cfg.gate:
+        # per-row output gate from the resident rows' own queries — the
+        # sharded twin of attention_apply's epilogue (ops/flash.py
+        # apply_output_gate), elementwise so no extra collective.
+        # Direct attribute access on purpose: cfg is an AttentionConfig
+        # (the caller passes self_attn_config()), and a wrong config
+        # type must raise rather than silently skip the gate while
+        # params["to_gate"] trains nowhere
+        from alphafold2_tpu.ops.flash import apply_output_gate
+
+        out = apply_output_gate(
+            out, _linear(params["to_gate"], x, dtype=dtype)
+        )
     return _linear(params["to_out"], out, dtype=dtype)
 
 

@@ -239,6 +239,14 @@ def _ring_cross_tokens(params, cfg: Alphafold2Config, q_tokens, ctx_tokens_local
     out = ring_attention(q, k, v, axis_name, mask=ctx_mask_local,
                          overlap=overlap)
     out = out.reshape(out.shape[0], out.shape[1], h * dh)
+    if cross_cfg.gate:
+        # resident-query output gate: elementwise on this shard's rows, so
+        # the ring schedule is untouched (ops/flash.py apply_output_gate)
+        from alphafold2_tpu.ops.flash import apply_output_gate
+
+        out = apply_output_gate(
+            out, linear(params["attn"]["to_gate"], qn, dtype=dtype)
+        )
     return linear(params["attn"]["to_out"], out, dtype=dtype)
 
 
@@ -353,13 +361,28 @@ def sp_layer_apply(layer, cfg: Alphafold2Config, x, m, x_mask, msa_mask, axis_na
     defaults to AF2_COMM_OVERLAP. The axial/tied collectives
     (all_to_all, logit psum) are single semantic barriers, not per-hop
     streams — there is nothing to double-buffer there.
+
+    cfg.trunk_schedule threads through shard_map: under
+    "branch_parallel" the two tracks' self-attentions — including their
+    collectives (the pair grid's all_to_all transpose vs the MSA track's
+    all_to_all / tied-logit psum) — are expressed as independent
+    branches joined (models/trunk.py schedule_join) before the cross
+    exchange, so the branches map onto DISJOINT mesh work: neither
+    branch's collectives are ordered behind the other branch's compute,
+    and the ICI can interleave them. Same math as serial (allclose;
+    tests/test_trunk_schedule.py pins it).
     """
-    from alphafold2_tpu.models.trunk import prenorm_ff_apply
+    from alphafold2_tpu.models.trunk import (
+        prenorm_ff_apply,
+        schedule_fork,
+        schedule_join,
+    )
 
     self_cfg = cfg.self_attn_config()
     b, n_local, n, d = x.shape
+    branch_parallel = cfg.trunk_schedule == "branch_parallel" and m is not None
 
-    x = x + sequence_parallel_axial_attention(
+    x1 = x + sequence_parallel_axial_attention(
         layer["seq_attn"]["attn"],
         self_cfg,
         layer_norm(layer["seq_attn"]["norm"], x),
@@ -367,14 +390,19 @@ def sp_layer_apply(layer, cfg: Alphafold2Config, x, m, x_mask, msa_mask, axis_na
         mask=x_mask,
     )
 
-    if m is not None:
-        m = m + _msa_self_attention(
+    if m is None:
+        x = x1
+    else:
+        m1 = m + _msa_self_attention(
             layer["msa_attn"]["attn"],
             cfg,
             layer_norm(layer["msa_attn"]["norm"], m),
             axis_name,
             msa_mask,
         )
+        if branch_parallel:
+            x1, m1 = schedule_join(x1, m1)
+        x, m = x1, m1
 
         if cfg.cross_attn_mode == "aligned":
             x = x + _aligned_gathered_cross(
@@ -399,6 +427,12 @@ def sp_layer_apply(layer, cfg: Alphafold2Config, x, m, x_mask, msa_mask, axis_na
                 overlap=overlap,
             )
             m = mf.reshape(m.shape)
+
+        if branch_parallel:
+            # close the exchange region: the next layer's join scopes to
+            # its own branches (models/trunk.py schedule_fork)
+            x = schedule_fork(x)
+            m = schedule_fork(m)
 
     x = x + prenorm_ff_apply(layer["seq_ff"], cfg, x)
     if m is not None:

@@ -299,7 +299,13 @@ class ServingEngine:
         self._base_key = jax.random.PRNGKey(cfg.seed)
         # the ladder is part of the numeric fingerprint: a sequence's
         # structure is a deterministic function of (sequence, bucket), and
-        # bucket assignment follows the ladder (serving/bucketing.py)
+        # bucket assignment follows the ladder (serving/bucketing.py).
+        # repr(model_cfg) serializes EVERY Alphafold2Config field — in
+        # particular trunk_schedule and attn_gate must be (and are) in
+        # the tag: schedules may differ in fusion-level float association
+        # and the gate changes the math outright, so the result LRU and
+        # the fleet's shared-tag bit-exactness pin must never alias
+        # results across them (tests/test_serving.py pins this)
         self._config_tag = repr((
             model_cfg, cfg.mds_iters, cfg.mds_init, cfg.seed, cfg.msa_rows,
             cfg.params_tag, self._ladder.buckets,

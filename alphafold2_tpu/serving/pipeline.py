@@ -15,6 +15,13 @@ Everything here is traceable: no host I/O, no Python branching on traced
 values, static knobs (`cfg`, `mds_iters`, `mds_init`) passed as Python
 values closed over at jit time. Batch-capable end to end — `tokens` is
 (b, L) and every output carries the batch axis.
+
+Every numeric knob of this pipeline must be covered by the serving
+config tag (serving/engine.py `_config_tag`, via repr of the full
+Alphafold2Config plus the MDS/bucket knobs): the result LRU and the
+fleet's bit-exactness pins key on it, so anything that can change a
+served structure — including the trunk schedule (`trunk_schedule`) and
+the fused output gate (`attn_gate`) — must never alias across configs.
 """
 
 from __future__ import annotations

@@ -18,6 +18,14 @@ Accepted snapshot formats (auto-detected, see `load_metrics`):
   * BASELINE.json: {"metric": ..., "published": {...}} — the `published`
     table (may be empty: a baseline with nothing published gates
     nothing and passes, loudly);
+  * sweep artifacts (PERF_SWEEP.jsonl / a single sweep row): JSON-lines
+    of {"bench": leg, "result": {...}} — every leg's numeric results
+    key under "<leg>.<metric>" (latest row per leg wins; error/skip
+    rows are dropped), so e.g. `branch_parallel_on.sec_per_step` and
+    `fused_gate_on.sec_per_step` gate automatically once a chip records
+    them. Multi-line workers record LIST results (the micro kernel
+    grid): each element keys under "<leg>.<its string fields>.<metric>"
+    and gates like any scalar leg;
   * any nested dict of numerics (engine stats / registry snapshots),
     flattened to dotted paths.
 
@@ -62,6 +70,7 @@ _RULES: Tuple[Tuple[str, str, float], ...] = (
     ("*occupancy*", "higher", 0.10),
     ("*vs_baseline*", "higher", 0.10),
     ("*sec_per_step*", "lower", 0.15),
+    ("*sec_per_iter*", "lower", 0.15),
     ("*sec_per_protein*", "lower", 0.15),
     ("*latency*", "lower", 0.15),
     ("*_seconds*", "lower", 0.15),
@@ -79,16 +88,82 @@ def rule_for(name: str, rules=_RULES) -> Optional[Tuple[str, float]]:
     return None
 
 
+def _sweep_rows_to_metrics(rows) -> Dict[str, float]:
+    """Sweep rows ({"bench": leg, "result": {...}}) -> flat metrics.
+
+    Later rows win per leg (a re-run supersedes its predecessor); rows
+    with a null/error result or a structured skip contribute nothing.
+    Multi-line workers (the micro kernel grid) record a LIST result —
+    each element gates too, qualified by ALL its string fields joined
+    in key-sorted order (dir/path/platform/shape -> e.g. `micro_kernel
+    .fwd.kernel.tpu.B32_n1152_h8_dh64.sec_per_iter`), and regression-
+    gates like any scalar leg; publish exactly that produced name into
+    BASELINE.json (compare() intersects names), not a hand-reordered
+    one."""
+    flat: Dict[str, float] = {}
+
+    def add(prefix: str, res: dict, qualify: bool) -> None:
+        if "skipped" in res:
+            return
+        if qualify:
+            # list elements need distinct names: qualify by the
+            # element's string fields (stable — worker grids are
+            # deterministic code). Scalar dict results keep their
+            # historical unqualified names.
+            ident = ".".join(
+                res[k] for k in sorted(res) if isinstance(res[k], str)
+            )
+            if ident:
+                prefix = f"{prefix}.{ident}"
+        for k, v in res.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                flat[f"{prefix}.{k}"] = float(v)
+
+    for e in rows:
+        if not isinstance(e, dict) or not isinstance(e.get("bench"), str):
+            continue
+        res = e.get("result")
+        if isinstance(res, dict):
+            add(e["bench"], res, qualify=False)
+        elif isinstance(res, list):
+            for item in res:
+                if isinstance(item, dict):
+                    add(e["bench"], item, qualify=True)
+    return flat
+
+
 def load_metrics(path_or_dict) -> Dict[str, float]:
     """One snapshot (path or already-parsed dict) -> flat {name: float}."""
     if isinstance(path_or_dict, dict):
         d = path_or_dict
     else:
         with open(path_or_dict) as fh:
-            d = json.load(fh)
+            text = fh.read()
+        try:
+            d = json.loads(text)
+        except json.JSONDecodeError:
+            # JSON-lines sweep artifact (PERF_SWEEP.jsonl): one JSON
+            # object per line; tolerate torn/blank lines (a wedged worker
+            # can die mid-write)
+            rows = []
+            for line in text.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+            if not rows:
+                raise ValueError(
+                    f"{path_or_dict}: neither a JSON object nor JSON lines"
+                ) from None
+            return _sweep_rows_to_metrics(rows)
         if not isinstance(d, dict):
             raise ValueError(f"{path_or_dict}: expected a JSON object, got "
                              f"{type(d).__name__}")
+    if isinstance(d.get("bench"), str):  # a single sweep row
+        return _sweep_rows_to_metrics([d])
     if isinstance(d.get("parsed"), dict):  # bench-driver artifact
         d = d["parsed"]
     if isinstance(d.get("published"), dict):  # BASELINE.json

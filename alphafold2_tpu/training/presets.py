@@ -25,6 +25,7 @@ import dataclasses
 import jax.numpy as jnp
 
 from alphafold2_tpu.models import Alphafold2Config, RefinerConfig
+from alphafold2_tpu.models.config import depth_aware_attn_defaults
 from alphafold2_tpu.training.e2e import E2EConfig
 
 NORTH_STAR_CROP = 384
@@ -62,15 +63,31 @@ def north_star_e2e_config(
         raise ValueError(f"smoke=True conflicts with tier={tier!r}")
     tier = tier or ("smoke" if smoke else "north_star")
     smoke = tier == "smoke"
-    # one row per tier: crop, msa_rows, dim, dim_head, compress, rdim, mds
-    crop, msa_rows, dim, dim_head, compress, rdim, mds_iters = {
+    # one row per tier: crop, msa_rows, dim, dim_head, compress, rdim,
+    # mds iters, mds init. The north-star MDS cut (25 iterations off a
+    # classical Torgerson warm start) is the PROMOTED default since PR 7:
+    # classical init reaches the random-init stress floor in ~1 iteration
+    # on exact and distogram-censored inputs, and e2e smoke training with
+    # (25, classical) tracks (200, random) at equal-or-lower loss
+    # (PERF.md round 4). The retired reference arm (200, random —
+    # reference train_end2end.py:157) stays reachable via e2e_overrides /
+    # train_end2end.py --mds-reference for parity runs, and the
+    # `e2e_mds200random` sweep leg measures it against this default.
+    crop, msa_rows, dim, dim_head, compress, rdim, mds_iters, mds_init = {
         "north_star": (NORTH_STAR_CROP, NORTH_STAR_MSA_ROWS, 256, 64, 4, 64,
-                       200),  # mds: reference train_end2end.py:157
-        "smoke": (SMOKE_CROP, SMOKE_MSA_ROWS, 32, 16, 1, 16, 5),
+                       25, "classical"),
+        "smoke": (SMOKE_CROP, SMOKE_MSA_ROWS, 32, 16, 1, 16, 5, "random"),
         "proportional": (PROPORTIONAL_CROP, PROPORTIONAL_MSA_ROWS, 64, 16, 4,
-                         32, 25),
+                         32, 25, "random"),
     }[tier]
     dtype = jnp.bfloat16 if tier == "north_star" else jnp.float32
+    # measured-headroom attention knobs, resolved by depth (PERF.md item
+    # 1): depth <= 24 raises chunk/tile, depth 48 keeps the proven values
+    attn_knobs = (
+        depth_aware_attn_defaults(depth)
+        if tier == "north_star"
+        else {"attn_batch_chunk": 0, "attn_flash_tile_elems": 1 << 25}
+    )
 
     model = Alphafold2Config(
         dim=dim,
@@ -91,10 +108,11 @@ def north_star_e2e_config(
         # chunk attention ops over the folded-batch axis so QKV/out
         # projections never materialize over all 1.3M pair tokens (only
         # needed at north-star scale; chunking tiny shapes just adds
-        # lax.map dispatch)
-        attn_batch_chunk=32 if tier == "north_star" else 0,
+        # lax.map dispatch). Chunk and tile sizes are depth-aware
+        # (models/config.py depth_aware_attn_defaults)
         # bound the 2048-wide GEGLU intermediate on the pair stream
         ff_chunk_size=32768 if tier == "north_star" else 0,
+        **attn_knobs,
     )
     if model_overrides:
         model = dataclasses.replace(model, **model_overrides)
@@ -107,6 +125,7 @@ def north_star_e2e_config(
             atom_chunk=256 if tier == "north_star" else 0,
         ),
         mds_iters=mds_iters,
+        mds_init=mds_init,
     )
     if e2e_overrides:
         ecfg = dataclasses.replace(ecfg, **e2e_overrides)

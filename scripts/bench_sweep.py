@@ -38,6 +38,14 @@ import numpy as np
 
 spec = json.loads(sys.argv[1])
 
+if spec.get("require_tpu") and jax.devices()[0].platform != "tpu":
+    # structured skip (the overlap legs' pattern): the schedule/fusion
+    # A/B legs are TPU measurements — a CPU fallback number would be
+    # recorded as if it were one
+    print(json.dumps({"skipped": "leg requires a TPU device",
+                      "platform": jax.devices()[0].platform}))
+    sys.exit(0)
+
 from alphafold2_tpu.training import (
     DataConfig, TrainConfig, e2e_loss_fn, e2e_train_state_init,
     make_train_step, north_star_e2e_config, stack_microbatches,
@@ -46,22 +54,33 @@ from alphafold2_tpu.training import (
 
 depth = spec["depth"]
 # ONE source for the north-star config (training/presets.py); the sweep's
-# tuning axes are override patches so a knob rename breaks loudly here
+# tuning axes are override patches so a knob rename breaks loudly here.
+# Knobs ABSENT from the spec follow the preset defaults (depth-aware
+# attention chunk/tile resolver, promoted 25-iter classical MDS), so the
+# base legs always measure exactly the driver-bench configuration.
 ecfg, crop, msa_rows = north_star_e2e_config(
     depth,
     model_overrides=dict(
-        attn_batch_chunk=spec["batch_chunk"],
-        attn_flash_tile_elems=spec["tile_elems"],
-        attn_flash_qb_target=spec.get("qb_target"),
+        **({"attn_flash_qb_target": spec["qb_target"]}
+           if "qb_target" in spec else {}),
+        **({"attn_batch_chunk": spec["batch_chunk"]}
+           if "batch_chunk" in spec else {}),
+        **({"attn_flash_tile_elems": spec["tile_elems"]}
+           if "tile_elems" in spec else {}),
         **({"ff_chunk_size": spec["ff_chunk"]} if "ff_chunk" in spec else {}),
         **({"attn_flash_compute_dtype_logits": spec["logit_bf16"]}
            if "logit_bf16" in spec else {}),
+        **({"trunk_schedule": spec["trunk_schedule"]}
+           if "trunk_schedule" in spec else {}),
+        **({"attn_gate": spec["attn_gate"]} if "attn_gate" in spec else {}),
         **{k: spec[k] for k in ("heads", "dim_head") if k in spec},
     ),
     e2e_overrides=dict(
-        mds_bwd_iters=spec["mds_bwd_iters"],
-        mds_unroll=spec.get("mds_unroll", 1),
-        mds_init=spec.get("mds_init", "random"),
+        **({"mds_bwd_iters": spec["mds_bwd_iters"]}
+           if "mds_bwd_iters" in spec else {}),
+        **({"mds_unroll": spec["mds_unroll"]}
+           if "mds_unroll" in spec else {}),
+        **({"mds_init": spec["mds_init"]} if "mds_init" in spec else {}),
         **({"mds_iters": spec["mds_iters"]} if "mds_iters" in spec else {}),
     ),
 )
@@ -82,6 +101,12 @@ elif spec["kernel"] == "off":
     os.environ["AF2_DISABLE_FLASH_KERNEL"] = "1"
 elif spec["kernel"] != "auto":
     raise ValueError(f"bad kernel policy {spec['kernel']!r}")
+if spec.get("unfuse_gate"):
+    # fused_gate control arm: Pallas kernel still runs the attention
+    # core, the sigmoid gate applies as a separate XLA epilogue
+    # (ops/flash.py gate_epilogue_unfused) — the on/off delta is the
+    # epilogue fusion alone, not kernel-core-vs-XLA-streaming
+    os.environ["AF2_UNFUSE_GATE_EPILOGUE"] = "1"
 
 tcfg = TrainConfig(learning_rate=3e-4, grad_accum=1)
 dcfg = DataConfig(batch_size=1, max_len=crop, msa_rows=msa_rows, seed=0)
@@ -102,7 +127,8 @@ state, loss = compiled(state, batch, jax.random.PRNGKey(2))
 loss = float(np.asarray(loss))
 dt = time.perf_counter() - t0
 assert np.isfinite(loss), loss
-print(json.dumps({"sec_per_step": round(dt, 2), "loss": round(loss, 4)}))
+print(json.dumps({"sec_per_step": round(dt, 2), "loss": round(loss, 4),
+                  "platform": jax.devices()[0].platform}))
 """
 
 
@@ -247,20 +273,20 @@ def record(entry):
 
 
 def run_and_record(name, code_or_path, argv, timeout, extra=None):
-    """One measurement subprocess; False = tunnel wedged, stop the sweep
-    (a wedged worker hangs every later backend init)."""
+    """One measurement subprocess; (False, res) = tunnel wedged, stop the
+    sweep (a wedged worker hangs every later backend init)."""
     res, err, dt = run_sub(code_or_path, argv, timeout)
     record({"bench": name, **(extra or {}), "result": res, "error": err,
             "wall": round(dt, 1)})
     if err == "timeout":
         record({"bench": "sweep", "error": "tunnel wedged; stopping"})
-        return False
+        return False, res
     if err == LOCK_BUSY:
         # another client (e.g. the round-end driver bench) owns the tunnel:
         # stop instead of burning a lock-timeout per leg
         record({"bench": "sweep", "error": "TPU lock busy; stopping"})
-        return False
-    return True
+        return False, res
+    return True, res
 
 
 def main():
@@ -286,7 +312,19 @@ def main():
     def done_key(name, spec):
         return (name, json.dumps(spec, sort_keys=True) if spec else "")
 
+    def is_skip(res):
+        # structured skips (require_tpu legs on a CPU-degraded tunnel,
+        # single-device overlap probes) are NOT measurements: counting
+        # them as done would silence the leg forever — "timed on the
+        # next healthy chip" is the whole contract
+        if isinstance(res, dict):
+            return "skipped" in res
+        if isinstance(res, list):
+            return all(isinstance(i, dict) and "skipped" in i for i in res)
+        return False
+
     done = set()
+    prior = {}  # done_key -> latest recorded result (for alias legs)
     if not args.force_all and os.path.exists(OUT):
         with open(OUT) as f:
             for line in f:
@@ -294,8 +332,10 @@ def main():
                     e = json.loads(line)
                 except ValueError:
                     continue
-                if e.get("result") is not None:
-                    done.add(done_key(e.get("bench"), e.get("spec")))
+                if e.get("result") is not None and not is_skip(e["result"]):
+                    key = done_key(e.get("bench"), e.get("spec"))
+                    done.add(key)
+                    prior[key] = e["result"]
 
     # 1) e2e step-time sweep FIRST: it is the sweep's purpose, and a hang
     # in any later micro leg must not cost these measurements. Order is
@@ -308,8 +348,11 @@ def main():
     #   chunk96  — LAST: it was mid-flight when the tunnel wedged on
     #              2026-07-31 (8 s CPU in 35 min — blocked before tracing,
     #              so likely a victim not the cause, but it has form).
-    base = dict(depth=args.depth, kernel="auto", batch_chunk=32,
-                tile_elems=1 << 25, mds_bwd_iters=None)
+    # the base spec pins ONLY depth + kernel policy: chunk/tile sizes and
+    # the MDS arm follow the preset (depth-aware resolver, promoted
+    # 25-iter classical MDS), so e2e_auto is exactly the driver-bench
+    # configuration by construction
+    base = dict(depth=args.depth, kernel="auto")
     variants = [("e2e_auto", base)]
     if not args.quick:
         variants += [
@@ -331,13 +374,13 @@ def main():
             # single-chip lever; BASELINE config 5 pins dim/depth, not
             # the head split
             ("e2e_h4dh128", {**base, "heads": 4, "dim_head": 128}),
-            # Torgerson warm start + 25-iteration tail: classical init
-            # reaches the random-init stress floor in ~1 iteration on
-            # exact AND distogram-censored real inputs (geometry/mds.py,
-            # tests/test_geometry.py) — this leg measures the step-time
-            # win of dropping the 200-iteration sequential Guttman tail
-            ("e2e_mds25classical",
-             {**base, "mds_iters": 25, "mds_init": "classical"}),
+            # the RETIRED reference MDS arm (200 iterations, random init)
+            # measured against the promoted (25, classical) default the
+            # base legs now inherit: quantifies on chip what the cut
+            # bought, and catches any regression the classical warm
+            # start's eigendecomposition might cost at batch-1 latency
+            ("e2e_mds200random",
+             {**base, "mds_iters": 200, "mds_init": "random"}),
             # bf16 score/probability tiles in the XLA streaming path:
             # halves the attention passes' dominant HBM traffic (the f32
             # logit materialization — PERF.md round-5 traffic budget) at
@@ -355,21 +398,74 @@ def main():
             ("e2e_logit_bf16", {**base, "logit_bf16": True,
                                 "kernel": "off"}),
             ("e2e_mdsbwd25", {**base, "mds_bwd_iters": 25}),
-            # MDS scan unroll: amortizes the 200 sequential small-kernel
+            # MDS scan unroll: amortizes the sequential small-kernel
             # iterations' dispatch overhead (PERF.md "MDS latency")
             ("e2e_mdsunroll8", {**base, "mds_unroll": 8}),
-            ("e2e_tile26", {**base, "tile_elems": 1 << 26}),
+            # the OLD chunk/tile values A/B'd against the depth-aware
+            # resolver defaults (96 / 2^26 at depth <= 24) the base legs
+            # now inherit — the direct on-chip test of the resolver
+            # decision (session-5's chunk96 leg measured the reverse
+            # direction against the then-32 base)
+            ("e2e_tile25", {**base, "tile_elems": 1 << 25}),
             # e2e_chunk0 is RETIRED: measured OOM at compile (session 5,
             # PERF.md) — re-attempting a known-dead config risks a worker
             # crash for zero information
-            ("e2e_chunk96", {**base, "batch_chunk": 96}),
+            ("e2e_chunk32", {**base, "batch_chunk": 32}),
+            # branch-parallel trunk schedule A/B (ISSUE 7 tentpole): the
+            # SAME step with the intra-layer pair/MSA branches expressed
+            # as joined concurrent units vs the serial reference —
+            # allclose-pinned, so any delta is schedule, not math. TPU
+            # legs (require_tpu: structured skip elsewhere).
+            ("branch_parallel_on",
+             {**base, "trunk_schedule": "branch_parallel",
+              "require_tpu": True}),
+            # the off arm's measured configuration IS e2e_auto's (serial
+            # is the preset default): the loop below records it as an
+            # ALIAS of e2e_auto's TPU measurement instead of paying a
+            # second multi-minute compile on the wedge-prone tunnel; it
+            # only runs as its own subprocess when no e2e_auto TPU
+            # number exists to copy
+            ("branch_parallel_off",
+             {**base, "trunk_schedule": "serial", "require_tpu": True}),
+            # fused-gate A/B: gated attention with the gate fused into
+            # the Pallas kernel's finish step (on) vs the SAME kernel
+            # core with the gate applied as a separate XLA epilogue
+            # multiply (off: AF2_UNFUSE_GATE_EPILOGUE) — identical math,
+            # identical core, so the delta isolates the removed HBM
+            # out-read/multiply/write pass. (A kernel:"off" arm would
+            # also carry the whole kernel-core-vs-XLA-streaming delta,
+            # already measured in the session-4 kernel on/off legs.)
+            ("fused_gate_on",
+             {**base, "attn_gate": True, "kernel": "force",
+              "require_tpu": True}),
+            ("fused_gate_off",
+             {**base, "attn_gate": True, "kernel": "force",
+              "unfuse_gate": True, "require_tpu": True}),
         ]
+    e2e_results = dict(prior)  # done_key -> result, grown as legs run
     for name, spec in variants:
-        if done_key(name, spec) in done:
+        key = done_key(name, spec)
+        if key in done:
             print(f"skip {name}: already recorded in {OUT}", flush=True)
             continue
-        if not run_and_record(name, E2E_WORKER, [json.dumps(spec)],
-                              timeout=2100, extra={"spec": spec}):
+        if name == "branch_parallel_off":
+            src = e2e_results.get(done_key("e2e_auto", base))
+            # platform guard: older rows predate the worker's platform
+            # field, and a CPU e2e_auto number must never masquerade as
+            # a TPU leg's measurement — those fall through to a real run
+            # (which structured-skips off-TPU anyway)
+            if isinstance(src, dict) and src.get("platform") == "tpu":
+                record({"bench": name, "spec": spec, "result": src,
+                        "alias_of": "e2e_auto", "error": None, "wall": 0.0})
+                print(f"{name}: aliased from e2e_auto (serial is the "
+                      f"preset default — identical configuration)",
+                      flush=True)
+                continue
+        ok, res = run_and_record(name, E2E_WORKER, [json.dumps(spec)],
+                                 timeout=2100, extra={"spec": spec})
+        if res is not None:
+            e2e_results[key] = res
+        if not ok:
             sys.exit(3)  # wedged-tunnel code: watchers retry later
 
     # 1b) communication-overlap A/B pair (multi-chip only; single-chip
@@ -383,8 +479,9 @@ def main():
         if done_key(name, spec) in done:
             print(f"skip {name}: already recorded in {OUT}", flush=True)
             continue
-        if not run_and_record(name, OVERLAP_WORKER, [json.dumps(spec)],
-                              timeout=1200, extra={"spec": spec}):
+        ok, _ = run_and_record(name, OVERLAP_WORKER, [json.dumps(spec)],
+                               timeout=1200, extra={"spec": spec})
+        if not ok:
             sys.exit(3)  # wedged-tunnel code: watchers retry later
 
     # 2) kernel microbench + block-size tuning at the chunk shape the model
@@ -408,10 +505,11 @@ def main():
         if done_key(name, None) in done:
             print(f"skip {name}: already recorded in {OUT}", flush=True)
             continue
-        if not run_and_record(
+        ok, _ = run_and_record(
             name, micro, ["--b", "32", "--n", "1152", "--iters", "20", *extra],
             timeout=1500,
-        ):
+        )
+        if not ok:
             sys.exit(3)  # wedged-tunnel code: watchers retry later
 
 

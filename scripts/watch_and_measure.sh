@@ -3,8 +3,9 @@
 # remaining measurements in information-value order: the e2e decomposition
 # (where-the-time-goes — the sweep showed the knobs are all noise, so the
 # decomposition is what identifies the real sink), then the sweep (the new
-# e2e legs — ff-chunk, qbt1152, h4dh128, mds25classical — plus the kernel
-# micro grid; measured nowhere else), then the depth ladder LAST: the
+# e2e legs — ff-chunk, qbt1152, h4dh128, mds200random, the
+# branch_parallel and fused_gate A/B pairs, chunk32/tile25 — plus the
+# kernel micro grid; measured nowhere else), then the depth ladder LAST: the
 # round-end driver bench re-measures depth 24 + depth 48 regardless, so
 # under a short recovery window the ladder is the redundant stage
 # (already-recorded legs are skipped by all three). Each script exits 3

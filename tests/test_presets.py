@@ -28,10 +28,32 @@ def test_north_star_shapes_and_dtypes():
     assert m.dtype == jnp.bfloat16 and ecfg.refiner.dtype == jnp.bfloat16
     assert m.reversible and m.msa_tie_row_attn
     assert m.cross_attn_mode == "aligned" and m.cross_attn_compress_ratio == 4
-    assert ecfg.mds_iters == 200  # reference train_end2end.py:157
+    # the promoted MDS cut (PR 7): 25 iterations off the classical
+    # Torgerson warm start — reference parity (200, random) stays
+    # reachable via overrides / --mds-reference
+    assert ecfg.mds_iters == 25 and ecfg.mds_init == "classical"
     # memory-bounding chunks must be ON at north-star scale
     assert m.attn_batch_chunk > 0 and m.ff_chunk_size > 0
     assert ecfg.refiner.atom_chunk > 0
+
+
+def test_depth_aware_attn_knob_resolver():
+    # PERF.md item 1: depth <= 24 has ~2 GB of headroom to spend on
+    # bigger chunks/tiles; depth 48 keeps the proven-to-fit values
+    deep, _, _ = north_star_e2e_config(48)
+    assert deep.model.attn_batch_chunk == 32
+    assert deep.model.attn_flash_tile_elems == 1 << 25
+    shallow, _, _ = north_star_e2e_config(12)
+    assert shallow.model.attn_batch_chunk == 96
+    assert shallow.model.attn_flash_tile_elems == 1 << 26
+    # boundary: 24 is still headroom tier
+    edge, _, _ = north_star_e2e_config(24)
+    assert edge.model.attn_batch_chunk == 96
+    # explicit overrides still win (the sweep's A/B legs)
+    back, _, _ = north_star_e2e_config(
+        12, model_overrides=dict(attn_batch_chunk=32)
+    )
+    assert back.model.attn_batch_chunk == 32
 
 
 def test_smoke_is_cpu_safe_and_distinct():
@@ -67,3 +89,66 @@ def test_unknown_override_fails_loudly():
     # a renamed knob must break the sweep at config build, not mid-trace
     with pytest.raises(TypeError):
         north_star_e2e_config(12, model_overrides=dict(no_such_knob=1))
+
+
+def test_sweep_aliases_branch_parallel_off_to_e2e_auto(tmp_path, monkeypatch):
+    # serial is the preset default, so branch_parallel_off's measured
+    # configuration IS e2e_auto's: the sweep must record an alias row
+    # (copying e2e_auto's TPU number) instead of paying a second
+    # multi-minute compile+measure on the wedge-prone tunnel — and must
+    # NOT alias a CPU e2e_auto number into a require_tpu leg
+    import importlib
+    import json
+    import sys
+
+    sys.path.insert(0, "scripts")
+    bench_sweep = importlib.import_module("bench_sweep")
+
+    def drive(prior_rows):
+        out = tmp_path / f"sweep_{len(prior_rows)}.jsonl"
+        out.write_text(
+            "".join(json.dumps(r) + "\n" for r in prior_rows))
+        monkeypatch.setattr(bench_sweep, "OUT", str(out))
+        launched = []
+
+        def fake_run(name, code_or_path, argv, timeout, extra=None):
+            launched.append(name)
+            bench_sweep.record({"bench": name, **(extra or {}),
+                                "result": {"skipped": "fake"}, "error": None})
+            return True, {"skipped": "fake"}
+
+        monkeypatch.setattr(bench_sweep, "run_and_record", fake_run)
+        monkeypatch.setattr(sys, "argv", ["bench_sweep.py", "--skip-micro"])
+        bench_sweep.main()
+        rows = [json.loads(l) for l in out.read_text().splitlines()]
+        return launched, rows
+
+    base = dict(depth=12, kernel="auto")
+    tpu_row = {"bench": "e2e_auto", "spec": base,
+               "result": {"sec_per_step": 24.4, "loss": 3.2,
+                          "platform": "tpu"}, "error": None}
+    launched, rows = drive([tpu_row])
+    assert "branch_parallel_off" not in launched  # aliased, not run
+    alias = [r for r in rows if r.get("bench") == "branch_parallel_off"]
+    assert len(alias) == 1 and alias[0]["alias_of"] == "e2e_auto"
+    assert alias[0]["result"] == tpu_row["result"]
+
+    # CPU source (or a pre-platform-field row): falls through to a real
+    # run, which structured-skips off-TPU
+    cpu_row = {"bench": "e2e_auto", "spec": base,
+               "result": {"sec_per_step": 99.0, "platform": "cpu"},
+               "error": None}
+    launched, rows = drive([cpu_row])
+    assert "branch_parallel_off" in launched
+    assert not any(r.get("alias_of") for r in rows)
+
+    # a structured-skip row is NOT a measurement: it must not mark the
+    # leg done, or the require_tpu legs would never be timed on the
+    # next healthy chip ("skip on CPU, timed on chip" is the contract)
+    skip_row = {"bench": "branch_parallel_on",
+                "spec": {**base, "trunk_schedule": "branch_parallel",
+                         "require_tpu": True},
+                "result": {"skipped": "leg requires a TPU device",
+                           "platform": "cpu"}, "error": None}
+    launched, rows = drive([skip_row])
+    assert "branch_parallel_on" in launched  # re-attempted, not silenced

@@ -808,3 +808,31 @@ def test_fleet_matches_single_engine_bit_exact(tiny_params):
     finally:
         single.shutdown()
         fleet.shutdown()
+
+
+def test_config_tag_covers_trunk_schedule_and_fused_gate(tiny_params):
+    """PR 7 satellite: the result LRU / fleet bit-exactness pins key on
+    the config tag, which must never alias results across trunk
+    schedules (fusion-level float association may differ) or across the
+    gated/ungated attention (different math AND params). The tag reprs
+    the full Alphafold2Config, so every new numeric knob lands in it by
+    construction — this pins the two PR-7 knobs explicitly."""
+    import dataclasses as _dc
+
+    scfg = serving_cfg(buckets=(8,))
+    base = ServingEngine(tiny_params, TINY, scfg)
+    variants = {
+        "branch_parallel": _dc.replace(TINY, trunk_schedule="branch_parallel"),
+        "gated": _dc.replace(TINY, attn_gate=True),
+    }
+    try:
+        tags = {"base": base._config_tag}
+        for name, cfg in variants.items():
+            # gated params have an extra projection; init fresh per cfg
+            params = alphafold2_init(jax.random.PRNGKey(0), cfg)
+            eng = ServingEngine(params, cfg, scfg)
+            tags[name] = eng._config_tag
+            eng.shutdown(drain=False)
+        assert len(set(tags.values())) == len(tags), tags
+    finally:
+        base.shutdown(drain=False)

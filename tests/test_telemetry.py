@@ -560,3 +560,83 @@ class TestTrainingTraceIntegration:
         assert len(restores) == 1
         assert restores[0]["attrs"]["cause"] == "RuntimeError"
         assert "in-memory" in restores[0]["attrs"]["restored_from"]
+
+
+class TestSweepArtifactGate:
+    """PERF_SWEEP.jsonl auto-detection (PR 7): sweep legs gate like any
+    other snapshot, so a future on-chip run of the new legs
+    (branch_parallel_on/off, fused_gate_on/off, ...) is regression-gated
+    with zero extra wiring."""
+
+    def _sweep(self, tmp_path, name, rows):
+        p = tmp_path / name
+        p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        return str(p)
+
+    def test_jsonl_rows_flatten_and_gate(self, tmp_path):
+        baseline = self._sweep(tmp_path, "base.jsonl", [
+            {"bench": "branch_parallel_on", "spec": {"trunk_schedule":
+             "branch_parallel"}, "result": {"sec_per_step": 20.0},
+             "error": None},
+            {"bench": "fused_gate_on", "result": {"sec_per_step": 24.0}},
+            # structured skip and error rows contribute nothing
+            {"bench": "overlap_on", "result": {"skipped": "single-device"}},
+            {"bench": "e2e_auto", "result": None, "error": "timeout"},
+        ])
+        current = self._sweep(tmp_path, "cur.jsonl", [
+            {"bench": "branch_parallel_on", "result": {"sec_per_step": 19.0}},
+            {"bench": "fused_gate_on", "result": {"sec_per_step": 30.0}},
+        ])
+        passed, rows = check(current, baseline)
+        by_metric = {r["metric"]: r for r in rows}
+        assert not passed  # fused_gate_on regressed 25% > 15% tol
+        assert by_metric["branch_parallel_on.sec_per_step"]["status"] == "ok"
+        assert by_metric["fused_gate_on.sec_per_step"]["status"] == "regressed"
+        # the skip/error legs never became comparable metrics
+        assert not any(m.startswith(("overlap_on", "e2e_auto"))
+                       for m in by_metric)
+
+    def test_rerun_rows_supersede(self, tmp_path):
+        path = self._sweep(tmp_path, "re.jsonl", [
+            {"bench": "e2e_auto", "result": {"sec_per_step": 99.0}},
+            {"bench": "e2e_auto", "result": {"sec_per_step": 24.4}},
+        ])
+        from alphafold2_tpu.telemetry.check import load_metrics
+
+        assert load_metrics(path) == {"e2e_auto.sec_per_step": 24.4}
+
+    def test_single_sweep_row_dict(self):
+        from alphafold2_tpu.telemetry.check import load_metrics
+
+        got = load_metrics({"bench": "fused_gate_off",
+                            "result": {"sec_per_step": 25.0, "loss": 3.1}})
+        assert got == {"fused_gate_off.sec_per_step": 25.0,
+                       "fused_gate_off.loss": 3.1}
+
+    def test_list_results_gate_too(self, tmp_path):
+        # multi-line workers (the micro kernel grid) record LIST results:
+        # each element must still become a gateable metric — qualified by
+        # its string fields so grid points don't collide — instead of
+        # being silently dropped from the gate
+        row = {"bench": "micro_kernel", "result": [
+            {"path": "kernel", "dir": "fwd", "shape": "B32_n1152",
+             "sec_per_iter": 0.5, "platform": "tpu"},
+            {"path": "kernel", "dir": "grad", "shape": "B32_n1152",
+             "sec_per_iter": 1.2, "platform": "tpu"},
+            {"skipped": "kernel path requires TPU"},  # contributes nothing
+        ]}
+        from alphafold2_tpu.telemetry.check import load_metrics
+
+        got = load_metrics(row)
+        assert got == {
+            "micro_kernel.fwd.kernel.tpu.B32_n1152.sec_per_iter": 0.5,
+            "micro_kernel.grad.kernel.tpu.B32_n1152.sec_per_iter": 1.2,
+        }
+        # and a regression in one grid point fails the gate
+        base = self._sweep(tmp_path, "b.jsonl", [row])
+        bad = {"bench": "micro_kernel", "result": [
+            {**row["result"][0], "sec_per_iter": 0.9}, row["result"][1],
+        ]}
+        cur = self._sweep(tmp_path, "c.jsonl", [bad])
+        passed, rows = check(cur, base)
+        assert not passed

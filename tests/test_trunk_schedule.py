@@ -1,0 +1,216 @@
+"""Branch-parallel trunk schedule (ISSUE 7 tentpole): numeric parity
+against the serial reference on every trunk variant, and the structural
+schedule assertions of analysis/schedule_lint.py.
+
+The branch-parallel arm re-groups ops that are already independent in the
+serial dataflow, so parity is allclose for BOTH forward values and
+gradients — any drift means the schedule changed the math, which it must
+never do (the serving config tag still separates the arms: fusion-level
+float association may differ on real hardware).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu.models import Alphafold2Config
+from alphafold2_tpu.models.reversible import (
+    reversible_trunk_apply,
+    reversible_trunk_init,
+)
+from alphafold2_tpu.models.trunk import (
+    branch_parallel_layer_apply,
+    sequential_trunk_apply,
+    trunk_layer_init,
+)
+from alphafold2_tpu.parallel import make_mesh, sp_trunk_apply
+
+N_DEV = 8
+
+CFG = Alphafold2Config(
+    dim=16, depth=2, heads=2, dim_head=8, max_seq_len=64,
+    msa_tie_row_attn=True,
+)
+CFG_BP = dataclasses.replace(CFG, trunk_schedule="branch_parallel")
+
+
+def _setup(cfg, n=16, rows=8, cols=16, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 2 + cfg.depth)
+    layers = [trunk_layer_init(k, cfg) for k in keys[2:]]
+    x = jax.random.normal(keys[0], (1, n, n, cfg.dim))
+    m = jax.random.normal(keys[1], (1, rows, cols, cfg.dim))
+    x_mask = jnp.ones((1, n, n), bool).at[:, :, -3:].set(False)
+    msa_mask = jnp.ones((1, rows, cols), bool).at[:, :, -2:].set(False)
+    return layers, x, m, x_mask, msa_mask
+
+
+def _assert_tree_close(a, b, atol):
+    jax.tree_util.tree_map(
+        lambda s, t: np.testing.assert_allclose(
+            np.asarray(s), np.asarray(t), atol=atol
+        ),
+        a, b,
+    )
+
+
+def test_config_rejects_unknown_schedule():
+    with pytest.raises(ValueError, match="trunk_schedule"):
+        Alphafold2Config(dim=16, trunk_schedule="diagonal")
+
+
+def test_sequential_branch_parallel_matches_serial():
+    layers, x, m, x_mask, msa_mask = _setup(CFG)
+
+    def run(cfg):
+        return jax.jit(
+            lambda ls, a, b: sequential_trunk_apply(
+                ls, cfg, a, b, x_mask=x_mask, msa_mask=msa_mask
+            )
+        )
+
+    want = run(CFG)(layers, x, m)
+    got = run(CFG_BP)(layers, x, m)
+    _assert_tree_close(got, want, atol=1e-5)
+
+    def loss(cfg):
+        f = run(cfg)
+
+        def inner(ls):
+            xo, mo = f(ls, x, m)
+            return jnp.sum(xo ** 2) + jnp.sum(mo ** 2)
+
+        return inner
+
+    gs = jax.jit(jax.grad(loss(CFG)))(layers)
+    gb = jax.jit(jax.grad(loss(CFG_BP)))(layers)
+    _assert_tree_close(gb, gs, atol=1e-4)
+
+
+def test_sequential_branch_parallel_scan_and_remat_arms():
+    # the schedule composes with the compile-time/memory knobs: scanned
+    # layer bodies and per-layer remat both dispatch through the same
+    # trunk_layer_apply body
+    layers, x, m, x_mask, msa_mask = _setup(CFG)
+    want = jax.jit(
+        lambda ls, a, b: sequential_trunk_apply(ls, CFG, a, b)
+    )(layers, x, m)
+    for extra in ({"scan_layers": True}, {"remat": True}):
+        cfg = dataclasses.replace(CFG_BP, **extra)
+        got = jax.jit(
+            lambda ls, a, b, cfg=cfg: sequential_trunk_apply(ls, cfg, a, b)
+        )(layers, x, m)
+        _assert_tree_close(got, want, atol=1e-5)
+
+
+def test_reversible_branch_parallel_matches_serial():
+    rcfg = dataclasses.replace(CFG, reversible=True)
+    rcfg_bp = dataclasses.replace(rcfg, trunk_schedule="branch_parallel")
+    stacked = reversible_trunk_init(jax.random.PRNGKey(3), rcfg)
+    _, x, m, _, _ = _setup(rcfg)
+
+    def run(cfg):
+        return jax.jit(lambda p, a, b: reversible_trunk_apply(p, cfg, a, b))
+
+    want = run(rcfg)(stacked, x, m)
+    got = run(rcfg_bp)(stacked, x, m)
+    _assert_tree_close(got, want, atol=1e-5)
+
+    def loss(cfg):
+        f = run(cfg)
+
+        def inner(p):
+            xo, mo = f(p, x, m)
+            return jnp.sum(xo ** 2) + jnp.sum(mo ** 2)
+
+        return inner
+
+    gs = jax.jit(jax.grad(loss(rcfg)))(stacked)
+    gb = jax.jit(jax.grad(loss(rcfg_bp)))(stacked)
+    _assert_tree_close(gb, gs, atol=1e-4)
+
+
+def test_sp_branch_parallel_matches_serial_aligned():
+    # the north-star mode: aligned cross-attention, tied rows, the row
+    # axes sharded over the full mesh
+    if len(jax.devices()) < N_DEV:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = dataclasses.replace(CFG, cross_attn_mode="aligned", depth=1)
+    cfg_bp = dataclasses.replace(cfg, trunk_schedule="branch_parallel")
+    layers, x, m, x_mask, msa_mask = _setup(cfg)
+    mesh = make_mesh({"seq": N_DEV})
+
+    def run(cfg):
+        return jax.jit(
+            lambda ls, a, b: sp_trunk_apply(
+                ls, cfg, a, b, mesh, x_mask=x_mask, msa_mask=msa_mask
+            )
+        )
+
+    want = run(cfg)(layers, x, m)
+    got = run(cfg_bp)(layers, x, m)
+    _assert_tree_close(got, want, atol=1e-5)
+
+
+def test_serialize_twin_is_numerically_identity():
+    # the lint fixture couples the branches through + 0 * sum(...): it
+    # must never change values, only the lowered dependence structure
+    layers, x, m, x_mask, msa_mask = _setup(CFG)
+    want = branch_parallel_layer_apply(layers[0], CFG_BP, x, m)
+    got = branch_parallel_layer_apply(
+        layers[0], CFG_BP, x, m, serialize_twin=True
+    )
+    _assert_tree_close(got, want, atol=0)
+
+
+# --- the structural schedule assertions (analysis/schedule_lint.py) ---------
+
+
+def _lower(fn, *args):
+    from jax import export as jexport
+
+    return jexport.export(jax.jit(fn), platforms=["tpu"])(*args).mlir_module()
+
+
+def test_schedule_lint_passes_clean_and_flags_twin():
+    from alphafold2_tpu.analysis.schedule_lint import (
+        check_branch_parallel,
+        check_serial_unmarked,
+        check_serialized_twin_detected,
+    )
+
+    layers, x, m, _, _ = _setup(CFG)
+    xs = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    ms = jax.ShapeDtypeStruct(m.shape, m.dtype)
+
+    txt = _lower(
+        lambda a, b: sequential_trunk_apply(layers, CFG_BP, a, b), xs, ms
+    )
+    assert check_branch_parallel(txt, min_joins=CFG.depth) == []
+
+    txt_serial = _lower(
+        lambda a, b: sequential_trunk_apply(layers, CFG, a, b), xs, ms
+    )
+    assert check_serial_unmarked(txt_serial) == []
+    # and the branch check itself reports the missing markers loudly
+    assert check_branch_parallel(txt_serial, min_joins=1)
+
+    txt_twin = _lower(
+        lambda a, b: branch_parallel_layer_apply(
+            layers[0], CFG_BP, a, b, serialize_twin=True
+        ),
+        xs, ms,
+    )
+    assert check_serialized_twin_detected(txt_twin) == []
+    # the twin is flagged BY the branch check (that is what the detector
+    # self-check certifies)
+    assert check_branch_parallel(txt_twin, min_joins=1)
+
+
+def test_schedule_pass_registered():
+    from alphafold2_tpu.analysis import PASSES, _REPO_WIDE
+
+    assert "schedule" in PASSES
+    assert "schedule" in _REPO_WIDE

@@ -62,6 +62,17 @@ def main():
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--accum", type=int, default=2)
     ap.add_argument("--mds-iters", type=int, default=20)
+    ap.add_argument("--mds-init", choices=["classical", "random"],
+                    default="classical",
+                    help="MDS warm start: 'classical' (Torgerson "
+                         "eigendecomposition — the promoted training "
+                         "default, reaches the random-init stress floor "
+                         "in ~1 iteration) or 'random' (reference parity)")
+    ap.add_argument("--mds-reference", action="store_true",
+                    help="restore the retired reference MDS arm for "
+                         "parity runs: 200 iterations from a random init "
+                         "(reference train_end2end.py:157), overriding "
+                         "--mds-iters/--mds-init")
     ap.add_argument("--mds-bwd-iters", type=int, default=None,
                     help="truncate MDS backprop to the last K iterations "
                          "(implicit-diff approximation; None = full unroll)")
@@ -150,7 +161,8 @@ def main():
             dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         ),
         refiner=RefinerConfig(num_tokens=14, dim=64, depth=args.refiner_depth),
-        mds_iters=args.mds_iters,
+        mds_iters=200 if args.mds_reference else args.mds_iters,
+        mds_init="random" if args.mds_reference else args.mds_init,
         mds_bwd_iters=args.mds_bwd_iters,
     )
     tcfg = tcfg_from_args(args, grad_accum=args.accum)
