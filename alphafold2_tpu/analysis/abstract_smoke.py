@@ -262,6 +262,59 @@ def _targets() -> Dict[str, Callable[[], None]]:
             abstract((4, 4, 16), jnp.int32), abstract((4, 4, 16), jnp.bool_),
         )
 
+    @register("ops.quant_matmul")
+    def _quant_matmul():
+        # per-channel PTQ + the fused-dequant Pallas kernel construction
+        # (use_kernel=True traces the pallas_call), the XLA dequant
+        # reference arm, and a stacked reversible-layout quantize
+        from alphafold2_tpu.ops.quant import quant_matmul, quantize_weight
+
+        def run(x, w):
+            qw, scale = quantize_weight(w)
+            return quant_matmul(x, qw, scale, use_kernel=True)
+
+        jax.eval_shape(run, abstract((6, 4, 32)), abstract((32, 16)))
+
+        def run_xla(x, w):
+            qw, scale = quantize_weight(w, per_channel=False)
+            return quant_matmul(x, qw, scale, use_kernel=False,
+                                dtype=jnp.bfloat16)
+
+        jax.eval_shape(run_xla, abstract((4, 32)), abstract((32, 16)))
+        jax.eval_shape(
+            lambda w: quantize_weight(w), abstract((3, 32, 16))
+        )
+
+    @register("serving.quant_residency")
+    def _quant_residency():
+        # the engine's build-time precision seam: int8 config -> PTQ tree
+        # (fp32 master untouched) + residency info, second build under
+        # the same tag served from the process cache (host-side
+        # construction check, like reliability.*)
+        import dataclasses
+
+        from alphafold2_tpu.models import Alphafold2Config, alphafold2_init
+        from alphafold2_tpu.serving.quant_residency import (
+            clear_residency_cache,
+            resident_params,
+        )
+
+        tiny = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8,
+                                max_seq_len=16)
+        params = alphafold2_init(key, tiny)
+        clear_residency_cache()
+        try:
+            same, info = resident_params(params, tiny)
+            assert same is params and info["weight_dtype"] == "f32"
+            int8_cfg = dataclasses.replace(tiny, weight_dtype="int8")
+            tree, info = resident_params(params, int8_cfg)
+            assert info["weight_bytes"] < info["fp32_weight_bytes"]
+            assert not info["cached"]
+            tree2, info2 = resident_params(params, int8_cfg)
+            assert tree2 is tree and info2["cached"]
+        finally:
+            clear_residency_cache()
+
     @register("serving.fleet")
     def _serving_fleet():
         # fleet round trip over stub engines: admission -> dispatch ->

@@ -151,6 +151,18 @@ class Alphafold2Config:
     ff_chunk_size: int = 0
     template_attn_depth: int = 2
     dtype: Any = jnp.float32
+    # weight residency/precision arm (INFERENCE-ONLY):
+    #   "f32"  — fp32 master weights, the training/default arm;
+    #   "int8" — per-channel symmetric post-training quantization of the
+    #     trunk's dense/projection weights (ops/quant.py quantize_tree):
+    #     int8 values + f32 per-output-channel scales, dequant fused into
+    #     the matmul epilogue on the TPU kernel path
+    #     (ops/quant_kernel.py) so no fp32 weight copy ever crosses HBM.
+    #     The serving tier quantizes at engine build (keyed by config
+    #     tag, serving/quant_residency.py); training entry points reject
+    #     this value loudly (ops/quant.py reject_quant_training). Changes
+    #     numerics: part of the serving config tag by repr construction.
+    weight_dtype: str = "f32"
 
     def __post_init__(self):
         if self.reversible and self.remat:
@@ -178,6 +190,11 @@ class Alphafold2Config:
             raise ValueError(
                 f"trunk_schedule must be 'serial' or 'branch_parallel', "
                 f"got {self.trunk_schedule!r}"
+            )
+        if self.weight_dtype not in ("f32", "int8"):
+            raise ValueError(
+                f"weight_dtype must be 'f32' or 'int8', "
+                f"got {self.weight_dtype!r}"
             )
         if self.attn_gate and (
             self.sparse_self_attn is True

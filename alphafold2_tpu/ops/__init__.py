@@ -27,8 +27,24 @@ from alphafold2_tpu.ops.feedforward import (
     feed_forward_apply,
 )
 from alphafold2_tpu.ops.flash import blockwise_attention, flash_attention
+from alphafold2_tpu.ops.quant import (
+    dequantize_tree,
+    dequantize_weight,
+    quant_matmul,
+    quantize_tree,
+    quantize_weight,
+    reject_quant_training,
+    tree_weight_bytes,
+)
 
 __all__ = [
+    "dequantize_tree",
+    "dequantize_weight",
+    "quant_matmul",
+    "quantize_tree",
+    "quantize_weight",
+    "reject_quant_training",
+    "tree_weight_bytes",
     "linear_init",
     "linear",
     "layer_norm_init",

@@ -42,7 +42,19 @@ def linear_init(key, d_in: int, d_out: int, bias: bool = True):
 
 
 def linear(params, x, dtype=None):
-    """y = x @ w (+ b). Computes in `dtype` if given (params are cast)."""
+    """y = x @ w (+ b). Computes in `dtype` if given (params are cast).
+
+    Quantized params (the PTQ tree rewrite `{"qw": int8, "scale": f32}`
+    from ops/quant.py quantize_tree) dispatch to the mixed-precision
+    matmul instead — this is THE chokepoint every dense/projection layer
+    flows through, so the int8 inference arm needs no per-layer wiring."""
+    if "qw" in params:
+        from alphafold2_tpu.ops.quant import quant_matmul
+
+        y = quant_matmul(x, params["qw"], params["scale"], dtype=dtype)
+        if "b" in params:
+            y = y + params["b"].astype(y.dtype)
+        return y
     w = params["w"]
     if dtype is not None:
         w = w.astype(dtype)

@@ -1,0 +1,147 @@
+"""Pallas TPU kernel for the int8-weight mixed-precision matmul.
+
+The inference fast path under ops/quant.py's `quant_matmul`: activations
+(f32/bf16) times PER-CHANNEL-quantized int8 weights, with the dequant
+scale applied in the kernel EPILOGUE. The weight tensor crosses HBM as
+int8 — a quarter of the f32 traffic on the trunk's dense layers, which
+are memory-bound at serving batch sizes — and the int8 -> activation-dtype
+cast happens on the VMEM-resident tile, so a dequantized weight copy is
+never materialized in HBM (the traffic the pure-XLA reference arm,
+ops/quant.py `quant_matmul_xla`, pays by construction).
+
+Streaming layout mirrors ops/flash_kernel.py: a 3-D grid whose LAST
+dimension walks the contraction (K) blocks sequentially (dimension
+semantics "arbitrary") with an f32 accumulator in VMEM scratch, while
+Mosaic's pipeline double-buffers the activation and weight tile fetches.
+The per-output-channel scale rides as a (1, bn) row-vector block and
+multiplies the accumulator once, in the finish step — f32 epilogue math,
+one cast to the output dtype at the very end, exactly the contract the
+XLA reference arm follows so the two arms are allclose (tier-1 parity
+matrix in tests/test_quant.py; `supported_quant` gates auto-dispatch the
+way `supported_fused` gates the fused attention kernel).
+
+On non-TPU backends the kernel runs in interpreter mode (tests), keeping
+one code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu import compat
+from alphafold2_tpu.compat import pallas as pl, pallas_tpu as pltpu
+from alphafold2_tpu.ops.core import pallas_interpret as _interpret
+from alphafold2_tpu.ops.flash_kernel import pick_block
+
+# Activation dtypes the MXU path handles with exact int8 -> dtype casts
+# (|q| <= 127 is exactly representable in both); everything else streams
+# via the XLA reference arm.
+_SUPPORTED_X_DTYPES = (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
+
+# Per-grid-step VMEM working set is bounded by the fixed tile targets
+# below (double-buffered (bm, bk) activations + (bk, bn) int8 weights +
+# the (bm, bn) f32 accumulator scratch + a (1, bn) scale row); the only
+# shape-dependent residency is the grid bookkeeping, so the supported
+# range is wide. The dim caps below are a sanity bound, not a VMEM one.
+_MAX_DIM = 1 << 24
+
+# int8 tiles want >= (32, 128) sublane x lane granularity; 128-multiples
+# satisfy every operand dtype in the kernel at once.
+_BM_TARGET = 256
+_BN_TARGET = 256
+_BK_TARGET = 256
+
+
+def supported_quant(m: int, k: int, n: int, x_dtype=jnp.float32) -> bool:
+    """Shapes/dtypes the int8-weight kernel handles; everything else takes
+    the XLA dequant reference arm (ops/quant.py `quant_matmul_xla`).
+
+    Tiles stream through the grid's sequential dimension, so there is no
+    per-row residency bound to enforce (unlike the flash kernels' row
+    vectors) — the gate is activation dtype (f32/bf16 exact int8 casts)
+    plus sane dimension bounds."""
+    return (
+        0 < m <= _MAX_DIM
+        and 0 < k <= _MAX_DIM
+        and 0 < n <= _MAX_DIM
+        and jnp.dtype(x_dtype) in _SUPPORTED_X_DTYPES
+    )
+
+
+# first two grid dims parallel (each (mi, ni) pair owns a private output
+# window), streamed contraction dim sequential — the flash backward's
+# semantics (ops/flash_kernel.py _BWD_PARAMS)
+_QMM_PARAMS = compat.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary")
+)
+
+_out_struct = compat.out_struct
+
+
+def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr, *, nkb):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    x = x_ref[...]                    # (bm, bk) activation dtype
+    w = w_ref[...]                    # (bk, bn) int8
+    # the ONLY dequant in the kernel: int8 -> activation dtype on the
+    # VMEM tile (exact — |q| <= 127), so the MXU runs at the activation
+    # dtype's peak and HBM only ever saw int8 weight bytes
+    acc_scr[...] = acc_scr[...] + jax.lax.dot_general(
+        x, w.astype(x.dtype),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(ki == nkb - 1)
+    def _finish():
+        # per-channel scale epilogue in f32 on the f32 accumulator, one
+        # cast at the very end — the exact math quant_matmul_xla runs, so
+        # kernel-on and kernel-off arms differ only in rounding
+        s = s_ref[...].astype(jnp.float32)      # (1, bn)
+        o_ref[...] = (acc_scr[...] * s).astype(o_ref.dtype)
+
+
+def quant_matmul_tpu(x, qw, scale, *, bm=None, bn=None, bk=None):
+    """Fused-dequant matmul: x (m, k) f32/bf16 @ qw (k, n) int8, scaled
+    per output channel by `scale` (n,) f32 in the kernel epilogue.
+    Returns (m, n) in x.dtype. bm/bn/bk override the tile sizes (None =
+    padding-aware pick_block)."""
+    m, k = x.shape
+    n = qw.shape[1]
+    bm = pick_block(m, target=_BM_TARGET) if bm is None else bm
+    bn = pick_block(n, target=_BN_TARGET) if bn is None else bn
+    bk = pick_block(k, target=_BK_TARGET) if bk is None else bk
+
+    pad_m, pad_k, pad_n = (-m) % bm, (-k) % bk, (-n) % bn
+    if pad_m or pad_k:
+        x = jnp.pad(x, ((0, pad_m), (0, pad_k)))
+    if pad_k or pad_n:
+        qw = jnp.pad(qw, ((0, pad_k), (0, pad_n)))
+    scale2 = scale.reshape(1, n)
+    if pad_n:
+        scale2 = jnp.pad(scale2, ((0, 0), (0, pad_n)))
+    mp, kp, np_ = m + pad_m, k + pad_k, n + pad_n
+    nkb = kp // bk
+
+    out = pl.pallas_call(
+        functools.partial(_qmm_kernel, nkb=nkb),
+        out_shape=_out_struct((mp, np_), x.dtype, x, qw, scale2),
+        grid=(mp // bm, np_ // bn, nkb),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, ki: (i, ki)),
+            pl.BlockSpec((bk, bn), lambda i, j, ki: (ki, j)),
+            pl.BlockSpec((1, bn), lambda i, j, ki: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, ki: (i, j)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=_QMM_PARAMS,
+        interpret=_interpret(),
+    )(x, qw, scale2)
+    return out[:m, :n]

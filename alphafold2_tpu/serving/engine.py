@@ -70,6 +70,7 @@ from alphafold2_tpu.serving.errors import (
 )
 from alphafold2_tpu.serving.metrics import ServingMetrics
 from alphafold2_tpu.serving.pipeline import predict_structure
+from alphafold2_tpu.serving.quant_residency import resident_params
 from alphafold2_tpu.telemetry import NULL_TRACER
 
 
@@ -295,17 +296,26 @@ class ServingEngine:
         self.cfg = cfg
         self.model_cfg = model_cfg
         self._model_apply_fn = model_apply_fn
+        # precision arm (serving/quant_residency.py): weight_dtype="int8"
+        # places the per-channel-PTQ tree on device instead of the fp32
+        # master — quantized once per residency tag process-wide, so a
+        # fleet of replicas over one master tree shares the work
+        params, self._weight_residency = resident_params(
+            params, model_cfg, params_tag=cfg.params_tag
+        )
         self._params = jax.device_put(params)
         self._base_key = jax.random.PRNGKey(cfg.seed)
         # the ladder is part of the numeric fingerprint: a sequence's
         # structure is a deterministic function of (sequence, bucket), and
         # bucket assignment follows the ladder (serving/bucketing.py).
         # repr(model_cfg) serializes EVERY Alphafold2Config field — in
-        # particular trunk_schedule and attn_gate must be (and are) in
-        # the tag: schedules may differ in fusion-level float association
-        # and the gate changes the math outright, so the result LRU and
-        # the fleet's shared-tag bit-exactness pin must never alias
-        # results across them (tests/test_serving.py pins this)
+        # particular trunk_schedule, attn_gate, and weight_dtype must be
+        # (and are) in the tag: schedules may differ in fusion-level
+        # float association, the gate changes the math outright, and the
+        # int8 precision arm serves rounded weights — so the result LRU,
+        # the AOT executables, and the fleet's shared-tag bit-exactness
+        # pin must never alias results across them (tests/test_serving.py
+        # pins all three)
         self._config_tag = repr((
             model_cfg, cfg.mds_iters, cfg.mds_init, cfg.seed, cfg.msa_rows,
             cfg.params_tag, self._ladder.buckets,
@@ -336,6 +346,9 @@ class ServingEngine:
             latency_window=cfg.latency_window, logger=metrics_logger,
             tracer=self._tracer,
         )
+        # per-tag weight-bytes gauge: what THIS engine's config tag costs
+        # in resident weight HBM (the int8 arm's headline residency win)
+        self.metrics.set_weight_bytes(self._weight_residency)
 
         self._closed = False
         self._drain_on_stop = True
@@ -531,6 +544,7 @@ class ServingEngine:
         snap["buckets"] = list(self._ladder.buckets)
         snap["max_batch"] = self.cfg.max_batch
         snap["closed"] = self._closed
+        snap["weights"] = dict(self._weight_residency)
         if self._breaker is not None:
             snap["breaker"] = self._breaker.snapshot()
         # the unified telemetry view: every registry metric (per-bucket

@@ -32,12 +32,13 @@ returns bit-identical results — pinned by tests against the
 single-engine path. Fleet latency/cache stats count each request once,
 at its terminal outcome.
 
-Degraded mode: with `degraded_mds_iters` set, the fleet holds one extra
-engine at a cheaper config tag (same params, fewer MDS iterations — a
-second tenant of the result-cache keyspace). It takes traffic only when
-every full replica is down or the queue is past `degrade_depth`, and
-every response it serves is flagged `degraded=True` — the client always
-knows which answer it got.
+Degraded mode: with `degraded_mds_iters` and/or `degraded_weight_dtype`
+set, the fleet holds one extra engine at a cheaper config tag (fewer MDS
+iterations, and/or int8 PTQ trunk weights — serving/quant_residency.py —
+a second tenant of the result-cache keyspace at ~1/4 the weight
+residency). It takes traffic only when every full replica is down or the
+queue is past `degrade_depth`, and every response it serves is flagged
+`degraded=True` — the client always knows which answer it got.
 
 Every replica breaker gets seeded `breaker_jitter` with a per-replica
 seed, so a fleet-wide dependency failure does not re-probe in lockstep.
@@ -106,6 +107,11 @@ class FleetConfig:
     default_timeout_s: Optional[float] = 60.0  # fleet-level deadline
     requeue_limit: int = 2       # replica failovers per request
     degraded_mds_iters: int = 0  # >0: hold a cheaper-tag fallback engine
+    degraded_weight_dtype: str = ""  # "int8": the degraded tier serves
+    #                              per-channel-PTQ int8 trunk weights
+    #                              (ops/quant.py) — a precision degrade
+    #                              that composes with degraded_mds_iters;
+    #                              ""/"f32" keeps full-precision weights
     degrade_depth: int = 0       # queue depth that routes NEW work to the
     #                              degraded tier (0 = only on total outage)
     probe_interval_s: float = 5.0    # heartbeat cadence, healthy replicas
@@ -126,6 +132,11 @@ class FleetConfig:
             )
         if self.degraded_mds_iters < 0 or self.degrade_depth < 0:
             raise ValueError("degraded knobs must be >= 0")
+        if self.degraded_weight_dtype not in ("", "f32", "int8"):
+            raise ValueError(
+                f"degraded_weight_dtype must be '', 'f32', or 'int8', "
+                f"got {self.degraded_weight_dtype!r}"
+            )
 
 
 class FleetRequest:
@@ -293,9 +304,21 @@ class ServingFleet:
             )
 
         self._degraded_rep: Optional[_Replica] = None
-        if fleet_cfg.degraded_mds_iters:
-            dcfg = dataclasses.replace(
-                serving_cfg, mds_iters=fleet_cfg.degraded_mds_iters)
+        # the degraded tier can be cheaper on MDS iterations, on weight
+        # precision (int8 PTQ trunk), or both — either knob arms it. Its
+        # model config diverges from the full replicas' exactly when the
+        # precision knob is set, which moves it to its own config tag
+        # (results can never alias the full-precision cache keyspace).
+        self._degraded_model_cfg = self._model_cfg
+        if fleet_cfg.degraded_weight_dtype == "int8":
+            self._degraded_model_cfg = dataclasses.replace(
+                model_cfg, weight_dtype="int8")
+        if (fleet_cfg.degraded_mds_iters
+                or fleet_cfg.degraded_weight_dtype == "int8"):
+            dcfg = serving_cfg
+            if fleet_cfg.degraded_mds_iters:
+                dcfg = dataclasses.replace(
+                    serving_cfg, mds_iters=fleet_cfg.degraded_mds_iters)
             self._degraded_rep = _Replica(
                 DEGRADED, self._make_factory(DEGRADED, dcfg))
             self._degraded_rep.engine = self._degraded_rep.factory()
@@ -308,8 +331,10 @@ class ServingFleet:
     # ------------------------------------------------------------ factories
 
     def _default_factory(self, name, cfg, fault_hook):
+        model_cfg = (self._degraded_model_cfg if name == DEGRADED
+                     else self._model_cfg)
         return ServingEngine(
-            self._params, self._model_cfg, cfg,
+            self._params, model_cfg, cfg,
             model_apply_fn=self._model_apply_fn,
             fault_hook=fault_hook, tracer=self._tracer,
         )

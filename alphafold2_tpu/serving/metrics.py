@@ -147,6 +147,19 @@ class ServingMetrics:
         with self._compiles_lock:
             self._compile_seconds[bucket] = gauge
 
+    def set_weight_bytes(self, residency: dict):
+        """Per-tag resident-weight gauge (serving/quant_residency.py):
+        `serving_weight_bytes{tag, weight_dtype}` = bytes this engine's
+        parameter tree keeps resident in device memory — the
+        multi-precision serving story's capacity metric (an int8 tag
+        costs ~4x less than its f32 twin, so more tags fit a replica)."""
+        self.registry.gauge(
+            "serving_weight_bytes",
+            help="resident parameter-tree bytes for this engine's "
+                 "residency tag",
+            tag=residency["tag"], weight_dtype=residency["weight_dtype"],
+        ).set(residency["weight_bytes"])
+
     def record_compile(self, bucket: int, seconds: float):
         """Back-compat direct recording (pre-tracker callers/tests)."""
         gauge = self.registry.gauge(

@@ -1,0 +1,116 @@
+"""Multi-precision weight residency for the serving tier.
+
+A serving replica's HBM bill has two tenants: executables (bounded by
+the bucket ladder, PR 2) and WEIGHTS — one full parameter tree per
+distinct config tag resident on the device. With
+`Alphafold2Config.weight_dtype="int8"` an engine serves per-channel-PTQ
+int8 trunk weights (ops/quant.py) instead of the fp32 master: ~3.9x
+fewer weight bytes on the north-star trunk, and int8 (not fp32) HBM
+traffic on every dense layer via the fused-dequant kernel
+(ops/quant_kernel.py).
+
+This module is the build-time seam the engine calls BEFORE placing
+params on device:
+
+  * `resident_params(params, model_cfg)` — identity for f32 configs;
+    for int8 configs returns the PTQ tree (fp32 master untouched),
+    served from a small process-level cache keyed by the residency tag
+    so a FLEET of replicas sharing one master tree (serving/fleet.py
+    builds N engines over the same `params` object) quantizes ONCE, not
+    N times.
+  * `residency_tag(model_cfg, params_tag)` — the cache key and the
+    label on the per-tag weight-bytes gauge (`serving_weight_bytes` in
+    ServingMetrics): weight_dtype plus a short digest of the full
+    model-config repr and the checkpoint fingerprint. Two checkpoints,
+    or two precision arms of one checkpoint, can never share an entry —
+    the same never-alias stance as the engine's result-cache config tag
+    (which covers `weight_dtype` by repr construction).
+
+The cache holds a strong reference to the SOURCE tree per entry and
+revalidates by identity: a new params object under the same tag (e.g. a
+reloaded checkpoint with an unchanged params_tag — caller error, but a
+cheap one) re-quantizes instead of serving stale weights.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+from typing import Tuple
+
+from alphafold2_tpu.ops.quant import quantize_tree, tree_weight_bytes
+
+__all__ = ["resident_params", "residency_tag", "clear_residency_cache"]
+
+_CACHE_MAX = 8  # distinct (config, checkpoint) tags held at once
+
+_lock = threading.Lock()
+# tag -> {"source": params, "tree": quantized tree, "info": dict}
+_cache: "collections.OrderedDict[str, dict]" = collections.OrderedDict()
+
+
+def residency_tag(model_cfg, params_tag: str = "") -> str:
+    """Short, label-safe identity of one resident weight set:
+    `<weight_dtype>-<12-hex digest of (repr(model_cfg), params_tag)>`.
+    repr covers every Alphafold2Config field, so any knob that changes
+    what should be resident changes the tag."""
+    digest = hashlib.sha256(
+        repr((model_cfg, params_tag)).encode()
+    ).hexdigest()[:12]
+    return f"{getattr(model_cfg, 'weight_dtype', 'f32')}-{digest}"
+
+
+def resident_params(params, model_cfg, *, params_tag: str = "") -> Tuple[object, dict]:
+    """The tree an engine should place on device for `model_cfg`, plus a
+    residency info dict:
+
+      {"tag", "weight_dtype", "weight_bytes" (the resident tree),
+       "fp32_weight_bytes" (the master tree), "cached" (True when the
+       quantized tree came from the process cache)}
+
+    f32 configs return `params` unchanged. int8 configs return the PTQ
+    tree (ops/quant.py `quantize_tree`, default trunk selection); the
+    fp32 master is never mutated.
+    """
+    tag = residency_tag(model_cfg, params_tag)
+    if getattr(model_cfg, "weight_dtype", "f32") != "int8":
+        fp32_bytes = tree_weight_bytes(params)
+        return params, {
+            "tag": tag,
+            "weight_dtype": "f32",
+            "weight_bytes": fp32_bytes,
+            "fp32_weight_bytes": fp32_bytes,
+            "cached": False,
+        }
+
+    with _lock:
+        entry = _cache.get(tag)
+        if entry is not None and entry["source"] is params:
+            # hit: the cached info already carries both byte counts — no
+            # whole-tree walk on the N-1 replica builds after the first
+            _cache.move_to_end(tag)
+            return entry["tree"], {**entry["info"], "cached": True}
+
+    fp32_bytes = tree_weight_bytes(params)
+    qtree = quantize_tree(params)
+    info = {
+        "tag": tag,
+        "weight_dtype": "int8",
+        "weight_bytes": tree_weight_bytes(qtree),
+        "fp32_weight_bytes": fp32_bytes,
+        "cached": False,
+    }
+    with _lock:
+        _cache[tag] = {"source": params, "tree": qtree, "info": info}
+        _cache.move_to_end(tag)
+        while len(_cache) > _CACHE_MAX:
+            _cache.popitem(last=False)
+    return qtree, dict(info)
+
+
+def clear_residency_cache() -> None:
+    """Drop every cached quantized tree (tests; also frees the host-side
+    strong references to retired checkpoints)."""
+    with _lock:
+        _cache.clear()

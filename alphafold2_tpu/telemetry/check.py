@@ -62,6 +62,18 @@ _RULES: Tuple[Tuple[str, str, float], ...] = (
     ("*.sum", "ignore", 0.0),
     ("*total*", "ignore", 0.0),
     ("*uptime*", "ignore", 0.0),
+    # raw residency byte counts are static configuration properties, not
+    # run speed; the RATIO below is the gated residency metric
+    ("*weight_hbm_bytes*", "ignore", 0.0),
+    # QUALITY metrics (the quant_parity leg and future eval legs):
+    # bigger is better — without these rules the generic *latency*-style
+    # fallthroughs would either skip them or gate them backwards
+    ("*contact_precision*", "higher", 0.05),
+    ("*lddt*", "higher", 0.05),
+    ("*weight_hbm_ratio*", "higher", 0.05),
+    ("*quant_weight_ratio*", "higher", 0.05),
+    # divergence-from-reference metrics: smaller is better
+    ("*distogram_kl*", "lower", 0.25),
     ("*steps_per_sec*", "higher", 0.10),
     ("*per_sec*", "higher", 0.10),
     ("*mfu*", "higher", 0.10),

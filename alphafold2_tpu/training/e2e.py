@@ -233,8 +233,11 @@ def e2e_params_init(key, ecfg: E2EConfig):
 
 def e2e_train_state_init(key, ecfg: E2EConfig, tcfg):
     """TrainState over the joint (trunk, refiner) param pytree."""
+    from alphafold2_tpu.ops.quant import reject_quant_training
     from alphafold2_tpu.training.harness import make_optimizer
 
+    # int8 weights are the inference-only serving arm (ops/quant.py)
+    reject_quant_training(ecfg, "e2e_train_state_init")
     params = e2e_params_init(key, ecfg)
     opt = make_optimizer(tcfg)
     return {"params": params, "opt_state": opt.init(params), "step": jnp.zeros((), jnp.int32)}

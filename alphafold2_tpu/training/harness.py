@@ -29,6 +29,7 @@ import numpy as np
 import optax
 
 from alphafold2_tpu.models import Alphafold2Config, alphafold2_apply, alphafold2_init
+from alphafold2_tpu.ops.quant import reject_quant_training
 from alphafold2_tpu.training.losses import bucketed_distance_matrix, distogram_cross_entropy
 
 
@@ -105,6 +106,9 @@ def make_optimizer(tcfg: TrainConfig) -> optax.GradientTransformation:
 
 
 def train_state_init(key, cfg: Alphafold2Config, tcfg: TrainConfig):
+    # int8 weights are the inference-only serving arm: refuse at the
+    # entry point, not as a custom-vjp error deep inside the scan
+    reject_quant_training(cfg, "train_state_init")
     params = alphafold2_init(key, cfg)
     opt = make_optimizer(tcfg)
     return {
@@ -152,6 +156,7 @@ def make_train_step(
     The returned step consumes a batch whose leaves carry a leading
     microbatch axis (grad_accum, per_device_batch, ...) and scans over it.
     """
+    reject_quant_training(cfg, "make_train_step")
     opt = make_optimizer(tcfg)
 
     def microbatch_grads(params, batch, rng):
@@ -245,6 +250,7 @@ def make_axis_accum_train_step(
         unflatten_buckets,
     )
 
+    reject_quant_training(cfg, "make_axis_accum_train_step")
     opt = make_optimizer(tcfg)
     n = tcfg.grad_accum
     if state_shape is None:

@@ -112,6 +112,11 @@ tcfg = TrainConfig(learning_rate=3e-4, grad_accum=1)
 dcfg = DataConfig(batch_size=1, max_len=crop, msa_rows=msa_rows, seed=0)
 batch = jax.device_put(next(stack_microbatches(synthetic_structure_batches(dcfg), 1)))
 state = e2e_train_state_init(jax.random.PRNGKey(0), ecfg, tcfg)
+# resident weight bytes of this leg's param tree (chip-free shape
+# arithmetic; computed BEFORE the step donates the state) — the
+# denominator the quant legs' residency win is measured against
+from alphafold2_tpu.ops.quant import tree_weight_bytes
+weight_hbm_bytes = tree_weight_bytes(state["params"])
 step = make_train_step(ecfg, tcfg, loss_fn=e2e_loss_fn)
 
 def run_one(state, batch, rng):
@@ -128,7 +133,182 @@ loss = float(np.asarray(loss))
 dt = time.perf_counter() - t0
 assert np.isfinite(loss), loss
 print(json.dumps({"sec_per_step": round(dt, 2), "loss": round(loss, 4),
+                  "weight_hbm_bytes": weight_hbm_bytes,
                   "platform": jax.devices()[0].platform}))
+"""
+
+
+# int8 weight-quantization A/B (ISSUE 8 tentpole): SERVING-shaped
+# inference — the trunk forward -> distogram -> MDS pipeline the engine
+# AOT-compiles — at the north-star model configuration, f32 master
+# weights vs the per-channel-PTQ int8 tree through the fused-dequant
+# Pallas matmul. BOTH arms pin the same forced attention-kernel core
+# (AF2_FLASH_AUTO_MIN_J=0), so the on/off delta isolates the weight
+# path: int8 HBM weight traffic + in-kernel dequant vs full fp32 weight
+# reads. weight_hbm_bytes rides along so the residency win and the
+# latency delta come from the same row. TPU legs (require_tpu:
+# structured skip elsewhere — a CPU number would not measure HBM).
+QUANT_WORKER = r"""
+import json, sys, time, os
+spec = json.loads(sys.argv[1])
+os.environ["AF2_FLASH_AUTO_MIN_J"] = "0"   # same forced kernel core, both arms
+if spec["weight_dtype"] == "int8":
+    # force the fused-dequant kernel: a silent XLA-dequant fallback would
+    # record fp32-traffic numbers under the int8 leg's name
+    os.environ["AF2_QUANT_KERNEL"] = "force"
+import jax
+import numpy as np
+
+if spec.get("require_tpu") and jax.devices()[0].platform != "tpu":
+    print(json.dumps({"skipped": "leg requires a TPU device",
+                      "platform": jax.devices()[0].platform}))
+    sys.exit(0)
+
+import dataclasses
+import jax.numpy as jnp
+from alphafold2_tpu.models import alphafold2_init
+from alphafold2_tpu.ops.quant import quantize_tree, tree_weight_bytes
+from alphafold2_tpu.serving.pipeline import predict_structure
+from alphafold2_tpu.training import north_star_e2e_config
+
+ecfg, crop, msa_rows = north_star_e2e_config(spec["depth"])
+cfg = dataclasses.replace(ecfg.model, weight_dtype=spec["weight_dtype"])
+# fp32 master init, PTQ as the serving tier would at engine build
+params = alphafold2_init(jax.random.PRNGKey(0), ecfg.model)
+if spec["weight_dtype"] == "int8":
+    params = quantize_tree(params)
+weight_hbm_bytes = tree_weight_bytes(params)
+params = jax.device_put(params)
+
+L = spec.get("len", crop)
+rs = np.random.RandomState(0)
+tokens = jnp.asarray(rs.randint(0, 21, (1, L)), jnp.int32)
+mask = jnp.ones((1, L), bool)
+msa = jnp.asarray(rs.randint(0, 21, (1, msa_rows, L)), jnp.int32)
+msa_mask = jnp.ones((1, msa_rows, L), bool)
+
+def run(params, tokens, mask, msa, msa_mask, key):
+    out = predict_structure(params, cfg, tokens, mask=mask, msa=msa,
+                            msa_mask=msa_mask, rng=key,
+                            mds_iters=25, mds_init="classical")
+    return out["coords"], out["confidence"]
+
+compiled = jax.jit(run).lower(
+    params, tokens, mask, msa, msa_mask, jax.random.PRNGKey(1)).compile()
+c, _ = compiled(params, tokens, mask, msa, msa_mask, jax.random.PRNGKey(1))
+np.asarray(c)  # fetch: dispatch-proof warmup
+iters = spec.get("iters", 3)
+t0 = time.perf_counter()
+for i in range(iters):
+    c, _ = compiled(params, tokens, mask, msa, msa_mask,
+                    jax.random.PRNGKey(2 + i))
+c.block_until_ready()
+dt = (time.perf_counter() - t0) / iters
+assert np.isfinite(np.asarray(c)).all()
+print(json.dumps({"sec_per_iter": round(dt, 3),
+                  "weight_hbm_bytes": weight_hbm_bytes,
+                  "platform": jax.devices()[0].platform}))
+"""
+
+
+# Chip-free quant leg: runs on ANY host (no require_tpu) so the int8
+# arm's residency and quality numbers exist even while the TPU tunnel is
+# unreachable. Three records in one row:
+#   * north-star residency via jax.eval_shape (no params materialized):
+#     weight_hbm_bytes f32 vs int8, full-tree ratio, and the >=3.5x
+#     quantized-tensor ratio the ISSUE 8 acceptance pins (asserted);
+#   * interpret-mode fused-dequant kernel vs the XLA dequant reference
+#     arm on a real (small) model forward — allclose-pinned;
+#   * int8-vs-fp32 quality deltas at the same small shapes: mean
+#     distogram KL and top-L contact precision of the int8 arm scored
+#     against the fp32 arm's contacts. telemetry.check gates these via
+#     the *distogram_kl* (lower) / *contact_precision* (higher) rules.
+QUANT_PARITY_WORKER = r"""
+import json, sys, os
+spec = json.loads(sys.argv[1])
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from alphafold2_tpu.models import (
+    Alphafold2Config, alphafold2_apply, alphafold2_init,
+)
+from alphafold2_tpu.ops.quant import (
+    quantize_tree, quantized_path_bytes, tree_weight_bytes,
+)
+from alphafold2_tpu.training import north_star_e2e_config
+
+out = {"platform": jax.devices()[0].platform}
+
+# 1) residency at the NORTH-STAR preset — pure shape arithmetic
+ecfg, crop, msa_rows = north_star_e2e_config(spec.get("depth", 12))
+shapes = jax.eval_shape(
+    lambda k: alphafold2_init(k, ecfg.model), jax.random.PRNGKey(0))
+qshapes = jax.eval_shape(quantize_tree, shapes)
+before, after = quantized_path_bytes(shapes)
+out["weight_hbm_bytes_f32"] = tree_weight_bytes(shapes)
+out["weight_hbm_bytes_int8"] = tree_weight_bytes(qshapes)
+out["weight_hbm_ratio"] = round(
+    out["weight_hbm_bytes_f32"] / out["weight_hbm_bytes_int8"], 3)
+out["quant_weight_ratio"] = round(before / after, 3)
+assert out["quant_weight_ratio"] >= 3.5, out  # ISSUE 8 acceptance pin
+
+# 2) kernel-vs-XLA parity + int8-vs-fp32 quality at CPU-runnable shapes
+cfg = Alphafold2Config(dim=32, depth=2, heads=2, dim_head=16,
+                       max_seq_len=48, msa_tie_row_attn=True)
+params = alphafold2_init(jax.random.PRNGKey(1), cfg)
+qp = quantize_tree(params)
+rs = np.random.RandomState(0)
+L = 32
+seq = jnp.asarray(rs.randint(0, 21, (1, L)))
+msa = jnp.asarray(rs.randint(0, 21, (1, 4, L)))
+mask = jnp.ones((1, L), bool)
+mmask = jnp.ones((1, 4, L), bool)
+
+def logits_with(p, kernel_env):
+    # eager apply: the dispatch gate re-reads AF2_QUANT_KERNEL per call
+    os.environ["AF2_QUANT_KERNEL"] = kernel_env
+    try:
+        return np.asarray(alphafold2_apply(
+            p, cfg, seq, msa, mask=mask, msa_mask=mmask), np.float32)
+    finally:
+        os.environ.pop("AF2_QUANT_KERNEL", None)
+
+l_f32 = logits_with(params, "off")
+l_krn = logits_with(qp, "force")  # fused-dequant kernel (interpret off-TPU)
+l_xla = logits_with(qp, "off")    # XLA dequant reference arm
+np.testing.assert_allclose(l_krn, l_xla, atol=5e-4)
+out["kernel_vs_xla_max_abs"] = float(np.abs(l_krn - l_xla).max())
+
+def softmax(z):
+    z = z - z.max(-1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(-1, keepdims=True)
+
+p_ref, p_q = softmax(l_f32), softmax(l_krn)
+kl = (p_ref * (np.log(p_ref + 1e-9) - np.log(p_q + 1e-9))).sum(-1)
+# floored at 1e-9: a recorded 0.0 baseline would turn ANY later nonzero
+# KL into an infinite relative change under telemetry.check's
+# lower-better rule — the floor keeps the gate's ratio math finite
+out["distogram_kl"] = max(float(kl.mean()), 1e-9)
+
+# top-L contact precision, int8 arm scored against the fp32 arm: rank
+# pairs (i < j, |i-j| >= 3) by model distance (center_distogram), take
+# each arm's L strongest contacts, precision = overlap / L. Rank-based,
+# so it needs no absolute contact threshold a random-init distogram
+# might never cross.
+from alphafold2_tpu.geometry import center_distogram
+
+def top_contacts(logits):
+    d, _ = center_distogram(jnp.asarray(softmax(logits)))
+    d = np.asarray(d)[0]
+    ii, jj = np.triu_indices(L, k=3)
+    order = np.argsort(d[ii, jj])[:L]
+    return set(zip(ii[order].tolist(), jj[order].tolist()))
+
+ref, got = top_contacts(l_f32), top_contacts(l_krn)
+out["contact_precision"] = round(len(ref & got) / max(len(got), 1), 4)
+print(json.dumps(out))
 """
 
 
@@ -481,6 +661,28 @@ def main():
             continue
         ok, _ = run_and_record(name, OVERLAP_WORKER, [json.dumps(spec)],
                                timeout=1200, extra={"spec": spec})
+        if not ok:
+            sys.exit(3)  # wedged-tunnel code: watchers retry later
+
+    # 1c) int8 weight-quantization legs (ISSUE 8): quant_parity is
+    # chip-free (residency + parity + quality deltas record NOW, on any
+    # host); the quant_int8 on/off A/B times the serving-shaped forward
+    # on TPU only (structured skip elsewhere — never marked done, so the
+    # next healthy chip measures it automatically).
+    for name, spec, worker, timeout in (
+        ("quant_parity", {"depth": args.depth}, QUANT_PARITY_WORKER, 900),
+        ("quant_int8_on",
+         {"depth": args.depth, "weight_dtype": "int8", "require_tpu": True},
+         QUANT_WORKER, 2100),
+        ("quant_int8_off",
+         {"depth": args.depth, "weight_dtype": "f32", "require_tpu": True},
+         QUANT_WORKER, 2100),
+    ):
+        if done_key(name, spec) in done:
+            print(f"skip {name}: already recorded in {OUT}", flush=True)
+            continue
+        ok, _ = run_and_record(name, worker, [json.dumps(spec)],
+                               timeout=timeout, extra={"spec": spec})
         if not ok:
             sys.exit(3)  # wedged-tunnel code: watchers retry later
 

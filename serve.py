@@ -113,6 +113,10 @@ def main():
     ap.add_argument("--dim-head", type=int, default=16)
     ap.add_argument("--ckpt-dir", default=None, help="restore trained params")
     ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--weight-dtype", choices=("f32", "int8"), default="f32",
+                    help="serving weight precision: int8 = per-channel "
+                         "PTQ trunk weights with fused-dequant matmuls "
+                         "(~4x less weight HBM; inference-only arm)")
     ap.add_argument("--max-seq-len", type=int, default=None,
                     help="positional-table size; MUST match the training "
                          "config when restoring (default: largest bucket)")
@@ -155,6 +159,11 @@ def main():
                     help="MDS iterations for the degraded fallback tier; "
                          "-1 = auto (max(1, mds_iters // 4)), 0 = no "
                          "degraded tier (fleet mode)")
+    ap.add_argument("--degraded-weight-dtype", choices=("", "f32", "int8"),
+                    default="",
+                    help="weight precision for the degraded fallback tier "
+                         "(int8 = PTQ trunk weights; fleet mode; composes "
+                         "with --degraded-iters)")
     ap.add_argument("--degrade-depth", type=int, default=0,
                     help="admission-queue depth past which NEW work spills "
                          "to the degraded tier (0 = degraded serves only "
@@ -224,6 +233,9 @@ def main():
         dim_head=args.dim_head,
         max_seq_len=args.max_seq_len or max(64, buckets[-1]),
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        # engine build quantizes at this knob (serving/quant_residency.py);
+        # checkpoints stay fp32 masters — PTQ happens at serve time
+        weight_dtype=args.weight_dtype,
     )
 
     from alphafold2_tpu.models import alphafold2_init
@@ -233,10 +245,18 @@ def main():
         train_state_init,
     )
 
+    # checkpoints hold fp32 MASTER weights whatever the serving precision
+    # arm: restore against the f32 twin of the config (train_state_init
+    # loudly rejects int8 — it is inference-only), then let the engine
+    # quantize at build (serving/quant_residency.py)
+    import dataclasses as _dc
+
+    restore_cfg = _dc.replace(cfg, weight_dtype="f32")
     params, step, _ = restore_params_for_inference(
-        args.ckpt_dir, train_state_init, jax.random.PRNGKey(0), cfg,
+        args.ckpt_dir, train_state_init, jax.random.PRNGKey(0), restore_cfg,
         TrainConfig(),
-        cold_params_fn=lambda: alphafold2_init(jax.random.PRNGKey(0), cfg),
+        cold_params_fn=lambda: alphafold2_init(
+            jax.random.PRNGKey(0), restore_cfg),
     )
     # cache fingerprint: two checkpoints must never share result entries
     params_tag = f"{args.ckpt_dir}@step{step}" if args.ckpt_dir else ""
@@ -300,6 +320,7 @@ def main():
                 default_timeout_s=args.request_timeout,
                 requeue_limit=args.requeue_limit,
                 degraded_mds_iters=degraded_iters,
+                degraded_weight_dtype=args.degraded_weight_dtype,
                 degrade_depth=args.degrade_depth,
                 probe_interval_s=args.probe_interval,
                 reprobe_interval_s=args.reprobe_interval,
@@ -308,9 +329,14 @@ def main():
             injector=injector,
             tracer=tracer,
         )
+        degraded_desc = ", ".join(
+            ([f"mds_iters={degraded_iters}"] if degraded_iters else [])
+            + ([f"weights={args.degraded_weight_dtype}"]
+               if args.degraded_weight_dtype == "int8" else [])
+        )
         print(f"fleet: {args.replicas} replica(s), shared queue "
               f"{args.fleet_queue}, degraded tier "
-              + (f"mds_iters={degraded_iters}" if degraded_iters else "OFF"))
+              + (degraded_desc or "OFF"))
     else:
         engine = ServingEngine(
             params, cfg, serving_cfg,

@@ -811,12 +811,14 @@ def test_fleet_matches_single_engine_bit_exact(tiny_params):
 
 
 def test_config_tag_covers_trunk_schedule_and_fused_gate(tiny_params):
-    """PR 7 satellite: the result LRU / fleet bit-exactness pins key on
-    the config tag, which must never alias results across trunk
-    schedules (fusion-level float association may differ) or across the
-    gated/ungated attention (different math AND params). The tag reprs
-    the full Alphafold2Config, so every new numeric knob lands in it by
-    construction — this pins the two PR-7 knobs explicitly."""
+    """PR 7/8 satellite: the result LRU / AOT executables / fleet
+    bit-exactness pins key on the config tag, which must never alias
+    results across trunk schedules (fusion-level float association may
+    differ), across the gated/ungated attention (different math AND
+    params), or across weight-precision arms (int8 serves rounded
+    weights). The tag reprs the full Alphafold2Config, so every new
+    numeric knob lands in it by construction — this pins the PR-7 knobs
+    and the PR-8 weight_dtype explicitly."""
     import dataclasses as _dc
 
     scfg = serving_cfg(buckets=(8,))
@@ -824,6 +826,7 @@ def test_config_tag_covers_trunk_schedule_and_fused_gate(tiny_params):
     variants = {
         "branch_parallel": _dc.replace(TINY, trunk_schedule="branch_parallel"),
         "gated": _dc.replace(TINY, attn_gate=True),
+        "int8": _dc.replace(TINY, weight_dtype="int8"),
     }
     try:
         tags = {"base": base._config_tag}
@@ -836,3 +839,109 @@ def test_config_tag_covers_trunk_schedule_and_fused_gate(tiny_params):
         assert len(set(tags.values())) == len(tags), tags
     finally:
         base.shutdown(drain=False)
+
+
+# ------------------------------------------- multi-precision residency
+
+
+def test_engine_int8_quantizes_at_build_and_serves(tiny_params):
+    """weight_dtype='int8' (PR 8): the engine places the PTQ tree on
+    device (qw/scale leaves, fewer bytes), reports the per-tag residency
+    in stats() and the serving_weight_bytes gauge, and serves finite
+    structures through the fused-dequant matmul path."""
+    import dataclasses as _dc
+
+    from alphafold2_tpu.ops.quant import is_quantized_linear, iter_linear_dicts
+    from alphafold2_tpu.serving.quant_residency import clear_residency_cache
+
+    clear_residency_cache()
+    scfg = serving_cfg(buckets=(8,), max_batch=2)
+    eng = ServingEngine(tiny_params, _dc.replace(TINY, weight_dtype="int8"),
+                        scfg)
+    try:
+        quantized = [
+            p for p, d in iter_linear_dicts(eng._params)
+            if is_quantized_linear(d)
+        ]
+        assert quantized  # the device tree really is the int8 one
+        res = eng._weight_residency
+        assert res["weight_dtype"] == "int8"
+        assert res["weight_bytes"] < res["fp32_weight_bytes"]
+        r = eng.predict(seq_of(6))
+        assert np.isfinite(r.coords).all() and np.isfinite(r.confidence).all()
+        st = eng.stats()
+        assert st["weights"]["weight_dtype"] == "int8"
+        assert st["weights"]["weight_bytes"] == res["weight_bytes"]
+        gauges = st["telemetry"]["metrics"]["gauges"]
+        wkeys = [k for k in gauges if "serving_weight_bytes" in str(k)]
+        assert wkeys and any(
+            gauges[k] == res["weight_bytes"] for k in wkeys
+        )
+    finally:
+        eng.shutdown(drain=False)
+        clear_residency_cache()
+
+
+def test_residency_cache_shares_quantization_across_replicas(tiny_params):
+    """A fleet builds N engines over ONE master tree: the process-level
+    residency cache must hand every engine after the first the SAME
+    quantized tree (identity, not just equality), and a different master
+    under the same tag must re-quantize instead of serving stale weights."""
+    import dataclasses as _dc
+
+    from alphafold2_tpu.serving.quant_residency import (
+        clear_residency_cache,
+        resident_params,
+    )
+
+    clear_residency_cache()
+    int8_cfg = _dc.replace(TINY, weight_dtype="int8")
+    try:
+        t1, i1 = resident_params(tiny_params, int8_cfg)
+        t2, i2 = resident_params(tiny_params, int8_cfg)
+        assert t2 is t1 and not i1["cached"] and i2["cached"]
+        # fresh master object, same tag -> revalidated, re-quantized
+        other = alphafold2_init(jax.random.PRNGKey(1), TINY)
+        t3, i3 = resident_params(other, int8_cfg)
+        assert t3 is not t1 and not i3["cached"]
+        # a params_tag split keeps two checkpoints apart
+        t4, i4 = resident_params(tiny_params, int8_cfg, params_tag="ckpt-b")
+        assert i4["tag"] != i1["tag"]
+    finally:
+        clear_residency_cache()
+
+
+def test_fleet_degraded_precision_tier(tiny_params):
+    """FleetConfig.degraded_weight_dtype='int8' (PR 8): the degraded
+    tier exists even with degraded_mds_iters=0, serves int8 weights at
+    its OWN config tag (no cross-precision result aliasing), and the
+    full replicas stay fp32."""
+    scfg = serving_cfg(buckets=(8,), max_batch=2)
+    from alphafold2_tpu.serving.quant_residency import clear_residency_cache
+
+    clear_residency_cache()
+    fleet = ServingFleet(
+        tiny_params, TINY, scfg,
+        FleetConfig(replicas=1, probe_interval_s=0,
+                    degraded_weight_dtype="int8"),
+    )
+    try:
+        rep = fleet._replicas["r0"]
+        deg = fleet._degraded_rep
+        assert deg is not None
+        assert deg.engine.model_cfg.weight_dtype == "int8"
+        assert rep.engine.model_cfg.weight_dtype == "f32"
+        assert deg.engine._config_tag != rep.engine._config_tag
+        assert (deg.engine._weight_residency["weight_bytes"]
+                < rep.engine._weight_residency["weight_bytes"])
+        # normal traffic goes to the full-precision replica
+        r = fleet.predict(seq_of(5))
+        assert not r.degraded and np.isfinite(r.coords).all()
+    finally:
+        fleet.shutdown()
+        clear_residency_cache()
+
+
+def test_fleet_config_validates_degraded_weight_dtype():
+    with pytest.raises(ValueError, match="degraded_weight_dtype"):
+        FleetConfig(degraded_weight_dtype="int4")
