@@ -1,7 +1,7 @@
 """af2lint: in-repo static analysis for a JAX codebase that cannot afford
 runtime discovery of statically detectable breakage.
 
-Six passes, each a module in this package:
+Seven passes, each a module in this package:
 
   * ``compat``   — AST linter: no `jax.experimental.*` access and no
                    drift-table symbol outside `alphafold2_tpu/compat.py`
@@ -28,7 +28,11 @@ Six passes, each a module in this package:
                    SP) via `jax.export` and structurally asserts each
                    layer's pair/MSA branches are data-independent before
                    their join marker, with a serialized-twin detector
-                   self-check (schedule_lint.py).
+                   self-check (schedule_lint.py);
+  * ``metrics``  — metric-name drift: every name registered at a
+                   `.counter(`/`.gauge(`/`.histogram(` call site must be
+                   documented in docs/OBSERVABILITY.md's inventory block
+                   and vice versa (metrics_lint.py).
 
 CLI: ``python -m alphafold2_tpu.analysis --strict`` (docs/STATIC_ANALYSIS.md).
 """
@@ -82,6 +86,12 @@ def _run_schedule(root, files=None, **_):
     return run(root, files=files)
 
 
+def _run_metrics(root, files=None, **_):
+    from alphafold2_tpu.analysis.metrics_lint import run
+
+    return run(root, files=files)
+
+
 # name -> runner(root, files=..., axes=...) -> list[Finding]
 PASSES = {
     "compat": _run_compat,
@@ -90,11 +100,14 @@ PASSES = {
     "smoke": _run_smoke,
     "overlap": _run_overlap,
     "schedule": _run_schedule,
+    "metrics": _run_metrics,
 }
 
 # passes that verify whole programs rather than the given files: dropped
-# from file-scoped invocations unless explicitly selected
-_REPO_WIDE = ("smoke", "overlap", "schedule")
+# from file-scoped invocations unless explicitly selected ("metrics"
+# rides here for its docs side: a one-file invocation cannot judge
+# whether a documented name is registered ELSEWHERE)
+_REPO_WIDE = ("smoke", "overlap", "schedule", "metrics")
 
 
 def run_passes(root, select=None, files=None, axes=None):

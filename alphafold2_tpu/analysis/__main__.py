@@ -18,7 +18,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m alphafold2_tpu.analysis",
         description="af2lint: JAX-aware static analysis "
-        "(compat / trace / sharding / smoke)",
+        "(compat / trace / sharding / smoke / overlap / schedule / "
+        "metrics)",
     )
     ap.add_argument(
         "paths",

@@ -1,0 +1,199 @@
+"""af2lint pass 7 "metrics": metric names vs docs/OBSERVABILITY.md.
+
+The operations plane made metric names an API: dashboards scrape them,
+the SLO engine selects on them, and the runbook (docs/OPERATIONS.md)
+keys diagnostics off them. Nothing enforced the contract — rename a
+counter and every consumer silently reads zero. This pass makes the
+drift static:
+
+  * every metric name registered with a STRING LITERAL at a
+    `.counter(` / `.gauge(` / `.histogram(` call site in
+    `alphafold2_tpu/` must appear in the metric inventory block of
+    docs/OBSERVABILITY.md (METRICS001);
+  * every name in the inventory must be registered somewhere
+    (METRICS002) — a deleted metric must leave the docs with it;
+  * the inventory block itself must exist, fenced by
+    ``<!-- af2lint:metrics:begin -->`` / ``<!-- af2lint:metrics:end -->``
+    markers (METRICS003).
+
+Dynamic names (f-strings like `CompileTracker`'s ``f"{prefix}_total"``)
+cannot be resolved statically; they become suffix WILDCARDS
+(``*_total``) that vouch for matching inventory entries — so
+`serving_compile_last_seconds` is documentable even though no literal
+registers it — but are exempt from METRICS001 themselves. A wildcard
+whose literal part is too short to be distinctive (``*_total`` would
+match MOST counters, making METRICS002 vacuous) vouches only for names
+it forms with a literal ``prefix="..."`` kwarg collected from the same
+scope — the `CompileTracker(prefix="serving_compile")` idiom.
+
+Scope: the `alphafold2_tpu` package minus `analysis/` (the linter's own
+smoke fixtures register throwaway names) and minus tests. Suppress a
+deliberate internal-only metric with ``# af2lint: disable=METRICS001``.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+from alphafold2_tpu.analysis.common import (
+    Finding,
+    filter_suppressed,
+    iter_py_files,
+    parse_file,
+    rel,
+    suppressed_lines,
+)
+
+PASS = "metrics"
+DOC_PATH = Path("docs") / "OBSERVABILITY.md"
+BEGIN_MARK = "<!-- af2lint:metrics:begin -->"
+END_MARK = "<!-- af2lint:metrics:end -->"
+
+_REGISTRY_METHODS = ("counter", "gauge", "histogram")
+#: a metric row: the FIRST backticked token of a table line — later
+#: cells backtick label names, which are not metric names
+_DOC_NAME_RE = re.compile(
+    r"^\|\s*`([a-zA-Z_:][a-zA-Z0-9_:]*)`", re.MULTILINE
+)
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def doc_inventory(root) -> Tuple[Optional[set], int]:
+    """(documented names, marker line) from the OBSERVABILITY.md
+    inventory block; (None, 0) when the markers are missing."""
+    path = Path(root) / DOC_PATH
+    try:
+        text = path.read_text()
+    except OSError:
+        return None, 0
+    begin = text.find(BEGIN_MARK)
+    end = text.find(END_MARK)
+    if begin < 0 or end < 0 or end < begin:
+        return None, 0
+    line = text[:begin].count("\n") + 1
+    block = text[begin:end]
+    names = {
+        m for m in _DOC_NAME_RE.findall(block) if _NAME_RE.match(m)
+    }
+    return names, line
+
+
+def _literal_or_pattern(node) -> Tuple[Optional[str], Optional[str]]:
+    """(literal_name, wildcard_pattern) for a metric-name argument node:
+    a Constant str is a literal; a JoinedStr maps each interpolation to
+    `*`; anything else is unresolvable (None, None)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, None
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("*")
+        return None, "".join(parts)
+    return None, None
+
+
+#: a wildcard's non-`*` part must be at least this long to vouch on its
+#: own — `*_total` (6 literal chars) matches most counters and would
+#: make the stale-docs direction vacuous; `*_last_seconds` (13) is a
+#: distinctive dynamic family
+_MIN_DISTINCTIVE_LITERAL = 8
+
+
+def collect_call_sites(root, files=None):
+    """(literals, patterns, prefixes): metric names registered in the
+    package. literals = [(name, path, line, suppressed)]; patterns =
+    [wildcard, ...]; prefixes = literal `prefix="..."` kwarg values (the
+    dynamic-name-factory idiom, used to anchor short wildcards)."""
+    root = Path(root)
+    pkg = root / "alphafold2_tpu"
+    literals, patterns, prefixes = [], [], set()
+    for path in iter_py_files(root, files):
+        p = Path(path)
+        parts = p.parts
+        if "tests" in parts:
+            continue
+        try:
+            inside = p.resolve().is_relative_to(pkg.resolve())
+        except AttributeError:  # py<3.9 has no is_relative_to
+            inside = str(pkg) in str(p.resolve())
+        if not inside or "analysis" in parts:
+            continue
+        src, tree = parse_file(p)
+        if tree is None:
+            continue
+        supp = suppressed_lines(src)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if (kw.arg == "prefix"
+                        and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)):
+                    prefixes.add(kw.value.value)
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REGISTRY_METHODS
+                    and node.args):
+                continue
+            name, pattern = _literal_or_pattern(node.args[0])
+            if name is not None:
+                literals.append((name, p, node.lineno, supp))
+            elif pattern is not None and "*" in pattern:
+                patterns.append(pattern)
+    return literals, patterns, prefixes
+
+
+def _vouched(name: str, patterns, prefixes) -> bool:
+    """Whether a documented-but-not-literally-registered name is covered
+    by a dynamic call site: distinctive wildcards match directly; short
+    wildcards only through a collected `prefix=` literal."""
+    for pat in patterns:
+        literal = pat.replace("*", "")
+        if len(literal) >= _MIN_DISTINCTIVE_LITERAL:
+            if fnmatch.fnmatch(name, pat):
+                return True
+        elif any(fnmatch.fnmatch(name, pat.replace("*", p, 1))
+                 for p in prefixes):
+            return True
+    return False
+
+
+def run(root, files: Optional[Sequence] = None) -> List[Finding]:
+    documented, doc_line = doc_inventory(root)
+    if documented is None:
+        return [Finding(
+            PASS, "METRICS003", str(DOC_PATH), 1,
+            f"metric inventory block not found: expected {BEGIN_MARK!r} "
+            f"... {END_MARK!r} markers in docs/OBSERVABILITY.md",
+        )]
+    literals, patterns, prefixes = collect_call_sites(root, files)
+    findings: List[Finding] = []
+    seen = set()
+    for name, path, line, supp in literals:
+        seen.add(name)
+        if name not in documented:
+            findings.extend(filter_suppressed([Finding(
+                PASS, "METRICS001", rel(path, root), line,
+                f"metric {name!r} is registered here but missing from the "
+                f"docs/OBSERVABILITY.md inventory — document it (or "
+                f"suppress an internal-only metric)",
+            )], supp))
+    # files-scoped invocations see only a slice of the call sites; the
+    # documented-but-unused direction is only meaningful repo-wide
+    if files is None:
+        for name in sorted(documented - seen):
+            if _vouched(name, patterns, prefixes):
+                continue  # vouched for by a dynamic-prefix call site
+            findings.append(Finding(
+                PASS, "METRICS002", str(DOC_PATH), doc_line,
+                f"documented metric {name!r} is never registered by any "
+                f"counter()/gauge()/histogram() call site — stale docs "
+                f"or a renamed metric",
+            ))
+    return findings

@@ -48,8 +48,16 @@ class CircuitState(str, enum.Enum):
 
 
 class CircuitBreaker:
+    """Args beyond the state-machine knobs: `on_open` is an optional
+    callback invoked with `snapshot()` each time the circuit transitions
+    to OPEN (a fresh trip or a failed half-open probe re-opening) — the
+    flight recorder's incident seam (telemetry/ops_plane.py). It runs
+    OUTSIDE the breaker lock on the thread that recorded the failure;
+    exceptions are printed and swallowed (observability must never wedge
+    the dispatch path)."""
+
     def __init__(self, threshold: int, reset_s: float, clock=time.monotonic,
-                 jitter: float = 0.0, seed: int = 0):
+                 jitter: float = 0.0, seed: int = 0, on_open=None):
         if threshold < 1:
             raise ValueError(f"threshold must be >= 1, got {threshold}")
         if reset_s < 0:
@@ -59,6 +67,7 @@ class CircuitBreaker:
         self.threshold = threshold
         self.reset_s = reset_s
         self.jitter = jitter
+        self.on_open = on_open
         # seeded, per-instance: two breakers with different seeds draw
         # different delay sequences; the same seed replays exactly
         self._rng = random.Random(seed)
@@ -109,17 +118,27 @@ class CircuitBreaker:
             self._probe_in_flight = False
 
     def record_failure(self):
+        opened = False
         with self._lock:
             now = self._clock()
             if self._state is CircuitState.HALF_OPEN:
                 # the probe failed: back to open for a fresh window
                 self._open(now)
                 self._probe_in_flight = False
+                opened = True
             elif self._state is CircuitState.CLOSED:
                 self._failures += 1
                 if self._failures >= self.threshold:
                     self._open(now)
+                    opened = True
             # already open: stragglers from pre-trip dispatches are no news
+        if opened and self.on_open is not None:
+            try:
+                self.on_open(self.snapshot())
+            except Exception:  # noqa: BLE001 — see class docstring
+                import traceback
+
+                traceback.print_exc()
 
     def abandon_probe(self):
         """The admitted half-open probe never produced a dispatch outcome

@@ -71,7 +71,7 @@ from alphafold2_tpu.serving.errors import (
 from alphafold2_tpu.serving.metrics import ServingMetrics
 from alphafold2_tpu.serving.pipeline import predict_structure
 from alphafold2_tpu.serving.quant_residency import resident_params
-from alphafold2_tpu.telemetry import NULL_TRACER
+from alphafold2_tpu.telemetry import NULL_TRACER, new_trace_id
 
 
 @dataclasses.dataclass(frozen=True)
@@ -157,13 +157,17 @@ class PredictionResult:
     replica: str = ""         # fleet: serving replica name
     degraded: bool = False    # fleet: served by the degraded tier
     requeues: int = 0         # fleet: replica failovers survived
+    trace_id: str = ""        # request trace id: grep it in span exports /
+    #                           flight-recorder bundles to reconstruct this
+    #                           request's whole cross-replica life
 
 
 class ServingRequest:
     """Client handle: a future resolved by the scheduler worker."""
 
     def __init__(self, seq: str, tokens: np.ndarray, msa, msa_mask,
-                 cache_key: str, bucket: int, deadline: Optional[float]):
+                 cache_key: str, bucket: int, deadline: Optional[float],
+                 trace_id: str = ""):
         self.seq = seq
         self.tokens = tokens
         self.msa = msa
@@ -171,6 +175,7 @@ class ServingRequest:
         self.cache_key = cache_key
         self.bucket = bucket
         self.deadline = deadline
+        self.trace_id = trace_id or new_trace_id()
         self.submitted_at = time.monotonic()
         self._event = threading.Event()
         self._lock = threading.Lock()
@@ -277,11 +282,22 @@ class ServingEngine:
         serving.batch / serving_compile / serving.execute /
         serving.respond (worker thread). None (production default) wires
         the no-op NULL_TRACER: one boolean test per phase, no records.
+        Per-request spans carry `trace_id`; multi-request spans carry the
+        `trace_ids` list (docs/OBSERVABILITY.md).
+      replica_name: fleet identity stamped as a `replica` attribute on
+        every serving span, so a shared fleet tracer attributes each span
+        to the replica that recorded it ("" = single-engine, no tag).
+      incident_hook: optional `fn(kind, **attrs)` called when a
+        reliability seam trips — `breaker_open` (circuit transitioned to
+        open) and `watchdog_fire` (hung-batch watchdog) — the flight
+        recorder's `incident` method plugs in here
+        (telemetry/ops_plane.py). Exceptions from the hook are swallowed
+        with a traceback: observability must never take the engine down.
     """
 
     def __init__(self, params, model_cfg, cfg: ServingConfig = ServingConfig(),
                  *, model_apply_fn=None, metrics_logger=None, fault_hook=None,
-                 tracer=None):
+                 tracer=None, replica_name: str = "", incident_hook=None):
         self._ladder = BucketLadder(cfg.buckets)
         if self._ladder.max_len > model_cfg.max_seq_len:
             raise ValueError(
@@ -326,10 +342,14 @@ class ServingEngine:
         self._batch_counter = 0
         self._fault_hook = fault_hook
         self._dispatch_counter = 0  # worker-thread only (the chaos clock)
+        self.replica_name = replica_name
+        self._span_tags = {"replica": replica_name} if replica_name else {}
+        self._incident_hook = incident_hook
         self._breaker = (
             CircuitBreaker(cfg.breaker_threshold, cfg.breaker_reset_s,
                            jitter=cfg.breaker_jitter,
-                           seed=cfg.breaker_jitter_seed)
+                           seed=cfg.breaker_jitter_seed,
+                           on_open=self._on_breaker_open)
             if cfg.breaker_threshold else None
         )
 
@@ -367,25 +387,38 @@ class ServingEngine:
     # ------------------------------------------------------------------ API
 
     def submit(self, seq: str, *, msa=None, msa_mask=None,
-               timeout: Optional[float] = None) -> ServingRequest:
+               timeout: Optional[float] = None,
+               trace_id: str = "") -> ServingRequest:
         """Enqueue one sequence; returns immediately with a future.
+
+        `trace_id` correlates every span/result of this request; "" mints
+        a fresh one (the fleet passes the id it minted at ITS front door,
+        so a requeued request keeps one id across replicas).
 
         Raises EngineClosedError / InvalidSequenceError /
         RequestTooLongError / QueueFullError / CircuitOpenError
         synchronously — a rejected request never occupies queue capacity.
         """
+        trace_id = trace_id or new_trace_id()
         # the span wraps validation + cache/coalesce lookup + enqueue; a
         # rejection exits it with an `error` attribute, so the trace shows
         # rejected submissions as first-class lifecycle events
         with self._tracer.span("serving.enqueue", cat="serving",
-                               length=len(seq)) as sp:
+                               length=len(seq), trace_id=trace_id,
+                               **self._span_tags) as sp:
             req = self._submit(seq, msa=msa, msa_mask=msa_mask,
-                               timeout=timeout)
+                               timeout=timeout, trace_id=trace_id)
             sp.set("bucket", req.bucket)
+            if req.trace_id != trace_id:
+                # coalesced onto an identical in-flight request: the
+                # shared future keeps the FIRST submitter's id — record
+                # the attachment so this submitter's id still resolves
+                sp.set("coalesced_onto", req.trace_id)
             return req
 
     def _submit(self, seq: str, *, msa=None, msa_mask=None,
-                timeout: Optional[float] = None) -> ServingRequest:
+                timeout: Optional[float] = None,
+                trace_id: str = "") -> ServingRequest:
         if self._closed:
             self._reject(EngineClosedError("engine is shut down"))
         seq = seq.strip().upper()
@@ -443,11 +476,13 @@ class ServingEngine:
             self.metrics.inc("completed")
             self.metrics.latency.observe(0.0)
             req = ServingRequest(seq, tokens, msa_arr, msa_mask, key, bucket,
-                                 deadline=None)
+                                 deadline=None, trace_id=trace_id)
             # array aliasing with the cache entry is fine here: result()
-            # copies on every read, so clients can never reach it
+            # copies on every read, so clients can never reach it. The
+            # trace id is THIS request's, not the computing request's —
+            # a cache hit is a lifecycle event of the hitting request.
             req._finish(result=dataclasses.replace(
-                cached, from_cache=True, latency_s=0.0,
+                cached, from_cache=True, latency_s=0.0, trace_id=trace_id,
             ))
             return req
 
@@ -473,7 +508,7 @@ class ServingEngine:
                     f"after {self.cfg.breaker_reset_s}s"
                 ))
             req = ServingRequest(seq, tokens, msa_arr, msa_mask, key, bucket,
-                                 deadline)
+                                 deadline, trace_id=trace_id)
             # count submitted BEFORE the worker can possibly complete the
             # request — counting after enqueue lets a stats() reader see
             # completed > submitted (negative in_flight) transiently
@@ -513,6 +548,23 @@ class ServingEngine:
         self.metrics.inc_error(exc)
         raise exc from None
 
+    def _incident(self, kind: str, **attrs):
+        """Report one reliability incident to the hook (flight recorder).
+        A raising hook is reported and swallowed: observability must
+        never take the serving path down with it."""
+        if self._incident_hook is None:
+            return
+        try:
+            self._incident_hook(kind, replica=self.replica_name, **attrs)
+        except Exception:  # noqa: BLE001 — see docstring
+            import traceback
+
+            traceback.print_exc()
+
+    def _on_breaker_open(self, snapshot: dict):
+        """CircuitBreaker on_open callback (called outside its lock)."""
+        self._incident("breaker_open", **snapshot)
+
     def predict(self, seq: str, *, msa=None, msa_mask=None,
                 timeout: Optional[float] = None) -> PredictionResult:
         """Synchronous convenience: submit + block for the result."""
@@ -532,6 +584,27 @@ class ServingEngine:
         backlog_batches = 1 + self._queue.qsize() // self.cfg.max_batch
         est = self.cfg.max_wait_s + per_batch * backlog_batches
         return float(min(60.0, max(0.05, est)))
+
+    def health(self) -> dict:
+        """Cheap liveness payload for `/healthz` (telemetry/ops_plane.py):
+        no engine stats, no model touch. `status` is "ok" (serving),
+        "degraded" (up but fast-rejecting: breaker not closed), or
+        "down" (closed or worker dead — the HTTP layer maps it to 503)."""
+        alive = self._worker.is_alive()
+        status = "ok" if (not self._closed and alive) else "down"
+        out = {
+            "status": status,
+            "closed": self._closed,
+            "worker_alive": alive,
+            "queue_depth": self._queue.qsize(),
+            "queue_capacity": self.cfg.max_queue,
+        }
+        if self._breaker is not None:
+            snap = self._breaker.snapshot()
+            out["breaker"] = snap["state"]
+            if status == "ok" and snap["state"] != "closed":
+                out["status"] = "degraded"
+        return out
 
     def stats(self) -> dict:
         """JSON-ready health/stats snapshot."""
@@ -666,7 +739,8 @@ class ServingEngine:
             return exe(self._params, tokens, mask, key, msa, msa_mask)
         return exe(self._params, tokens, mask, key)
 
-    def _dispatch(self, bucket: int, tokens, mask, msa=None, msa_mask=None):
+    def _dispatch(self, bucket: int, tokens, mask, msa=None, msa_mask=None,
+                  trace_ids=()):
         """One guarded dispatch: the chaos fault hook plus the optional
         hung-batch watchdog around `_call_executable`.
 
@@ -687,9 +761,16 @@ class ServingEngine:
             # the execute span covers device dispatch + (first-call)
             # compile; compile time is separately visible under the
             # nested `serving_compile` span, so execute-minus-compile is
-            # readable straight off the trace
-            with self._tracer.span("serving.execute", cat="serving",
-                                   bucket=bucket, dispatch=idx):
+            # readable straight off the trace. bind_trace stamps the
+            # batch ids onto that nested span too (CompileTracker never
+            # heard of requests) — on whichever thread call() runs, so a
+            # bundle grep for a victim's id finds the 30s compile that
+            # actually delayed it
+            with self._tracer.bind_trace(list(trace_ids)), \
+                    self._tracer.span("serving.execute", cat="serving",
+                                      bucket=bucket, dispatch=idx,
+                                      trace_ids=list(trace_ids),
+                                      **self._span_tags):
                 return self._call_executable(
                     bucket, tokens, mask, msa, msa_mask
                 )
@@ -712,6 +793,8 @@ class ServingEngine:
             target=runner, daemon=True, name=f"serving-dispatch-{idx}"
         ).start()
         if not done.wait(timeout):
+            self._incident("watchdog_fire", bucket=bucket, dispatch=idx,
+                           timeout_s=timeout, trace_ids=list(trace_ids))
             raise HungBatchError(
                 f"dispatch {idx} (bucket {bucket}) exceeded the {timeout}s "
                 f"hung-batch watchdog; call abandoned"
@@ -855,9 +938,12 @@ class ServingEngine:
             for req in live:
                 self._tracer.add("serving.queue_wait",
                                  now - req.submitted_at, cat="serving",
-                                 bucket=bucket)
+                                 bucket=bucket, trace_id=req.trace_id,
+                                 **self._span_tags)
         with self._tracer.span("serving.batch", cat="serving", bucket=bucket,
-                               n=len(live)):
+                               n=len(live),
+                               trace_ids=[r.trace_id for r in live],
+                               **self._span_tags):
             self._run_live(bucket, live, allow_split)
 
     def _run_live(self, bucket: int, live, allow_split: bool):
@@ -872,7 +958,8 @@ class ServingEngine:
             msa = msa_mask = None
             if self.cfg.msa_rows:
                 msa, msa_mask = self._pad_msa_batch(live, bucket)
-            out = self._dispatch(bucket, tokens, mask, msa, msa_mask)
+            out = self._dispatch(bucket, tokens, mask, msa, msa_mask,
+                                 trace_ids=[r.trace_id for r in live])
             coords = np.asarray(out["coords"])
             conf = np.asarray(out["confidence"])
             stress = np.asarray(out["stress"])
@@ -908,7 +995,9 @@ class ServingEngine:
             self._breaker.record_success()
         done_at = time.monotonic()
         with self._tracer.span("serving.respond", cat="serving",
-                               bucket=bucket, n=len(live)):
+                               bucket=bucket, n=len(live),
+                               trace_ids=[r.trace_id for r in live],
+                               **self._span_tags):
             self._respond(bucket, live, coords, conf, stress, n_real,
                           done_at)
 
@@ -926,6 +1015,8 @@ class ServingEngine:
                 bucket=bucket,
                 from_cache=False,
                 latency_s=done_at - req.submitted_at,
+                replica=self.replica_name,
+                trace_id=req.trace_id,
             )
             # the cached entry and the resolved result may share arrays:
             # clients only ever see result() copies

@@ -82,7 +82,7 @@ from alphafold2_tpu.serving.errors import (
     RequeueLimitError,
     ServingError,
 )
-from alphafold2_tpu.telemetry import NULL_TRACER, MetricRegistry
+from alphafold2_tpu.telemetry import NULL_TRACER, MetricRegistry, new_trace_id
 
 #: replica errors that justify trying ANOTHER replica — the replica (not
 #: the request) is the suspect. Everything else is terminal for the
@@ -146,13 +146,17 @@ class FleetRequest:
     `enqueued_at`); `requeues` counts replica failovers survived."""
 
     def __init__(self, seq: str, msa, msa_mask, priority: int,
-                 deadline: Optional[float]):
+                 deadline: Optional[float], trace_id: str = ""):
         self.seq = seq
         self.msa = msa
         self.msa_mask = msa_mask
         self.priority = priority
         self.deadline = deadline
         self.enqueued_at = time.monotonic()
+        # minted HERE (the fleet front door) and handed to every engine
+        # submit this request makes — admission queueing, routing, and
+        # requeues onto other replicas all carry ONE id
+        self.trace_id = trace_id or new_trace_id()
         self.requeues = 0
         self.failed_on = set()   # replica names this request failed on
         self.last_error: Optional[BaseException] = None
@@ -194,6 +198,7 @@ class FleetRequest:
             replica=self._meta.get("replica", ""),
             degraded=self._meta.get("degraded", False),
             requeues=self.requeues,
+            trace_id=self.trace_id,
         )
 
 
@@ -228,13 +233,19 @@ class ServingFleet:
       tracer / registry: fleet-level telemetry (replica engines keep
         their own `ServingMetrics`; the fleet registry carries the
         fleet_* metric families).
+      incident_hook: optional `fn(kind, **attrs)` — the flight-recorder
+        seam (telemetry/ops_plane.py). The fleet reports
+        `replica_drain` itself and threads the hook into every
+        default-factory engine (breaker_open / watchdog_fire); custom
+        `engine_factory` callers wire their own engines.
     """
 
     def __init__(self, params, model_cfg,
                  serving_cfg: ServingConfig = ServingConfig(),
                  fleet_cfg: FleetConfig = FleetConfig(), *,
                  engine_factory=None, model_apply_fn=None, injector=None,
-                 tracer=None, registry: Optional[MetricRegistry] = None):
+                 tracer=None, registry: Optional[MetricRegistry] = None,
+                 incident_hook=None):
         self.cfg = fleet_cfg
         self._params = params
         self._model_cfg = model_cfg
@@ -244,6 +255,7 @@ class ServingFleet:
         self._ladder = BucketLadder(serving_cfg.buckets)
         self._tracer = tracer if tracer is not None else NULL_TRACER
         self.registry = registry if registry is not None else MetricRegistry()
+        self._incident_hook = incident_hook
         self._factory = engine_factory or self._default_factory
 
         self._lock = threading.Lock()
@@ -337,6 +349,7 @@ class ServingFleet:
             self._params, model_cfg, cfg,
             model_apply_fn=self._model_apply_fn,
             fault_hook=fault_hook, tracer=self._tracer,
+            replica_name=name, incident_hook=self._incident_hook,
         )
 
     def _make_factory(self, name, cfg):
@@ -357,16 +370,22 @@ class ServingFleet:
 
     def submit(self, seq: str, *, msa=None, msa_mask=None,
                timeout: Optional[float] = None,
-               priority="normal") -> FleetRequest:
+               priority="normal", trace_id: str = "") -> FleetRequest:
         """Enqueue one sequence at the fleet front door; returns a future.
+
+        `trace_id` ("" mints one) correlates every span this request
+        touches — across the admission queue, the dispatcher, requeues,
+        and every replica engine — and rides the result for log/trace
+        cross-reference.
 
         Raises EngineClosedError / InvalidSequenceError /
         RequestTooLongError / QueueFullError(retry_after_s) synchronously.
         A lower-priority queued request may be EVICTED (resolved with a
         retry-after error) to admit a higher-priority one.
         """
+        trace_id = trace_id or new_trace_id()
         with self._tracer.span("fleet.enqueue", cat="fleet",
-                               length=len(seq)):
+                               length=len(seq), trace_id=trace_id):
             if self._closed:
                 raise EngineClosedError("fleet is shut down")
             seq = seq.strip().upper()
@@ -383,7 +402,8 @@ class ServingFleet:
             ttl = (self.cfg.default_timeout_s if timeout is None else timeout)
             deadline = (time.monotonic() + ttl) if ttl is not None else None
             entry = FleetRequest(seq, msa, msa_mask,
-                                 resolve_priority(priority), deadline)
+                                 resolve_priority(priority), deadline,
+                                 trace_id=trace_id)
             self._counts["submitted"].inc()
             try:
                 evicted = self._admission.offer(entry)
@@ -420,6 +440,34 @@ class ServingFleet:
         """Synchronous convenience: submit + block for the result."""
         return self.submit(seq, msa=msa, msa_mask=msa_mask, timeout=timeout,
                            priority=priority).result()
+
+    def health(self) -> dict:
+        """Cheap liveness payload for `/healthz` (telemetry/ops_plane.py):
+        HealthMonitor states + replica-up view, no engine stats. `status`
+        is "ok" (all replicas healthy), "degraded" (reduced capacity:
+        some replicas down, or only the degraded tier is serving), or
+        "down" (closed, or nothing can serve — mapped to HTTP 503)."""
+        snap = self._health.snapshot()
+        states = {name: t["state"] for name, t in snap["targets"].items()}
+        n_healthy = sum(1 for s in states.values() if s == "healthy")
+        with self._lock:
+            has_degraded = self._degraded_rep is not None
+        if self._closed or (n_healthy == 0 and not has_degraded):
+            status = "down"
+        elif n_healthy < len(states):
+            status = "degraded"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "closed": self._closed,
+            "replicas": states,
+            "healthy_replicas": n_healthy,
+            "total_replicas": len(states),
+            "degraded_tier": has_degraded,
+            "queue_depth": self._admission.depth(),
+            "queue_capacity": self.cfg.queue_capacity,
+        }
 
     def stats(self) -> dict:
         """JSON-ready fleet snapshot: terminal counters, admission queue,
@@ -530,7 +578,9 @@ class ServingFleet:
         self._queue_wait.observe(wait)
         if self._tracer.enabled:
             self._tracer.add("fleet.queue_wait", wait, cat="fleet",
-                             priority=entry.priority)
+                             priority=entry.priority,
+                             trace_id=entry.trace_id,
+                             requeues=entry.requeues)
         overloaded = (self.cfg.degrade_depth > 0
                       and self._admission.depth() >= self.cfg.degrade_depth)
         healthy = self._health.healthy_targets()
@@ -597,12 +647,19 @@ class ServingFleet:
                 retry_after_s=self._admission.retry_after_s()))
             return True
         try:
-            inner = engine.submit(
-                entry.seq, msa=entry.msa, msa_mask=entry.msa_mask,
-                # None would fall back to the ENGINE's default deadline;
-                # a deadline-less fleet request must stay deadline-less
-                timeout=remaining if remaining is not None else 1e9,
-            )
+            # bind_trace: any span a helper records on the dispatcher
+            # thread during THIS routing inherits the request's id
+            with self._tracer.bind_trace(entry.trace_id):
+                inner = engine.submit(
+                    entry.seq, msa=entry.msa, msa_mask=entry.msa_mask,
+                    # None would fall back to the ENGINE's default
+                    # deadline; a deadline-less fleet request must stay
+                    # deadline-less
+                    timeout=remaining if remaining is not None else 1e9,
+                    # the fleet's id, not a fresh engine-minted one: a
+                    # requeued request keeps one id across replicas
+                    trace_id=entry.trace_id,
+                )
         except QueueFullError:
             return False
         except (CircuitOpenError, EngineClosedError) as e:
@@ -750,6 +807,13 @@ class ServingFleet:
             rep = self._replicas[name]
             engine, rep.engine = rep.engine, None
         self._up_gauges[name].set(0)
+        if self._incident_hook is not None:
+            try:
+                self._incident_hook("replica_drain", replica=name,
+                                    reason=reason)
+            except Exception:  # noqa: BLE001 — observability must never
+                # take the supervisor down
+                traceback.print_exc()
         if engine is not None:
             engine.shutdown(drain=False, timeout=self.cfg.drain_timeout_s)
 

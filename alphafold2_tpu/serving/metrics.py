@@ -195,8 +195,9 @@ class ServingMetrics:
             - counts["failed"] - counts["timed_out"]
         )
         latency = self.latency.snapshot()
-        latency.pop("sum", None)  # lifetime sum is exposition detail, not
-        #                           part of the health-check payload shape
+        latency.pop("sum", None)      # lifetime sum and cumulative buckets
+        latency.pop("buckets", None)  # are exposition detail (/metrics has
+        #                               them), not health-check payload shape
         return {
             "uptime_s": uptime,
             "requests": {**counts, "in_flight": in_flight},

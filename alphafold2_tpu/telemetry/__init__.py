@@ -11,10 +11,17 @@ scaling results):
                   here now.
   * `logger`    — `MetricsLogger`, the step-cadence JSONL stream
                   (migrated from utils/observability.py).
-  * `profiling` — compile-event tracking, device-memory / analytic-FLOPs
-                  gauges, the jax.profiler `profile_trace` wrapper.
+  * `profiling` — compile-event tracking, device-memory / host-memory /
+                  analytic-FLOPs gauges, the jax.profiler `profile_trace`
+                  wrapper.
   * `check`     — perf-regression gate CLI
                   (`python -m alphafold2_tpu.telemetry.check`).
+  * `slo`       — declarative SLO objectives evaluated as fast/slow
+                  burn rates over registry deltas; alerts land back in
+                  the registry and in a structured event log.
+  * `ops_plane` — the LIVE operations plane: stdlib HTTP server
+                  (`/metrics`, `/healthz`, `/statusz`) + the incident
+                  flight recorder (`serve.py --ops-port/--flight-dir`).
 
 Everything is disabled-by-default at the call sites: an engine or
 trainer built without a tracer/registry runs the shared no-op singletons
@@ -25,10 +32,17 @@ names, how to open traces, how the gate reads baselines).
 """
 
 from alphafold2_tpu.telemetry.logger import MetricsLogger
+from alphafold2_tpu.telemetry.ops_plane import (
+    FlightRecorder,
+    OpsServer,
+    ops_server_for_engine,
+    ops_server_for_fleet,
+)
 from alphafold2_tpu.telemetry.profiling import (
     CompileTracker,
     device_memory_gauges,
     flops_gauges,
+    host_memory_gauges,
     profile_trace,
 )
 from alphafold2_tpu.telemetry.registry import (
@@ -41,7 +55,13 @@ from alphafold2_tpu.telemetry.registry import (
     flatten_snapshot,
     parse_prometheus_text,
 )
-from alphafold2_tpu.telemetry.trace import NULL_TRACER, Tracer
+from alphafold2_tpu.telemetry.slo import (
+    SloConfig,
+    SloEngine,
+    SloObjective,
+    default_slo_config,
+)
+from alphafold2_tpu.telemetry.trace import NULL_TRACER, Tracer, new_trace_id
 
 
 def add_telemetry_args(ap):
@@ -79,6 +99,7 @@ def finish_trace(tracer: Tracer, args):
 __all__ = [
     "CompileTracker",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "LatencyHistogram",
@@ -86,12 +107,21 @@ __all__ = [
     "MetricsLogger",
     "NULL_REGISTRY",
     "NULL_TRACER",
+    "OpsServer",
+    "SloConfig",
+    "SloEngine",
+    "SloObjective",
     "Tracer",
     "add_telemetry_args",
+    "default_slo_config",
     "device_memory_gauges",
     "finish_trace",
     "flatten_snapshot",
     "flops_gauges",
+    "host_memory_gauges",
+    "new_trace_id",
+    "ops_server_for_engine",
+    "ops_server_for_fleet",
     "parse_prometheus_text",
     "profile_trace",
     "tracer_from_args",

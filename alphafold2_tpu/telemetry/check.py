@@ -62,6 +62,15 @@ _RULES: Tuple[Tuple[str, str, float], ...] = (
     ("*.sum", "ignore", 0.0),
     ("*total*", "ignore", 0.0),
     ("*uptime*", "ignore", 0.0),
+    # cumulative histogram-bucket counters (registry snapshots flatten
+    # them under ...buckets.<le>): traffic volume, and their names carry
+    # the parent histogram's *_seconds* — without this rule they would
+    # gate as latencies
+    ("*buckets*", "ignore", 0.0),
+    # SLO verdict metrics (slo_burn_rate / slo_alert_active): operational
+    # state, not run speed — two runs of different length or chaos plans
+    # legitimately differ
+    ("*slo_*", "ignore", 0.0),
     # raw residency byte counts are static configuration properties, not
     # run speed; the RATIO below is the gated residency metric
     ("*weight_hbm_bytes*", "ignore", 0.0),

@@ -1,0 +1,448 @@
+"""The live operations plane: HTTP observability + incident flight recorder.
+
+Everything the telemetry subsystem measures was, until this module,
+post-hoc — metrics rode end-of-run `stats()` dumps and spans rode
+`--trace-out` exports. This module makes the stack OPERABLE while it
+runs, with two cooperating pieces, both stdlib-only (an inference fleet
+must not grow an HTTP-framework dependency for three read-only
+endpoints):
+
+`OpsServer` — a threaded `http.server` exposing:
+
+  * ``/metrics``  Prometheus text exposition (v0.0.4) of one registry —
+                  the scrape target; round-trips through
+                  `registry.parse_prometheus_text`.
+  * ``/healthz``  liveness JSON from the serving tier's `health()`
+                  (HealthMonitor states + replica-up view for the
+                  fleet, worker/breaker state for one engine). HTTP 200
+                  while status is "ok"/"degraded", 503 when "down" —
+                  load balancers need the status CODE, not JSON parsing.
+  * ``/statusz``  the deep-dive JSON: health + full stats snapshot +
+                  registry snapshot + span summary + SLO state + flight
+                  recorder state.
+
+  plus a background TICKER thread that drives the periodic work live
+  observability needs: `SloEngine.evaluate()`, `FlightRecorder.poll()`
+  (metric-delta events), and any extra `add_tick` callables (serve.py
+  adds host-memory gauges). Construction binds the socket (port 0 =
+  ephemeral, `.port` reports the real one) but nothing runs until
+  `start()`.
+
+`FlightRecorder` — the incident black box. A bounded in-memory ring of
+recent operational events (incidents, SLO transitions, metric deltas)
+rides along for free; when an incident TRIPS — breaker open, replica
+drain, watchdog fire, SLO page, all wired through the existing
+reliability seams (`ServingEngine(incident_hook=)`,
+`ServingFleet(incident_hook=)`, `SloEngine(on_page=)`) — it snapshots a
+forensic bundle to disk: the event ring, the tail of the span stream
+(trace_ids included, so the victim request's cross-replica life is in
+the bundle), the registry snapshot, and an optional stats payload.
+Bundles are rate-limited per incident kind (`min_interval_s`): a breaker
+flapping at 10 Hz must not turn the recorder into a disk-filling
+incident of its own (suppressed bundles are still ring events and
+counted).
+
+Wiring: `serve.py --ops-port/--flight-dir/--slo-config`, helpers
+`ops_server_for_engine` / `ops_server_for_fleet` below.
+docs/OBSERVABILITY.md "The operations plane" is the operator guide;
+docs/OPERATIONS.md maps each alert to its first diagnostic step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional
+
+from alphafold2_tpu.telemetry.registry import MetricRegistry
+from alphafold2_tpu.telemetry.trace import NULL_TRACER, Tracer
+
+#: incident kinds the stack's seams report today (an unknown kind is
+#: still recorded — this list is documentation, not a gate)
+KNOWN_INCIDENT_KINDS = (
+    "breaker_open",     # engine circuit transitioned to open
+    "replica_drain",    # fleet health monitor took a replica out
+    "watchdog_fire",    # hung-batch watchdog abandoned a dispatch
+    "slo_page",         # an SLO objective started firing
+)
+
+
+class FlightRecorder:
+    """Bounded event ring + incident bundle writer (see module docstring).
+
+    Args:
+      out_dir: where bundles land (created lazily on first incident).
+      tracer: span source for the bundle tail (`NULL_TRACER` = no spans).
+      registry: metric source for delta events and bundle snapshots; the
+        recorder also counts itself here (`flight_incidents_total{kind}`,
+        `flight_bundles_written_total`). None disables both.
+      stats_fn: optional zero-arg callable whose JSON-ready return value
+        is embedded in each bundle (an engine/fleet `stats`).
+      capacity: event-ring bound.
+      span_tail: how many of the most recent spans a bundle carries.
+      min_interval_s: per-kind bundle rate limit; suppressed incidents
+        are ring events only.
+      clock: wall clock for bundle timestamps (injectable for tests).
+    """
+
+    def __init__(self, out_dir: str, *, tracer: Tracer = NULL_TRACER,
+                 registry: Optional[MetricRegistry] = None, stats_fn=None,
+                 capacity: int = 1024, span_tail: int = 512,
+                 min_interval_s: float = 5.0, clock=time.time):
+        if capacity < 1 or span_tail < 0:
+            raise ValueError(
+                f"capacity must be >= 1 and span_tail >= 0, got "
+                f"{capacity}/{span_tail}"
+            )
+        self.out_dir = out_dir
+        self._tracer = tracer
+        self._registry = registry
+        self._stats_fn = stats_fn
+        self._span_tail = span_tail
+        self._min_interval_s = min_interval_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._capacity = capacity
+        self._seq = 0                  # bundle sequence number
+        self._last_bundle_at = {}      # kind -> wall ts of last bundle
+        self._bundles: List[str] = []  # paths written this process
+        self._suppressed = 0
+        self._last_counters = None     # poll() delta baseline
+
+    def bind(self, *, registry: Optional[MetricRegistry] = None,
+             stats_fn=None):
+        """Late wiring for the construction-order cycle: the recorder
+        must exist BEFORE the engine/fleet (it is their incident_hook),
+        but the engine owns the registry and stats the bundles embed."""
+        if registry is not None:
+            self._registry = registry
+        if stats_fn is not None:
+            self._stats_fn = stats_fn
+
+    # ------------------------------------------------------------- events
+
+    def note(self, kind: str, **attrs):
+        """Append one event to the ring (no disk I/O)."""
+        with self._lock:
+            self._events.append(
+                {"ts": self._clock(), "kind": kind, "attrs": attrs}
+            )
+            if len(self._events) > self._capacity:
+                del self._events[: len(self._events) - self._capacity]
+
+    def poll(self):
+        """Ticker hook: record which counters moved since the last poll
+        as one `metrics_delta` ring event — the bundle's answer to "what
+        was happening in the minute before the incident" even when spans
+        are off."""
+        if self._registry is None:
+            return
+        current = {}
+        for name, (kind, series) in self._registry.collect().items():
+            if kind != "counter":
+                continue
+            for key, metric in series.items():
+                current[(name, key)] = metric.value
+        with self._lock:
+            last, self._last_counters = self._last_counters, current
+        if last is None:
+            return
+        deltas = {}
+        for (name, key), v in current.items():
+            d = v - last.get((name, key), 0.0)
+            if d:
+                label = name + "".join(f"{{{k}={val}}}" for k, val in key)
+                deltas[label] = d
+        if deltas:
+            self.note("metrics_delta", deltas=deltas)
+
+    # ----------------------------------------------------------- incidents
+
+    def incident(self, kind: str, **attrs) -> Optional[str]:
+        """One incident: ring event + (rate limits permitting) a bundle
+        on disk. Returns the bundle path, or None when suppressed.
+        Never raises — the recorder is called from reliability seams
+        that must keep serving through a full disk."""
+        now = self._clock()
+        self.note("incident:" + kind, **attrs)
+        if self._registry is not None:
+            self._registry.counter(
+                "flight_incidents_total", help="incidents by kind",
+                kind=kind).inc()
+        with self._lock:
+            last = self._last_bundle_at.get(kind)
+            if last is not None and now - last < self._min_interval_s:
+                self._suppressed += 1
+                return None
+            self._last_bundle_at[kind] = now
+            self._seq += 1
+            seq = self._seq
+        try:
+            return self._write_bundle(seq, kind, attrs, now)
+        except Exception:  # noqa: BLE001 — see docstring
+            traceback.print_exc()
+            return None
+
+    def _write_bundle(self, seq: int, kind: str, attrs: dict,
+                      now: float) -> str:
+        bundle = {
+            "incident": {"seq": seq, "kind": kind, "ts": now,
+                         "attrs": attrs},
+            "events": None,   # filled under the lock below
+            "spans": self._tracer.spans(last=self._span_tail),
+        }
+        with self._lock:
+            bundle["events"] = list(self._events)
+        if self._registry is not None:
+            bundle["metrics"] = self._registry.snapshot()
+        if self._stats_fn is not None:
+            try:
+                bundle["stats"] = self._stats_fn()
+            except Exception:  # noqa: BLE001 — a failing stats provider
+                # must not cost the rest of the bundle
+                bundle["stats_error"] = traceback.format_exc()
+        os.makedirs(self.out_dir, exist_ok=True)
+        path = os.path.join(self.out_dir, f"incident-{seq:03d}-{kind}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(bundle, fh, indent=1, default=str)
+        os.replace(tmp, path)  # atomic: a reader never sees a torn bundle
+        if self._registry is not None:
+            self._registry.counter(
+                "flight_bundles_written_total",
+                help="forensic bundles snapshotted to disk").inc()
+        with self._lock:
+            self._bundles.append(path)
+        return path
+
+    def slo_page_hook(self, objective: str, transition: str, info: dict):
+        """Adapter matching `SloEngine(on_page=...)`: a FIRING transition
+        is an incident (bundle), a RESOLVED transition is a ring event."""
+        # info already carries objective/transition keys (slo.py builds
+        # it that way) — merge rather than re-pass, or the duplicate
+        # kwarg would TypeError and the page would never bundle
+        attrs = dict(info)
+        attrs.setdefault("objective", objective)
+        if transition == "firing":
+            self.incident("slo_page", **attrs)
+        else:
+            self.note("slo_" + transition, **attrs)
+
+    # -------------------------------------------------------------- stats
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "dir": self.out_dir,
+                "events": len(self._events),
+                "bundles": list(self._bundles),
+                "suppressed_bundles": self._suppressed,
+            }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """One request; the server instance carries the providers."""
+
+    server_version = "af2-ops/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: ARG002 — silence stdout;
+        # scrape-per-second access logs are noise in a serving console
+        pass
+
+    def _send(self, code: int, body: bytes, content_type: str):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, payload):
+        self._send(code, json.dumps(payload, indent=1, default=str)
+                   .encode("utf-8"), "application/json")
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        ops: "OpsServer" = self.server.ops  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                body = ops.registry.to_prometheus().encode("utf-8")
+                ops.registry.counter(
+                    "ops_scrapes_total",
+                    help="/metrics scrapes served").inc()
+                self._send(200, body,
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                payload = ops.health()
+                code = 503 if payload.get("status") == "down" else 200
+                self._send_json(code, payload)
+            elif path == "/statusz":
+                self._send_json(200, ops.statusz())
+            elif path == "/":
+                self._send_json(200, {"endpoints": [
+                    "/metrics", "/healthz", "/statusz"]})
+            else:
+                self._send_json(404, {"error": f"no such endpoint {path!r}"})
+        except Exception:  # noqa: BLE001 — a handler bug must answer 500,
+            # not silently drop the connection
+            self._send(500, traceback.format_exc().encode("utf-8"),
+                       "text/plain; charset=utf-8")
+
+
+class OpsServer:
+    """The observability HTTP server + periodic ticker (module docstring).
+
+    Construction BINDS the port (so `.port` is real immediately and a
+    bind failure surfaces at build, not mid-traffic) but serves nothing
+    until `start()`. `stop()` is idempotent and joins both threads.
+    """
+
+    def __init__(self, *, registry: MetricRegistry,
+                 health_fn: Optional[Callable[[], dict]] = None,
+                 stats_fn: Optional[Callable[[], dict]] = None,
+                 tracer: Tracer = NULL_TRACER,
+                 slo=None, recorder: Optional[FlightRecorder] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 tick_interval_s: float = 1.0):
+        if tick_interval_s <= 0:
+            raise ValueError(
+                f"tick_interval_s must be positive, got {tick_interval_s}"
+            )
+        self.registry = registry
+        self._health_fn = health_fn
+        self._stats_fn = stats_fn
+        self._tracer = tracer
+        self.slo = slo
+        self.recorder = recorder
+        self._tick_interval_s = tick_interval_s
+        self._extra_ticks: List[Callable[[], None]] = []
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.ops = self  # type: ignore[attr-defined]
+        self._serve_thread: Optional[threading.Thread] = None
+        self._tick_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------ address
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    # ----------------------------------------------------------- payloads
+
+    def health(self) -> dict:
+        if self._health_fn is None:
+            return {"status": "ok"}
+        return self._health_fn()
+
+    def statusz(self) -> dict:
+        out = {
+            "health": self.health(),
+            "metrics": self.registry.snapshot(),
+            "spans": self._tracer.summary(),
+        }
+        if self._stats_fn is not None:
+            out["stats"] = self._stats_fn()
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
+        if self.recorder is not None:
+            out["flight_recorder"] = self.recorder.snapshot()
+        return out
+
+    # ------------------------------------------------------------ lifecycle
+
+    def add_tick(self, fn: Callable[[], None]):
+        """Register an extra periodic callable on the ticker thread."""
+        self._extra_ticks.append(fn)
+
+    def tick(self):
+        """One ticker pass (tests call it directly; the thread loops it).
+        Each hook is isolated: one raising hook must not starve the
+        others or kill the ticker."""
+        hooks: List[Callable[[], None]] = []
+        if self.slo is not None:
+            hooks.append(self.slo.evaluate)
+        if self.recorder is not None:
+            hooks.append(self.recorder.poll)
+        hooks.extend(self._extra_ticks)
+        for fn in hooks:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — see docstring
+                traceback.print_exc()
+
+    def start(self):
+        if self._serve_thread is not None:
+            return
+        self._stop.clear()
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="ops-plane-http",
+            daemon=True)
+        self._serve_thread.start()
+
+        def tick_loop():
+            while not self._stop.wait(self._tick_interval_s):
+                self.tick()
+
+        self._tick_thread = threading.Thread(
+            target=tick_loop, name="ops-plane-ticker", daemon=True)
+        self._tick_thread.start()
+
+    def stop(self, timeout: Optional[float] = 5.0):
+        self._stop.set()
+        if self._tick_thread is not None:
+            self._tick_thread.join(timeout)
+            self._tick_thread = None
+        if self._serve_thread is not None:
+            # shutdown() blocks on an event only serve_forever() sets —
+            # calling it on a built-but-never-started server deadlocks
+            self._httpd.shutdown()
+            self._serve_thread.join(timeout)
+            self._serve_thread = None
+        self._httpd.server_close()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def ops_server_for_engine(engine, *, tracer: Tracer = NULL_TRACER,
+                          slo=None, recorder: Optional[FlightRecorder] = None,
+                          host: str = "127.0.0.1", port: int = 0,
+                          tick_interval_s: float = 1.0) -> OpsServer:
+    """Wire an `OpsServer` over one `ServingEngine`: its metrics
+    registry, `health()`, and `stats()`."""
+    return OpsServer(
+        registry=engine.metrics.registry, health_fn=engine.health,
+        stats_fn=engine.stats, tracer=tracer, slo=slo, recorder=recorder,
+        host=host, port=port, tick_interval_s=tick_interval_s,
+    )
+
+
+def ops_server_for_fleet(fleet, *, tracer: Tracer = NULL_TRACER,
+                         slo=None, recorder: Optional[FlightRecorder] = None,
+                         host: str = "127.0.0.1", port: int = 0,
+                         tick_interval_s: float = 1.0) -> OpsServer:
+    """Wire an `OpsServer` over a `ServingFleet`: the fleet registry
+    (fleet_* families + SLO/flight metrics), `health()` (HealthMonitor +
+    replica-up view), and the full fleet `stats()`."""
+    return OpsServer(
+        registry=fleet.registry, health_fn=fleet.health,
+        stats_fn=fleet.stats, tracer=tracer, slo=slo, recorder=recorder,
+        host=host, port=port, tick_interval_s=tick_interval_s,
+    )

@@ -90,6 +90,42 @@ class CompileTracker:
                 **labels).set(dt)
 
 
+def host_memory_gauges(registry: MetricRegistry) -> dict:
+    """Portable process-memory gauges: `host_memory_bytes{kind=rss}`
+    (current resident set, /proc when available) and `{kind=peak_rss}`
+    (lifetime peak via `resource.getrusage`). Unlike
+    `device_memory_gauges` this NEVER returns None — CPU-only runs get
+    host pressure where `device.memory_stats()` is blind — and costs two
+    syscalls, so the ops-plane ticker can call it every second.
+
+    Returns {"rss_bytes": ..., "peak_rss_bytes": ...} (0.0 for a field
+    the platform cannot report — absence is explicit, never a crash)."""
+    peak = rss = 0.0
+    try:
+        import resource
+        import sys
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        # ru_maxrss is kilobytes on Linux, bytes on macOS
+        peak = float(ru.ru_maxrss) * (1.0 if sys.platform == "darwin"
+                                      else 1024.0)
+    except (ImportError, OSError):  # resource is POSIX-only
+        pass
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    rss = float(line.split()[1]) * 1024.0  # kB field
+                    break
+    except OSError:
+        rss = peak  # no procfs: peak is the honest upper bound we have
+    out = {"rss_bytes": rss, "peak_rss_bytes": peak}
+    help_ = "process host memory (resource.getrusage / /proc/self/status)"
+    registry.gauge("host_memory_bytes", help=help_, kind="rss").set(rss)
+    registry.gauge("host_memory_bytes", help=help_, kind="peak_rss").set(peak)
+    return out
+
+
 def device_memory_gauges(registry: MetricRegistry,
                          device=None) -> Optional[dict]:
     """Record `device.memory_stats()` into gauges; returns the raw stats

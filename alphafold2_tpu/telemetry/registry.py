@@ -11,13 +11,17 @@ and the trainer loops with one typed, thread-safe registry:
     device memory, per-bucket compile seconds.
   * `Histogram` — sliding-window quantiles over observations, reusing
     `LatencyHistogram` (which lives here now; `utils.observability`
-    re-exports it for back-compat) plus a lifetime sum so Prometheus
-    summary exposition has `_sum`/`_count`.
+    re-exports it for back-compat), plus LIFETIME cumulative buckets
+    (`DEFAULT_BUCKET_BOUNDS`, seconds-oriented) so Prometheus exposition
+    is a real `histogram` type — `_bucket{le=...}`/`_sum`/`_count` a
+    Prometheus server can `histogram_quantile()` over and aggregate
+    across replicas, which summary-quantile gauges cannot.
 
 Exposition: `to_prometheus()` emits Prometheus text format (v0.0.4);
-`snapshot()` returns the same data as a JSON-ready dict. A minimal
-`parse_prometheus_text` parser lives here too so the round-trip is
-testable without a Prometheus server.
+`snapshot()` returns the same data as a JSON-ready dict (histograms
+carry both the sliding-window quantiles and the cumulative buckets). A
+minimal `parse_prometheus_text` parser lives here too so the round-trip
+is testable without a Prometheus server.
 
 Cost contract: `MetricRegistry(enabled=False)` hands every caller a
 shared no-op metric — no allocation, no locks, empty snapshots — so
@@ -26,6 +30,7 @@ instrumentation stays in hot paths unconditionally.
 
 from __future__ import annotations
 
+import bisect
 import collections
 import re
 import threading
@@ -163,25 +168,81 @@ class Gauge:
             return self._value
 
 
+#: cumulative-bucket upper bounds (seconds-oriented: the stack's
+#: histograms are latencies/waits). +Inf is implicit in exposition.
+DEFAULT_BUCKET_BOUNDS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0, 120.0,
+)
+
+
+def format_le(bound: float) -> str:
+    """Prometheus `le` label value: trimmed decimal, `+Inf` sentinel."""
+    if bound == float("inf"):
+        return "+Inf"
+    return format(bound, ".12g")
+
+
 class Histogram:
     """Sliding-window quantiles + lifetime sum/count, on LatencyHistogram
     internals (composition: the window/percentile machinery is shared with
-    every pre-registry call site)."""
+    every pre-registry call site) — plus LIFETIME cumulative buckets for
+    real Prometheus `histogram` exposition. Buckets are cumulative
+    counters (never windowed): a scraper computes rates from successive
+    scrapes, so the bucket counts must only ever grow."""
 
-    __slots__ = ("_hist",)
+    __slots__ = ("_hist", "_bounds", "_bucket_counts", "_bucket_sum",
+                 "_bucket_lock")
     kind = "histogram"
 
-    def __init__(self, window: int = 2048):
+    def __init__(self, window: int = 2048,
+                 bounds: Tuple[float, ...] = DEFAULT_BUCKET_BOUNDS):
+        if list(bounds) != sorted(bounds) or len(set(bounds)) != len(bounds):
+            raise ValueError(f"bucket bounds must be strictly increasing, "
+                             f"got {bounds}")
         self._hist = LatencyHistogram(window=window)
+        self._bounds = tuple(float(b) for b in bounds)
+        # per-bound NON-cumulative counts (+ one overflow slot for +Inf);
+        # cumulated at read time so observe() stays one increment. The
+        # lifetime sum rides the SAME lock so one exposition() read sees
+        # buckets/sum/count from the same observation set — Prometheus
+        # requires the +Inf bucket to equal _count on every scrape
+        self._bucket_counts = [0] * (len(self._bounds) + 1)
+        self._bucket_sum = 0.0
+        self._bucket_lock = threading.Lock()
 
     def observe(self, v: float):
+        v = float(v)
         self._hist.observe(v)
+        i = bisect.bisect_left(self._bounds, v)
+        with self._bucket_lock:
+            self._bucket_counts[i] += 1
+            self._bucket_sum += v
 
     def percentile(self, q: float) -> float:
         return self._hist.percentile(q)
 
+    def exposition(self) -> Tuple[dict, float, int]:
+        """(cumulative buckets incl. +Inf, lifetime sum, lifetime count),
+        mutually consistent: read under one lock, with count derived from
+        the buckets themselves."""
+        with self._bucket_lock:
+            counts = list(self._bucket_counts)
+            total = self._bucket_sum
+        out, running = {}, 0
+        for bound, n in zip(self._bounds + (float("inf"),), counts):
+            running += n
+            out[format_le(bound)] = running
+        return out, total, running
+
+    def buckets(self) -> dict:
+        """{le_label: cumulative count} including the implicit +Inf."""
+        return self.exposition()[0]
+
     def snapshot(self) -> dict:
-        return self._hist.snapshot()
+        snap = self._hist.snapshot()
+        snap["buckets"] = self.buckets()
+        return snap
 
 
 class _NoopMetric:
@@ -269,6 +330,17 @@ class MetricRegistry:
 
     # ------------------------------------------------------------- reading
 
+    def collect(self) -> Dict[str, Tuple[str, Dict[LabelsKey, object]]]:
+        """{name: (kind, {labels_key: metric})} — a consistent shallow
+        copy for PROGRAMMATIC readers (the SLO engine matching selectors
+        against counter series, the flight recorder diffing deltas).
+        The metric objects are the live ones: read-only use."""
+        with self._lock:
+            return {
+                n: (kind, dict(series))
+                for n, (kind, _, series) in self._families.items()
+            }
+
     def snapshot(self) -> dict:
         """JSON-ready dump: {"counters": {rendered_name: value}, "gauges":
         {...}, "histograms": {rendered_name: {count, p50, ...}}}."""
@@ -288,8 +360,11 @@ class MetricRegistry:
         return out
 
     def to_prometheus(self) -> str:
-        """Prometheus text exposition (v0.0.4). Histograms export as
-        summaries: per-quantile samples + `_sum` + `_count`."""
+        """Prometheus text exposition (v0.0.4). Histograms export as REAL
+        histograms: cumulative `_bucket{le=...}` samples (+Inf included)
+        plus `_sum`/`_count` — aggregatable across replicas and
+        `histogram_quantile()`-able, unlike the summary-quantile gauges
+        this used to emit."""
         lines = []
         with self._lock:
             families = {
@@ -299,23 +374,19 @@ class MetricRegistry:
         for name, (kind, help_, series) in sorted(families.items()):
             if help_:
                 lines.append(f"# HELP {name} {help_}")
-            lines.append(
-                f"# TYPE {name} {'summary' if kind == 'histogram' else kind}"
-            )
+            lines.append(f"# TYPE {name} {kind}")
             for key, metric in sorted(series.items()):
                 if kind == "histogram":
-                    snap = metric.snapshot()
-                    for q, field in ((0.5, "p50"), (0.95, "p95"),
-                                     (0.99, "p99")):
-                        qkey = key + (("quantile", repr(q)),)
+                    buckets, vsum, count = metric.exposition()
+                    for le, cum in buckets.items():
+                        bkey = tuple(sorted(key + (("le", le),)))
                         lines.append(
-                            f"{name}{render_labels(tuple(sorted(qkey)))} "
-                            f"{snap[field]}"
+                            f"{name}_bucket{render_labels(bkey)} {cum}"
                         )
                     lines.append(f"{name}_sum{render_labels(key)} "
-                                 f"{snap['sum']}")
+                                 f"{vsum}")
                     lines.append(f"{name}_count{render_labels(key)} "
-                                 f"{snap['count']}")
+                                 f"{count}")
                 else:
                     lines.append(
                         f"{name}{render_labels(key)} {metric.value}"
@@ -336,9 +407,11 @@ def parse_prometheus_text(text: str) -> Dict[Tuple[str, LabelsKey], float]:
     """Minimal Prometheus text-format parser: {(name, labels): value}.
 
     Enough of the grammar to round-trip `to_prometheus()` output (and any
-    plain scrape of counters/gauges/summaries); not a validator. Raises
-    ValueError on a line it cannot parse — a silently-skipped sample
-    would make the round-trip test vacuous.
+    plain scrape of counters/gauges/histograms — cumulative
+    `_bucket{le=...}` samples are ordinary samples whose `le` label keys
+    the bound, `+Inf` included); not a validator. Raises ValueError on a
+    line it cannot parse — a silently-skipped sample would make the
+    round-trip test vacuous.
     """
     out: Dict[Tuple[str, LabelsKey], float] = {}
     for lineno, line in enumerate(text.splitlines(), 1):

@@ -22,14 +22,32 @@ Memory is bounded: at most `max_spans` completed spans are retained;
 further spans are counted in `dropped` (reported in `summary()` and the
 Chrome export) rather than silently discarded — truncated data must
 never read as complete data.
+
+Trace correlation: `new_trace_id()` mints a request-scoped id at the
+serving front door (fleet/engine `submit()`); `Tracer.bind_trace(id)`
+binds it thread-locally so every span recorded on that thread while
+bound carries a `trace_id` attribute, and multi-request phases (a batch,
+a device dispatch) attach the explicit `trace_ids` list instead. The
+same id travels queueing, dispatcher routing, requeues onto OTHER
+replicas, and the response (`PredictionResult.trace_id`), so one grep
+over an export reconstructs a request's whole cross-thread,
+cross-replica life (docs/OBSERVABILITY.md "The operations plane").
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 import time
+import uuid
 from typing import Optional
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex-char request trace id (random, not time-derived:
+    two fleets started in the same instant must not collide)."""
+    return uuid.uuid4().hex[:16]
 
 
 class _NullSpan:
@@ -126,6 +144,31 @@ class Tracer:
         end = self._clock() if end_at is None else end_at
         self._record(name, cat, end - duration_s, duration_s, 0, attrs)
 
+    @contextlib.contextmanager
+    def bind_trace(self, trace):
+        """Bind a trace identity to the CURRENT thread for the enclosed
+        block: every span recorded here (nested spans included, helpers
+        that never heard of tracing included — the AOT compile inside a
+        device dispatch is the motivating case) inherits it unless the
+        span set its own. `trace` is one id (str; spans gain `trace_id`)
+        or a list of ids for batch-scoped work (spans gain `trace_ids`).
+        No-op (beyond one boolean test) on a disabled tracer."""
+        if not self.enabled or not trace:
+            yield
+            return
+        prev = getattr(self._tls, "trace", None)
+        self._tls.trace = trace
+        try:
+            yield
+        finally:
+            self._tls.trace = prev
+
+    def current_trace_id(self) -> Optional[str]:
+        """The single id bound to this thread, if any (None under a
+        list binding — a batch has no one id)."""
+        bound = getattr(self._tls, "trace", None)
+        return bound if isinstance(bound, str) else None
+
     def _push(self) -> int:
         depth = getattr(self._tls, "depth", 0)
         self._tls.depth = depth + 1
@@ -135,6 +178,12 @@ class Tracer:
         self._tls.depth = getattr(self._tls, "depth", 1) - 1
 
     def _record(self, name, cat, t0, dur, depth, attrs):
+        bound = getattr(self._tls, "trace", None)
+        if isinstance(bound, str):
+            if "trace_id" not in attrs:
+                attrs["trace_id"] = bound
+        elif bound and "trace_ids" not in attrs:
+            attrs["trace_ids"] = list(bound)
         rec = {
             "name": name,
             "cat": cat,
@@ -153,10 +202,18 @@ class Tracer:
 
     # ------------------------------------------------------------- reading
 
-    def spans(self) -> list:
-        """Snapshot (shallow copies) of the completed spans."""
+    def spans(self, last: Optional[int] = None) -> list:
+        """Snapshot (shallow copies) of the completed spans; `last=N`
+        copies only the N most recent (the flight recorder's bundle
+        tail — copying 100k spans per incident would be the outage
+        amplifying itself)."""
         with self._lock:
-            return [dict(s) for s in self._spans]
+            if last is None:
+                src = self._spans
+            else:
+                # [-last:] with last=0 is the WHOLE list, not none of it
+                src = self._spans[-last:] if last > 0 else []
+            return [dict(s) for s in src]
 
     @property
     def span_count(self) -> int:

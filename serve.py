@@ -35,6 +35,7 @@ import json
 import os
 import random
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -188,8 +189,44 @@ def main():
                     help="write the final stats snapshot here (includes "
                          "the telemetry section: registry metrics + "
                          "per-phase span summaries)")
+    ap.add_argument("--stats-interval", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="with --stats-json: also flush the stats "
+                         "snapshot there every N seconds DURING the "
+                         "replay (atomic tmp+rename), so a crashed run "
+                         "keeps its last periodic snapshot instead of "
+                         "losing everything (0 = end-of-run only)")
     ap.add_argument("--metrics-jsonl", default=None,
                     help="stream one record per dispatched batch here")
+    # live operations plane (telemetry/ops_plane.py;
+    # docs/OBSERVABILITY.md "The operations plane")
+    ap.add_argument("--ops-port", type=int, default=None, metavar="PORT",
+                    help="serve the observability HTTP endpoints "
+                         "(/metrics Prometheus exposition, /healthz, "
+                         "/statusz) on 127.0.0.1:PORT while the replay "
+                         "runs (0 = ephemeral port, printed at startup); "
+                         "also arms the SLO engine (stock objectives "
+                         "unless --slo-config)")
+    ap.add_argument("--ops-port-file", default=None, metavar="PATH",
+                    help="write the bound ops-plane port here once "
+                         "listening (how a parent process finds an "
+                         "--ops-port 0 ephemeral port)")
+    ap.add_argument("--ops-tick", type=float, default=1.0,
+                    metavar="SECONDS",
+                    help="ops-plane ticker cadence: SLO evaluation, "
+                         "flight-recorder metric-delta polling, host "
+                         "memory gauges")
+    ap.add_argument("--slo-config", default=None, metavar="SLO_JSON",
+                    help="declarative SLO objectives (telemetry/slo.py "
+                         "schema; docs/OBSERVABILITY.md); default: stock "
+                         "availability/shed-rate/queue-wait objectives. "
+                         "Requires --ops-port (the ticker evaluates it)")
+    ap.add_argument("--flight-dir", default=None, metavar="DIR",
+                    help="arm the incident flight recorder: breaker "
+                         "opens, replica drains, watchdog fires, and SLO "
+                         "pages snapshot a forensic JSON bundle (recent "
+                         "spans incl. trace_ids, event ring, registry "
+                         "snapshot, stats) into DIR")
     from alphafold2_tpu.telemetry import (
         add_telemetry_args,
         finish_trace,
@@ -198,6 +235,20 @@ def main():
 
     add_telemetry_args(ap)  # --trace-out / --trace-max-spans
     args = ap.parse_args()
+    if args.slo_config and args.ops_port is None:
+        ap.error("--slo-config requires --ops-port (the ops-plane ticker "
+                 "is what evaluates the objectives)")
+    if args.stats_interval and not args.stats_json:
+        ap.error("--stats-interval requires --stats-json (it needs a "
+                 "path to flush to)")
+    if args.stats_interval < 0:
+        ap.error("--stats-interval must be positive (0 disables the "
+                 "periodic flush)")
+    if args.ops_port_file and args.ops_port is None:
+        ap.error("--ops-port-file requires --ops-port (there is no port "
+                 "to publish without the ops server)")
+    if args.ops_tick <= 0:
+        ap.error("--ops-tick must be positive")
 
     # single-client tunnel discipline AFTER argparse (--help must not
     # block on the lock) — same stance as predict.py
@@ -267,6 +318,20 @@ def main():
         else None
     )
     tracer = tracer_from_args(args)  # NULL_TRACER unless --trace-out
+    if (args.ops_port is not None or args.flight_dir) and not tracer.enabled:
+        # the ops plane and the flight recorder are span CONSUMERS
+        # (/statusz summaries, bundle tails with trace_ids): give them a
+        # live tracer even without --trace-out (no Chrome export then)
+        from alphafold2_tpu.telemetry import Tracer
+
+        tracer = Tracer(enabled=True, max_spans=args.trace_max_spans)
+    recorder = None
+    if args.flight_dir:
+        from alphafold2_tpu.telemetry import FlightRecorder
+
+        # registry/stats bound AFTER the engine exists (recorder must be
+        # built first: it is the engine's incident_hook)
+        recorder = FlightRecorder(args.flight_dir, tracer=tracer)
     injector = None
     if args.fault_plan:
         from alphafold2_tpu.reliability import FaultPlan
@@ -328,6 +393,7 @@ def main():
             ),
             injector=injector,
             tracer=tracer,
+            incident_hook=recorder.incident if recorder else None,
         )
         degraded_desc = ", ".join(
             ([f"mds_iters={degraded_iters}"] if degraded_iters else [])
@@ -343,7 +409,65 @@ def main():
             metrics_logger=logger,
             fault_hook=injector.serving_hook() if injector else None,
             tracer=tracer,
+            incident_hook=recorder.incident if recorder else None,
         )
+
+    # --- live operations plane -----------------------------------------
+    registry = engine.registry if fleet_mode else engine.metrics.registry
+    if recorder is not None:
+        recorder.bind(registry=registry, stats_fn=engine.stats)
+    ops = slo = None
+    if args.ops_port is not None:
+        from alphafold2_tpu.telemetry import (
+            SloConfig,
+            SloEngine,
+            default_slo_config,
+            host_memory_gauges,
+            ops_server_for_engine,
+            ops_server_for_fleet,
+        )
+
+        slo_cfg = (SloConfig.from_file(args.slo_config) if args.slo_config
+                   else default_slo_config("fleet" if fleet_mode
+                                           else "serving"))
+        slo = SloEngine(
+            registry, slo_cfg,
+            on_page=recorder.slo_page_hook if recorder else None,
+        )
+        make_ops = ops_server_for_fleet if fleet_mode else ops_server_for_engine
+        ops = make_ops(engine, tracer=tracer, slo=slo, recorder=recorder,
+                       port=args.ops_port, tick_interval_s=args.ops_tick)
+        ops.add_tick(lambda: host_memory_gauges(registry))
+        ops.start()
+        print(f"ops plane listening on {ops.url} "
+              f"(/metrics /healthz /statusz)")
+        if args.ops_port_file:
+            tmp = args.ops_port_file + ".tmp"
+            with open(tmp, "w") as fh:
+                fh.write(str(ops.port))
+            os.replace(tmp, args.ops_port_file)  # readers never see ""
+
+    stats_stop = threading.Event()
+    stats_thread = None
+    if args.stats_interval:
+        def _flush_stats():
+            while not stats_stop.wait(args.stats_interval):
+                try:
+                    snap = engine.stats()
+                    tmp = args.stats_json + ".tmp"
+                    with open(tmp, "w") as fh:
+                        json.dump(snap, fh, indent=2)
+                    os.replace(tmp, args.stats_json)  # atomic: a crash
+                    # mid-write never tears the last good snapshot
+                except Exception:  # noqa: BLE001 — a flush failure must
+                    # not kill the replay
+                    import traceback
+
+                    traceback.print_exc()
+
+        stats_thread = threading.Thread(
+            target=_flush_stats, name="stats-flusher", daemon=True)
+        stats_thread.start()
 
     # --- replay: submit everything, honoring backpressure explicitly ----
     t0 = time.time()
@@ -407,10 +531,11 @@ def main():
             tag += f" (requeued x{res.requeues})"
         if res.degraded:
             tag += " (DEGRADED)"
+        tid = f" tid={res.trace_id}" if res.trace_id else ""
         print(f"{name}: L={len(seq)} bucket={res.bucket} "
               f"stress={res.stress:.3f} "
               f"conf={100 * float(res.confidence.mean()):.1f}/100 "
-              f"lat={res.latency_s * 1000:.0f}ms{tag}")
+              f"lat={res.latency_s * 1000:.0f}ms{tag}{tid}")
         if args.out_dir:
             from alphafold2_tpu.geometry.pdb import coords_to_pdb
 
@@ -430,7 +555,17 @@ def main():
                 bfactors=100.0 * np.asarray(res.confidence),
             )
 
+    if slo is not None:
+        # one last evaluation BEFORE shutdown: a short replay whose
+        # burn crossed the threshold in its final window still records
+        # the firing transition
+        slo.evaluate()
+    if stats_thread is not None:
+        stats_stop.set()
+        stats_thread.join(timeout=5.0)
     engine.shutdown(drain=True)
+    if ops is not None:
+        ops.stop()
     if logger is not None:
         logger.close()
     finish_trace(tracer, args)
@@ -476,9 +611,28 @@ def main():
         )
         if stats["errors"]:
             print(f"errors by code: {stats['errors']}")
+    if slo is not None:
+        events = slo.events()
+        fired = sum(1 for e in events if e["transition"] == "firing")
+        if events:
+            print(f"SLO: {fired} alert(s) fired "
+                  f"({len(events)} transition(s)): "
+                  + ", ".join(f"{e['objective']}:{e['transition']}"
+                              for e in events[-6:]))
+        else:
+            print("SLO: no alerts")
+    if recorder is not None:
+        snap = recorder.snapshot()
+        if snap["bundles"]:
+            print(f"flight recorder: {len(snap['bundles'])} bundle(s) in "
+                  f"{snap['dir']}")
     if args.stats_json:
-        with open(args.stats_json, "w") as fh:
+        # same tmp+replace discipline as the periodic flusher: a crash
+        # mid-dump must not tear the last good snapshot it kept alive
+        tmp = args.stats_json + ".tmp"
+        with open(tmp, "w") as fh:
             json.dump(stats, fh, indent=2)
+        os.replace(tmp, args.stats_json)
         print(f"wrote {args.stats_json}")
     return 1 if failures else 0
 

@@ -319,6 +319,133 @@ class TestShardingPass:
 # ---------------------------------------------------------------------------
 
 
+class TestMetricsPass:
+    """Pass 7: metric-name drift vs the docs/OBSERVABILITY.md inventory."""
+
+    def _repo(self, tmp_path, code, doc):
+        pkg = tmp_path / "alphafold2_tpu"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(code)
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        (docs / "OBSERVABILITY.md").write_text(doc)
+        return tmp_path
+
+    DOC = (
+        "prose mentioning `not_a_metric` outside the block\n"
+        "<!-- af2lint:metrics:begin -->\n"
+        "| metric | kind | labels | meaning |\n"
+        "|---|---|---|---|\n"
+        "| `good_total` | counter | `code` | fine |\n"
+        "{extra}"
+        "<!-- af2lint:metrics:end -->\n"
+    )
+
+    def test_clean_when_call_sites_match_inventory(self, tmp_path):
+        from alphafold2_tpu.analysis.metrics_lint import run
+
+        root = self._repo(
+            tmp_path,
+            "def f(reg):\n    reg.counter('good_total', code='x').inc()\n",
+            self.DOC.format(extra=""),
+        )
+        assert run(root) == []
+
+    def test_undocumented_call_site_flagged(self, tmp_path):
+        from alphafold2_tpu.analysis.metrics_lint import run
+
+        root = self._repo(
+            tmp_path,
+            "def f(reg):\n"
+            "    reg.counter('good_total').inc()\n"
+            "    reg.gauge('sneaky_depth').set(1)\n",
+            self.DOC.format(extra=""),
+        )
+        findings = run(root)
+        assert [f.code for f in findings] == ["METRICS001"]
+        assert "sneaky_depth" in findings[0].message
+
+    def test_stale_doc_entry_flagged_and_wildcard_vouches(self, tmp_path):
+        from alphafold2_tpu.analysis.metrics_lint import run
+
+        root = self._repo(
+            tmp_path,
+            "def f(reg, prefix):\n"
+            "    reg.counter('good_total').inc()\n"
+            "    reg.gauge(f'{prefix}_last_seconds').set(1)\n",
+            self.DOC.format(
+                extra="| `ghost_total` | counter | | gone |\n"
+                      "| `compile_last_seconds` | gauge | | dynamic |\n"
+            ),
+        )
+        findings = run(root)
+        # ghost_total: documented, never registered; compile_last_seconds
+        # is vouched for by the f-string's *_last_seconds wildcard
+        assert [f.code for f in findings] == ["METRICS002"]
+        assert "ghost_total" in findings[0].message
+
+    def test_generic_wildcard_does_not_vouch_without_prefix(self, tmp_path):
+        """`f"{pre}_total"` becomes the wildcard `*_total`, which matches
+        MOST counters — letting it vouch would make METRICS002 vacuous.
+        A short wildcard must not cover an arbitrary stale doc row."""
+        from alphafold2_tpu.analysis.metrics_lint import run
+
+        root = self._repo(
+            tmp_path,
+            "def f(reg, pre):\n"
+            "    reg.counter('good_total').inc()\n"
+            "    reg.counter(f'{pre}_total').inc()\n",
+            self.DOC.format(
+                extra="| `ghost_total` | counter | | deleted metric |\n"),
+        )
+        findings = run(root)
+        assert [f.code for f in findings] == ["METRICS002"]
+        assert "ghost_total" in findings[0].message
+
+    def test_prefix_kwarg_anchors_generic_wildcard(self, tmp_path):
+        """A literal `prefix="..."` kwarg (the CompileTracker idiom)
+        anchors short wildcards: names it forms are vouched for."""
+        from alphafold2_tpu.analysis.metrics_lint import run
+
+        root = self._repo(
+            tmp_path,
+            "def f(reg, pre):\n"
+            "    reg.counter('good_total').inc()\n"
+            "    reg.counter(f'{pre}_total').inc()\n"
+            "def make(reg):\n"
+            "    return Tracker(reg, prefix='my_compile')\n",
+            self.DOC.format(
+                extra="| `my_compile_total` | counter | | dynamic family |\n"),
+        )
+        assert run(root) == []
+
+    def test_missing_markers_flagged(self, tmp_path):
+        from alphafold2_tpu.analysis.metrics_lint import run
+
+        root = self._repo(tmp_path, "x = 1\n", "# no inventory here\n")
+        findings = run(root)
+        assert [f.code for f in findings] == ["METRICS003"]
+
+    def test_suppression_comment_honored(self, tmp_path):
+        from alphafold2_tpu.analysis.metrics_lint import run
+
+        root = self._repo(
+            tmp_path,
+            "def f(reg):\n"
+            "    reg.counter('good_total').inc()\n"
+            "    reg.counter('internal_total').inc()"
+            "  # af2lint: disable=METRICS001\n",
+            self.DOC.format(extra=""),
+        )
+        assert run(root) == []
+
+    def test_metrics_pass_clean_on_repo(self):
+        """The real contract: every metric registered in this repo is in
+        the OBSERVABILITY.md inventory and vice versa."""
+        findings = run_passes(REPO_ROOT, select=("metrics",))
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+
 class TestRepoIsClean:
     def test_static_passes_clean_on_repo(self):
         """The CI gate, pinned as a test: compat + trace + sharding must

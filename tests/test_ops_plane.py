@@ -1,0 +1,878 @@
+"""Operations-plane tests: HTTP endpoints, SLO burn-rate engine, flight
+recorder, and fleet-wide trace correlation.
+
+Serving scenarios follow the tests/test_chaos.py stance: real scheduler /
+fleet / health machinery with the model call stubbed at the documented
+`_call_executable` seam — zero XLA compiles. The SLO engine runs on an
+injected clock (no sleeps). The HTTP tests bind ephemeral ports on
+loopback. The `-m slow` subprocess test at the bottom is the ISSUE 9
+acceptance scenario end to end through the real CLI.
+"""
+
+import functools
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from alphafold2_tpu.models import Alphafold2Config
+from alphafold2_tpu.reliability import Fault, FaultPlan
+from alphafold2_tpu.serving import (
+    FleetConfig,
+    ServingConfig,
+    ServingEngine,
+    ServingFleet,
+)
+from alphafold2_tpu.telemetry import (
+    FlightRecorder,
+    MetricRegistry,
+    OpsServer,
+    SloConfig,
+    SloEngine,
+    SloObjective,
+    Tracer,
+    default_slo_config,
+    host_memory_gauges,
+    new_trace_id,
+    ops_server_for_engine,
+    ops_server_for_fleet,
+    parse_prometheus_text,
+)
+
+TINY = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8, max_seq_len=16)
+
+
+def bounded(seconds):
+    """Per-test hang bound (tests/test_chaos.py stance)."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            result, exc = [], []
+
+            def run():
+                try:
+                    result.append(fn(*args, **kwargs))
+                except BaseException as e:  # noqa: BLE001
+                    exc.append(e)
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            t.join(seconds)
+            assert not t.is_alive(), f"{fn.__name__} exceeded {seconds}s"
+            if exc:
+                raise exc[0]
+            return result[0]
+        return wrapper
+    return deco
+
+
+class FakeEngine(ServingEngine):
+    """Model call stubbed at the documented seam."""
+
+    def _call_executable(self, bucket, tokens, mask, msa=None, msa_mask=None):
+        B, Lb = tokens.shape
+        return {
+            "coords": np.zeros((B, Lb, 3), np.float32),
+            "confidence": np.full((B, Lb), 0.5, np.float32),
+            "stress": np.zeros((B,), np.float32),
+        }
+
+
+def fake_engine(tracer=None, **overrides):
+    base = dict(buckets=(8, 16), max_batch=2, max_queue=8, max_wait_s=0.0,
+                request_timeout_s=30.0, cache_capacity=4)
+    base.update(overrides)
+    return FakeEngine({}, TINY, ServingConfig(**base), tracer=tracer)
+
+
+def seq_of(length, offset=0):
+    from alphafold2_tpu.constants import AA_ORDER
+
+    return "".join(
+        AA_ORDER[(offset + i) % len(AA_ORDER)] for i in range(length)
+    )
+
+
+def http_get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode("utf-8"), dict(resp.headers)
+
+
+# ---------------------------------------------------------------------------
+# trace correlation
+# ---------------------------------------------------------------------------
+
+
+class TestTraceCorrelation:
+    @bounded(60)
+    def test_engine_result_and_spans_carry_trace_id(self):
+        tracer = Tracer()
+        eng = fake_engine(tracer=tracer)
+        try:
+            req = eng.submit(seq_of(5))
+            res = req.result(timeout=10)
+            assert req.trace_id and res.trace_id == req.trace_id
+            spans = tracer.spans()
+            per_request = {
+                s["name"] for s in spans
+                if s["attrs"].get("trace_id") == req.trace_id
+            }
+            assert {"serving.enqueue", "serving.queue_wait"} <= per_request
+            multi = {
+                s["name"] for s in spans
+                if req.trace_id in s["attrs"].get("trace_ids", ())
+            }
+            assert {"serving.batch", "serving.execute",
+                    "serving.respond"} <= multi
+        finally:
+            eng.shutdown(timeout=10)
+
+    @bounded(60)
+    def test_caller_supplied_id_and_cache_hit_restamp(self):
+        eng = fake_engine()
+        try:
+            first = eng.submit(seq_of(6), trace_id="aaaa000011112222")
+            assert first.result(timeout=10).trace_id == "aaaa000011112222"
+            # identical query served from cache: the HIT's own id, not
+            # the computing request's
+            hit = eng.submit(seq_of(6), trace_id="bbbb000011112222")
+            res = hit.result(timeout=10)
+            assert res.from_cache and res.trace_id == "bbbb000011112222"
+        finally:
+            eng.shutdown(timeout=10)
+
+    @bounded(120)
+    def test_fleet_requeue_shares_one_trace_id_across_replicas(self):
+        """THE correlation pin: a request killed on r0 and requeued onto
+        r1 leaves spans on BOTH replicas carrying one trace_id."""
+        tracer = Tracer()
+        inj = FaultPlan(faults=(
+            Fault("kill_replica", replica="r0", at=0),
+        )).injector()
+        fleet = ServingFleet(
+            {}, TINY,
+            ServingConfig(buckets=(8, 16), max_batch=1, max_queue=8,
+                          max_wait_s=0.0, request_timeout_s=30.0,
+                          cache_capacity=0),
+            FleetConfig(replicas=2, probe_interval_s=0,
+                        reprobe_interval_s=30.0, fail_threshold=1,
+                        requeue_limit=2),
+            engine_factory=lambda n, c, h: FakeEngine(
+                {}, TINY, c, fault_hook=h, tracer=tracer, replica_name=n),
+            injector=inj,
+            tracer=tracer,
+        )
+        try:
+            req = fleet.submit(seq_of(5))
+            res = req.result(timeout=30)
+            assert res.requeues >= 1
+            assert res.trace_id == req.trace_id
+            spans = [
+                s for s in tracer.spans()
+                if s["attrs"].get("trace_id") == req.trace_id
+                or req.trace_id in s["attrs"].get("trace_ids", ())
+            ]
+            replicas = {s["attrs"].get("replica") for s in spans}
+            replicas.discard(None)
+            assert {"r0", "r1"} <= replicas, (
+                f"expected spans on both replicas, got {replicas}"
+            )
+        finally:
+            fleet.shutdown(timeout=10)
+
+    @bounded(60)
+    @pytest.mark.parametrize("watchdog", [None, 30.0])
+    def test_nested_helper_spans_inherit_batch_trace_ids(self, watchdog):
+        """The AOT-compile span inside a dispatch is recorded by
+        machinery (CompileTracker) that never heard of requests;
+        bind_trace must stamp the batch ids onto it on whichever thread
+        the call runs — inline or the watchdog runner."""
+        tracer = Tracer()
+
+        class CompilingEngine(FakeEngine):
+            def _call_executable(self, bucket, tokens, mask, msa=None,
+                                 msa_mask=None):
+                with self.metrics.compile_span(bucket):
+                    pass
+                return super()._call_executable(
+                    bucket, tokens, mask, msa, msa_mask)
+
+        cfg = ServingConfig(
+            buckets=(8, 16), max_batch=2, max_queue=8, max_wait_s=0.0,
+            request_timeout_s=30.0, cache_capacity=4,
+            watchdog_timeout_s=watchdog)
+        eng = CompilingEngine({}, TINY, cfg, tracer=tracer)
+        try:
+            req = eng.submit(seq_of(5))
+            req.result(timeout=10)
+            compile_spans = [s for s in tracer.spans()
+                             if s["name"] == "serving_compile"]
+            assert compile_spans
+            assert all(req.trace_id in s["attrs"]["trace_ids"]
+                       for s in compile_spans)
+        finally:
+            eng.shutdown(timeout=10)
+
+    def test_bind_trace_attaches_thread_locally(self):
+        tracer = Tracer()
+        with tracer.bind_trace("cafe000000000001"):
+            with tracer.span("outer"):
+                with tracer.span("inner", trace_id="override123"):
+                    pass
+        with tracer.span("unbound"):
+            pass
+        by_name = {s["name"]: s for s in tracer.spans()}
+        assert by_name["outer"]["attrs"]["trace_id"] == "cafe000000000001"
+        assert by_name["inner"]["attrs"]["trace_id"] == "override123"
+        assert "trace_id" not in by_name["unbound"]["attrs"]
+        assert tracer.current_trace_id() is None
+
+    def test_bind_trace_list_stamps_trace_ids(self):
+        tracer = Tracer()
+        with tracer.bind_trace(["a1", "b2"]):
+            assert tracer.current_trace_id() is None  # a batch has no one id
+            with tracer.span("batchy"):
+                pass
+            with tracer.span("explicit", trace_ids=["c3"]):
+                pass
+        by_name = {s["name"]: s for s in tracer.spans()}
+        assert by_name["batchy"]["attrs"]["trace_ids"] == ["a1", "b2"]
+        assert by_name["explicit"]["attrs"]["trace_ids"] == ["c3"]
+
+    def test_spans_last_zero_returns_none(self):
+        """Regression: [-0:] slices the WHOLE list — span_tail=0 means
+        'no spans in bundles', not 'every retained span'."""
+        tracer = Tracer()
+        with tracer.span("a"):
+            pass
+        assert tracer.spans(last=0) == []
+        assert len(tracer.spans(last=1)) == 1
+
+    def test_new_trace_ids_are_unique_hex(self):
+        ids = {new_trace_id() for _ in range(256)}
+        assert len(ids) == 256
+        assert all(len(i) == 16 and int(i, 16) >= 0 for i in ids)
+
+
+# ---------------------------------------------------------------------------
+# ops HTTP server
+# ---------------------------------------------------------------------------
+
+
+class TestOpsServer:
+    def test_metrics_scrape_round_trips_and_matches_snapshot(self):
+        """/metrics → parse_prometheus_text ≡ registry.snapshot(), every
+        counter, gauge, and histogram bucket/sum/count (the ISSUE 9
+        satellite pin)."""
+        r = MetricRegistry()
+        r.counter("req_total", help="x", outcome="ok").inc(5)
+        r.counter("req_total", outcome="bad").inc(2)
+        r.gauge("depth", shard="0").set(3.5)
+        h = r.histogram("wait_seconds")
+        for v in (0.01, 0.2, 7.0):
+            h.observe(v)
+        with OpsServer(registry=r) as srv:
+            status, text, headers = http_get(f"{srv.url}/metrics")
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        parsed = parse_prometheus_text(text)
+        snap = r.snapshot()
+        assert parsed[("req_total", (("outcome", "ok"),))] == 5.0
+        assert parsed[("req_total", (("outcome", "bad"),))] == 2.0
+        assert parsed[("depth", (("shard", "0"),))] == 3.5
+        hsnap = snap["histograms"]["wait_seconds"]
+        for le, cum in hsnap["buckets"].items():
+            assert parsed[("wait_seconds_bucket", (("le", le),))] == cum
+        assert parsed[("wait_seconds_count", ())] == hsnap["count"]
+        assert parsed[("wait_seconds_sum", ())] == pytest.approx(
+            hsnap["sum"])
+
+    def test_healthz_maps_down_to_503(self):
+        payloads = [{"status": "ok"}]
+        srv = OpsServer(registry=MetricRegistry(),
+                        health_fn=lambda: payloads[0])
+        with srv:
+            status, body, _ = http_get(f"{srv.url}/healthz")
+            assert status == 200 and json.loads(body)["status"] == "ok"
+            payloads[0] = {"status": "degraded"}
+            status, body, _ = http_get(f"{srv.url}/healthz")
+            assert status == 200  # degraded still takes traffic
+            payloads[0] = {"status": "down", "why": "drained"}
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                http_get(f"{srv.url}/healthz")
+            assert exc_info.value.code == 503
+            assert json.loads(exc_info.value.read())["why"] == "drained"
+
+    def test_statusz_sections_and_404(self):
+        r = MetricRegistry()
+        tracer = Tracer()
+        with tracer.span("phase.x"):
+            pass
+        slo = SloEngine(r, default_slo_config("serving"))
+        srv = OpsServer(registry=r, tracer=tracer, slo=slo,
+                        stats_fn=lambda: {"requests": {"completed": 1}})
+        with srv:
+            status, body, _ = http_get(f"{srv.url}/statusz")
+            payload = json.loads(body)
+            assert status == 200
+            for key in ("health", "metrics", "spans", "stats", "slo"):
+                assert key in payload
+            assert "phase.x" in payload["spans"]
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                http_get(f"{srv.url}/nope")
+            assert exc_info.value.code == 404
+
+    @bounded(60)
+    def test_engine_and_fleet_wiring_helpers(self):
+        eng = fake_engine()
+        try:
+            with ops_server_for_engine(eng) as srv:
+                eng.submit(seq_of(4)).result(timeout=10)
+                _, text, _ = http_get(f"{srv.url}/metrics")
+                parsed = parse_prometheus_text(text)
+                assert parsed[(
+                    "serving_requests_total", (("outcome", "completed"),)
+                )] == 1.0
+                status, body, _ = http_get(f"{srv.url}/healthz")
+                assert json.loads(body)["status"] == "ok"
+        finally:
+            eng.shutdown(timeout=10)
+        # after shutdown the health payload is "down"
+        assert eng.health()["status"] == "down"
+
+        fleet = ServingFleet(
+            {}, TINY,
+            ServingConfig(buckets=(8,), max_batch=1, max_queue=4,
+                          max_wait_s=0.0, cache_capacity=0),
+            FleetConfig(replicas=2, probe_interval_s=0,
+                        reprobe_interval_s=30.0, fail_threshold=1),
+            engine_factory=lambda n, c, h: FakeEngine(
+                {}, TINY, c, fault_hook=h, replica_name=n),
+        )
+        try:
+            with ops_server_for_fleet(fleet) as srv:
+                fleet.submit(seq_of(4)).result(timeout=10)
+                status, body, _ = http_get(f"{srv.url}/healthz")
+                payload = json.loads(body)
+                assert payload["status"] == "ok"
+                assert payload["healthy_replicas"] == 2
+                _, text, _ = http_get(f"{srv.url}/metrics")
+                parsed = parse_prometheus_text(text)
+                assert parsed[(
+                    "fleet_requests_total", (("outcome", "completed"),)
+                )] == 1.0
+                assert parsed[("fleet_replica_up",
+                               (("replica", "r0"),))] == 1.0
+        finally:
+            fleet.shutdown(timeout=10)
+
+    @bounded(30)
+    def test_stop_before_start_does_not_hang(self):
+        """socketserver.shutdown() deadlocks unless serve_forever() is
+        running — stop() on a built-but-never-started server must skip
+        it and just close the socket."""
+        srv = OpsServer(registry=MetricRegistry())
+        srv.stop()
+
+    def test_ticker_runs_registered_hooks(self):
+        r = MetricRegistry()
+        hits = []
+        srv = OpsServer(registry=r, tick_interval_s=0.05)
+        srv.add_tick(lambda: hits.append(1))
+        srv.add_tick(lambda: host_memory_gauges(r))
+        with srv:
+            deadline = time.monotonic() + 5.0
+            while not hits and time.monotonic() < deadline:
+                time.sleep(0.02)
+        assert hits
+        snap = r.snapshot()["gauges"]
+        assert snap['host_memory_bytes{kind="peak_rss"}'] > 0
+
+
+# ---------------------------------------------------------------------------
+# host memory gauges
+# ---------------------------------------------------------------------------
+
+
+def test_host_memory_gauges_always_report():
+    r = MetricRegistry()
+    out = host_memory_gauges(r)
+    assert out["peak_rss_bytes"] > 0  # this process certainly has a peak
+    assert out["rss_bytes"] > 0
+    g = r.snapshot()["gauges"]
+    assert g['host_memory_bytes{kind="rss"}'] == out["rss_bytes"]
+    assert g['host_memory_bytes{kind="peak_rss"}'] == out["peak_rss_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+
+def shed_objective(**overrides):
+    base = dict(
+        name="shed_rate", kind="ratio",
+        bad=[{"metric": "fleet_requests_total",
+              "labels": {"outcome": "shed"}}],
+        total=[{"metric": "fleet_requests_total",
+                "labels": {"outcome": "submitted"}}],
+        objective=0.9, fast_burn=1.0, slow_burn=1.0,
+    )
+    base.update(overrides)
+    return base
+
+
+class TestSloEngine:
+    def test_ratio_objective_fires_and_resolves(self):
+        r = MetricRegistry()
+        submitted = r.counter("fleet_requests_total", outcome="submitted")
+        shed = r.counter("fleet_requests_total", outcome="shed")
+        cfg = SloConfig.from_dict({
+            "fast_window_s": 10, "slow_window_s": 30,
+            "objectives": [shed_objective()],
+        })
+        pages = []
+        slo = SloEngine(r, cfg, on_page=lambda *a: pages.append(a),
+                        clock=lambda: 0.0)
+        submitted.inc(10)
+        slo.evaluate(now=0.0)
+        # window DELTAS: 10 new submissions, 5 of them shed => 50% shed
+        # ratio against a 10% budget => burn 5.0
+        submitted.inc(10)
+        shed.inc(5)
+        out = slo.evaluate(now=5.0)
+        assert out["shed_rate"]["active"]
+        assert out["shed_rate"]["burn_fast"] == pytest.approx(5.0)
+        assert pages and pages[0][0] == "shed_rate"
+        assert pages[0][1] == "firing"
+        snap = r.snapshot()
+        assert snap["counters"][
+            'slo_alerts_total{objective="shed_rate",transition="firing"}'
+        ] == 1
+        assert snap["gauges"][
+            'slo_alert_active{objective="shed_rate"}'] == 1
+        # clean traffic ages the sheds out of the fast window -> resolves
+        submitted.inc(100)
+        out = slo.evaluate(now=16.0)
+        assert not out["shed_rate"]["active"]
+        assert pages[-1][1] == "resolved"
+        events = slo.events()
+        assert [e["transition"] for e in events] == ["firing", "resolved"]
+
+    def test_failures_without_new_submissions_still_burn(self):
+        """bad/total counters move at DIFFERENT times (submit vs
+        terminal): a window where only failures land — submissions
+        stopped because the service is down — must read as full burn,
+        not as zero traffic (which would resolve an active page
+        mid-outage)."""
+        r = MetricRegistry()
+        submitted = r.counter("fleet_requests_total", outcome="submitted")
+        failed = r.counter("fleet_requests_total", outcome="failed")
+        cfg = SloConfig.from_dict({
+            "fast_window_s": 10, "slow_window_s": 10,
+            "objectives": [{
+                "name": "availability", "kind": "ratio",
+                "bad": [{"metric": "fleet_requests_total",
+                         "labels": {"outcome": "failed"}}],
+                "total": [{"metric": "fleet_requests_total",
+                           "labels": {"outcome": "submitted"}}],
+                "objective": 0.9, "fast_burn": 1.0, "slow_burn": 1.0,
+            }],
+        })
+        slo = SloEngine(r, cfg, clock=lambda: 0.0)
+        submitted.inc(10)
+        slo.evaluate(now=0.0)
+        # the 10 in-flight requests all fail LATER, after the client
+        # stopped submitting: only `failed` moves inside the window
+        failed.inc(10)
+        out = slo.evaluate(now=15.0)
+        assert out["availability"]["burn_fast"] == pytest.approx(10.0)
+        assert out["availability"]["active"]
+
+    def test_slow_window_deflaps_a_brief_blip(self):
+        """Fast-window breach alone must NOT page: the slow window has
+        to agree (multi-window burn alerting's whole point)."""
+        r = MetricRegistry()
+        submitted = r.counter("fleet_requests_total", outcome="submitted")
+        shed = r.counter("fleet_requests_total", outcome="shed")
+        cfg = SloConfig.from_dict({
+            "fast_window_s": 5, "slow_window_s": 100,
+            "objectives": [shed_objective(fast_burn=1.0, slow_burn=3.0)],
+        })
+        slo = SloEngine(r, cfg, clock=lambda: 0.0)
+        submitted.inc(1000)
+        slo.evaluate(now=0.0)
+        for t in range(1, 60):
+            submitted.inc(10)
+            slo.evaluate(now=float(t))
+        # one shed burst: fast burn spikes past its threshold, but the
+        # slow window dilutes the same burst under ITS threshold
+        submitted.inc(10)
+        shed.inc(30)
+        out = slo.evaluate(now=60.0)
+        assert out["shed_rate"]["burn_fast"] >= 1.0
+        assert out["shed_rate"]["burn_slow"] < 3.0
+        assert not out["shed_rate"]["active"]
+
+    def test_quantile_objective(self):
+        r = MetricRegistry()
+        h = r.histogram("fleet_queue_wait_seconds")
+        cfg = SloConfig.from_dict({
+            "fast_window_s": 4, "slow_window_s": 8,
+            "objectives": [{
+                "name": "qw", "kind": "quantile",
+                "metric": "fleet_queue_wait_seconds",
+                "quantile": 0.95, "threshold": 1.0,
+                "fast_burn": 2.0, "slow_burn": 2.0,
+            }],
+        })
+        slo = SloEngine(r, cfg, clock=lambda: 0.0)
+        h.observe(0.1)
+        out = slo.evaluate(now=0.0)
+        assert not out["qw"]["active"]
+        for _ in range(50):
+            h.observe(5.0)  # p95 -> 5x the threshold
+        for t in (1.0, 2.0, 3.0, 9.0):
+            out = slo.evaluate(now=t)
+        assert out["qw"]["active"]
+
+    def test_config_validation_rejects_loudly(self):
+        with pytest.raises(ValueError, match="unknown SLO config key"):
+            SloConfig.from_dict({"objectives": [], "typo": 1})
+        with pytest.raises(ValueError, match="unknown key"):
+            SloObjective.from_dict(shed_objective(wat=1))
+        with pytest.raises(ValueError, match="kind"):
+            SloObjective.from_dict(shed_objective(kind="nope"))
+        with pytest.raises(ValueError, match="bad"):
+            SloObjective.from_dict(
+                {"name": "x", "kind": "ratio", "total": []})
+        with pytest.raises(ValueError, match="fast_window_s"):
+            SloConfig(objectives=(), fast_window_s=10, slow_window_s=5)
+        with pytest.raises(ValueError, match="duplicate"):
+            SloConfig(objectives=(
+                SloObjective.from_dict(shed_objective()),
+                SloObjective.from_dict(shed_objective()),
+            ))
+
+    def test_from_file_round_trip(self, tmp_path):
+        path = tmp_path / "slo.json"
+        path.write_text(json.dumps({
+            "fast_window_s": 2, "slow_window_s": 8,
+            "objectives": [shed_objective()],
+        }))
+        cfg = SloConfig.from_file(str(path))
+        assert cfg.fast_window_s == 2
+        assert cfg.objectives[0].name == "shed_rate"
+
+    def test_default_configs_build_for_both_modes(self):
+        for prefix in ("fleet", "serving"):
+            cfg = default_slo_config(prefix)
+            names = {o.name for o in cfg.objectives}
+            assert "availability" in names and "shed_rate" in names
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_incident_writes_bundle_with_spans_ring_and_metrics(self, tmp_path):
+        r = MetricRegistry()
+        r.counter("fleet_requeue_total").inc(2)
+        tracer = Tracer()
+        with tracer.span("serving.batch", trace_ids=["abc123def4567890"]):
+            pass
+        rec = FlightRecorder(str(tmp_path), tracer=tracer, registry=r,
+                             stats_fn=lambda: {"requests": {"shed": 1}})
+        rec.note("warmup", detail="x")
+        path = rec.incident("breaker_open", replica="r0", trips=1)
+        assert path is not None
+        bundle = json.loads(open(path).read())
+        assert bundle["incident"]["kind"] == "breaker_open"
+        assert bundle["incident"]["attrs"]["replica"] == "r0"
+        kinds = [e["kind"] for e in bundle["events"]]
+        assert "warmup" in kinds and "incident:breaker_open" in kinds
+        assert any("abc123def4567890" in s["attrs"].get("trace_ids", ())
+                   for s in bundle["spans"])
+        assert bundle["metrics"]["counters"]["fleet_requeue_total"] == 2
+        assert bundle["stats"]["requests"]["shed"] == 1
+        snap = r.snapshot()["counters"]
+        assert snap['flight_incidents_total{kind="breaker_open"}'] == 1
+        assert snap["flight_bundles_written_total"] == 1
+
+    def test_rate_limit_suppresses_same_kind_bundles(self, tmp_path):
+        t = [0.0]
+        rec = FlightRecorder(str(tmp_path), min_interval_s=10.0,
+                             clock=lambda: t[0])
+        assert rec.incident("watchdog_fire") is not None
+        t[0] = 1.0
+        assert rec.incident("watchdog_fire") is None  # suppressed
+        assert rec.incident("replica_drain") is not None  # other kind ok
+        t[0] = 11.0
+        assert rec.incident("watchdog_fire") is not None
+        snap = rec.snapshot()
+        assert len(snap["bundles"]) == 3
+        assert snap["suppressed_bundles"] == 1
+
+    def test_slo_page_hook_bundles_firing_and_notes_resolved(self, tmp_path):
+        """Regression: SloEngine's info dict itself carries `objective` —
+        the hook must merge, not re-pass it as a kwarg, or every page
+        TypeErrors (swallowed by the evaluator) and no bundle is ever
+        written."""
+        r = MetricRegistry()
+        submitted = r.counter("fleet_requests_total", outcome="submitted")
+        shed = r.counter("fleet_requests_total", outcome="shed")
+        cfg = SloConfig.from_dict({
+            "fast_window_s": 10, "slow_window_s": 30,
+            "objectives": [shed_objective()],
+        })
+        rec = FlightRecorder(str(tmp_path), registry=r)
+        slo = SloEngine(r, cfg, on_page=rec.slo_page_hook,
+                        clock=lambda: 0.0)
+        submitted.inc(10)
+        slo.evaluate(now=0.0)
+        submitted.inc(10)
+        shed.inc(5)
+        slo.evaluate(now=5.0)   # fires -> the hook must write a bundle
+        snap = rec.snapshot()
+        assert len(snap["bundles"]) == 1
+        bundle = json.loads(open(snap["bundles"][0]).read())
+        assert bundle["incident"]["kind"] == "slo_page"
+        assert bundle["incident"]["attrs"]["objective"] == "shed_rate"
+        assert bundle["incident"]["attrs"]["transition"] == "firing"
+        submitted.inc(100)
+        slo.evaluate(now=16.0)  # resolves -> ring event, no new bundle
+        assert len(rec.snapshot()["bundles"]) == 1
+        events = json.loads(
+            open(rec.incident("watchdog_fire")).read())["events"]
+        assert any(e["kind"] == "slo_resolved" for e in events)
+
+    def test_ring_is_bounded_and_poll_records_deltas(self, tmp_path):
+        r = MetricRegistry()
+        c = r.counter("req_total", outcome="ok")
+        rec = FlightRecorder(str(tmp_path), registry=r, capacity=8)
+        rec.poll()        # baseline
+        c.inc(3)
+        rec.poll()        # delta event
+        for i in range(20):
+            rec.note("filler", i=i)
+        path = rec.incident("slo_page", objective="x")
+        bundle = json.loads(open(path).read())
+        assert len(bundle["events"]) <= 8
+        rec2 = FlightRecorder(str(tmp_path / "b"), registry=r)
+        rec2.poll()
+        c.inc(4)
+        rec2.poll()
+        path2 = rec2.incident("slo_page")
+        events = json.loads(open(path2).read())["events"]
+        delta = [e for e in events if e["kind"] == "metrics_delta"]
+        assert delta and delta[0]["attrs"]["deltas"][
+            "req_total{outcome=ok}"] == 4.0
+
+    @bounded(60)
+    def test_engine_watchdog_and_breaker_report_incidents(self, tmp_path):
+        from alphafold2_tpu.serving import HungBatchError, PredictionError
+
+        incidents = []
+
+        def hook(kind, **attrs):
+            incidents.append((kind, attrs))
+
+        inj = FaultPlan(faults=(
+            Fault("hung_request", at=0, hang_s=15.0),
+            Fault("request_error", at=1, count=2),
+        )).injector()
+        # threshold 3: the hung batch is failure 1, the two injected
+        # errors are 2 and 3 — the circuit opens on the LAST dispatch,
+        # so no submit in the loop is fast-rejected before it
+        eng = FakeEngine(
+            {}, TINY,
+            ServingConfig(buckets=(8,), max_batch=1, max_queue=8,
+                          max_wait_s=0.0, cache_capacity=0,
+                          watchdog_timeout_s=0.25, breaker_threshold=3),
+            fault_hook=inj.serving_hook(), incident_hook=hook,
+            replica_name="r7",
+        )
+        try:
+            with pytest.raises(HungBatchError):
+                eng.submit(seq_of(4)).result(timeout=10)
+            for i in range(2):
+                with pytest.raises(PredictionError):
+                    eng.submit(seq_of(5, offset=i)).result(timeout=10)
+            kinds = [k for k, _ in incidents]
+            assert "watchdog_fire" in kinds and "breaker_open" in kinds
+            by_kind = dict(reversed([(k, a) for k, a in incidents]))
+            assert by_kind["watchdog_fire"]["replica"] == "r7"
+            assert by_kind["watchdog_fire"]["trace_ids"]
+            assert by_kind["breaker_open"]["state"] == "open"
+        finally:
+            eng.shutdown(timeout=10)
+
+    @bounded(120)
+    def test_fleet_drain_trips_recorder_bundle(self, tmp_path):
+        tracer = Tracer()
+        rec = FlightRecorder(str(tmp_path), tracer=tracer)
+        inj = FaultPlan(faults=(
+            Fault("kill_replica", replica="r0", at=0),
+        )).injector()
+        fleet = ServingFleet(
+            {}, TINY,
+            ServingConfig(buckets=(8,), max_batch=1, max_queue=8,
+                          max_wait_s=0.0, request_timeout_s=30.0,
+                          cache_capacity=0),
+            FleetConfig(replicas=2, probe_interval_s=0,
+                        reprobe_interval_s=30.0, fail_threshold=1,
+                        requeue_limit=2),
+            engine_factory=lambda n, c, h: FakeEngine(
+                {}, TINY, c, fault_hook=h, tracer=tracer, replica_name=n),
+            injector=inj, tracer=tracer,
+            incident_hook=rec.incident,
+        )
+        rec.bind(registry=fleet.registry, stats_fn=fleet.stats)
+        try:
+            res = fleet.submit(seq_of(5)).result(timeout=30)
+            assert res.requeues >= 1
+            deadline = time.monotonic() + 20.0
+            while not rec.snapshot()["bundles"] and (
+                    time.monotonic() < deadline):
+                time.sleep(0.05)
+            bundles = rec.snapshot()["bundles"]
+            assert bundles, "replica drain never produced a bundle"
+            bundle = json.loads(open(bundles[0]).read())
+            assert bundle["incident"]["kind"] == "replica_drain"
+            assert bundle["incident"]["attrs"]["replica"] == "r0"
+            # the bundle's spans hold the victim's id on both replicas
+            tid = res.trace_id
+            replicas = set()
+            for s in bundle["spans"]:
+                attrs = s["attrs"]
+                if (attrs.get("trace_id") == tid
+                        or tid in attrs.get("trace_ids", ())):
+                    replicas.add(attrs.get("replica"))
+            replicas.discard(None)
+            assert {"r0", "r1"} <= replicas
+        finally:
+            fleet.shutdown(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario, end to end through the real CLI
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@bounded(420)
+def test_serve_cli_ops_plane_acceptance(tmp_path):
+    """ISSUE 9 acceptance: a 3-replica chaos replay with the ops plane up
+    yields (1) a LIVE /metrics scrape that round-trips through
+    parse_prometheus_text, (2) >=1 SLO alert recorded in the registry,
+    and (3) a flight-recorder bundle whose spans carry one killed
+    request's trace_id on two replicas."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    stats_path = tmp_path / "stats.json"
+    port_file = tmp_path / "ops.port"
+    flight_dir = tmp_path / "flight"
+    slo_path = tmp_path / "slo.json"
+    # tight windows + a sensitive shed objective so the chaos plan's
+    # sheds page within the replay's lifetime
+    slo_path.write_text(json.dumps({
+        "fast_window_s": 2, "slow_window_s": 8,
+        "objectives": [
+            {"name": "shed_rate", "kind": "ratio",
+             "bad": [{"metric": "fleet_requests_total",
+                      "labels": {"outcome": "shed"}}],
+             "total": [{"metric": "fleet_requests_total",
+                        "labels": {"outcome": "submitted"}}],
+             "objective": 0.99, "fast_burn": 1.0, "slow_burn": 1.0},
+            {"name": "queue_wait_p95", "kind": "quantile",
+             "metric": "fleet_queue_wait_seconds",
+             "quantile": 0.95, "threshold": 0.05,
+             "fast_burn": 1.0, "slow_burn": 1.0},
+        ],
+    }))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "serve.py"),
+         "--demo", "24", "--replicas", "3", "--buckets", "16,32",
+         "--dim", "16", "--depth", "1", "--heads", "2", "--dim-head", "8",
+         "--mds-iters", "4", "--max-batch", "2", "--queue-size", "4",
+         "--fleet-queue", "4", "--degrade-depth", "3",
+         "--request-timeout", "120", "--reprobe-interval", "0.3",
+         "--fault-plan",
+         os.path.join(repo, "docs", "examples", "fleet_chaos_plan.json"),
+         "--ops-port", "0", "--ops-port-file", str(port_file),
+         "--ops-tick", "0.3", "--slo-config", str(slo_path),
+         "--flight-dir", str(flight_dir),
+         "--stats-json", str(stats_path), "--stats-interval", "2",
+         "--seed", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    live_scrape = None
+    try:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline and not port_file.exists():
+            if proc.poll() is not None:
+                break
+            time.sleep(0.1)
+        assert port_file.exists(), "ops port file never appeared"
+        port = int(port_file.read_text())
+        # scrape LIVE while the replay runs (retry: the run may finish
+        # between the port write and our request on a fast machine)
+        while time.monotonic() < deadline and proc.poll() is None:
+            try:
+                _, text, _ = http_get(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5)
+                live_scrape = parse_prometheus_text(text)
+                if any(n == "fleet_requests_total"
+                       for n, _ in live_scrape):
+                    break
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.3)
+        out, err = proc.communicate(timeout=360)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            out, err = proc.communicate()
+    assert proc.returncode == 0, out[-2000:] + err[-2000:]
+    # (1) the live scrape parsed and carried the fleet families
+    assert live_scrape is not None, "never got a live /metrics scrape"
+    assert any(n == "fleet_requests_total" for n, _ in live_scrape)
+    # (2) >=1 SLO alert recorded in the registry
+    stats = json.loads(stats_path.read_text())
+    counters = stats["telemetry"]["metrics"]["counters"]
+    fired = sum(v for k, v in counters.items()
+                if k.startswith("slo_alerts_total")
+                and 'transition="firing"' in k)
+    assert fired >= 1, f"no SLO alert fired; slo counters: " + str(
+        {k: v for k, v in counters.items() if k.startswith("slo")})
+    # (3) a flight bundle whose spans carry one trace_id on two replicas
+    bundles = sorted(flight_dir.glob("incident-*.json"))
+    assert bundles, "no flight-recorder bundle on disk"
+    cross = set()
+    for bundle_path in bundles:
+        bundle = json.loads(bundle_path.read_text())
+        per_tid = {}
+        for s in bundle["spans"]:
+            attrs = s["attrs"]
+            rep = attrs.get("replica")
+            if rep is None:
+                continue
+            tids = attrs.get("trace_ids", ())
+            if attrs.get("trace_id"):
+                tids = list(tids) + [attrs["trace_id"]]
+            for tid in tids:
+                per_tid.setdefault(tid, set()).add(rep)
+        cross |= {tid for tid, reps in per_tid.items() if len(reps) >= 2}
+    assert cross, "no trace_id seen on two replicas in any bundle"
+    # the chaos plan killed r0 and r1: requeues guarantee >=1 such request
+    reqs = stats["requests"]
+    assert reqs["requeued"] >= 1 and reqs["failed"] == 0

@@ -182,13 +182,45 @@ class TestRegistry:
         h = r.histogram("lat_seconds", help="latency")
         for v in (0.1, 0.2, 0.4):
             h.observe(v)
-        parsed = parse_prometheus_text(r.to_prometheus())
+        text = r.to_prometheus()
+        assert "# TYPE lat_seconds histogram" in text
+        parsed = parse_prometheus_text(text)
         assert parsed[("req_total", (("outcome", "ok"),))] == 3.0
         assert parsed[("req_total", (("outcome", "failed"),))] == 1.0
         assert parsed[("queue_depth", ())] == 7.0
-        assert parsed[("lat_seconds", (("quantile", "0.5"),))] == 0.2
+        # real cumulative buckets (not summary-quantile gauges): each
+        # le bound carries the count of observations <= it, +Inf = count
+        assert parsed[("lat_seconds_bucket", (("le", "0.05"),))] == 0.0
+        assert parsed[("lat_seconds_bucket", (("le", "0.1"),))] == 1.0
+        assert parsed[("lat_seconds_bucket", (("le", "0.25"),))] == 2.0
+        assert parsed[("lat_seconds_bucket", (("le", "0.5"),))] == 3.0
+        assert parsed[("lat_seconds_bucket", (("le", "+Inf"),))] == 3.0
         assert parsed[("lat_seconds_count", ())] == 3.0
         assert parsed[("lat_seconds_sum", ())] == pytest.approx(0.7)
+        # the scrape agrees with the in-process snapshot, bucket by bucket
+        snap = r.snapshot()["histograms"]["lat_seconds"]
+        for le, cum in snap["buckets"].items():
+            assert parsed[("lat_seconds_bucket", (("le", le),))] == cum
+
+    def test_histogram_buckets_cumulative_and_monotonic(self):
+        """Buckets are LIFETIME cumulative counters: the sliding window
+        evicting old observations must never rewind a bucket count, and
+        counts are monotone in le."""
+        from alphafold2_tpu.telemetry.registry import Histogram
+
+        h = Histogram(window=4, bounds=(1.0, 2.0, 5.0))
+        for _ in range(10):
+            h.observe(0.5)
+        h.observe(10.0)  # lands only in +Inf
+        b = h.buckets()
+        assert b == {"1": 10, "2": 10, "5": 10, "+Inf": 11}
+        # window only holds 4 values but lifetime buckets kept all 11
+        assert h.snapshot()["window"] == 4
+        # boundary value counts into its own bucket (le is inclusive)
+        h.observe(2.0)
+        assert h.buckets()["2"] == 11
+        with pytest.raises(ValueError, match="increasing"):
+            Histogram(bounds=(1.0, 1.0, 2.0))
 
     def test_prometheus_label_escaping_roundtrips(self):
         r = MetricRegistry()
