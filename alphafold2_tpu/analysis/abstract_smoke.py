@@ -483,6 +483,43 @@ def _targets() -> Dict[str, Callable[[], None]]:
         assert not bad and rows[0]["status"] == "regressed"
 
     # --- parallel / overlap -------------------------------------------------
+    @register("parallel.partition_rules")
+    def _partition_rules():
+        # the registry matched over the REAL flagship train state
+        # (eval_shape'd — depth-stacked reversible layout included):
+        # raises on an unmatched leaf, a rank-incompatible rule, or a
+        # registry/model drift — the same contract the sharding-lint
+        # coverage pass enforces, kept here so `--files` smoke runs and
+        # CI target lists exercise it too
+        from jax.sharding import PartitionSpec
+
+        from alphafold2_tpu.models import Alphafold2Config
+        from alphafold2_tpu.parallel.rules import (
+            match_partition_rules,
+            partition_rules,
+        )
+        from alphafold2_tpu.training.harness import (
+            TrainConfig,
+            train_state_init,
+        )
+
+        cfg = Alphafold2Config(
+            dim=16, depth=2, heads=2, dim_head=8, max_seq_len=32,
+            reversible=True, msa_tie_row_attn=True,
+            cross_attn_compress_ratio=2,
+        )
+        state = jax.eval_shape(
+            lambda k: train_state_init(k, cfg, TrainConfig(grad_accum=1)),
+            key,
+        )
+        specs = match_partition_rules(partition_rules(True), state)
+        flat = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+        )
+        assert flat and all(isinstance(s, PartitionSpec) for s in flat)
+        sharded = [s for s in flat if any(e is not None for e in s)]
+        assert sharded, "TP registry produced no sharded specs"
+
     @register("parallel.overlap_bucketing")
     def _overlap_bucketing():
         import numpy as np  # module-level np is deleted after registration

@@ -439,6 +439,33 @@ def worker_main() -> None:
         ).mlir_module()
         problems[key] = check(analyze_schedule(txt))
 
+    # --- DP-overlap on the MULTI-HOST mesh shape ---------------------------
+    # A pod's mesh is hybrid: DP on the outer (DCN, cross-host) axis, the
+    # bandwidth-hungry strategy on the inner (ICI) axis. The overlap
+    # claim must survive THAT lowering — the all-reduce subgroups become
+    # strided over the inner axis, which is exactly the reshuffle that
+    # could silently reserialize the schedule. Same program, same
+    # structural assertions, hybrid {"data": 2} x {"model": 4} mesh
+    # (process-spanning in production; device-count-identical here, the
+    # lowering is what's under test).
+    from alphafold2_tpu.parallel import hybrid_mesh
+
+    hb_mesh = hybrid_mesh({"data": 2}, {"model": 4})
+    hb_batch = {
+        "seq": jax.ShapeDtypeStruct((3, 2, 8), jnp.int32),
+        "mask": jax.ShapeDtypeStruct((3, 2, 8), jnp.bool_),
+        "coords": jax.ShapeDtypeStruct((3, 2, 8, 3), jnp.float32),
+    }
+    step, _ = make_dp_overlap_train_step(
+        cfg, tcfg, hb_mesh, hb_batch, overlap=True, donate_state=False
+    )
+    txt = jexport.export(step, platforms=["tpu"])(
+        state, hb_batch
+    ).mlir_module()
+    problems["dp_overlap_hybrid_mesh"] = check_overlapped_dp(
+        analyze_schedule(txt), n_buckets
+    )
+
     print(json.dumps({"problems": problems}))
 
 

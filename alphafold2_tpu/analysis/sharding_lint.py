@@ -19,6 +19,22 @@ Checks every `PartitionSpec(...)` / `P(...)` construction (plus
             tuple arity disagrees with f's parameter count (f a lambda or
             a local def) — today this dies deep in shard_map's pytree
             mismatch error; the static message names the actual problem.
+
+Registry checks (PR 10 — the partition-rule registry in
+`parallel/rules.py` is DATA, so the lint can validate it without a chip):
+
+  SHARD005  a rule's PartitionSpec names an axis outside KNOWN_AXES.
+  SHARD006  a non-scalar leaf of the LIVE model tree (flagship reversible
+            train state + e2e state, `eval_shape`d — zero FLOPs) that no
+            rule matches, or that a matched rule cannot rank-adapt to —
+            the leaf would raise at sharding time on the pod; the lint
+            moves that to CI.
+  SHARD007  a rule whose regex does not compile.
+
+The registry checks run on whole-repo invocations (like the smoke pass,
+they are skipped for file-scoped lint runs). `check_registry` /
+`check_coverage` accept fixture rules/trees directly — the test suite
+feeds deliberately-broken fixtures through them.
 """
 
 from __future__ import annotations
@@ -193,6 +209,109 @@ class _Visitor(ast.NodeVisitor):
             )
 
 
+_RULES_SRC = "alphafold2_tpu/parallel/rules.py"
+
+
+def check_registry(rules=None, axes: Optional[Set[str]] = None) -> List[Finding]:
+    """SHARD005/SHARD007 over a rule set (default: the live TP registry):
+    every axis named by a rule's spec must be in KNOWN_AXES, and every
+    pattern must compile. Takes fixture rules for tests."""
+    import re as _re
+
+    if rules is None:
+        from alphafold2_tpu.parallel.rules import TP_RULES
+
+        rules = TP_RULES
+    if axes is None:
+        from alphafold2_tpu.parallel.mesh import KNOWN_AXES
+
+        axes = set(KNOWN_AXES)
+    from alphafold2_tpu.parallel.rules import rule_axes
+
+    findings: List[Finding] = []
+    for i, (pattern, spec) in enumerate(rules):
+        try:
+            _re.compile(pattern)
+        except _re.error as e:
+            findings.append(Finding(
+                PASS, "SHARD007", _RULES_SRC, 1,
+                f"rule #{i} pattern {pattern!r} is not a valid regex: {e}",
+            ))
+        for ax in sorted(rule_axes([(pattern, spec)])):
+            if ax not in axes:
+                findings.append(Finding(
+                    PASS, "SHARD005", _RULES_SRC, 1,
+                    f"rule #{i} ({pattern!r}) names mesh axis {ax!r} "
+                    f"not in KNOWN_AXES {sorted(axes)} — typo, or a "
+                    "new axis missing its registry entry",
+                ))
+    return findings
+
+
+def check_coverage(rules=None, tree=None) -> List[Finding]:
+    """SHARD006: cross-check the registry against a param/state tree —
+    by default the LIVE flagship trees (reversible tied-row pretrain
+    state AND the full e2e state), obtained chip-free via `eval_shape`.
+    Takes a fixture tree for tests."""
+    if rules is None:
+        from alphafold2_tpu.parallel.rules import TP_RULES
+
+        rules = TP_RULES
+    from alphafold2_tpu.parallel.rules import unmatched_leaves
+
+    trees = []
+    if tree is not None:
+        trees.append(("fixture", tree))
+    else:
+        try:
+            import jax
+
+            from alphafold2_tpu.models import Alphafold2Config, RefinerConfig
+            from alphafold2_tpu.training import E2EConfig, e2e_train_state_init
+            from alphafold2_tpu.training.harness import (
+                TrainConfig,
+                train_state_init,
+            )
+
+            cfg = Alphafold2Config(
+                dim=16, depth=2, heads=2, dim_head=8, max_seq_len=32,
+                msa_tie_row_attn=True, cross_attn_compress_ratio=2,
+            )
+            ecfg = E2EConfig(
+                model=Alphafold2Config(
+                    dim=16, depth=2, heads=2, dim_head=8, max_seq_len=32,
+                    reversible=True, msa_tie_row_attn=True,
+                    cross_attn_compress_ratio=2,
+                ),
+                refiner=RefinerConfig(
+                    num_tokens=14, dim=16, depth=1, msg_dim=16
+                ),
+                mds_iters=2,
+            )
+            tcfg = TrainConfig(grad_accum=1)
+            key = jax.random.PRNGKey(0)
+            trees.append(("train_state(flagship)", jax.eval_shape(
+                lambda k: train_state_init(k, cfg, tcfg), key)))
+            trees.append(("e2e_train_state(reversible)", jax.eval_shape(
+                lambda k: e2e_train_state_init(k, ecfg, tcfg), key)))
+        except Exception as e:  # the import/trace itself broke
+            return [Finding(
+                PASS, "SHARD006", _RULES_SRC, 1,
+                f"could not eval_shape the live model trees for registry "
+                f"coverage: {type(e).__name__}: {e}",
+            )]
+    findings: List[Finding] = []
+    for label, t in trees:
+        for name, shape in unmatched_leaves(rules, t):
+            findings.append(Finding(
+                PASS, "SHARD006", _RULES_SRC, 1,
+                f"{label}: no partition rule covers leaf {name!r} "
+                f"(shape {shape}) — it would raise at sharding time; add "
+                "a rule to parallel/rules.py",
+            ))
+    return findings
+
+
 def run(root, files: Optional[Sequence] = None, axes=None) -> List[Finding]:
     axes = set(axes) if axes is not None else _default_axes(root)
     findings: List[Finding] = []
@@ -224,4 +343,11 @@ def run(root, files: Optional[Sequence] = None, axes=None) -> List[Finding]:
         v = _Visitor(rel(path, root), src, axes, defs)
         v.visit(tree)
         findings.extend(filter_suppressed(v.findings, suppressed_lines(src)))
+    if files is None:
+        # whole-repo run: validate the partition-rule registry itself
+        # (axes + regexes) and cross-check it against the live model
+        # trees chip-free. Skipped for file-scoped invocations, same
+        # stance as the smoke pass.
+        findings.extend(check_registry(axes=axes or None))
+        findings.extend(check_coverage())
     return findings

@@ -37,12 +37,19 @@ import jax
 __all__ = [
     "JAX_VERSION",
     "CompilerParams",
+    "backend_initialized",
+    "broadcast_one_to_all",
+    "enable_cpu_collectives",
     "create_hybrid_device_mesh",
+    "make_array_from_process_local_data",
+    "make_global_array_from_host",
     "out_struct",
     "pallas",
     "pallas_tpu",
     "pcast",
+    "process_allgather",
     "shard_map",
+    "sync_global_devices",
     "typeof_vma",
 ]
 
@@ -151,6 +158,154 @@ def pcast(x, axis_names, *, to: str = "varying"):
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axis_names, to=to)
     return x
+
+
+# --- multi-host runtime ----------------------------------------------------
+# The multihost utilities live under jax.experimental on every JAX this
+# repo supports; resolved here so parallel/distributed.py and
+# training/checkpoint.py stay free of experimental imports (compat-lint
+# contract). `make_array_from_process_local_data` moved to the jax
+# namespace in 0.4.31 — older trees fall back to per-device assembly
+# from process-index slices (the "process-index slicing" route).
+
+
+def enable_cpu_collectives() -> bool:
+    """Select a cross-process collectives implementation for the CPU
+    backend (Gloo). Without one, a multi-process CPU runtime enumerates
+    the pod's devices but every cross-process computation dies with
+    "Multiprocess computations aren't implemented on the CPU backend" —
+    the 2-process test matrix (and any CPU-pod rehearsal) needs this set
+    BEFORE backend init. Returns False when this jaxlib has no such
+    option (TPU-only builds, future renames); harmless then, since only
+    CPU multi-process paths need it."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        return True
+    except Exception:
+        return False
+
+
+def backend_initialized() -> bool:
+    """True once any XLA backend has been created in this process — the
+    point past which `jax.distributed.initialize` is too late (the
+    backend already enumerated only-local devices). Resolution is
+    version-tolerant: the public predicate when present, else the
+    backend cache xla_bridge maintains on every supported JAX."""
+    try:
+        from jax.lib import xla_bridge as xb
+    except Exception:  # pragma: no cover - layout drift
+        return False
+    fn = getattr(xb, "backends_are_initialized", None)
+    if fn is not None:
+        try:
+            return bool(fn())
+        except Exception:  # pragma: no cover
+            pass
+    return bool(getattr(xb, "_backends", None))
+
+
+def sync_global_devices(name: str) -> None:
+    """Cross-process barrier (multihost_utils.sync_global_devices): every
+    process blocks until all reach the same named point. No-op with one
+    process — callers need no guard."""
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def broadcast_one_to_all(x, is_source: Optional[bool] = None):
+    """multihost_utils.broadcast_one_to_all: process 0's value on every
+    process (identity single-process)."""
+    if jax.process_count() <= 1:
+        return x
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(x, is_source=is_source)
+
+
+def process_allgather(x, *, tiled: bool = True):
+    """multihost_utils.process_allgather: the GLOBAL value of a (possibly
+    cross-process-sharded) array, materialized host-side on every
+    process. Identity-to-numpy single-process."""
+    if jax.process_count() <= 1:
+        import numpy as np
+
+        return jax.tree_util.tree_map(
+            lambda a: np.asarray(jax.device_get(a)), x
+        )
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x, tiled=tiled)
+
+
+def make_global_array_from_host(x, sharding):
+    """Global jax.Array from a host value EVERY process already holds.
+
+    `jax.device_put(host_value, cross_process_sharding)` broadcasts the
+    bytes from process 0 over the wire (and the CPU backend's gloo
+    transport aborts on the interleaved small transfers a whole pytree
+    produces). When the host value is identical on all processes —
+    restored checkpoint bytes, same-seed init — no transfer is needed at
+    all: each process feeds its OWN addressable shards from its local
+    copy via `make_array_from_callback`. Single-process this degenerates
+    to a plain sharded device_put."""
+    import numpy as np
+
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
+def make_array_from_process_local_data(sharding, local_data, global_shape=None):
+    """`jax.make_array_from_process_local_data` across versions: assemble
+    a global jax.Array from this process's rows of the batch. On JAX
+    trees without the helper (< 0.4.31), falls back to
+    `make_array_from_single_device_arrays` over process-index slices of
+    the local data — each local device gets its addressable block."""
+    fn = getattr(jax, "make_array_from_process_local_data", None)
+    if fn is not None:
+        return fn(sharding, local_data, global_shape)
+    import numpy as np
+
+    local_data = np.asarray(local_data)
+    if global_shape is None:
+        # the real API infers the global shape by scaling sharded dims;
+        # the fallback cannot do that reliably (it would have to guess
+        # which dims are process-sharded), so require it explicitly —
+        # every in-repo caller passes it
+        raise ValueError(
+            "make_array_from_process_local_data fallback (JAX < 0.4.31) "
+            "requires an explicit global_shape"
+        )
+    addressable = sharding.addressable_devices_indices_map(tuple(global_shape))
+    # map each addressable device's GLOBAL index window into local
+    # coordinates: along every process-sharded dim this process owns a
+    # contiguous block, offset by the minimum start across its own
+    # addressable windows (computed PER DIM — two dims sharded across
+    # processes carry two different offsets)
+    offsets: dict = {}
+    arrays = []
+    for dev, idx in addressable.items():
+        loc = []
+        for d, sl in enumerate(idx):
+            start = 0 if sl.start is None else sl.start
+            stop = global_shape[d] if sl.stop is None else sl.stop
+            if global_shape[d] != local_data.shape[d]:
+                if d not in offsets:
+                    offsets[d] = min(
+                        (0 if s[d].start is None else s[d].start)
+                        for s in addressable.values()
+                    )
+                loc.append(slice(start - offsets[d], stop - offsets[d]))
+            else:
+                loc.append(sl)
+        arrays.append(jax.device_put(local_data[tuple(loc)], dev))
+    return jax.make_array_from_single_device_arrays(
+        tuple(global_shape), sharding, arrays
+    )
 
 
 # --- device mesh helpers ---------------------------------------------------

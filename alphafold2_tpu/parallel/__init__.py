@@ -7,6 +7,15 @@ collectives. See SURVEY.md §2.2 for the strategy-by-strategy mapping.
 """
 
 from alphafold2_tpu.parallel.mesh import data_parallel_mesh, hybrid_mesh, make_mesh
+from alphafold2_tpu.parallel.rules import (
+    TP_RULES,
+    match_partition_rules,
+    named_tree_map,
+    partition_rules,
+    rule_axes,
+    tree_path_string,
+    unmatched_leaves,
+)
 from alphafold2_tpu.parallel.sharding import (
     batch_shardings,
     param_spec,
@@ -21,6 +30,7 @@ from alphafold2_tpu.parallel.overlap import (
 )
 from alphafold2_tpu.parallel.train import (
     make_dp_overlap_train_step,
+    make_multihost_train_step,
     make_sharded_train_step,
     make_sp_train_step,
     make_pp_train_step,
@@ -46,6 +56,7 @@ from alphafold2_tpu.parallel.pipeline import (
     pipeline_trunk_apply,
 )
 from alphafold2_tpu.parallel.distributed import (
+    distributed_startup,
     global_mesh,
     initialize_from_env,
     process_local_batch_size,
@@ -57,8 +68,17 @@ __all__ = [
     "alphafold2_apply_pp",
     "pipeline_trunk_apply",
     "initialize_from_env",
+    "distributed_startup",
     "global_mesh",
     "process_local_batch_size",
+    "TP_RULES",
+    "match_partition_rules",
+    "named_tree_map",
+    "partition_rules",
+    "rule_axes",
+    "tree_path_string",
+    "unmatched_leaves",
+    "make_multihost_train_step",
     "ring_attention",
     "ulysses_attention",
     "axial_alltoall_transpose",

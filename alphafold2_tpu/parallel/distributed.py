@@ -30,6 +30,8 @@ from typing import Mapping, Optional
 
 import jax
 
+from alphafold2_tpu import compat
+
 
 def initialize_from_env(
     *,
@@ -52,7 +54,28 @@ def initialize_from_env(
         pid_env = os.environ.get("AF2_PROCESS_ID")
         process_id = int(pid_env) if pid_env is not None else None
 
+    will_init = (coordinator and num_processes > 1) or (
+        os.environ.get("AF2_AUTO_INIT") == "1"
+    )
+    if will_init and compat.backend_initialized():
+        # joining AFTER backend init would leave this process on its
+        # local-only device view while claiming pod membership — every
+        # mesh built from jax.devices() would silently be a one-host
+        # mesh. Refuse loudly; the fix is ordering, not retrying.
+        raise RuntimeError(
+            "initialize_from_env() called after JAX's backend was already "
+            "initialized — the distributed runtime must be joined BEFORE "
+            "the first backend-initializing JAX call (jax.devices(), any "
+            "computation, ...). Move the startup call (see "
+            "distributed_startup) to the top of main()."
+        )
+
     if coordinator and num_processes > 1:
+        # CPU pods (the test matrix, accelerator-free hosts) need a
+        # cross-process collectives impl picked before backend init;
+        # harmless on non-CPU backends, so no platform sniffing — the
+        # env var may be unset with the backend still resolving to CPU
+        compat.enable_cpu_collectives()
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
@@ -64,6 +87,87 @@ def initialize_from_env(
         jax.distributed.initialize()  # TPU-pod metadata auto-detection
         return True
     return False
+
+
+def distributed_startup(label: str = "") -> bool:
+    """The shared CLI startup: every entry point (train_pre.py,
+    train_end2end.py, serve.py, predict.py) calls this once, right after
+    argparse and before anything that initializes the JAX backend.
+
+    Joins the multi-host runtime when one is configured (the
+    AF2_COORDINATOR/... contract above), errors LOUDLY if the backend
+    was already initialized (see initialize_from_env), and prints one
+    line describing the joined topology so multi-host logs self-identify
+    their process. Returns True when a distributed runtime was joined.
+    """
+    joined = initialize_from_env()
+    if joined:
+        tag = f"{label}: " if label else ""
+        print(
+            f"{tag}joined multi-host runtime: process "
+            f"{jax.process_index()}/{jax.process_count()}, "
+            f"{jax.local_device_count()} local / {jax.device_count()} "
+            "global devices",
+            flush=True,
+        )
+    return joined
+
+
+# --- CPU-pod rehearsal harness ----------------------------------------------
+# One definition of "launch N coordinated CPU processes" shared by the
+# 2-process test matrix (tests/test_distributed.py) and the MULTICHIP
+# dryrun's multihost_dp leg (__graft_entry__.py) — the env hygiene here
+# (axon scrub, no inherited XLA flags, NO shared persistent compile
+# cache: an executable cached under one process topology must never be
+# replayed under another) was learned the hard way and must not drift
+# between the two callers.
+
+
+def free_local_port() -> int:
+    """An OS-assigned free TCP port for a localhost coordinator."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def cpu_pod_env(
+    *,
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    repo_path: Optional[str] = None,
+    extra: Optional[Mapping[str, str]] = None,
+) -> dict:
+    """Scrubbed subprocess env for one process of a CPU-pod rehearsal.
+
+    Pins the CPU platform, removes the TPU-tunnel pin, inherited XLA
+    flags (workers provision their own virtual device counts), and any
+    persistent compile-cache dir (topology aliasing hazard — see module
+    comment). With `coordinator` set, adds the AF2_COORDINATOR /
+    AF2_NUM_PROCESSES / AF2_PROCESS_ID launch contract; `extra` wins
+    over everything.
+    """
+    env = dict(os.environ)
+    for var in (
+        "PALLAS_AXON_POOL_IPS",
+        "JAX_PLATFORM_NAME",
+        "JAX_COMPILATION_CACHE_DIR",
+        "XLA_FLAGS",
+    ):
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if coordinator is not None:
+        env["AF2_COORDINATOR"] = coordinator
+        env["AF2_NUM_PROCESSES"] = str(num_processes)
+        env["AF2_PROCESS_ID"] = str(process_id)
+    if repo_path:
+        env["PYTHONPATH"] = os.pathsep.join(
+            [repo_path] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+        )
+    env.update(dict(extra) if extra else {})
+    return env
 
 
 def global_mesh(axes: Mapping[str, int]):

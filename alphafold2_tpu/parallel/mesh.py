@@ -34,31 +34,73 @@ from alphafold2_tpu import compat
 KNOWN_AXES = frozenset({"data", "model", "seq", "sp", "pipe"})
 
 
+def _default_devices(axes: Mapping[str, int], n: int) -> list:
+    """Default device list for a mesh of extent `n`: ALL processes'
+    devices (`jax.devices()` — the GLOBAL view), with the multi-process
+    footgun closed explicitly. Single-process, a product smaller than the
+    device count trims to a prefix (the long-standing test idiom:
+    {"seq": 2} on the 8-device virtual platform). Multi-process, a
+    trimmed prefix would be the first host(s)' devices only — a mesh
+    that LOOKS like it spans the pod but quietly dropped every other
+    process — so there the product must equal `jax.device_count()`
+    exactly; deliberate subsets pass `devices=` explicitly
+    (e.g. `jax.local_devices()` for a host-local mesh)."""
+    devs = list(jax.devices())
+    if jax.process_count() > 1 and n != jax.device_count():
+        raise ValueError(
+            f"mesh {dict(axes)} covers {n} devices but this is a "
+            f"{jax.process_count()}-process run with "
+            f"jax.device_count()={jax.device_count()} global "
+            f"({jax.local_device_count()} local) devices — size the axes "
+            "to the GLOBAL device count, or pass an explicit `devices=` "
+            "subset (jax.local_devices() for a deliberately host-local "
+            "mesh)"
+        )
+    return devs
+
+
 def make_mesh(
     axes: Mapping[str, int],
     devices: Optional[Sequence] = None,
 ) -> Mesh:
     """Build a mesh with the given {axis_name: size} layout.
 
-    Axis order follows dict order; sizes must multiply to the device count
-    used. `devices` defaults to all visible devices (trimmed to the product
-    of the axis sizes).
+    Axis order follows dict order; sizes must multiply to the device
+    count used. `devices` defaults to all visible devices across ALL
+    processes (`jax.devices()`, trimmed to the product of the axis
+    sizes); in a multi-process run the default requires the product to
+    equal `jax.device_count()` exactly — see `_default_devices`.
     """
     names = tuple(axes.keys())
     sizes = tuple(axes.values())
     n = int(np.prod(sizes))
-    devs = list(devices) if devices is not None else jax.devices()
+    devs = list(devices) if devices is not None else _default_devices(axes, n)
     if len(devs) < n:
         raise ValueError(f"need {n} devices for mesh {dict(axes)}, have {len(devs)}")
     grid = np.asarray(devs[:n]).reshape(sizes)
     return Mesh(grid, names)
 
 
-def data_parallel_mesh(n: Optional[int] = None) -> Mesh:
-    """All (or the first n) devices on a single "data" axis."""
-    devs = jax.devices()
-    n = n if n is not None else len(devs)
-    return make_mesh({"data": n}, devs)
+def data_parallel_mesh(n: Optional[int] = None, *, local: bool = False) -> Mesh:
+    """All (or the first n) devices on a single "data" axis.
+
+    The default derives n from `jax.device_count()` — the GLOBAL count,
+    spanning every process of a pod. `local=True` derives from
+    `jax.local_device_count()` over `jax.local_devices()` instead, for
+    callers that WANT a host-local mesh (per-host preprocessing,
+    single-host tools) — the choice is explicit either way, so a
+    single-process assumption can never silently produce a local-only
+    mesh on a pod."""
+    if local:
+        devs = jax.local_devices()
+        n = n if n is not None else jax.local_device_count()
+        return make_mesh({"data": n}, devs)
+    if n is None:
+        n = jax.device_count()
+    # default devices: the multi-process exact-cover guard applies — an
+    # explicit n that covers only some hosts' devices must error, not
+    # silently build a prefix (one-host) mesh
+    return make_mesh({"data": n})
 
 
 def hybrid_mesh(
@@ -87,7 +129,6 @@ def hybrid_mesh(
     Example: 4 slices x 8 chips, DP over slices, SP within:
         hybrid_mesh({"data": 4}, {"seq": 8})
     """
-    devs = list(devices) if devices is not None else jax.devices()
     dcn_names, ici_names = tuple(dcn_axes), tuple(ici_axes)
     dcn_sizes, ici_sizes = tuple(dcn_axes.values()), tuple(ici_axes.values())
     names = dcn_names + ici_names
@@ -96,6 +137,10 @@ def hybrid_mesh(
     n_dcn = int(np.prod(dcn_sizes))
     n_ici = int(np.prod(ici_sizes))
     n = n_dcn * n_ici
+    devs = (
+        list(devices) if devices is not None
+        else _default_devices({**dcn_axes, **ici_axes}, n)
+    )
     if len(devs) < n:
         raise ValueError(
             f"need {n} devices for mesh {dict(dcn_axes)} x {dict(ici_axes)}, "

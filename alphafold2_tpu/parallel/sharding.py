@@ -1,17 +1,12 @@
-"""Sharding rules: PartitionSpecs for params, optimizer state, and batches.
+"""Sharding: NamedShardings for train states and batches over a mesh.
 
-Tensor-parallel layout (the Megatron split, expressed as GSPMD annotations
-rather than collective calls):
-  * attention to_q / to_kv weights shard their OUTPUT (head) dim;
-  * attention to_out weight shards its INPUT dim (XLA inserts the psum);
-  * feed-forward proj_in shards output, proj_out shards input;
-  * the KV-compression conv shards its output channels (per-head groups);
-  * embeddings, norms, biases of row-sharded layers: replicated.
-
-Rules match on parameter-tree path suffixes, so they apply unchanged to the
-optimizer state (whose mu/nu subtrees mirror the param tree) and to the
-reversible trunk's depth-stacked params (leading depth axis is detected by
-leaf rank).
+The tensor-parallel layout lives in the partition-rule REGISTRY
+(`parallel/rules.py` — regex over named param paths -> PartitionSpec,
+first match wins). This module binds matched specs to a concrete mesh.
+The registry applies unchanged to the optimizer state (optax's mu/nu
+subtrees mirror the param tree, so suffix rules match) and to the
+reversible trunk's depth-stacked params (rank adaptation in
+`rules.spec_for_leaf`); unmatched non-scalar leaves raise loudly.
 """
 
 from __future__ import annotations
@@ -21,69 +16,32 @@ from typing import Any
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-
-def _path_names(path) -> tuple:
-    names = []
-    for e in path:
-        if isinstance(e, jax.tree_util.DictKey):
-            names.append(str(e.key))
-        elif isinstance(e, jax.tree_util.SequenceKey):
-            names.append(str(e.idx))
-        elif isinstance(e, jax.tree_util.GetAttrKey):
-            names.append(str(e.name))
-    return tuple(names)
-
-
-def _tp_spec(names: tuple, leaf) -> P:
-    """Tensor-parallel PartitionSpec for one param leaf (base rank, no
-    depth-stacking)."""
-    if not names:
-        return P()
-    leaf_name = names[-1]
-    parent = names[-2] if len(names) >= 2 else ""
-    if leaf_name == "w":
-        if parent in ("to_q", "to_kv", "proj_in"):
-            return P(None, "model")  # af2lint: rank=2 — column parallel: shard output dim
-        if parent in ("to_out", "proj_out"):
-            return P("model", None)  # af2lint: rank=2 — row parallel: shard input dim
-    if leaf_name == "b" and parent in ("to_q", "to_kv", "proj_in"):
-        return P("model")
-    if parent == "compress":
-        # conv kernel (k, in_per_group, out) / bias (out,): shard out
-        if leaf_name == "w":
-            return P(None, None, "model")  # af2lint: rank=3 — (k, in_per_group, out)
-        if leaf_name == "b":
-            return P("model")
-    return P()
+from alphafold2_tpu.parallel.rules import (
+    match_partition_rules,
+    partition_rules,
+    spec_for_leaf,
+    tree_path_string,
+)
 
 
 def param_spec(path, leaf, *, tp: bool) -> P:
-    """PartitionSpec for a param (or optimizer-state) leaf."""
-    if not hasattr(leaf, "ndim"):
-        return P()
-    names = _path_names(path)
-    if not tp:
-        return P()
-    spec = _tp_spec(names, leaf)
-    base_rank = {"w": 2, "b": 1, "table": 2, "scale": 1, "bias": 1}.get(
-        names[-1] if names else "", None
-    )
-    if names and names[-2:-1] == ("compress",) and names[-1] == "w":
-        base_rank = 3
-    if base_rank is not None and leaf.ndim == base_rank + 1:
-        # depth-stacked (reversible trunk): leading depth axis is replicated
-        spec = P(None, *spec)
-    return spec
+    """PartitionSpec for one param (or optimizer-state) leaf, by tree
+    path. Back-compat shim over the registry: prefer
+    `rules.match_partition_rules` for whole trees."""
+    spec = spec_for_leaf(tree_path_string(path), leaf, partition_rules(tp))
+    return spec if spec is not None else P()
 
 
 def state_shardings(mesh: Mesh, state: Any, *, tp: bool = True):
-    """NamedShardings for a full train state (params + opt state + step)."""
+    """NamedShardings for a full train state (params + opt state + step):
+    the partition-rule registry matched over the named tree, bound to
+    `mesh`. TP rules apply only when the mesh actually has a "model"
+    axis; otherwise everything replicates."""
     has_model = tp and "model" in mesh.axis_names
-    return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: NamedSharding(
-            mesh, param_spec(path, leaf, tp=has_model)
-        ),
-        state,
+    specs = match_partition_rules(partition_rules(has_model), state)
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), specs,
+        is_leaf=lambda x: isinstance(x, P),
     )
 
 
@@ -103,3 +61,18 @@ def batch_shardings(mesh: Mesh, batch: Any, *, microbatched: bool = True):
 
 def replicated(mesh: Mesh):
     return NamedSharding(mesh, P())
+
+
+def host_to_global(tree: Any, shardings: Any):
+    """Global jax.Arrays for a host-side pytree every process holds
+    identically (same-seed init, restored checkpoint bytes): each leaf
+    materializes onto `shardings` with each process feeding its OWN
+    addressable shards — no cross-process transfer
+    (compat.make_global_array_from_host). The standard way to pin a
+    freshly-initialized or restored train state to a process-spanning
+    mesh."""
+    from alphafold2_tpu import compat
+
+    return jax.tree_util.tree_map(
+        compat.make_global_array_from_host, tree, shardings
+    )

@@ -94,6 +94,90 @@ def make_sharded_train_step(
     return jitted, st_shardings
 
 
+def make_multihost_train_step(
+    cfg,
+    tcfg: TrainConfig,
+    local_example_batch,
+    *,
+    axes=None,
+    loss_fn: Callable = distogram_loss_fn,
+    tp: bool = True,
+    donate_state: bool = True,
+    state_init: Callable = train_state_init,
+):
+    """The process-spanning train step: DP(xTPxSP) over ALL processes.
+
+    The single-process `make_sharded_train_step` already is the
+    multi-host step — GSPMD neither knows nor cares that the mesh's
+    devices live in N processes — so this builder only supplies the
+    multi-host plumbing around it:
+
+      * the mesh spans `jax.devices()` (every process's devices; `axes`
+        defaults to pure DP over the GLOBAL device count, and must
+        multiply to exactly that count — parallel/mesh.py refuses
+        local-only silent fallbacks in multi-process runs);
+      * `local_example_batch` is this PROCESS's shard, with leading
+        (grad_accum, per_process_batch, ...) axes; the compiled step
+        consumes the GLOBAL batch (per-process x process count), built
+        each step by the returned `assemble` (training/data.py
+        `assemble_global_batch` over
+        `compat.make_array_from_process_local_data`);
+      * params/optimizer state shard by the partition-rule registry
+        (replicated for pure DP; "model"-axis rules under TP), identical
+        on every process.
+
+    Every process must call the returned step in lockstep with its own
+    local shard (SPMD); metrics come back fully replicated, so
+    `float(metrics["loss"])` is process-local and identical everywhere.
+
+    Returns (jitted_step, state_shardings, assemble, mesh) where
+    `assemble(local_batch)` -> global-batch pytree of jax.Arrays.
+    """
+    from alphafold2_tpu.parallel.mesh import make_mesh
+    from alphafold2_tpu.training.data import assemble_global_batch
+
+    if axes is None:
+        axes = {"data": jax.device_count()}
+    # no explicit devices=: the default path carries mesh.py's
+    # multi-process exact-cover guard (a local-count-derived axes dict
+    # must error, not silently build a one-host mesh)
+    mesh = make_mesh(axes)
+    procs = jax.process_count()
+
+    def global_struct(x):
+        if not hasattr(x, "ndim") or x.ndim <= 1:
+            return x
+        shape = list(x.shape)
+        shape[1] = shape[1] * procs  # axis 1: the microbatched batch axis
+        return jax.ShapeDtypeStruct(tuple(shape), x.dtype)
+
+    example = jax.tree_util.tree_map(global_struct, local_example_batch)
+    data_size = dict(axes).get("data", 0)
+    global_b = next(
+        (leaf.shape[1] for leaf in jax.tree_util.tree_leaves(example)
+         if hasattr(leaf, "ndim") and leaf.ndim > 1),
+        None,
+    )
+    if data_size and global_b is not None and global_b % data_size:
+        raise ValueError(
+            f"global batch {global_b} (= per-process x "
+            f"{procs} processes) must be divisible by the mesh's data "
+            f"axis size ({data_size} devices) — every chip gets an "
+            "equal batch shard; raise the global batch or shrink the "
+            "data axis"
+        )
+    step, st_shardings = make_sharded_train_step(
+        cfg, tcfg, mesh, example,
+        loss_fn=loss_fn, tp=tp, donate_state=donate_state,
+        state_init=state_init,
+    )
+
+    def assemble(local_batch):
+        return assemble_global_batch(local_batch, mesh, microbatched=True)
+
+    return step, st_shardings, assemble, mesh
+
+
 def make_dp_overlap_train_step(
     cfg,
     tcfg: TrainConfig,

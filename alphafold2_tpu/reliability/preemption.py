@@ -123,11 +123,41 @@ class PreemptionHandler:
         """Run `fn()` once, on the first check() after the flag trips."""
         self._callbacks.append(fn)
 
+    def _pod_consensus(self, local: bool) -> bool:
+        """Global OR of the preemption flag across processes. SIGTERM is
+        per-process (the scheduler rarely signals every host in the same
+        instant); if only the signaled process entered the collective
+        checkpoint save, the others would march into the next step's
+        collectives and the pod would deadlock on mismatched programs.
+        Polling is a step-boundary event on every process in lockstep
+        (run_resilient), so a tiny allgather here makes the WHOLE pod
+        observe the preemption at the same boundary. Single-process (and
+        any environment where the collective is unavailable): the local
+        flag, unchanged."""
+        try:
+            import jax
+
+            if jax.process_count() <= 1:
+                return local
+            import numpy as np
+
+            from alphafold2_tpu import compat
+
+            flags = compat.process_allgather(
+                np.asarray([local], np.int32), tiled=True
+            )
+            return bool(np.asarray(flags).any())
+        except Exception:
+            return local
+
     def check(self) -> bool:
-        """Poll point for long-running loops: returns True once preempted,
-        firing any registered drain callbacks exactly once."""
-        if not self._event.is_set():
+        """Poll point for long-running loops: returns True once preempted
+        (on ANY process of a pod — see _pod_consensus), firing any
+        registered drain callbacks exactly once."""
+        if not self._pod_consensus(self._event.is_set()):
             return False
+        # latch locally: on a pod the signal may have landed elsewhere
+        self._event.set()
         with self._lock:
             if not self._callbacks_fired:
                 self._callbacks_fired = True

@@ -83,6 +83,10 @@ _RULES: Tuple[Tuple[str, str, float], ...] = (
     ("*quant_weight_ratio*", "higher", 0.05),
     # divergence-from-reference metrics: smaller is better
     ("*distogram_kl*", "lower", 0.25),
+    # multi-host scale-out parity (the multihost_dp dryrun leg): the
+    # pod's throughput ratio vs the single-process twin — higher is
+    # better, and a drop means the cross-process path regressed
+    ("*scaling_efficiency*", "higher", 0.10),
     ("*steps_per_sec*", "higher", 0.10),
     ("*per_sec*", "higher", 0.10),
     ("*mfu*", "higher", 0.10),

@@ -24,9 +24,13 @@ from alphafold2_tpu.training.harness import (
 from alphafold2_tpu.training.data import (
     DataConfig,
     ResilientBatches,
+    assemble_global_batch,
     bucket_batches,
     bucketed_microbatches,
+    per_process_microbatch_fn,
+    process_shard,
     resilient_batches,
+    shard_items,
     stack_microbatches,
     synthetic_batches,
     synthetic_microbatch_fn,
@@ -100,7 +104,11 @@ __all__ = [
     "ResilientBatches",
     "bucket_batches",
     "bucketed_microbatches",
+    "assemble_global_batch",
+    "per_process_microbatch_fn",
+    "process_shard",
     "resilient_batches",
+    "shard_items",
     "stack_microbatches",
     "synthetic_batches",
     "synthetic_microbatch_fn",

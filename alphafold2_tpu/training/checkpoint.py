@@ -29,6 +29,8 @@ from typing import Any, Callable, Optional
 import jax
 import numpy as np
 
+from alphafold2_tpu import compat
+
 try:  # orbax is in the baked image; keep a clear error if it is not
     import orbax.checkpoint as ocp
 except Exception as e:  # pragma: no cover
@@ -139,6 +141,26 @@ _STATE_FMT = "step_{:08d}.npz"
 _MANIFEST_SUFFIX = ".manifest.json"
 
 
+def _host_tree(state):
+    """Host-side numpy copy of a (possibly multi-process-sharded) state.
+
+    Single-process (and fully-addressable arrays) this is plain
+    device_get. On a pod, a leaf sharded across processes (TP params, DP
+    opt state) is not fetchable locally — `compat.process_allgather`
+    materializes the GLOBAL value on every host. COLLECTIVE: every
+    process must call this in lockstep (the saver does, before gating
+    the actual write to process 0)."""
+
+    def host_leaf(x):
+        if hasattr(x, "is_fully_addressable"):
+            if x.is_fully_addressable or getattr(x, "is_fully_replicated", False):
+                return np.asarray(jax.device_get(x))
+            return np.asarray(compat.process_allgather(x, tiled=True))
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree_util.tree_map(host_leaf, state)
+
+
 def _leaf_paths(tree):
     """(json-able path, host numpy leaf) pairs in flatten order."""
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
@@ -228,6 +250,14 @@ class VerifiedCheckpointManager:
     advances past a preemption poll point, and the npz serialization the
     sizes this repo trains at is milliseconds — async would only reopen
     the torn-write window this class exists to close.
+
+    Multi-host: every process calls save()/restore() in lockstep (SPMD).
+    save() materializes the host copy collectively (cross-process leaves
+    allgather), PROCESS 0 alone writes and prunes, and a cross-process
+    barrier fences the write; restore() reads from the (shared —
+    contract) directory on every process and cross-checks the chosen
+    step's sha256 against process 0 before loading, so a divergent
+    directory fails loudly instead of training from inconsistent states.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
@@ -302,42 +332,60 @@ class VerifiedCheckpointManager:
         if self._closed:
             raise RuntimeError("save() on a closed VerifiedCheckpointManager")
         if step is None:
-            step = int(np.asarray(jax.device_get(state["step"])))
+            step = int(np.asarray(_host_tree(state["step"])))
         if not force and step % self.save_interval_steps != 0:
             return False
-        items = _leaf_paths(jax.device_get(state))
-        arrays, leaf_meta = {}, []
-        for i, (_, leaf) in enumerate(items):
-            packed, meta = _pack_leaf(np.asarray(leaf))
-            arrays[f"leaf_{i:05d}"] = packed
-            leaf_meta.append(meta)
+        # COLLECTIVE on a pod: PROCESS 0 materializes the host copy and
+        # writes; the others only join the allgathers that
+        # cross-process-sharded leaves need (replicated leaves cost them
+        # nothing — no point device_getting GBs to discard), and the
+        # barrier below keeps any process from racing ahead to a restore
+        # (or exit) before the files are durable. Multi-host contract:
+        # `self.directory` is one SHARED filesystem. Leaf order is the
+        # flatten order on every process, so the collectives line up.
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            for leaf in jax.tree_util.tree_leaves(state):
+                if (hasattr(leaf, "is_fully_addressable")
+                        and not leaf.is_fully_addressable
+                        and not getattr(leaf, "is_fully_replicated", False)):
+                    compat.process_allgather(leaf, tiled=True)
+            compat.sync_global_devices(f"af2:ckpt:save:{step}")
+            return True
+        items = _leaf_paths(_host_tree(state))
+        if jax.process_index() == 0:
+            arrays, leaf_meta = {}, []
+            for i, (_, leaf) in enumerate(items):
+                packed, meta = _pack_leaf(np.asarray(leaf))
+                arrays[f"leaf_{i:05d}"] = packed
+                leaf_meta.append(meta)
 
-        state_path = self._state_path(step)
-        tmp = state_path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **arrays)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, state_path)
+            state_path = self._state_path(step)
+            tmp = state_path + ".tmp"
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, state_path)
 
-        manifest = {
-            "step": step,
-            "sha256": _sha256_file(state_path),
-            "leaves": len(items),
-            "paths": [segs for segs, _ in items],
-            "leaf_meta": leaf_meta,
-        }
-        manifest_path = self._manifest_path(step)
-        tmp = manifest_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(manifest, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, manifest_path)
+            manifest = {
+                "step": step,
+                "sha256": _sha256_file(state_path),
+                "leaves": len(items),
+                "paths": [segs for segs, _ in items],
+                "leaf_meta": leaf_meta,
+            }
+            manifest_path = self._manifest_path(step)
+            tmp = manifest_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, manifest_path)
 
-        if self._fault_hook is not None:
-            self._fault_hook(step, state_path, manifest_path)
-        self._prune()
+            if self._fault_hook is not None:
+                self._fault_hook(step, state_path, manifest_path)
+            self._prune()
+        compat.sync_global_devices(f"af2:ckpt:save:{step}")
         return True
 
     def _prune(self):
@@ -388,30 +436,61 @@ class VerifiedCheckpointManager:
                 )
             arr = stored[key]
             sharding = getattr(template, "sharding", None)
+            # make_global_array_from_host, not device_put: on a pod the
+            # restored bytes are identical on every process (verified +
+            # broadcast-checked), so each process feeds its own shards —
+            # a cross-process device_put broadcast is wasted wire (and
+            # trips gloo on CPU pods)
             out.append(
-                jax.device_put(arr, sharding) if sharding is not None
-                else jax.numpy.asarray(arr)
+                compat.make_global_array_from_host(arr, sharding)
+                if sharding is not None else jax.numpy.asarray(arr)
             )
         leaves, treedef = jax.tree_util.tree_flatten(abstract_state)
         assert len(leaves) == len(out)
         return jax.tree_util.tree_unflatten(treedef, out)
 
+    def _assert_consistent_across_processes(self, step: int) -> None:
+        """Pod restore sanity: every process must be about to load the
+        SAME verified bytes. Process 0's (step, sha256) broadcasts to
+        all; a mismatch means the processes see divergent checkpoint
+        directories (non-shared filesystem, torn replication) — restore
+        proceeding would silently train from inconsistent states, so it
+        raises instead."""
+        if jax.process_count() <= 1:
+            return
+        with open(self._manifest_path(step)) as f:
+            sha = json.load(f).get("sha256", "")
+        local = np.frombuffer(f"{step:08d}:{sha}".encode(), np.uint8)
+        ref = np.asarray(compat.broadcast_one_to_all(local))
+        if not np.array_equal(ref, local):
+            raise RuntimeError(
+                f"process {jax.process_index()} would restore step {step} "
+                f"sha {sha[:12]}..., but process 0 sees different bytes — "
+                f"the checkpoint directory {self.directory} is not "
+                "consistent across processes (multi-host checkpointing "
+                "requires one shared filesystem)"
+            )
+
     def restore(self, abstract_state: Any = None, step: Optional[int] = None) -> Any:
         """Restore `step` (must verify) or, by default, the newest step that
         PASSES verification — falling back past corrupt/truncated newer
-        steps with a printed warning per skipped step."""
+        steps with a printed warning per skipped step. Multi-process,
+        the chosen step is cross-checked against process 0 before any
+        bytes load (broadcast-consistent restore)."""
         if step is not None:
             if not self.verify(step):
                 raise FileNotFoundError(
                     f"checkpoint step {step} in {self.directory} is missing "
                     "or failed sha256 verification"
                 )
+            self._assert_consistent_across_processes(step)
             return self._load(step, abstract_state)
         candidates = self.all_steps()
         if not candidates:
             raise FileNotFoundError(f"no checkpoint found under {self.directory}")
         for s in reversed(candidates):
             if self.verify(s):
+                self._assert_consistent_across_processes(s)
                 return self._load(s, abstract_state)
             print(f"warning: checkpoint step {s} in {self.directory} failed "
                   "verification (torn write or corruption) — falling back")
@@ -444,11 +523,22 @@ def abstract_like(state: Any, shardings: Any = None):
     """ShapeDtypeStruct skeleton of `state` for sharded restore.
 
     `shardings`: matching pytree of jax.sharding.Sharding (e.g. from
-    parallel.state_shardings) or None for unspecified placement.
-    """
+    parallel.state_shardings). When None, shardings are DERIVED from the
+    state's own leaves (live jax.Arrays carry `.sharding`; host numpy
+    leaves restore placement-free) — so restoring "like" a live sharded
+    state round-trips its layout without the caller threading the
+    shardings tree separately (the crash-recovery path in
+    `run_resilient` restores against the in-memory good state, which on
+    a pod is already globally sharded)."""
     shapes = jax.eval_shape(lambda s: s, state)
     if shardings is None:
-        return shapes
+        def derived(leaf, sds):
+            sh = getattr(leaf, "sharding", None)
+            if sh is None:
+                return sds
+            return jax.ShapeDtypeStruct(sds.shape, sds.dtype, sharding=sh)
+
+        return jax.tree_util.tree_map(derived, state, shapes)
     return jax.tree_util.tree_map(
         lambda sds, sh: jax.ShapeDtypeStruct(sds.shape, sds.dtype, sharding=sh),
         shapes,

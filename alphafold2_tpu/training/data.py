@@ -129,6 +129,121 @@ def synthetic_microbatch_fn(cfg: DataConfig, grad_accum: int, source=None):
     return fetch
 
 
+# --- per-process pipeline (multi-host) ---------------------------------------
+#
+# The pod data contract (ScaleFold, arxiv 2404.11068; SNIPPETS [3]'s
+# DataParallelPartitioner): the GLOBAL batch is per-process batch x
+# process count, every process's pipeline yields ONLY its own rows, and
+# the train step consumes one global jax.Array assembled from the local
+# shards (`compat.make_array_from_process_local_data`). Nothing below
+# imports the mesh machinery at module scope, so the data layer stays
+# importable host-side without touching parallel/.
+
+
+def process_shard(batch: dict, *, index: Optional[int] = None,
+                  count: Optional[int] = None, axis: int = 0) -> dict:
+    """This process's rows of a host-side GLOBAL batch.
+
+    `axis` is the batch axis (0 for plain batches, 1 for microbatched
+    (accum, b, ...) stacks). Rows [index * b/count, (index+1) * b/count)
+    — concatenating every process's shard along `axis` reconstructs the
+    global batch exactly, which is what makes the multi-process loss
+    bit-identical to the single-process twin on the same stream. Scalars
+    and non-array entries (e.g. the `bucket` tag) pass through."""
+    import jax
+
+    if index is None:
+        index = jax.process_index()
+    if count is None:
+        count = jax.process_count()
+
+    def shard(x):
+        if not hasattr(x, "ndim") or x.ndim <= axis:
+            return x
+        b = x.shape[axis]
+        if b % count != 0:
+            raise ValueError(
+                f"global batch axis {b} must divide across {count} "
+                "processes (global batch = per-process batch x process "
+                "count)"
+            )
+        lo = index * (b // count)
+        sl = [slice(None)] * x.ndim
+        sl[axis] = slice(lo, lo + b // count)
+        return x[tuple(sl)]
+
+    return {k: shard(v) for k, v in batch.items()}
+
+
+def shard_items(items: Iterator, *, index: Optional[int] = None,
+                count: Optional[int] = None) -> Iterator:
+    """Process-strided view of a record stream (for real corpus sources:
+    each process KEEPS every count-th record starting at its index and
+    never materializes the rest — feed the result to `bucket_batches`).
+    Synthetic index-pure sources use `process_shard` row slicing instead,
+    which preserves bit-exactness against the single-process stream."""
+    import jax
+
+    if index is None:
+        index = jax.process_index()
+    if count is None:
+        count = jax.process_count()
+    for i, item in enumerate(items):
+        if i % count == index:
+            yield item
+
+
+def per_process_microbatch_fn(cfg: DataConfig, grad_accum: int, source=None,
+                              *, index: Optional[int] = None,
+                              count: Optional[int] = None):
+    """`synthetic_microbatch_fn` for one process of a pod: `fetch(step)`
+    returns only this process's rows of the step's GLOBAL microbatch
+    stack (cfg.batch_size is the GLOBAL batch). Still a pure function of
+    the step index, so retries/resume stay replay-exact, and
+    `resilient_batches` composes underneath exactly as single-process."""
+    base = synthetic_microbatch_fn(cfg, grad_accum, source=source)
+
+    def fetch(step: int) -> dict:
+        return process_shard(base(step), index=index, count=count, axis=1)
+
+    return fetch
+
+
+def assemble_global_batch(local_batch: dict, mesh, *,
+                          microbatched: bool = True,
+                          count: Optional[int] = None) -> dict:
+    """Global jax.Arrays from this process's host-side shard.
+
+    Each leaf's batch axis (axis 1 when `microbatched`, else 0) scales by
+    the process count and shards over the mesh's "data" axis; every
+    other axis stays replicated. Single-process this degenerates to a
+    device_put with the same shardings, so the single-process twin can
+    run the identical code path. Non-array entries pass through."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from alphafold2_tpu import compat
+
+    if count is None:
+        count = jax.process_count()
+    axis = 1 if microbatched else 0
+
+    def assemble(x):
+        if not hasattr(x, "ndim") or x.ndim <= axis:
+            return x
+        parts = [None] * x.ndim
+        if "data" in mesh.axis_names:
+            parts[axis] = "data"
+        sharding = NamedSharding(mesh, PartitionSpec(*parts))
+        global_shape = list(x.shape)
+        global_shape[axis] = x.shape[axis] * count
+        return compat.make_array_from_process_local_data(
+            sharding, np.asarray(x), tuple(global_shape)
+        )
+
+    return {k: assemble(v) for k, v in local_batch.items()}
+
+
 class ResilientBatches:
     """Retrying/skipping wrapper over a batch source — the data-pipeline
     answer to a flaky filesystem or a corrupt shard: a failed fetch is

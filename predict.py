@@ -101,6 +101,13 @@ def main():
     # hours (scripts/tpu_lock.py). Held for the process lifetime.
     hostenv.tunnel_guard()
 
+    # multi-host entry: no-op unless the AF2_COORDINATOR/... contract is
+    # configured; must run BEFORE the first backend-initializing JAX call
+    # (the shared startup errors loudly otherwise; parallel/distributed.py)
+    from alphafold2_tpu.parallel.distributed import distributed_startup
+
+    distributed_startup("predict")
+
     import jax.numpy as jnp
 
     from alphafold2_tpu.constants import aa_to_tokens

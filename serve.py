@@ -254,6 +254,16 @@ def main():
     # block on the lock) — same stance as predict.py
     hostenv.tunnel_guard()
 
+    # multi-host entry: no-op unless the AF2_COORDINATOR/... contract is
+    # configured; must run BEFORE the first backend-initializing JAX call
+    # (the shared startup errors loudly otherwise). Serving itself stays
+    # per-process — the engine/fleet serve this host's devices — but a
+    # pod-launched serve.py must still join the runtime or its
+    # jax.devices() view silently degrades to one host.
+    from alphafold2_tpu.parallel.distributed import distributed_startup
+
+    distributed_startup("serve")
+
     import jax.numpy as jnp
 
     from alphafold2_tpu.models import Alphafold2Config

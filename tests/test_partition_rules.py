@@ -1,0 +1,233 @@
+"""Partition-rule registry (parallel/rules.py) + its sharding-lint checks.
+
+PR 10's rule registry replaced the hand-threaded suffix logic: regex over
+named tree paths -> PartitionSpec, first match wins, rank-adapted for the
+reversible trunk's depth-stacked layout, applied uniformly to params and
+the optimizer state's mu/nu mirrors, with unmatched non-scalar leaves
+raising loudly. These tests pin each clause of that contract, plus the
+lint's fixture behavior (SHARD005 bogus axis / SHARD006 unmatched leaf /
+SHARD007 bad regex) and the live-registry clean gate.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from alphafold2_tpu.models import Alphafold2Config
+from alphafold2_tpu.parallel import make_mesh
+from alphafold2_tpu.parallel.rules import (
+    TP_RULES,
+    match_partition_rules,
+    named_tree_map,
+    partition_rules,
+    rule_axes,
+    spec_for_leaf,
+    tree_path_string,
+    unmatched_leaves,
+)
+from alphafold2_tpu.parallel.sharding import state_shardings
+from alphafold2_tpu.training.harness import TrainConfig, train_state_init
+
+
+def _flagship_state_shape(reversible=False):
+    cfg = Alphafold2Config(
+        dim=16, depth=2, heads=2, dim_head=8, max_seq_len=32,
+        reversible=reversible, msa_tie_row_attn=True,
+        cross_attn_compress_ratio=2,
+    )
+    return jax.eval_shape(
+        lambda k: train_state_init(k, cfg, TrainConfig(grad_accum=1)),
+        jax.random.PRNGKey(0),
+    )
+
+
+def _specs_by_suffix(specs):
+    out = {}
+    flat = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )[0]
+    for path, spec in flat:
+        out[tree_path_string(path)] = spec
+    return out
+
+
+def test_named_tree_map_paths():
+    tree = {"a": {"b": [np.zeros(2), np.zeros(3)]}}
+    names = []
+    named_tree_map(lambda n, _leaf: names.append(n), tree)
+    assert sorted(names) == ["a/b/0", "a/b/1"]
+
+
+def test_tp_layout_matches_megatron_split():
+    specs = _specs_by_suffix(
+        match_partition_rules(partition_rules(True), _flagship_state_shape())
+    )
+    def find(suffix):
+        return {n: s for n, s in specs.items() if n.endswith(suffix)}
+
+    for n, s in find("to_q/w").items():
+        assert s == P(None, "model"), (n, s)
+    for n, s in find("to_out/w").items():
+        assert s == P("model", None), (n, s)
+    for n, s in find("proj_in/b").items():
+        assert s == P("model"), (n, s)
+    for n, s in find("compress/w").items():
+        assert s == P(None, None, "model"), (n, s)
+    for n, s in find("norm/scale").items():
+        assert s == P(), (n, s)
+    for n, s in find("table").items():
+        assert s == P(), (n, s)
+
+
+def test_scalar_leaves_stay_replicated():
+    specs = _specs_by_suffix(
+        match_partition_rules(partition_rules(True), _flagship_state_shape())
+    )
+    assert specs["step"] == P()
+    counts = {n: s for n, s in specs.items() if n.endswith("count")}
+    assert counts and all(s == P() for s in counts.values())
+    # scalars bypass the rules entirely — even a rule set that covers
+    # nothing leaves them replicated instead of raising
+    got = match_partition_rules(
+        [(r"never_matches_anything", P("model"))],
+        {"step": np.zeros(()), "one": np.zeros((1,))},
+    )
+    assert got == {"step": P(), "one": P()}
+
+
+def test_optimizer_mirrors_match_param_rules():
+    """optax's mu/nu subtrees mirror the param tree; the suffix rules
+    must give the mirror EXACTLY the spec of its parameter."""
+    specs = _specs_by_suffix(
+        match_partition_rules(partition_rules(True), _flagship_state_shape())
+    )
+    params = {
+        n[len("params/"):]: s for n, s in specs.items()
+        if n.startswith("params/")
+    }
+    assert params
+    for prefix in ("mu/", "nu/"):
+        mirrors = {
+            n.split(prefix, 1)[1]: s for n, s in specs.items() if prefix in n
+        }
+        assert set(mirrors) == set(params)
+        for leaf, s in mirrors.items():
+            assert s == params[leaf], (prefix, leaf, s, params[leaf])
+
+
+def test_depth_stacked_reversible_leading_axis():
+    """The reversible trunk stores per-layer params depth-stacked: a
+    rank-(k+1) leaf gets the rule's spec shifted right under a leading
+    replicated depth axis."""
+    specs = _specs_by_suffix(
+        match_partition_rules(
+            partition_rules(True), _flagship_state_shape(reversible=True)
+        )
+    )
+    stacked_q = {n: s for n, s in specs.items()
+                 if "trunk" in n and n.endswith("to_q/w")}
+    assert stacked_q and all(s == P(None, None, "model")
+                             for s in stacked_q.values())
+    stacked_out = {n: s for n, s in specs.items()
+                   if "trunk" in n and n.endswith("to_out/w")}
+    assert stacked_out and all(s == P(None, "model", None)
+                               for s in stacked_out.values())
+
+
+def test_unmatched_leaf_raises():
+    tree = {"novel_module": {"mystery_kernel": np.zeros((4, 4))}}
+    with pytest.raises(ValueError, match="no partition rule matched"):
+        match_partition_rules(TP_RULES, tree)
+    missing = unmatched_leaves(TP_RULES, tree)
+    assert missing == [("novel_module/mystery_kernel", (4, 4))]
+
+
+def test_rank_incompatible_rule_raises():
+    # a rank-2 rule matching a rank-4 leaf is a layout bug, not a
+    # silently-replicated tensor
+    with pytest.raises(ValueError, match="rank"):
+        spec_for_leaf(
+            "x/to_q/w", jax.ShapeDtypeStruct((2, 2, 3, 4), np.float32),
+            TP_RULES,
+        )
+    # and it counts as UNCOVERED for the lint probe
+    tree = {"to_q": {"w": np.zeros((2, 2, 3, 4))}}
+    assert unmatched_leaves(TP_RULES, tree) == [("to_q/w", (2, 2, 3, 4))]
+
+
+def test_rule_axes_and_replicated_rules():
+    assert rule_axes(TP_RULES) == {"model"}
+    assert rule_axes(partition_rules(False)) == set()
+    specs = match_partition_rules(
+        partition_rules(False), _flagship_state_shape()
+    )
+    flat = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    assert flat and all(s == P() for s in flat)
+
+
+def test_state_shardings_binds_registry_to_mesh():
+    mesh = make_mesh({"data": 4, "model": 2})
+    shape = _flagship_state_shape()
+    sh = state_shardings(mesh, shape, tp=True)
+    by_name = {}
+    for path, s in jax.tree_util.tree_flatten_with_path(sh)[0]:
+        by_name[tree_path_string(path)] = s
+    q = [s for n, s in by_name.items() if n.endswith("to_q/w")]
+    assert q and all(s.spec == P(None, "model") for s in q)
+    # a mesh WITHOUT a model axis degrades to fully replicated even with
+    # tp=True — there is nothing to shard over
+    dp_mesh = make_mesh({"data": 4})
+    sh = state_shardings(dp_mesh, shape, tp=True)
+    assert all(
+        s.spec == P()
+        for s in jax.tree_util.tree_leaves(
+            sh, is_leaf=lambda x: hasattr(x, "spec")
+        )
+    )
+
+
+# --- the sharding-lint registry checks --------------------------------------
+
+
+def test_lint_flags_rule_with_unknown_axis():
+    from alphafold2_tpu.analysis.sharding_lint import check_registry
+
+    # the bogus axis IS the fixture under test
+    bad = [(r"(^|/)to_q/w$", P(None, "bogus_axis"))]  # af2lint: disable=SHARD002
+    findings = check_registry(rules=bad)
+    assert any(
+        f.code == "SHARD005" and "bogus_axis" in f.message for f in findings
+    ), findings
+
+
+def test_lint_flags_bad_regex():
+    from alphafold2_tpu.analysis.sharding_lint import check_registry
+
+    findings = check_registry(rules=[(r"to_q/(w$", P())])
+    assert any(f.code == "SHARD007" for f in findings), findings
+
+
+def test_lint_flags_unmatched_fixture_tree():
+    from alphafold2_tpu.analysis.sharding_lint import check_coverage
+
+    tree = {"params": {"rogue": {"kernel": np.zeros((3, 3))}}}
+    findings = check_coverage(rules=TP_RULES, tree=tree)
+    assert any(
+        f.code == "SHARD006" and "rogue/kernel" in f.message
+        for f in findings
+    ), findings
+
+
+def test_lint_live_registry_clean():
+    """The committed registry must cover the committed model — the gate
+    af2lint --strict runs repo-wide, pinned here at test granularity."""
+    from alphafold2_tpu.analysis.sharding_lint import (
+        check_coverage,
+        check_registry,
+    )
+
+    assert check_registry() == []
+    assert check_coverage() == []

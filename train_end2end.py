@@ -135,12 +135,37 @@ def main():
 
     # multi-host entry: no-op unless AF2_COORDINATOR/AF2_NUM_PROCESSES/
     # AF2_PROCESS_ID (or AF2_AUTO_INIT=1 on TPU pods) are set — one command
-    # per host, see parallel/distributed.py
-    from alphafold2_tpu.parallel.distributed import initialize_from_env
+    # per host, BEFORE the first backend-initializing JAX call (the shared
+    # startup errors loudly otherwise; parallel/distributed.py)
+    from alphafold2_tpu.parallel.distributed import distributed_startup
 
-    if initialize_from_env():
-        print(f"joined multi-host runtime: process {jax.process_index()}/"
-              f"{jax.process_count()}, {jax.device_count()} global devices")
+    distributed_startup("train_end2end")
+    procs = jax.process_count()
+    if procs > 1:
+        # validate the pod contract BEFORE any manager/state is built
+        bad = None
+        if args.sp_shards:
+            bad = "--sp-shards shards the grid single-process; pods shard the batch (DP)"
+        elif args.trunk_segments:
+            bad = "--trunk-segments is a single-device execution chain"
+        elif args.data != "synthetic" or args.features == "esm":
+            bad = ("multi-host training runs --data synthetic with msa/none "
+                   "features (no per-process contract for stateful sources)")
+        elif args.fault_plan:
+            bad = "--fault-plan is single-process chaos tooling"
+        elif args.batch % jax.device_count():
+            bad = (f"--batch {args.batch} is the GLOBAL batch and must "
+                   f"divide across jax.device_count()="
+                   f"{jax.device_count()} devices ({procs} processes x "
+                   f"{jax.local_device_count()} local) — the DP mesh "
+                   "spans every chip of the pod")
+        elif args.ckpt_dir and not args.ckpt_verify:
+            bad = ("multi-host checkpointing needs the verified manager — "
+                   "add --ckpt-verify")
+        elif args.profile_dir:
+            bad = "--profile-dir is single-process tooling"
+        if bad:
+            raise SystemExit(bad)
 
     import jax.numpy as jnp
 
@@ -263,7 +288,39 @@ def main():
                          "are exclusive: the segmented chain donates state "
                          "internally, which invalidates the supervisor's "
                          "rollback reference")
-    if args.sp_shards:
+    if procs > 1:
+        # pod path: DP over a process-spanning mesh; per-process pipelines
+        # feed local shards, assembled into global arrays every step
+        # (parallel/train.py make_multihost_train_step; same contract as
+        # train_pre.py)
+        from alphafold2_tpu.parallel import make_multihost_train_step
+        from alphafold2_tpu.parallel.sharding import host_to_global
+        from alphafold2_tpu.training import process_shard
+
+        example_local = process_shard(
+            synthetic_microbatch_fn(
+                dcfg, tcfg.grad_accum, source=synthetic_structure_batches
+            )(int(state["step"])),
+            axis=1,
+        )
+        jitted, st_shardings, assemble, _mh_mesh = make_multihost_train_step(
+            ecfg, tcfg, example_local,
+            loss_fn=e2e_loss_fn, state_init=e2e_train_state_init,
+            tp=False, donate_state=not resilient,
+        )
+        state = host_to_global(state, st_shardings)
+
+        def train_step(st, batch, rng=None):
+            return jitted(st, assemble(batch), rng)
+
+        def _local(src):
+            for b in src:
+                yield process_shard(b, axis=1)
+
+        batches = _local(batches)
+        if args.metrics_jsonl and jax.process_index() != 0:
+            args.metrics_jsonl = None  # one metrics file, written by proc 0
+    elif args.sp_shards:
         from alphafold2_tpu.parallel import make_mesh, make_sp_train_step, sp_e2e_loss_fn
 
         mesh = make_mesh({"seq": args.sp_shards})
@@ -299,6 +356,11 @@ def main():
         )
     )
 
+    if args.eval_every and procs > 1:
+        print("note: --eval-every is ignored on multi-host runs (the "
+              "structure eval is a single-process convenience)")
+        args.eval_every = 0
+
     base_rng = jax.random.fold_in(jax.random.PRNGKey(args.seed), 1)
     start = int(state["step"])
     if resumed:
@@ -327,10 +389,19 @@ def main():
             # step-indexed fetch: a retried/resumed step refetches the
             # IDENTICAL batch, making recovery replay-exact (the esm
             # feature wrapper is iterator-shaped, so it keeps `next`
-            # semantics)
-            source = synthetic_microbatch_fn(
-                dcfg, tcfg.grad_accum, source=synthetic_structure_batches
-            )
+            # semantics). On a pod the fetch yields only THIS process's
+            # rows (same purity)
+            if procs > 1:
+                from alphafold2_tpu.training import per_process_microbatch_fn
+
+                source = per_process_microbatch_fn(
+                    dcfg, tcfg.grad_accum,
+                    source=synthetic_structure_batches,
+                )
+            else:
+                source = synthetic_microbatch_fn(
+                    dcfg, tcfg.grad_accum, source=synthetic_structure_batches
+                )
         else:
             source = batches
         fetch = resilient_batches(source, injector=injector)

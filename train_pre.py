@@ -97,13 +97,44 @@ def main():
 
     # multi-host entry: no-op unless AF2_COORDINATOR/AF2_NUM_PROCESSES/
     # AF2_PROCESS_ID (or AF2_AUTO_INIT=1 on TPU pods) are set — one command
-    # per host, see parallel/distributed.py
-    from alphafold2_tpu.parallel.distributed import initialize_from_env
+    # per host, BEFORE the first backend-initializing JAX call (the shared
+    # startup errors loudly otherwise; parallel/distributed.py)
+    from alphafold2_tpu.parallel.distributed import distributed_startup
 
-    if initialize_from_env():
-        import jax as _jax
-        print(f"joined multi-host runtime: process {_jax.process_index()}/"
-              f"{_jax.process_count()}, {_jax.device_count()} global devices")
+    distributed_startup("train_pre")
+    procs = jax.process_count()
+    if procs > 1:
+        # validate the pod contract BEFORE any manager/state is built
+        if args.sp_shards:
+            raise SystemExit(
+                "--sp-shards is the single-process grid-sharding path; "
+                "multi-host runs shard the batch (DP) — drop the flag"
+            )
+        if args.data != "synthetic":
+            raise SystemExit(
+                f"--data {args.data} has no per-process sharding contract "
+                "yet; multi-host training runs --data synthetic"
+            )
+        if args.fault_plan:
+            raise SystemExit(
+                "--fault-plan is single-process chaos tooling; a per-host "
+                "injected fault would desync the SPMD step — run chaos "
+                "drills single-process"
+            )
+        if args.batch % jax.device_count():
+            raise SystemExit(
+                f"--batch {args.batch} is the GLOBAL batch and must "
+                f"divide across jax.device_count()={jax.device_count()} "
+                f"devices ({procs} processes x "
+                f"{jax.local_device_count()} local) — the DP mesh spans "
+                "every chip of the pod"
+            )
+        if args.ckpt_dir and not args.ckpt_verify:
+            raise SystemExit(
+                "multi-host checkpointing runs through the verified "
+                "manager (process-0 writes + cross-process barrier + "
+                "broadcast-consistent restore) — add --ckpt-verify"
+            )
 
     import jax.numpy as jnp
 
@@ -210,7 +241,42 @@ def main():
     else:
         batches = stack_microbatches(it, tcfg.grad_accum)
 
-    if args.sp_shards:
+    assemble = None
+    if procs > 1:
+        # pod path: the DP(xTP) step over a process-spanning mesh. The
+        # global batch is --batch x --accum as ever; every process's
+        # pipeline yields ONLY its own rows (training/data.py contract)
+        # and the step consumes one global jax.Array assembled from the
+        # local shards each step
+        from alphafold2_tpu.parallel import make_multihost_train_step
+        from alphafold2_tpu.parallel.sharding import host_to_global
+        from alphafold2_tpu.training import process_shard
+
+        # per-process view of the SAME global stream: row-slices, so the
+        # pod run is bit-identical to the single-process twin
+        example_local = process_shard(
+            synthetic_microbatch_fn(dcfg, tcfg.grad_accum)(start), axis=1
+        )
+        jitted, st_shardings, assemble, _mh_mesh = make_multihost_train_step(
+            cfg, tcfg, example_local, tp=False,
+            donate_state=not resilient,
+        )
+        # params replicate identically on every process (same seed /
+        # same restored bytes); each process feeds its own shards — no
+        # cross-process transfer (parallel/sharding.py host_to_global)
+        state = host_to_global(state, st_shardings)
+
+        def train_step(st, batch, rng=None):
+            return jitted(st, assemble(batch), rng)
+
+        def _local(it):
+            for b in it:
+                yield process_shard(b, axis=1)
+
+        batches = _local(batches)
+        if args.metrics_log and jax.process_index() != 0:
+            args.metrics_log = None  # one metrics file, written by proc 0
+    elif args.sp_shards:
         # sequence-parallel trunk: the pair grid (not the batch) shards —
         # the regime where crops outgrow one chip (parallel/sp_trunk.py)
         from alphafold2_tpu.parallel import make_mesh, make_sp_train_step
@@ -247,8 +313,14 @@ def main():
             print("note: --eval-every is ignored under the resilient loop")
         if args.data == "synthetic":
             # step-indexed fetch: a retried/resumed step refetches the
-            # IDENTICAL batch, making recovery replay-exact
-            source = synthetic_microbatch_fn(dcfg, tcfg.grad_accum)
+            # IDENTICAL batch, making recovery replay-exact. On a pod the
+            # fetch yields only THIS process's rows (same purity)
+            if procs > 1:
+                from alphafold2_tpu.training import per_process_microbatch_fn
+
+                source = per_process_microbatch_fn(dcfg, tcfg.grad_accum)
+            else:
+                source = synthetic_microbatch_fn(dcfg, tcfg.grad_accum)
         else:
             def stream():
                 for b in batches:
@@ -287,6 +359,10 @@ def main():
         return
 
     eval_batch, eval_loss_fn, eval_key = None, None, "eval_loss"
+    if args.eval_every and procs > 1:
+        print("note: --eval-every is ignored on multi-host runs (the "
+              "held-out eval is a single-process convenience)")
+        args.eval_every = 0
     if args.eval_every:
         # a FIXED held-out batch from a seed the training stream never
         # draws (stream seeds derive from args.seed; this one is offset).
