@@ -358,6 +358,95 @@ def _targets() -> Dict[str, Callable[[], None]]:
         finally:
             fleet.shutdown()
 
+    @register("serving.featurize")
+    def _serving_featurize():
+        # featurize tier round trip: the pure featurize function agrees
+        # with its own re-run (determinism is the tier's bit-exactness
+        # contract), and a 1-worker pool carries a job through submit ->
+        # worker -> on_done -> clean shutdown
+        import threading
+
+        import numpy as np
+
+        from alphafold2_tpu.serving import (
+            BucketLadder,
+            FeaturizeConfig,
+            FeaturizePool,
+            featurize_request,
+        )
+
+        ladder = BucketLadder((8, 16))
+        a = featurize_request("acdef", ladder=ladder)
+        b = featurize_request("ACDEF", ladder=ladder)
+        assert a.seq == b.seq == "ACDEF" and a.bucket == 8
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+
+        done = threading.Event()
+        out = {}
+        pool = FeaturizePool(FeaturizeConfig(workers=1), ladder)
+        try:
+            pool.submit("ACDEF", on_done=lambda bun, exc: (
+                out.update(bundle=bun, exc=exc), done.set()))
+            assert done.wait(30)
+            assert out["exc"] is None and out["bundle"].bucket == 8
+            assert pool.stats()["requests"]["completed"] == 1
+        finally:
+            pool.shutdown()
+
+    @register("serving.autoscale")
+    def _serving_autoscale():
+        # autoscaler state machine over a stub fleet with an injected
+        # clock: policy validation, a sustained-signal scale-up, and an
+        # idle scale-down after the hysteresis window — no threads
+        from alphafold2_tpu.serving import ReplicaAutoscaler, ScalePolicy
+        from alphafold2_tpu.telemetry import MetricRegistry
+
+        registry = MetricRegistry()
+        depth = registry.gauge("fleet_queue_depth")
+        occ = registry.gauge("fleet_occupancy")
+
+        class StubFleet:
+            _closed = False
+
+            def __init__(self):
+                self.registry = registry
+                self.n = 1
+
+            def sample_gauges(self):
+                pass
+
+            def replica_count(self):
+                return self.n
+
+            def add_replica(self):
+                self.n += 1
+                return f"r{self.n - 1}"
+
+            def remove_replica(self, name=None):
+                self.n -= 1
+                return f"r{self.n}"
+
+        fleet = StubFleet()
+        t = [0.0]
+        scaler = ReplicaAutoscaler(
+            fleet,
+            ScalePolicy(min_replicas=1, max_replicas=2, up_sustain=2,
+                        down_sustain=2, up_cooldown_s=0.0,
+                        down_cooldown_s=5.0),
+            registry=registry, clock=lambda: t[0])
+        depth.set(4), occ.set(2.0)
+        for _ in range(2):
+            scaler.tick()
+            t[0] += 1.0
+        assert fleet.n == 2, fleet.n
+        depth.set(0), occ.set(0.0)
+        t[0] += 10.0  # past the hysteresis window
+        for _ in range(2):
+            scaler.tick()
+            t[0] += 1.0
+        assert fleet.n == 1, fleet.n
+        assert len(scaler.scale_events()) == 2
+
     # --- reliability --------------------------------------------------------
     # host-side subsystems: no shapes to eval, but the same failure class —
     # an import- or construction-time regression in the chaos layer must

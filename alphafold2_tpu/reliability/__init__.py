@@ -35,6 +35,7 @@ from alphafold2_tpu.reliability.faults import (
     FaultInjector,
     FaultPlan,
     InjectedFault,
+    WorkerKilled,
 )
 from alphafold2_tpu.reliability.health import HealthMonitor, ReplicaState
 from alphafold2_tpu.reliability.preemption import Preempted, PreemptionHandler
@@ -46,6 +47,7 @@ __all__ = [
     "FaultInjector",
     "FaultPlan",
     "InjectedFault",
+    "WorkerKilled",
     "CircuitBreaker",
     "CircuitState",
     "HealthMonitor",

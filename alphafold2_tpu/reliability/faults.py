@@ -71,6 +71,13 @@ FAULT_KINDS = (
     "kill_replica",     # named fleet replica: fail every dispatch from `at` on
     "slow_replica",     # named fleet replica: sleep `delay_s` per dispatch
     "flap_replica",     # named fleet replica: fail `count` dispatches, recover
+    "slow_featurize",   # featurize tier: sleep `delay_s` at job index `at`
+    "kill_featurize_worker",  # featurize tier: kill the worker thread
+    #                     serving job index `at` (the pool must respawn it
+    #                     and not lose the job)
+    "scale_flap",       # autoscaler: force alternating up/down demands at
+    #                     tick index `at` (`count` forced demands) — the
+    #                     hysteresis window must absorb them
 )
 
 #: kinds that target one named fleet replica and require `replica`
@@ -82,6 +89,14 @@ _CKPT_MODES = ("truncate", "corrupt", "no_manifest")
 class InjectedFault(RuntimeError):
     """The exception every raising fault kind delivers — chaos tests (and
     recovery-path logs) can tell injected failures from organic ones."""
+
+
+class WorkerKilled(InjectedFault):
+    """`kill_featurize_worker`'s delivery: distinct from a plain
+    InjectedFault because the featurize pool must treat it as the WORKER
+    dying (respawn the thread, requeue the job) rather than the request
+    failing — exactly how an organic thread death differs from a bad
+    input."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -364,6 +379,51 @@ class FaultInjector:
 
         return hook
 
+    # -- hook: featurize tier (serving/featurize.py) -------------------------
+
+    def featurize_hook(self):
+        """Returns the FeaturizePool fault_hook: called with the pool's
+        job index at the top of every featurize job. The index is an
+        INJECTOR-side counter (the replica_hook stance): a respawned
+        worker thread must not rewind the schedule. `slow_featurize`
+        sleeps on the worker; `kill_featurize_worker` raises
+        `WorkerKilled`, which the pool converts into a worker death +
+        job requeue rather than a request failure."""
+        import time
+
+        def hook(engine_index: int):
+            with self._lock:
+                index = self._replica_dispatch.get("__featurize__", 0)
+                self._replica_dispatch["__featurize__"] = index + 1
+            f = self._take("slow_featurize", index)
+            if f is not None:
+                time.sleep(f.delay_s)
+            f = self._take("kill_featurize_worker", index)
+            if f is not None:
+                raise WorkerKilled(f.describe())
+
+        return hook
+
+    # -- hook: autoscaler ticks (serving/autoscale.py) -----------------------
+
+    def autoscale_hook(self):
+        """Returns the ReplicaAutoscaler fault_hook: called with the tick
+        index on every evaluation; returns a FORCED scale demand
+        ("up"/"down", alternating per delivery) while a `scale_flap`
+        fault is live, None otherwise. A forced demand bypasses the
+        policy's sustain counters but NOT its hysteresis window — the
+        chaos suite asserts the window absorbs the flapping."""
+        flips = [0]
+
+        def hook(tick_index: int) -> Optional[str]:
+            f = self._take("scale_flap", tick_index)
+            if f is None:
+                return None
+            flips[0] += 1
+            return "up" if flips[0] % 2 else "down"
+
+        return hook
+
 
 def _check_main(argv=None) -> int:
     """`python -m alphafold2_tpu.reliability.faults --check plan.json` —
@@ -396,7 +456,7 @@ def _check_main(argv=None) -> int:
             extra.append(f"replica={f.replica}")
         if f.kind == "ckpt_corrupt":
             extra.append(f"mode={f.mode}")
-        if f.kind in ("slow_request", "slow_replica"):
+        if f.kind in ("slow_request", "slow_replica", "slow_featurize"):
             extra.append(f"delay_s={f.delay_s}")
         if f.kind == "hung_request":
             extra.append(f"hang_s={f.hang_s}")

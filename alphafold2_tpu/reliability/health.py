@@ -54,6 +54,7 @@ class _Target:
         self.on_drain = on_drain
         self.on_reinstate = on_reinstate
         self.state = ReplicaState.HEALTHY
+        self.retiring = False           # deliberate removal in progress
         self.consecutive_failures = 0   # dispatch evidence (router-reported)
         self.consecutive_probe_failures = 0
         self.last_probe_at: Optional[float] = None
@@ -160,6 +161,37 @@ class HealthMonitor:
             if t.state is not ReplicaState.DOWN:
                 self._mark_down(t, reason)
 
+    def retire(self, name: str, reason: str = "retired"):
+        """Deliberate permanent removal (autoscale scale-down, rolling
+        replacement): the target takes no more traffic, its `on_drain`
+        callback runs on the next tick — the SAME drain path a sick
+        replica takes, so the owner's teardown logic is one code path —
+        and it is never re-probed or reinstated; once the drain has run,
+        the target is unregistered. Idempotent, and safe to call on a
+        target that is already DOWN (e.g. a failure drain racing an
+        autoscale decision): the drain callback is re-scheduled exactly
+        once and the owner's callback must tolerate an already-torn-down
+        replica (the fleet's does — that is the no-double-drain pin)."""
+        with self._lock:
+            t = self._targets.get(name)
+            if t is None or t.retiring:
+                return
+            t.retiring = True
+            if t.state is not ReplicaState.DOWN:
+                self._mark_down(t, reason)
+            else:
+                # already down (possibly already drained): schedule one
+                # cleanup pass through the same callback
+                t.down_reason = t.down_reason or reason
+                t.drain_pending = True
+
+    def unregister(self, name: str):
+        """Drop a target from supervision (no callbacks). The retire()
+        path calls this itself after the final drain; direct use is for
+        owners tearing down out-of-band."""
+        with self._lock:
+            self._targets.pop(name, None)
+
     def _mark_down(self, t: _Target, reason: str):
         t.state = ReplicaState.DOWN
         t.down_since = self._clock()
@@ -197,11 +229,15 @@ class HealthMonitor:
                     t.on_drain(t.name, reason)
                 except Exception:  # noqa: BLE001 — supervision must survive
                     traceback.print_exc()
+            if t.retiring:
+                # the final drain has run: the target leaves supervision
+                # (no re-probe could ever reinstate it)
+                self.unregister(t.name)
         for t in probes:
             self._run_probe(t)
 
     def _probe_due(self, t: _Target, now: float) -> bool:
-        if t.probe is None or t.drain_pending:
+        if t.probe is None or t.drain_pending or t.retiring:
             return False
         if t.state is ReplicaState.HEALTHY:
             if self.probe_interval_s <= 0:
@@ -289,6 +325,7 @@ class HealthMonitor:
                 "targets": {
                     t.name: {
                         "state": t.state.value,
+                        "retiring": t.retiring,
                         "consecutive_failures": t.consecutive_failures,
                         "drains": t.drains,
                         "reinstatements": t.reinstatements,

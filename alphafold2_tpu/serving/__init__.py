@@ -20,6 +20,7 @@ from alphafold2_tpu.serving.bucketing import (
     BucketLadder,
     pad_batch,
 )
+from alphafold2_tpu.serving.autoscale import ReplicaAutoscaler, ScalePolicy
 from alphafold2_tpu.serving.cache import ResultCache, request_key
 from alphafold2_tpu.serving.engine import (
     PredictionResult,
@@ -30,6 +31,7 @@ from alphafold2_tpu.serving.engine import (
 from alphafold2_tpu.serving.errors import (
     CircuitOpenError,
     EngineClosedError,
+    FeaturizeError,
     HungBatchError,
     InvalidSequenceError,
     NoHealthyReplicaError,
@@ -38,7 +40,14 @@ from alphafold2_tpu.serving.errors import (
     RequestTimeoutError,
     RequestTooLongError,
     RequeueLimitError,
+    ScaleRejectedError,
     ServingError,
+)
+from alphafold2_tpu.serving.featurize import (
+    FeatureBundle,
+    FeaturizeConfig,
+    FeaturizePool,
+    featurize_request,
 )
 from alphafold2_tpu.serving.fleet import (
     FleetConfig,
@@ -63,9 +72,15 @@ __all__ = [
     "pad_batch",
     "ResultCache",
     "request_key",
+    "FeatureBundle",
+    "FeaturizeConfig",
+    "FeaturizePool",
+    "featurize_request",
     "FleetConfig",
     "FleetRequest",
     "PredictionResult",
+    "ReplicaAutoscaler",
+    "ScalePolicy",
     "ServingConfig",
     "ServingEngine",
     "ServingFleet",
@@ -73,6 +88,7 @@ __all__ = [
     "ServingMetrics",
     "CircuitOpenError",
     "EngineClosedError",
+    "FeaturizeError",
     "HungBatchError",
     "InvalidSequenceError",
     "NoHealthyReplicaError",
@@ -81,5 +97,6 @@ __all__ = [
     "RequestTimeoutError",
     "RequestTooLongError",
     "RequeueLimitError",
+    "ScaleRejectedError",
     "ServingError",
 ]

@@ -50,7 +50,6 @@ from typing import Optional, Tuple
 import jax
 import numpy as np
 
-from alphafold2_tpu.constants import aa_to_tokens
 from alphafold2_tpu.serving.bucketing import (
     DEFAULT_BUCKETS,
     BucketLadder,
@@ -62,7 +61,6 @@ from alphafold2_tpu.serving.errors import (
     CircuitOpenError,
     EngineClosedError,
     HungBatchError,
-    InvalidSequenceError,
     PredictionError,
     QueueFullError,
     RequestTimeoutError,
@@ -388,18 +386,28 @@ class ServingEngine:
 
     def submit(self, seq: str, *, msa=None, msa_mask=None,
                timeout: Optional[float] = None,
-               trace_id: str = "") -> ServingRequest:
+               trace_id: str = "", features=None) -> ServingRequest:
         """Enqueue one sequence; returns immediately with a future.
 
         `trace_id` correlates every span/result of this request; "" mints
         a fresh one (the fleet passes the id it minted at ITS front door,
         so a requeued request keeps one id across replicas).
 
+        `features` is an optional pre-computed `featurize.FeatureBundle`
+        (the fleet's CPU featurization tier, or a client that prepared
+        its own): tokenization and MSA normalization are skipped — the
+        bundle IS that work, produced by the same `featurize_request`
+        function the inline path runs, so results are bit-identical
+        either way (the tier moves work across threads, never changes
+        it). `seq`/`msa`/`msa_mask` are ignored when given.
+
         Raises EngineClosedError / InvalidSequenceError /
         RequestTooLongError / QueueFullError / CircuitOpenError
         synchronously — a rejected request never occupies queue capacity.
         """
         trace_id = trace_id or new_trace_id()
+        if features is not None:
+            seq = features.seq
         # the span wraps validation + cache/coalesce lookup + enqueue; a
         # rejection exits it with an `error` attribute, so the trace shows
         # rejected submissions as first-class lifecycle events
@@ -407,7 +415,8 @@ class ServingEngine:
                                length=len(seq), trace_id=trace_id,
                                **self._span_tags) as sp:
             req = self._submit(seq, msa=msa, msa_mask=msa_mask,
-                               timeout=timeout, trace_id=trace_id)
+                               timeout=timeout, trace_id=trace_id,
+                               features=features)
             sp.set("bucket", req.bucket)
             if req.trace_id != trace_id:
                 # coalesced onto an identical in-flight request: the
@@ -418,53 +427,55 @@ class ServingEngine:
 
     def _submit(self, seq: str, *, msa=None, msa_mask=None,
                 timeout: Optional[float] = None,
-                trace_id: str = "") -> ServingRequest:
+                trace_id: str = "", features=None) -> ServingRequest:
         if self._closed:
             self._reject(EngineClosedError("engine is shut down"))
-        seq = seq.strip().upper()
-        try:
-            tokens = aa_to_tokens(seq, strict=True)
-        except ValueError as e:
-            self._reject(InvalidSequenceError(str(e)))
-        try:
-            bucket = self._ladder.bucket_for(len(seq))
-        except ServingError as e:
-            self._reject(e)
+        if features is not None:
+            # pre-featurized path (serving/featurize.py): the bundle was
+            # produced by the SAME featurize_request function the inline
+            # branch below delegates to, against the same ladder/msa_rows
+            # — only cheap consistency guards remain (a bundle featurized
+            # for a different deployment must not slip through)
+            seq = features.seq
+            tokens = features.tokens
+            msa_arr, msa_mask = features.msa, features.msa_mask
+            try:
+                bucket = self._ladder.bucket_for(len(seq))
+            except ServingError as e:
+                self._reject(e)
+            if msa_arr is not None and (
+                    self.cfg.msa_rows == 0
+                    or msa_arr.shape[0] > self.cfg.msa_rows):
+                self._reject(ServingError(
+                    f"pre-featurized msa has {msa_arr.shape[0]} rows; "
+                    f"this engine serves msa_rows={self.cfg.msa_rows}"
+                ))
+            # a client-built bundle is untrusted input: a mask without
+            # an alignment (or mis-shaped against it) would otherwise
+            # first explode in batch assembly as a replica-attributed
+            # PredictionError — which the fleet would requeue across
+            # replicas and count as replica failure evidence
+            if msa_arr is None and msa_mask is not None:
+                self._reject(ServingError(
+                    "pre-featurized msa_mask given without msa"))
+            if (msa_arr is not None and msa_mask is not None
+                    and msa_mask.shape != msa_arr.shape):
+                self._reject(ServingError(
+                    f"pre-featurized msa_mask shape {msa_mask.shape} "
+                    f"does not match msa shape {msa_arr.shape}"))
+        else:
+            from alphafold2_tpu.serving.featurize import featurize_request
 
-        msa_arr = None
-        if msa is None and msa_mask is not None:
-            # a mask without an alignment is meaningless — and if let
-            # through it would reach batch assembly shaped against a
-            # query-row MSA (or silently split cache keys on msa_rows=0)
-            self._reject(ServingError("msa_mask given without msa"))
-        if msa is not None:
-            if self.cfg.msa_rows == 0:
-                self._reject(ServingError(
-                    "engine is configured sequence-only (msa_rows=0); "
-                    "rebuild with ServingConfig(msa_rows=N) to serve MSAs"
-                ))
-            msa_arr = np.asarray(msa, np.int32)
-            if msa_arr.ndim != 2 or msa_arr.shape[1] != len(seq):
-                self._reject(ServingError(
-                    f"msa must be (rows, {len(seq)}) tokens, got "
-                    f"{msa_arr.shape}"
-                ))
-            if msa_arr.shape[0] > self.cfg.msa_rows:
-                # explicit rejection, not silent truncation (the same
-                # stance as RequestTooLongError): conditioning data must
-                # never be discarded without the client knowing
-                self._reject(ServingError(
-                    f"msa has {msa_arr.shape[0]} rows; this engine serves "
-                    f"at most msa_rows={self.cfg.msa_rows} — subsample "
-                    f"client-side or deploy with a larger msa_rows"
-                ))
-            if msa_mask is not None:
-                msa_mask = np.asarray(msa_mask, bool)
-                if msa_mask.shape != msa_arr.shape:
-                    self._reject(ServingError(
-                        f"msa_mask shape {msa_mask.shape} does not match "
-                        f"msa shape {msa_arr.shape}"
-                    ))
+            try:
+                bundle = featurize_request(
+                    seq, msa, msa_mask,
+                    ladder=self._ladder, msa_rows=self.cfg.msa_rows,
+                )
+            except ServingError as e:
+                self._reject(e)
+            seq, tokens = bundle.seq, bundle.tokens
+            msa_arr, msa_mask = bundle.msa, bundle.msa_mask
+            bucket = bundle.bucket
 
         key = request_key(seq, msa_arr, self._config_tag, msa_mask=msa_mask)
 

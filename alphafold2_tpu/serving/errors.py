@@ -132,3 +132,29 @@ class RequeueLimitError(ServingError):
     ``__cause__``."""
 
     code = "requeue_limit"
+
+
+class FeaturizeError(ServingError):
+    """CPU featurization of the request failed (serving/featurize.py):
+    the feature-prep worker raised while tokenizing / assembling the MSA
+    stream / assigning the bucket, or the featurize tier lost the job
+    past its retry budget (e.g. repeated worker deaths mid-job). The
+    underlying exception is chained as ``__cause__`` when there is one.
+    Semantic input rejections (invalid residues, oversize sequences)
+    keep their own sharper codes — this code means the TIER failed the
+    request, not that the request was malformed."""
+
+    code = "featurize_failed"
+
+
+class ScaleRejectedError(ServingError):
+    """The fleet refused a replica-pool scale action (serving/autoscale.py
+    → `ServingFleet.add_replica` / `remove_replica`): shrinking below one
+    replica, removing an unknown or already-retiring replica, scaling a
+    closed fleet, or shrinking while the pool is unhealthy (a drain on
+    top of failure-drained capacity would amplify the outage). Counted
+    per code in `stats()["errors"]` so a wedged autoscaler loop is
+    visible on dashboards, and carried in the autoscaler's decision
+    log."""
+
+    code = "scale_rejected"

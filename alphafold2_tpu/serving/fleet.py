@@ -57,7 +57,7 @@ import time
 import traceback
 from typing import Optional
 
-from alphafold2_tpu.constants import AA_ORDER, aa_to_tokens
+from alphafold2_tpu.constants import AA_ORDER
 from alphafold2_tpu.reliability.health import HealthMonitor, ReplicaState
 from alphafold2_tpu.serving.admission import (
     AdmissionConfig,
@@ -74,13 +74,19 @@ from alphafold2_tpu.serving.errors import (
     CircuitOpenError,
     EngineClosedError,
     HungBatchError,
-    InvalidSequenceError,
     NoHealthyReplicaError,
     PredictionError,
     QueueFullError,
     RequestTimeoutError,
     RequeueLimitError,
+    ScaleRejectedError,
     ServingError,
+)
+from alphafold2_tpu.serving.featurize import (
+    FeatureBundle,
+    FeaturizeConfig,
+    FeaturizePool,
+    featurize_request,
 )
 from alphafold2_tpu.telemetry import NULL_TRACER, MetricRegistry, new_trace_id
 
@@ -122,6 +128,14 @@ class FleetConfig:
     breaker_jitter: float = 0.25  # seeded reopen spread per replica
     dispatch_backoff_s: float = 0.01  # router sleep when every target is full
     tick_interval_s: float = 0.05     # health thread granularity
+    # CPU featurization tier (serving/featurize.py): >0 workers puts a
+    # separately-sized feature-prep pool in FRONT of the admission queue
+    # — raw-sequence submissions featurize there; pre-featurized bundles
+    # bypass it. 0 = featurize inline on the submit thread (the pre-tier
+    # behavior, bit-identical results).
+    featurize_workers: int = 0
+    featurize_queue: int = 128
+    featurize_retry_limit: int = 1    # worker-death requeues per job
 
     def __post_init__(self):
         if self.replicas < 1:
@@ -137,6 +151,11 @@ class FleetConfig:
                 f"degraded_weight_dtype must be '', 'f32', or 'int8', "
                 f"got {self.degraded_weight_dtype!r}"
             )
+        if self.featurize_workers < 0 or self.featurize_queue < 1:
+            raise ValueError(
+                "featurize_workers must be >= 0 and featurize_queue >= 1, "
+                f"got {self.featurize_workers}/{self.featurize_queue}"
+            )
 
 
 class FleetRequest:
@@ -146,10 +165,12 @@ class FleetRequest:
     `enqueued_at`); `requeues` counts replica failovers survived."""
 
     def __init__(self, seq: str, msa, msa_mask, priority: int,
-                 deadline: Optional[float], trace_id: str = ""):
+                 deadline: Optional[float], trace_id: str = "",
+                 features: Optional[FeatureBundle] = None):
         self.seq = seq
         self.msa = msa
         self.msa_mask = msa_mask
+        self.features = features   # set by the featurize tier (or caller)
         self.priority = priority
         self.deadline = deadline
         self.enqueued_at = time.monotonic()
@@ -206,10 +227,13 @@ class _Replica:
     """One engine slot; the engine reference swaps across drain/restart
     cycles (guarded by the fleet lock)."""
 
-    def __init__(self, name: str, factory):
+    def __init__(self, name: str, index: int, cfg: ServingConfig):
         self.name = name
-        self.factory = factory   # () -> ServingEngine
+        self.index = index       # monotone creation index (victim ranking)
+        self.cfg = cfg           # live: rolling updates swap it in place
+        self.factory = None      # () -> ServingEngine; reads self.cfg
         self.engine: Optional[ServingEngine] = None
+        self.retiring = False    # deliberate removal in progress
         self.in_flight = 0
         self.dispatches = 0
         self.probe_counter = 0
@@ -285,6 +309,21 @@ class ServingFleet:
             help="fleet submit->terminal latency, sliding window")
         self._up_gauges = {}
 
+        # ---- live queue/occupancy gauges (sample_gauges ticker hook) ----
+        self._queue_depth_gauge = self.registry.gauge(
+            "fleet_queue_depth",
+            help="live admission-queue depth (sampled by the ops ticker "
+                 "so scrapes see pressure between requests)")
+        self._service_ema_gauge = self.registry.gauge(
+            "fleet_service_ema_seconds",
+            help="admission drain-rate EMA (per-request service seconds)")
+        self._occupancy_gauge = self.registry.gauge(
+            "fleet_occupancy",
+            help="dispatched requests per slot of healthy replica "
+                 "capacity (the autoscaler's load signal)")
+        self._replicas_gauge = self.registry.gauge(
+            "fleet_replicas", help="current (non-retiring) replica count")
+
         # ---- replicas + health ----
         self._admission = AdmissionController(
             AdmissionConfig(capacity=fleet_cfg.queue_capacity))
@@ -294,25 +333,25 @@ class ServingFleet:
             fail_threshold=fleet_cfg.fail_threshold,
         )
         self._replicas = {}
-        for i in range(fleet_cfg.replicas):
-            name = f"r{i}"
-            rcfg = dataclasses.replace(
-                serving_cfg,
-                breaker_jitter=(fleet_cfg.breaker_jitter
-                                if serving_cfg.breaker_threshold else 0.0),
-                breaker_jitter_seed=i,
-            )
-            rep = _Replica(name, self._make_factory(name, rcfg))
-            rep.engine = rep.factory()
-            self._replicas[name] = rep
-            self._up_gauges[name] = self.registry.gauge(
-                "fleet_replica_up", help="1 = taking traffic", replica=name)
-            self._up_gauges[name].set(1)
-            self._health.register(
-                name,
-                probe=lambda n=name: self._probe_replica(n),
-                on_drain=self._drain_replica,
-                on_reinstate=self._reinstate_replica,
+        self._replica_seq = 0
+        self._autoscaler = None
+        for _ in range(fleet_cfg.replicas):
+            self._spawn_replica()
+
+        # ---- CPU featurization tier (serving/featurize.py) ----
+        self._featurize: Optional[FeaturizePool] = None
+        if fleet_cfg.featurize_workers > 0:
+            self._featurize = FeaturizePool(
+                FeaturizeConfig(
+                    workers=fleet_cfg.featurize_workers,
+                    queue_capacity=fleet_cfg.featurize_queue,
+                    retry_limit=fleet_cfg.featurize_retry_limit,
+                ),
+                self._ladder, msa_rows=serving_cfg.msa_rows,
+                registry=self.registry, tracer=self._tracer,
+                fault_hook=(injector.featurize_hook()
+                            if injector is not None else None),
+                incident_hook=self._incident_hook,
             )
 
         self._degraded_rep: Optional[_Replica] = None
@@ -331,8 +370,9 @@ class ServingFleet:
             if fleet_cfg.degraded_mds_iters:
                 dcfg = dataclasses.replace(
                     serving_cfg, mds_iters=fleet_cfg.degraded_mds_iters)
-            self._degraded_rep = _Replica(
-                DEGRADED, self._make_factory(DEGRADED, dcfg))
+            self._degraded_rep = _Replica(DEGRADED, -1, dcfg)
+            self._degraded_rep.factory = self._make_factory(
+                self._degraded_rep)
             self._degraded_rep.engine = self._degraded_rep.factory()
 
         self._health.start(fleet_cfg.tick_interval_s)
@@ -352,13 +392,18 @@ class ServingFleet:
             replica_name=name, incident_hook=self._incident_hook,
         )
 
-    def _make_factory(self, name, cfg):
-        hook = (self._injector.replica_hook(name)
+    def _make_factory(self, rep: _Replica):
+        hook = (self._injector.replica_hook(rep.name)
                 if self._injector is not None else None)
 
         def build():
             try:
-                return self._factory(name, cfg, hook)
+                # rep.cfg is read at BUILD time, not closure time: a
+                # rolling update swaps the cfg and cycles the replica
+                # through the drain path — the reinstatement probe's
+                # fresh engine picks up the new cfg (and the current
+                # self._params master) automatically
+                return self._factory(rep.name, rep.cfg, hook)
             except Exception:  # noqa: BLE001 — a failing restart is a
                 # failed probe, not a fleet crash
                 traceback.print_exc()
@@ -366,21 +411,66 @@ class ServingFleet:
 
         return build
 
+    def _spawn_replica(self) -> _Replica:
+        """Create, build, and register one replica (ctor + add_replica).
+        Builds the engine OUTSIDE the fleet lock (it may compile)."""
+        with self._lock:
+            i = self._replica_seq
+            self._replica_seq += 1
+            name = f"r{i}"
+            rcfg = dataclasses.replace(
+                self._serving_cfg,
+                breaker_jitter=(self.cfg.breaker_jitter
+                                if self._serving_cfg.breaker_threshold
+                                else 0.0),
+                breaker_jitter_seed=i,
+            )
+            rep = _Replica(name, i, rcfg)
+            rep.factory = self._make_factory(rep)
+        rep.engine = rep.factory()
+        with self._lock:
+            self._replicas[name] = rep
+            gauge = self._up_gauges.get(name)
+            if gauge is None:
+                gauge = self.registry.gauge(
+                    "fleet_replica_up", help="1 = taking traffic",
+                    replica=name)
+                self._up_gauges[name] = gauge
+        gauge.set(1 if rep.engine is not None else 0)
+        self._health.register(
+            name,
+            probe=lambda n=name: self._probe_replica(n),
+            on_drain=self._drain_replica,
+            on_reinstate=self._reinstate_replica,
+        )
+        return rep
+
     # ----------------------------------------------------------------- API
 
     def submit(self, seq: str, *, msa=None, msa_mask=None,
                timeout: Optional[float] = None,
-               priority="normal", trace_id: str = "") -> FleetRequest:
+               priority="normal", trace_id: str = "",
+               features: Optional[FeatureBundle] = None) -> FleetRequest:
         """Enqueue one sequence at the fleet front door; returns a future.
 
         `trace_id` ("" mints one) correlates every span this request
-        touches — across the admission queue, the dispatcher, requeues,
-        and every replica engine — and rides the result for log/trace
-        cross-reference.
+        touches — across the featurize tier, the admission queue, the
+        dispatcher, requeues, and every replica engine — and rides the
+        result for log/trace cross-reference.
+
+        With a featurize tier configured (`FleetConfig.featurize_workers`
+        > 0) a RAW submission enters the CPU featurization pool first
+        and reaches the admission queue from a pool worker — validation
+        errors then resolve the returned future instead of raising here
+        (the submit thread never blocks on feature prep). A
+        pre-featurized `features` bundle BYPASSES the tier and keeps the
+        fully-synchronous contract. Without a tier, featurization runs
+        inline exactly as before.
 
         Raises EngineClosedError / InvalidSequenceError /
-        RequestTooLongError / QueueFullError(retry_after_s) synchronously.
-        A lower-priority queued request may be EVICTED (resolved with a
+        RequestTooLongError / QueueFullError(retry_after_s) synchronously
+        on the paths that validate synchronously (see above). A
+        lower-priority queued request may be EVICTED (resolved with a
         retry-after error) to admit a higher-priority one.
         """
         trace_id = trace_id or new_trace_id()
@@ -388,51 +478,105 @@ class ServingFleet:
                                length=len(seq), trace_id=trace_id):
             if self._closed:
                 raise EngineClosedError("fleet is shut down")
-            seq = seq.strip().upper()
-            try:
-                aa_to_tokens(seq, strict=True)
-            except ValueError as e:
-                self._count_error(InvalidSequenceError(str(e)))
-                raise InvalidSequenceError(str(e)) from None
-            try:
-                self._ladder.bucket_for(len(seq))
-            except ServingError as e:
-                self._count_error(e)
-                raise
             ttl = (self.cfg.default_timeout_s if timeout is None else timeout)
             deadline = (time.monotonic() + ttl) if ttl is not None else None
+
+            if features is None and self._featurize is None:
+                # no tier: featurize inline on the submit thread (the
+                # pre-tier contract — same function, same errors)
+                try:
+                    features = featurize_request(
+                        seq, msa, msa_mask,
+                        ladder=self._ladder,
+                        msa_rows=self._serving_cfg.msa_rows,
+                    )
+                except ServingError as e:
+                    self._count_error(e)
+                    raise
+            if features is not None:
+                entry = FleetRequest(features.seq, msa, msa_mask,
+                                     resolve_priority(priority), deadline,
+                                     trace_id=trace_id, features=features)
+                self._counts["submitted"].inc()
+                self._admit(entry, raise_on_full=True)
+                return entry
+
+            # featurize tier: the pool's bounded queue is the new first
+            # backpressure point; queue-full there raises synchronously
+            # like admission queue-full always has
             entry = FleetRequest(seq, msa, msa_mask,
                                  resolve_priority(priority), deadline,
                                  trace_id=trace_id)
             self._counts["submitted"].inc()
             try:
-                evicted = self._admission.offer(entry)
+                self._featurize.submit(
+                    seq, msa, msa_mask, trace_id=trace_id,
+                    on_done=lambda bundle, exc, e=entry:
+                    self._on_featurized(e, bundle, exc))
             except QueueFullError as e:
                 # stays counted as submitted: shed is its terminal
                 # outcome, so in_flight arithmetic balances
+                self._shed_counter("featurize_queue_full").inc()
+                self._counts["shed"].inc()
+                self._count_error(e)
+                raise
+            except EngineClosedError as e:
+                self._resolve_failed(entry, e)
+                raise
+            return entry
+
+    def _on_featurized(self, entry: FleetRequest, bundle, exc):
+        """Featurize-pool completion (pool worker thread): attach the
+        features and offer the entry to the admission queue, or resolve
+        it with the featurization error. Never raises."""
+        if exc is not None:
+            self._resolve_failed(entry, exc)
+            return
+        entry.features = bundle
+        entry.seq = bundle.seq
+        self._admit(entry, raise_on_full=False)
+
+    def _admit(self, entry: FleetRequest, *, raise_on_full: bool):
+        """Offer an accepted entry to the admission queue; shed/eviction
+        accounting in one place for the sync and async entry paths."""
+        try:
+            evicted = self._admission.offer(entry)
+        except QueueFullError as e:
+            # the entry stays counted as submitted: shed is its terminal
+            # outcome, so in_flight arithmetic balances
+            if raise_on_full:
                 self._shed_counter("queue_full").inc()
                 self._counts["shed"].inc()
                 self._count_error(e)
                 raise
-            if evicted is not None:
-                self._resolve_shed(
-                    evicted, "evicted",
-                    QueueFullError(
-                        "evicted by a higher-priority arrival under "
-                        "overload; retry with backoff",
-                        retry_after_s=self._admission.retry_after_s(),
-                    ))
-            # close the TOCTOU window against shutdown() (the engine's
-            # stance, engine.py): if the closed flag flipped after the
-            # entry check, shutdown's final drain may already be past
-            # this entry — resolve it ourselves; _finish is resolve-once,
-            # so losing the race to a still-draining dispatcher is
-            # harmless
-            if self._closed and self._resolve_failed(entry, EngineClosedError(
+            self._resolve_shed(entry, "queue_full", e)
+            return
+        if evicted is not None:
+            self._resolve_shed(
+                evicted, "evicted",
+                QueueFullError(
+                    "evicted by a higher-priority arrival under "
+                    "overload; retry with backoff",
+                    retry_after_s=self._admission.retry_after_s(),
+                ))
+        # close the TOCTOU window against shutdown() (the engine's
+        # stance, engine.py): if the ROUTER is stopping (or crashed —
+        # the crash guard closes the fleet with the stop event unset
+        # but the thread dead), its final drain may already be past
+        # this entry — resolve it ourselves; _finish is resolve-once,
+        # so losing the race to a still-draining dispatcher is
+        # harmless. The closed flag alone is NOT the test: during
+        # shutdown(drain=True) the featurize tier drains THROUGH here
+        # while the dispatcher is still serving ("serves what it still
+        # can"), and failing those entries would break that promise.
+        dispatcher_gone = (self._stop.is_set()
+                           or not self._dispatcher.is_alive())
+        if (self._closed and dispatcher_gone
+                and self._resolve_failed(entry, EngineClosedError(
                     "fleet shut down while the request was being "
-                    "submitted")):
+                    "submitted"))):
+            if raise_on_full:
                 raise EngineClosedError("fleet is shut down")
-            return entry
 
     def predict(self, seq: str, *, msa=None, msa_mask=None,
                 timeout: Optional[float] = None,
@@ -441,6 +585,178 @@ class ServingFleet:
         return self.submit(seq, msa=msa, msa_mask=msa_mask, timeout=timeout,
                            priority=priority).result()
 
+    # -------------------------------------------------------- elasticity
+
+    def replica_count(self) -> int:
+        """Non-retiring full replicas (the autoscaler's pool size)."""
+        with self._lock:
+            return sum(1 for r in self._replicas.values() if not r.retiring)
+
+    def add_replica(self) -> str:
+        """Grow the pool by one replica (autoscale scale-up). Returns the
+        new replica's name. Raises ScaleRejectedError when the fleet is
+        closed or the engine fails to build — a failed grow must be a
+        visible decision outcome, not a zombie slot."""
+        if self._closed:
+            raise ScaleRejectedError("fleet is shut down")
+        rep = self._spawn_replica()
+        if rep.engine is None:
+            # take the stillborn slot back out through the normal path
+            rep.retiring = True
+            self._health.retire(rep.name, "failed_to_build")
+            raise ScaleRejectedError(
+                f"replica {rep.name} engine failed to build")
+        return rep.name
+
+    def remove_replica(self, name: Optional[str] = None) -> str:
+        """Shrink the pool by one replica through the HealthMonitor
+        drain path (autoscale scale-down): the victim stops taking
+        traffic immediately, its queued work fails back through the
+        requeue path onto the survivors (nothing is lost), and the
+        health tick unregisters it after the drain runs. `name=None`
+        picks the least-loaded healthy replica (newest on ties).
+
+        Raises ScaleRejectedError when: the fleet is closed; the pool
+        would drop below one replica; `name` is unknown or already
+        retiring; or (victim unspecified) any replica is DOWN — draining
+        on top of failure-drained capacity would amplify the outage, so
+        autoscale shrink is refused while the pool is unhealthy."""
+        with self._lock:
+            if self._closed:
+                raise ScaleRejectedError("fleet is shut down")
+            live = [r for r in self._replicas.values() if not r.retiring]
+            if len(live) <= 1:
+                raise ScaleRejectedError(
+                    "refusing to shrink below one replica")
+            healthy = set(self._health.healthy_targets())
+            if name is None:
+                down = sorted(r.name for r in live if r.name not in healthy)
+                if down:
+                    raise ScaleRejectedError(
+                        f"replica(s) {down} are down — refusing to shrink "
+                        f"already-degraded capacity")
+                victim = sorted(live,
+                                key=lambda r: (r.in_flight, -r.index))[0]
+            else:
+                victim = self._replicas.get(name)
+                if victim is None or victim.retiring:
+                    raise ScaleRejectedError(
+                        f"no live replica named {name!r}")
+            victim.retiring = True
+        self._health.retire(victim.name, "scale_down")
+        return victim.name
+
+    def attach_autoscaler(self, autoscaler):
+        """Bind a ReplicaAutoscaler so `stats()` carries its snapshot
+        (the acceptance surface) and shutdown() stops its ticker."""
+        self._autoscaler = autoscaler
+
+    def sample_gauges(self):
+        """Ticker hook (ops plane / autoscaler): publish the LIVE queue
+        and occupancy signals as registry gauges — until this hook,
+        queue depth and the drain-rate EMA were visible only inside
+        `stats()` snapshots, so a `/metrics` scrape between requests
+        never saw queue pressure."""
+        snap = self._admission.snapshot()
+        self._queue_depth_gauge.set(snap["depth"])
+        self._service_ema_gauge.set(snap["service_ema_s"] or 0.0)
+        healthy = set(self._health.healthy_targets())
+        with self._lock:
+            live = [r for r in self._replicas.values() if not r.retiring]
+            n_live = len(live)
+            in_flight = sum(r.in_flight for r in live
+                            if r.name in healthy)
+            slots = sum(r.cfg.max_batch for r in live
+                        if r.name in healthy)
+        self._replicas_gauge.set(n_live)
+        self._occupancy_gauge.set(in_flight / slots if slots else 0.0)
+        if self._featurize is not None:
+            self._featurize.sample_gauges()
+
+    def rolling_update(self, *, params=None, model_cfg=None,
+                       params_tag: Optional[str] = None,
+                       timeout_s: float = 120.0) -> dict:
+        """Zero-downtime deploy: swap the master weights and/or model
+        config, then cycle each replica through the SAME HealthMonitor
+        drain path a failure takes — one at a time, waiting for the
+        re-probe to reinstate it behind a fresh engine (which reads the
+        new masters) before touching the next, so the pool never drops
+        more than one replica of capacity and in-flight work requeues
+        onto the survivors.
+
+        `params_tag` MUST change when `params` does: it is part of the
+        result-cache key, and stale-tag cache entries would serve the
+        OLD weights' structures after the update. Returns a summary dict
+        ({replica: restarts}). Raises ScaleRejectedError if the fleet is
+        closed or a replica fails to come back inside `timeout_s`."""
+        if params is not None and params_tag is None:
+            raise ValueError(
+                "rolling_update(params=...) requires params_tag=: the "
+                "result cache keys on it — reusing the old tag would "
+                "serve stale structures from the previous weights"
+            )
+        if params is None and model_cfg is None and params_tag is None:
+            raise ValueError("rolling_update: nothing to update")
+        with self._lock:
+            if self._closed:
+                raise ScaleRejectedError("fleet is shut down")
+            if params is not None:
+                self._params = params
+            if model_cfg is not None:
+                self._model_cfg = model_cfg
+                self._degraded_model_cfg = model_cfg
+                if self.cfg.degraded_weight_dtype == "int8":
+                    self._degraded_model_cfg = dataclasses.replace(
+                        model_cfg, weight_dtype="int8")
+            reps = sorted(
+                (r for r in self._replicas.values() if not r.retiring),
+                key=lambda r: r.index)
+            if params_tag is not None:
+                # the template too, not just live replicas: a replica
+                # the autoscaler ADDS after this deploy is spawned from
+                # self._serving_cfg and must carry the new tag — a fresh
+                # engine serving the new weights under the old tag would
+                # alias the old weights' result-cache keyspace
+                self._serving_cfg = dataclasses.replace(
+                    self._serving_cfg, params_tag=params_tag)
+                for r in reps:
+                    r.cfg = dataclasses.replace(r.cfg,
+                                                params_tag=params_tag)
+                if self._degraded_rep is not None:
+                    self._degraded_rep.cfg = dataclasses.replace(
+                        self._degraded_rep.cfg, params_tag=params_tag)
+            degraded = self._degraded_rep
+        summary = {}
+        for rep in reps:
+            try:
+                self._health.force_down(rep.name, "rolling_update")
+            except KeyError:
+                continue  # retired (autoscale) since we captured reps
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                try:
+                    state = self._health.state(rep.name)
+                except KeyError:
+                    break  # retired mid-update: nothing left to cycle
+                if (state is ReplicaState.HEALTHY
+                        and rep.engine is not None):
+                    break
+                time.sleep(min(0.02, self.cfg.reprobe_interval_s))
+            else:
+                raise ScaleRejectedError(
+                    f"rolling update stalled: {rep.name} not reinstated "
+                    f"within {timeout_s}s")
+            summary[rep.name] = rep.restarts
+        if degraded is not None:
+            # the degraded tier has no health-managed drain path; swap
+            # its engine directly (it serves only overflow/outage)
+            old, degraded.engine = degraded.engine, None
+            if old is not None:
+                old.shutdown(drain=False,
+                             timeout=self.cfg.drain_timeout_s)
+            degraded.engine = degraded.factory()
+        return summary
+
     def health(self) -> dict:
         """Cheap liveness payload for `/healthz` (telemetry/ops_plane.py):
         HealthMonitor states + replica-up view, no engine stats. `status`
@@ -448,7 +764,10 @@ class ServingFleet:
         some replicas down, or only the degraded tier is serving), or
         "down" (closed, or nothing can serve — mapped to HTTP 503)."""
         snap = self._health.snapshot()
-        states = {name: t["state"] for name, t in snap["targets"].items()}
+        # retiring replicas are deliberate removals mid-drain, not lost
+        # capacity: they must not flip /healthz to "degraded"
+        states = {name: t["state"] for name, t in snap["targets"].items()
+                  if not t.get("retiring")}
         n_healthy = sum(1 for s in states.values() if s == "healthy")
         with self._lock:
             has_degraded = self._degraded_rep is not None
@@ -487,17 +806,22 @@ class ServingFleet:
             errors = {code: int(c.value)
                       for code, c in self._errors.items()}
         replicas = {}
+        # one snapshot, not per-name state() lookups: a replica retired
+        # between our reps copy and here has already left the health
+        # registry, and indexing it would KeyError a /statusz scrape
+        health_states = {name: t["state"] for name, t
+                         in self._health.snapshot()["targets"].items()}
         for rep in reps + ([degraded] if degraded else []):
             engine = rep.engine
             replicas[rep.name] = {
                 "state": (DEGRADED if rep.name == DEGRADED
-                          else self._health.state(rep.name).value),
+                          else health_states.get(rep.name, "retired")),
                 "in_flight": rep.in_flight,
                 "dispatches": rep.dispatches,
                 "restarts": rep.restarts,
                 "engine": engine.stats() if engine is not None else None,
             }
-        return {
+        out = {
             "closed": self._closed,
             "requests": counts,
             "shed": shed,
@@ -512,6 +836,11 @@ class ServingFleet:
                 "spans": self._tracer.summary(),
             },
         }
+        if self._featurize is not None:
+            out["featurize"] = self._featurize.stats()
+        if self._autoscaler is not None:
+            out["autoscale"] = self._autoscaler.snapshot()
+        return out
 
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
         """Stop the front door, the router, the supervisor, and every
@@ -520,6 +849,16 @@ class ServingFleet:
         EngineClosedError — nothing is left unresolved. Idempotent."""
         self._closed = True
         self._drain_on_stop = drain
+        if self._autoscaler is not None:
+            # the control loop must not scale a closing fleet (tick()
+            # also checks _closed; stopping the fallback thread is belt
+            # and braces)
+            self._autoscaler.stop()
+        if self._featurize is not None:
+            # featurize first: its pending jobs resolve their entries
+            # (drain=True runs them through admission; anything the
+            # dispatcher no longer serves fails terminally below)
+            self._featurize.shutdown(drain=drain)
         self._stop.set()
         self._dispatcher.join(timeout)
         self._health.stop()
@@ -585,8 +924,11 @@ class ServingFleet:
                       and self._admission.depth() >= self.cfg.degrade_depth)
         healthy = self._health.healthy_targets()
         with self._lock:
+            # .get: a replica retired by the autoscaler may briefly
+            # linger in the health view (or vice versa) mid-transition
             ranked = sorted(
-                (self._replicas[n] for n in healthy),
+                (r for r in (self._replicas.get(n) for n in healthy)
+                 if r is not None and not r.retiring),
                 key=lambda r: r.in_flight,
             )
             degraded = self._degraded_rep
@@ -659,6 +1001,9 @@ class ServingFleet:
                     # the fleet's id, not a fresh engine-minted one: a
                     # requeued request keeps one id across replicas
                     trace_id=entry.trace_id,
+                    # featurized once (tier or inline), dispatched many:
+                    # a requeue onto another replica reuses the bundle
+                    features=entry.features,
                 )
         except QueueFullError:
             return False
@@ -776,7 +1121,10 @@ class ServingFleet:
         cache cannot vouch for a dead engine). Restarts the engine first
         if a drain tore it down. Runs on the health thread."""
         with self._lock:
-            rep = self._replicas[name]
+            rep = self._replicas.get(name)
+        if rep is None or rep.retiring:
+            return False  # mid-retirement: never vouch for a leaving slot
+        with self._lock:
             engine = rep.engine
         if engine is None or getattr(engine, "_closed", False):
             engine = rep.factory()
@@ -799,13 +1147,24 @@ class ServingFleet:
             return False
 
     def _drain_replica(self, name: str, reason: str):
-        """Health-thread callback: take the sick engine out of rotation
-        and fail its queued work BACK through the requeue path (shutdown
-        drain=False resolves everything pending with EngineClosedError,
-        which `_on_replica_done` converts into requeues)."""
+        """Health-thread callback: take the sick (or retiring) engine out
+        of rotation and fail its queued work BACK through the requeue
+        path (shutdown drain=False resolves everything pending with
+        EngineClosedError, which `_on_replica_done` converts into
+        requeues). Idempotent — a failure drain racing an autoscale
+        retirement finds engine=None the second time and only runs the
+        retirement bookkeeping (the no-double-drain pin)."""
         with self._lock:
-            rep = self._replicas[name]
+            rep = self._replicas.get(name)
+            if rep is None:
+                return
             engine, rep.engine = rep.engine, None
+            retiring = rep.retiring
+            if retiring:
+                # the drain has run: the slot leaves the pool for good
+                # (the health monitor unregisters its target right after
+                # this callback returns)
+                self._replicas.pop(name, None)
         self._up_gauges[name].set(0)
         if self._incident_hook is not None:
             try:
@@ -818,4 +1177,6 @@ class ServingFleet:
             engine.shutdown(drain=False, timeout=self.cfg.drain_timeout_s)
 
     def _reinstate_replica(self, name: str):
-        self._up_gauges[name].set(1)
+        gauge = self._up_gauges.get(name)
+        if gauge is not None:
+            gauge.set(1)

@@ -87,6 +87,11 @@ _RULES: Tuple[Tuple[str, str, float], ...] = (
     # pod's throughput ratio vs the single-process twin — higher is
     # better, and a drop means the cross-process path regressed
     ("*scaling_efficiency*", "higher", 0.10),
+    # disaggregated-serving featurization overlap (the featurize_overlap
+    # chip-free leg): (featurize busy + execute busy) / wall — > 1 means
+    # CPU feature prep genuinely overlapped accelerator dispatch; a drop
+    # means the tier re-serialized
+    ("*overlap_ratio*", "higher", 0.10),
     ("*steps_per_sec*", "higher", 0.10),
     ("*per_sec*", "higher", 0.10),
     ("*mfu*", "higher", 0.10),

@@ -68,6 +68,9 @@ KNOWN_INCIDENT_KINDS = (
     "replica_drain",    # fleet health monitor took a replica out
     "watchdog_fire",    # hung-batch watchdog abandoned a dispatch
     "slo_page",         # an SLO objective started firing
+    "scale_up",         # autoscaler grew the replica pool
+    "scale_down",       # autoscaler retired a replica
+    "featurize_worker_death",  # a featurize worker thread died (respawned)
 )
 
 

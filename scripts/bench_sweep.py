@@ -312,6 +312,74 @@ print(json.dumps(out))
 """
 
 
+# Chip-free featurization-overlap leg (ISSUE 11): drives a REAL tiny
+# fleet (1 replica, precompiled) with a 2-worker featurize tier in front
+# of the admission queue, with per-job featurize cost made non-trivial by
+# a deterministic slow_featurize plan (a stand-in for real MSA assembly —
+# the tier's value is structural, not CPU-speed-dependent). Records
+#   featurize_overlap_ratio = (featurize busy + execute busy) / wall
+# > 1 means CPU feature prep genuinely ran WHILE the engine dispatched
+# (the ParaFold split working); a regression that re-serializes the tier
+# drags the ratio to <= 1. Gated by telemetry.check's *overlap_ratio*
+# higher-is-better rule once recorded.
+FEATURIZE_WORKER = r"""
+import json, sys, time
+spec = json.loads(sys.argv[1])
+import jax
+import numpy as np
+
+from alphafold2_tpu.constants import AA_ORDER
+from alphafold2_tpu.models import Alphafold2Config, alphafold2_init
+from alphafold2_tpu.reliability import Fault, FaultPlan
+from alphafold2_tpu.serving import FleetConfig, ServingConfig, ServingFleet
+from alphafold2_tpu.telemetry import Tracer
+
+n = spec.get("n", 24)
+delay = spec.get("featurize_delay_s", 0.08)
+cfg = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8, max_seq_len=32)
+params = alphafold2_init(jax.random.PRNGKey(0), cfg)
+plan = FaultPlan(faults=(
+    Fault("slow_featurize", at=0, count=n, delay_s=delay),
+))
+tracer = Tracer(enabled=True)
+fleet = ServingFleet(
+    params, cfg,
+    ServingConfig(buckets=(16, 32), max_batch=4, max_queue=64,
+                  max_wait_s=0.01, mds_iters=4, cache_capacity=0,
+                  precompile=True),
+    FleetConfig(replicas=1, queue_capacity=64, featurize_workers=2,
+                probe_interval_s=0, default_timeout_s=300.0),
+    injector=plan.injector(), tracer=tracer,
+)
+rng = np.random.RandomState(0)
+seqs = ["".join(AA_ORDER[rng.randint(0, 20)] for _ in range(
+    int(rng.randint(8, 32)))) for _ in range(n)]
+t0 = time.perf_counter()
+reqs = [fleet.submit(s) for s in seqs]
+for r in reqs:
+    r.result(timeout=300)
+wall = time.perf_counter() - t0
+fams = fleet.registry.collect()
+feat_busy = sum(
+    m.value
+    for m in fams.get("featurize_busy_seconds_total", (None, {}))[1].values()
+)
+summary = tracer.summary()
+exec_busy = summary.get("serving.execute", {}).get("total_s", 0.0)
+fleet.shutdown(drain=True)
+assert feat_busy > 0 and exec_busy > 0, (feat_busy, exec_busy)
+ratio = (feat_busy + exec_busy) / wall
+print(json.dumps({
+    "featurize_overlap_ratio": round(ratio, 3),
+    "featurize_busy_s": round(feat_busy, 3),
+    "execute_busy_s": round(exec_busy, 3),
+    "wall_s": round(wall, 3),
+    "n_requests": n,
+    "platform": jax.devices()[0].platform,
+}))
+"""
+
+
 # Communication-compute overlap A/B (the multi-chip distribution story,
 # ISSUE 5): times the double-buffered vs synchronous schedules of the two
 # overlapped paths — ring attention and the backward-overlapped DP-accum
@@ -669,8 +737,12 @@ def main():
     # host); the quant_int8 on/off A/B times the serving-shaped forward
     # on TPU only (structured skip elsewhere — never marked done, so the
     # next healthy chip measures it automatically).
+    # featurize_overlap (ISSUE 11) is chip-free like quant_parity: the
+    # disaggregated-serving overlap ratio records on any host.
     for name, spec, worker, timeout in (
         ("quant_parity", {"depth": args.depth}, QUANT_PARITY_WORKER, 900),
+        ("featurize_overlap", {"n": 24, "featurize_delay_s": 0.08},
+         FEATURIZE_WORKER, 900),
         ("quant_int8_on",
          {"depth": args.depth, "weight_dtype": "int8", "require_tpu": True},
          QUANT_WORKER, 2100),

@@ -175,6 +175,33 @@ def main():
                     help="down-replica reinstatement probe cadence, seconds")
     ap.add_argument("--fail-threshold", type=int, default=2,
                     help="consecutive replica failures that drain it")
+    # disaggregated serving (serving/featurize.py + serving/autoscale.py;
+    # docs/SERVING.md "The featurization tier")
+    ap.add_argument("--featurize-workers", type=int, default=0,
+                    help="CPU featurization worker threads in front of "
+                         "the admission queue (0 = featurize inline); "
+                         ">0 selects the fleet tier even with one "
+                         "replica")
+    ap.add_argument("--featurize-queue", type=int, default=128,
+                    help="featurize-tier bounded queue capacity")
+    ap.add_argument("--min-replicas", type=int, default=None,
+                    help="autoscaler floor (requires --max-replicas)")
+    ap.add_argument("--max-replicas", type=int, default=None,
+                    help="autoscaler ceiling; setting it ARMS the "
+                         "elastic replica autoscaler (fleet tier), "
+                         "which grows/shrinks the pool live from "
+                         "queue-wait p95 / occupancy / SLO burn")
+    ap.add_argument("--scale-policy", default=None, metavar="POLICY_JSON",
+                    help="autoscaler thresholds/hysteresis "
+                         "(serving.ScalePolicy JSON; unknown keys "
+                         "reject loudly); default: stock policy with "
+                         "--min/--max-replicas bounds")
+    ap.add_argument("--scale-grace", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="with the autoscaler armed: keep the process "
+                         "alive (idle, still ticking) up to this long "
+                         "after the replay drains, so idle scale-down "
+                         "is observable before shutdown")
     ap.add_argument("--fault-plan", default=None, metavar="PLAN_JSON",
                     help="chaos schedule (reliability.FaultPlan JSON): "
                          "replica-scoped kill/slow/flap faults in fleet "
@@ -249,6 +276,16 @@ def main():
                  "to publish without the ops server)")
     if args.ops_tick <= 0:
         ap.error("--ops-tick must be positive")
+    if args.min_replicas is not None and args.max_replicas is None:
+        ap.error("--min-replicas requires --max-replicas (the pair arms "
+                 "the autoscaler)")
+    if args.scale_policy and args.max_replicas is None:
+        ap.error("--scale-policy requires --max-replicas (nothing "
+                 "evaluates a policy without the autoscaler armed)")
+    if args.scale_grace and args.max_replicas is None:
+        ap.error("--scale-grace requires --max-replicas")
+    if args.featurize_workers < 0:
+        ap.error("--featurize-workers must be >= 0")
 
     # single-client tunnel discipline AFTER argparse (--help must not
     # block on the lock) — same stance as predict.py
@@ -350,7 +387,16 @@ def main():
         print(f"fault plan: {len(injector.plan.faults)} fault(s) from "
               f"{args.fault_plan}")
 
-    fleet_mode = args.replicas > 1
+    autoscale_armed = args.max_replicas is not None
+    min_replicas = args.min_replicas if args.min_replicas is not None else 1
+    fleet_mode = (args.replicas > 1 or autoscale_armed
+                  or args.featurize_workers > 0)
+    initial_replicas = args.replicas
+    if autoscale_armed:
+        if args.max_replicas < min_replicas:
+            ap.error("--max-replicas must be >= --min-replicas")
+        initial_replicas = min(max(args.replicas, min_replicas),
+                               args.max_replicas)
     serving_cfg = ServingConfig(
         buckets=buckets,
         max_batch=args.max_batch,
@@ -390,7 +436,7 @@ def main():
         engine = ServingFleet(
             params, cfg, serving_cfg,
             FleetConfig(
-                replicas=args.replicas,
+                replicas=initial_replicas,
                 queue_capacity=args.fleet_queue,
                 default_timeout_s=args.request_timeout,
                 requeue_limit=args.requeue_limit,
@@ -400,6 +446,8 @@ def main():
                 probe_interval_s=args.probe_interval,
                 reprobe_interval_s=args.reprobe_interval,
                 fail_threshold=args.fail_threshold,
+                featurize_workers=args.featurize_workers,
+                featurize_queue=args.featurize_queue,
             ),
             injector=injector,
             tracer=tracer,
@@ -410,9 +458,11 @@ def main():
             + ([f"weights={args.degraded_weight_dtype}"]
                if args.degraded_weight_dtype == "int8" else [])
         )
-        print(f"fleet: {args.replicas} replica(s), shared queue "
-              f"{args.fleet_queue}, degraded tier "
-              + (degraded_desc or "OFF"))
+        print(f"fleet: {initial_replicas} replica(s), shared queue "
+              f"{args.fleet_queue}, featurize tier "
+              + (f"{args.featurize_workers} worker(s)"
+                 if args.featurize_workers else "OFF")
+              + ", degraded tier " + (degraded_desc or "OFF"))
     else:
         engine = ServingEngine(
             params, cfg, serving_cfg,
@@ -426,6 +476,31 @@ def main():
     registry = engine.registry if fleet_mode else engine.metrics.registry
     if recorder is not None:
         recorder.bind(registry=registry, stats_fn=engine.stats)
+
+    # --- elastic replica autoscaler (serving/autoscale.py) --------------
+    scaler = scale_policy = None
+    if autoscale_armed:
+        from alphafold2_tpu.serving import ReplicaAutoscaler, ScalePolicy
+
+        scale_policy = (ScalePolicy.from_file(args.scale_policy)
+                        if args.scale_policy else ScalePolicy())
+        # the CLI bounds armed the scaler; they win over file defaults
+        scale_policy = _dc.replace(scale_policy,
+                                   min_replicas=min_replicas,
+                                   max_replicas=args.max_replicas)
+        scaler = ReplicaAutoscaler(
+            engine, scale_policy,
+            incident_hook=recorder.incident if recorder else None,
+            fault_hook=injector.autoscale_hook() if injector else None,
+        )
+        print(f"autoscaler: replicas in "
+              f"[{scale_policy.min_replicas}, "
+              f"{scale_policy.max_replicas}], "
+              f"up @ p95>={scale_policy.up_queue_wait_p95_s}s | "
+              f"burn>={scale_policy.up_burn} | "
+              f"occ>={scale_policy.up_occupancy}, "
+              f"cooldowns {scale_policy.up_cooldown_s}/"
+              f"{scale_policy.down_cooldown_s}s")
     ops = slo = None
     if args.ops_port is not None:
         from alphafold2_tpu.telemetry import (
@@ -448,6 +523,11 @@ def main():
         ops = make_ops(engine, tracer=tracer, slo=slo, recorder=recorder,
                        port=args.ops_port, tick_interval_s=args.ops_tick)
         ops.add_tick(lambda: host_memory_gauges(registry))
+        if fleet_mode:
+            # live queue/occupancy gauges (+ featurize depth): scrapes
+            # see pressure between requests, and the autoscaler's
+            # signals stay fresh
+            ops.add_tick(engine.sample_gauges)
         ops.start()
         print(f"ops plane listening on {ops.url} "
               f"(/metrics /healthz /statusz)")
@@ -456,6 +536,13 @@ def main():
             with open(tmp, "w") as fh:
                 fh.write(str(ops.port))
             os.replace(tmp, args.ops_port_file)  # readers never see ""
+    if scaler is not None:
+        # the autoscaler always gets its OWN control thread (same
+        # cadence as the ops ticker): a scale-up's engine build can
+        # compile for seconds, and riding the shared OpsTicker would
+        # stall SLO evaluation / flight-recorder polling / gauge
+        # sampling during exactly the overload it is reacting to
+        scaler.start(args.ops_tick)
 
     stats_stop = threading.Event()
     stats_thread = None
@@ -565,6 +652,15 @@ def main():
                 bfactors=100.0 * np.asarray(res.confidence),
             )
 
+    if scaler is not None and args.scale_grace > 0:
+        # idle grace: the replay has drained — keep ticking so the
+        # autoscaler can observe the idle pool and scale back down
+        # before shutdown (the demo's scale-down leg)
+        grace_deadline = time.time() + args.scale_grace
+        while time.time() < grace_deadline:
+            if engine.replica_count() <= scale_policy.min_replicas:
+                break
+            time.sleep(0.1)
     if slo is not None:
         # one last evaluation BEFORE shutdown: a short replay whose
         # burn crossed the threshold in its final window still records
@@ -599,6 +695,23 @@ def main():
         states = {name: rep["state"]
                   for name, rep in stats["replicas"].items()}
         print(f"replicas: {states}")
+        if args.featurize_workers:
+            feat = stats.get("featurize", {})
+            freqs = feat.get("requests", {})
+            print(f"featurize tier: {freqs.get('completed', 0)} job(s) "
+                  f"({freqs.get('failed', 0)} failed, "
+                  f"{freqs.get('requeued', 0)} requeued), "
+                  f"{feat.get('worker_deaths', 0)} worker death(s), "
+                  f"busy {feat.get('busy_seconds', 0.0):.2f}s")
+        if scaler is not None:
+            ev = scaler.scale_events()
+            ups = sum(1 for e in ev if e["action"] == "up")
+            downs = sum(1 for e in ev if e["action"] == "down")
+            dec = scaler.snapshot()["decisions"]
+            print(f"autoscaler: {ups} scale-up(s), {downs} "
+                  f"scale-down(s), {dec.get('suppressed', 0)} "
+                  f"suppressed, {dec.get('rejected', 0)} rejected; "
+                  f"replicas now {engine.replica_count()}")
         if stats["errors"]:
             print(f"errors by code: {stats['errors']}")
         if injector is not None:

@@ -1,0 +1,812 @@
+"""Disaggregated-serving tests (tier-1, CPU): the featurization tier and
+the elastic replica autoscaler (ISSUE 11).
+
+Featurize-tier tests drive the real `FeaturizePool` (real threads, stub
+or real engines); autoscaler policy tests drive `ReplicaAutoscaler`
+against an injected clock and a stub fleet — no sleeps, the whole
+scale-up/scale-down/hysteresis matrix is deterministic. Fleet
+elasticity tests (add/remove through the HealthMonitor drain path,
+rolling update, the kill-vs-scale-down race) use the chaos suite's
+stubbed-engine fleet so they run in milliseconds with zero XLA
+compiles.
+"""
+
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from alphafold2_tpu.constants import AA_ORDER
+from alphafold2_tpu.models import Alphafold2Config, alphafold2_init
+from alphafold2_tpu.reliability import (
+    Fault,
+    FaultPlan,
+    HealthMonitor,
+    WorkerKilled,
+)
+from alphafold2_tpu.serving import (
+    BucketLadder,
+    FeatureBundle,
+    FeaturizeConfig,
+    FeaturizeError,
+    FeaturizePool,
+    FleetConfig,
+    InvalidSequenceError,
+    QueueFullError,
+    ReplicaAutoscaler,
+    ScalePolicy,
+    ScaleRejectedError,
+    ServingConfig,
+    ServingEngine,
+    ServingError,
+    ServingFleet,
+    featurize_request,
+)
+from alphafold2_tpu.telemetry import MetricRegistry
+
+TINY = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8, max_seq_len=16)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return alphafold2_init(jax.random.PRNGKey(0), TINY)
+
+
+def seq_of(length, offset=0):
+    return "".join(
+        AA_ORDER[(offset + i) % len(AA_ORDER)] for i in range(length)
+    )
+
+
+class FakeEngine(ServingEngine):
+    """Model call stubbed at the documented seam (test_serving stance)."""
+
+    def _call_executable(self, bucket, tokens, mask, msa=None, msa_mask=None):
+        B, Lb = tokens.shape
+        return {
+            "coords": np.zeros((B, Lb, 3), np.float32),
+            "confidence": np.full((B, Lb), 0.5, np.float32),
+            "stress": np.zeros((B,), np.float32),
+        }
+
+
+def fleet_scfg(**overrides):
+    base = dict(buckets=(8, 16), max_batch=2, max_queue=16, max_wait_s=0.0,
+                request_timeout_s=30.0, cache_capacity=0)
+    base.update(overrides)
+    return ServingConfig(**base)
+
+
+def fake_fleet(injector=None, scfg=None, **overrides):
+    base = dict(replicas=2, probe_interval_s=0, reprobe_interval_s=0.05,
+                fail_threshold=1, requeue_limit=2)
+    base.update(overrides)
+    return ServingFleet(
+        {}, TINY, scfg or fleet_scfg(), FleetConfig(**base),
+        engine_factory=lambda n, c, h: FakeEngine({}, TINY, c, fault_hook=h),
+        injector=injector,
+    )
+
+
+def plan(*faults):
+    return FaultPlan(faults=tuple(faults))
+
+
+# ------------------------------------------------------- featurize tier
+
+
+def test_featurize_request_is_deterministic_and_strict():
+    ladder = BucketLadder((8, 16))
+    a = featurize_request(" acdefghik ", ladder=ladder)
+    b = featurize_request("ACDEFGHIK", ladder=ladder)
+    assert a.seq == b.seq == "ACDEFGHIK"
+    assert a.bucket == b.bucket == 16
+    np.testing.assert_array_equal(a.tokens, b.tokens)
+    with pytest.raises(InvalidSequenceError):
+        featurize_request("ACXZ1", ladder=ladder)
+    with pytest.raises(ServingError):
+        featurize_request("ACDEF", msa_mask=np.ones((1, 5), bool),
+                          ladder=ladder)
+    with pytest.raises(ServingError, match="sequence-only"):
+        featurize_request("ACDEF", msa=np.zeros((1, 5), np.int32),
+                          ladder=ladder, msa_rows=0)
+
+
+def test_pre_featurized_submit_matches_inline_engine(tiny_params):
+    """The bit-exactness pin: a bundle computed OUT of the engine (the
+    tier's whole mechanism) serves the identical structure the inline
+    path serves — featurization moves across threads, never changes."""
+    scfg = fleet_scfg(buckets=(8,), max_batch=1, mds_iters=2,
+                      cache_capacity=0)
+    seq = seq_of(5)
+    eng = ServingEngine(tiny_params, TINY, scfg)
+    try:
+        want = eng.predict(seq)
+        bundle = featurize_request(seq, ladder=BucketLadder(scfg.buckets))
+        got = eng.submit(seq, features=bundle).result(timeout=60)
+        np.testing.assert_array_equal(want.coords, got.coords)
+        np.testing.assert_array_equal(want.confidence, got.confidence)
+        assert want.stress == got.stress
+    finally:
+        eng.shutdown(timeout=10)
+
+
+def test_featurize_pool_round_trip_and_stats():
+    pool = FeaturizePool(FeaturizeConfig(workers=2), BucketLadder((8, 16)))
+    try:
+        done = threading.Event()
+        out = {}
+        pool.submit("acdef", on_done=lambda b, e: (
+            out.update(bundle=b, exc=e), done.set()))
+        assert done.wait(10)
+        assert out["exc"] is None
+        assert isinstance(out["bundle"], FeatureBundle)
+        assert out["bundle"].seq == "ACDEF" and out["bundle"].bucket == 8
+        st = pool.stats()
+        assert st["requests"]["submitted"] == 1
+        assert st["requests"]["completed"] == 1
+        assert st["busy_seconds"] > 0
+    finally:
+        pool.shutdown()
+
+
+def test_featurize_pool_semantic_error_keeps_sharp_code():
+    pool = FeaturizePool(FeaturizeConfig(workers=1), BucketLadder((8,)))
+    try:
+        done = threading.Event()
+        out = {}
+        pool.submit("ACXZ1", on_done=lambda b, e: (
+            out.update(exc=e), done.set()))
+        assert done.wait(10)
+        assert isinstance(out["exc"], InvalidSequenceError)
+        assert pool.stats()["requests"]["failed"] == 1
+    finally:
+        pool.shutdown()
+
+
+def test_featurize_pool_backpressure_is_synchronous():
+    """A full featurize queue sheds at submit with retry advice — the
+    first backpressure point of the disaggregated front door."""
+    pool = FeaturizePool(
+        FeaturizeConfig(workers=1, queue_capacity=1), BucketLadder((8,)),
+        fault_hook=lambda i: time.sleep(0.3),  # wedge the lone worker
+    )
+    try:
+        for _ in range(3):
+            try:
+                pool.submit("ACDEF", on_done=lambda b, e: None)
+            except QueueFullError as exc:
+                assert exc.retry_after_s is not None
+                break
+        else:
+            pytest.fail("featurize queue never filled")
+    finally:
+        pool.shutdown(drain=False)
+
+
+def test_kill_featurize_worker_respawns_and_requeues_job():
+    """A worker death is a TIER event, not a request failure: the job
+    requeues onto the respawned worker and completes; deaths are
+    counted and reported through the incident hook."""
+    incidents = []
+    inj = plan(Fault("kill_featurize_worker", at=0)).injector()
+    pool = FeaturizePool(
+        FeaturizeConfig(workers=1, retry_limit=1), BucketLadder((8,)),
+        fault_hook=inj.featurize_hook(),
+        incident_hook=lambda kind, **a: incidents.append(kind),
+    )
+    try:
+        done = threading.Event()
+        out = {}
+        pool.submit("ACDEF", on_done=lambda b, e: (
+            out.update(bundle=b, exc=e), done.set()))
+        assert done.wait(10)
+        assert out["exc"] is None and out["bundle"].seq == "ACDEF"
+        st = pool.stats()
+        assert st["worker_deaths"] == 1
+        assert st["requests"]["requeued"] == 1
+        assert st["requests"]["completed"] == 1
+        assert st["workers"] == 1  # respawned to configured size
+        assert incidents == ["featurize_worker_death"]
+        assert inj.exhausted()
+    finally:
+        pool.shutdown()
+
+
+def test_repeated_worker_deaths_exhaust_retry_budget():
+    inj = plan(Fault("kill_featurize_worker", at=0, count=5)).injector()
+    pool = FeaturizePool(
+        FeaturizeConfig(workers=1, retry_limit=1), BucketLadder((8,)),
+        fault_hook=inj.featurize_hook(),
+    )
+    try:
+        done = threading.Event()
+        out = {}
+        pool.submit("ACDEF", on_done=lambda b, e: (
+            out.update(exc=e), done.set()))
+        assert done.wait(10)
+        assert isinstance(out["exc"], FeaturizeError)
+        assert isinstance(out["exc"].__cause__, WorkerKilled)
+    finally:
+        pool.shutdown(drain=False)
+
+
+def test_fleet_featurize_tier_serves_and_resolves_async_errors():
+    """With the tier in front of admission, raw submissions featurize on
+    pool workers; validation failures resolve the FUTURE (the submit
+    thread never blocks on feature prep) and land in the error counts."""
+    fleet = fake_fleet(featurize_workers=2)
+    try:
+        reqs = [fleet.submit(seq_of(4 + i % 3, offset=i)) for i in range(6)]
+        bad = fleet.submit("ACXZ1")
+        for r in reqs:
+            assert r.result(timeout=30).coords is not None
+        with pytest.raises(InvalidSequenceError):
+            bad.result(timeout=30)
+        st = fleet.stats()
+        assert st["requests"]["completed"] == 6
+        assert st["requests"]["failed"] == 1
+        assert st["requests"]["in_flight"] == 0
+        assert st["errors"]["invalid_sequence"] == 1
+        assert st["featurize"]["requests"]["completed"] == 6
+        assert st["featurize"]["requests"]["failed"] == 1
+    finally:
+        fleet.shutdown(timeout=30)
+
+
+def test_shutdown_drain_serves_featurize_queued_requests():
+    """The drain promise crosses tiers: requests still in the featurize
+    queue when shutdown(drain=True) starts are featurized, admitted,
+    and SERVED by the still-draining dispatcher — not failed by the
+    closed-flag TOCTOU check."""
+    inj = plan(Fault("slow_featurize", at=0, count=4,
+                     delay_s=0.1)).injector()
+    fleet = fake_fleet(inj, featurize_workers=1)
+    try:
+        reqs = [fleet.submit(seq_of(5, offset=i)) for i in range(4)]
+        fleet.shutdown(drain=True, timeout=30)
+        for r in reqs:
+            assert r.result(timeout=30).coords is not None
+        st = fleet.stats()
+        assert st["requests"]["completed"] == 4
+        assert st["requests"]["failed"] == 0
+    finally:
+        fleet.shutdown(timeout=30)
+
+
+def test_malformed_client_bundle_rejected_synchronously():
+    """A client-built FeatureBundle is untrusted: a mask without an
+    alignment (or mis-shaped against it) must reject at submit — never
+    reach batch assembly as a replica-attributed PredictionError."""
+    scfg = fleet_scfg(buckets=(8,), max_batch=1, msa_rows=2)
+    eng = FakeEngine({}, TINY, scfg)
+    try:
+        ok = featurize_request(seq_of(5), ladder=BucketLadder((8,)))
+        bad_mask = FeatureBundle(seq=ok.seq, tokens=ok.tokens, msa=None,
+                                 msa_mask=np.ones((1, 5), bool), bucket=8)
+        with pytest.raises(ServingError, match="without msa"):
+            eng.submit(ok.seq, features=bad_mask)
+        bad_shape = FeatureBundle(
+            seq=ok.seq, tokens=ok.tokens,
+            msa=np.zeros((1, 5), np.int32),
+            msa_mask=np.ones((2, 5), bool), bucket=8)
+        with pytest.raises(ServingError, match="does not match"):
+            eng.submit(ok.seq, features=bad_shape)
+        too_many_rows = FeatureBundle(
+            seq=ok.seq, tokens=ok.tokens,
+            msa=np.zeros((3, 5), np.int32), msa_mask=None, bucket=8)
+        with pytest.raises(ServingError, match="msa_rows"):
+            eng.submit(ok.seq, features=too_many_rows)
+    finally:
+        eng.shutdown(timeout=10)
+
+
+def test_slow_featurize_delays_but_serves():
+    inj = plan(Fault("slow_featurize", at=0, count=2,
+                     delay_s=0.05)).injector()
+    fleet = fake_fleet(inj, featurize_workers=1)
+    try:
+        res = [fleet.submit(seq_of(5, offset=i)).result(timeout=30)
+               for i in range(3)]
+        assert all(r.coords is not None for r in res)
+        assert fleet.stats()["requests"]["failed"] == 0
+        assert inj.exhausted()
+    finally:
+        fleet.shutdown(timeout=30)
+
+
+# ------------------------------------------------- autoscaler unit matrix
+
+
+class StubFleet:
+    """Minimal scaling target: counts replicas, records actions, and can
+    be told to refuse (the drain-refused path)."""
+
+    _closed = False
+
+    def __init__(self, registry, n=1, refuse_down=None):
+        self.registry = registry
+        self.n = n
+        self.actions = []
+        self.refuse_down = refuse_down
+        self.counted_errors = []
+
+    def sample_gauges(self):
+        pass
+
+    def replica_count(self):
+        return self.n
+
+    def add_replica(self):
+        self.n += 1
+        self.actions.append("up")
+        return f"r{self.n - 1}"
+
+    def remove_replica(self, name=None):
+        if self.refuse_down is not None:
+            raise ScaleRejectedError(self.refuse_down)
+        self.n -= 1
+        self.actions.append("down")
+        return f"r{self.n}"
+
+    def _count_error(self, exc):
+        self.counted_errors.append(exc.code)
+
+
+def mk_scaler(registry=None, fleet=None, fault_hook=None, incidents=None,
+              **policy):
+    registry = registry if registry is not None else MetricRegistry()
+    fleet = fleet if fleet is not None else StubFleet(registry)
+    base = dict(min_replicas=1, max_replicas=3, up_sustain=2,
+                down_sustain=2, up_cooldown_s=1.0, down_cooldown_s=5.0)
+    base.update(policy)
+    t = [0.0]
+    scaler = ReplicaAutoscaler(
+        fleet, ScalePolicy(**base), registry=registry,
+        clock=lambda: t[0], fault_hook=fault_hook,
+        incident_hook=(lambda kind, **a: incidents.append(kind))
+        if incidents is not None else None,
+    )
+    return scaler, fleet, registry, t
+
+
+def test_scale_policy_validation_and_file_round_trip(tmp_path):
+    with pytest.raises(ValueError, match="unknown scale-policy key"):
+        ScalePolicy.from_dict({"max_replicaz": 3})
+    with pytest.raises(ValueError):
+        ScalePolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        ScalePolicy(up_occupancy=0.2, down_occupancy=0.5)
+    p = tmp_path / "policy.json"
+    p.write_text(json.dumps({"min_replicas": 2, "max_replicas": 5,
+                             "down_cooldown_s": 7.5}))
+    pol = ScalePolicy.from_file(str(p))
+    assert pol.min_replicas == 2 and pol.max_replicas == 5
+    assert pol.down_cooldown_s == 7.5
+
+
+def test_scale_up_on_sustained_queue_wait_burn():
+    scaler, fleet, registry, t = mk_scaler()
+    hist = registry.histogram("fleet_queue_wait_seconds")
+    for _ in range(8):
+        hist.observe(5.0)  # p95 far past the 2.0s threshold
+    registry.gauge("fleet_queue_depth").set(3)
+    scaler.tick()                      # sustain 1/2: no action
+    assert fleet.n == 1
+    t[0] += 1.0
+    scaler.tick()                      # sustain 2/2: up
+    assert fleet.n == 2
+    assert [e["action"] for e in scaler.scale_events()] == ["up"]
+
+
+def test_scale_up_on_slo_burn_and_occupancy():
+    # burn trigger (with a live queue)
+    scaler, fleet, registry, t = mk_scaler(up_sustain=1)
+    registry.gauge("fleet_queue_depth").set(1)
+    registry.gauge("slo_burn_rate", objective="queue_wait_p95",
+                   window="fast").set(3.0)
+    scaler.tick()
+    assert fleet.n == 2
+    # occupancy trigger needs no queue at all (work is IN the engines)
+    scaler2, fleet2, registry2, _ = mk_scaler(up_sustain=1)
+    registry2.gauge("fleet_occupancy").set(0.95)
+    scaler2.tick()
+    assert fleet2.n == 2
+
+
+def test_burn_without_live_queue_does_not_scale_up():
+    """A stale fast-burn gauge with an empty queue (burst long drained)
+    must not grow the pool."""
+    scaler, fleet, registry, t = mk_scaler(up_sustain=1)
+    registry.gauge("slo_burn_rate", objective="x", window="fast").set(9.0)
+    registry.gauge("fleet_queue_depth").set(0)
+    scaler.tick()
+    assert fleet.n == 1
+
+
+def test_scale_down_on_idle_respects_hysteresis_window():
+    scaler, fleet, registry, t = mk_scaler(up_sustain=1, down_sustain=2)
+    registry.gauge("fleet_occupancy").set(0.95)
+    scaler.tick()                      # up at t=0
+    assert fleet.n == 2
+    registry.gauge("fleet_occupancy").set(0.0)
+    registry.gauge("fleet_queue_depth").set(0)
+    for _ in range(4):                 # idle, but inside the 5s window
+        t[0] += 0.5
+        scaler.tick()
+    assert fleet.n == 2                # suppressed, not acted
+    snap = scaler.snapshot()
+    assert snap["decisions"]["suppressed"] >= 1
+    t[0] = 10.0                        # past down_cooldown_s
+    scaler.tick()
+    scaler.tick()
+    assert fleet.n == 1
+    events = [e["action"] for e in scaler.scale_events()]
+    assert events == ["up", "down"]
+
+
+def test_scale_flap_fault_is_absorbed_by_hysteresis():
+    """The chaos pin: forced alternating demands (scale_flap) bypass
+    sustain but NOT the cooldown window — actions can never be spaced
+    closer than the hysteresis allows."""
+    inj = plan(Fault("scale_flap", at=0, count=6)).injector()
+    scaler, fleet, registry, t = mk_scaler(
+        fault_hook=inj.autoscale_hook(),
+        up_cooldown_s=2.0, down_cooldown_s=2.0, max_replicas=5)
+    action_times = []
+    for i in range(6):
+        before = fleet.n
+        scaler.tick()
+        if fleet.n != before:
+            action_times.append(t[0])
+        t[0] += 0.5
+    assert inj.exhausted()
+    assert len(action_times) >= 1
+    gaps = [b - a for a, b in zip(action_times, action_times[1:])]
+    assert all(g >= 2.0 for g in gaps), gaps  # never faster than window
+    assert scaler.snapshot()["decisions"]["suppressed"] >= 1
+
+
+def test_bounds_suppress_at_min_and_max():
+    scaler, fleet, registry, t = mk_scaler(
+        up_sustain=1, down_sustain=1, max_replicas=1, min_replicas=1,
+        up_cooldown_s=0.0, down_cooldown_s=0.0)
+    registry.gauge("fleet_occupancy").set(0.95)
+    scaler.tick()                      # at max: suppressed
+    assert fleet.n == 1
+    registry.gauge("fleet_occupancy").set(0.0)
+    t[0] += 1.0
+    scaler.tick()                      # at min: suppressed
+    assert fleet.n == 1
+    assert scaler.snapshot()["decisions"]["suppressed"] == 2
+    reasons = [e["reason"] for e in scaler.events()]
+    assert "at_max" in reasons and "at_min" in reasons
+
+
+def test_rejected_scale_down_is_counted_not_raised():
+    scaler, fleet, registry, t = mk_scaler(
+        up_sustain=1, down_sustain=1, down_cooldown_s=0.0,
+        fleet=StubFleet(MetricRegistry(), n=2,
+                        refuse_down="r1 is down — refusing"))
+    # rewire registry onto the fleet's (mk_scaler made a fresh one)
+    registry = scaler.registry
+    registry.gauge("fleet_queue_depth").set(0)
+    registry.gauge("fleet_occupancy").set(0.0)
+    scaler.tick()  # wants down, fleet refuses
+    assert fleet.n == 2
+    assert scaler.snapshot()["decisions"]["rejected"] == 1
+    assert fleet.counted_errors == ["scale_rejected"]
+    assert scaler.snapshot()["decisions"]["down"] == 0
+
+
+def test_scale_incident_hook_fires_on_actions():
+    incidents = []
+    scaler, fleet, registry, t = mk_scaler(up_sustain=1,
+                                           incidents=incidents)
+    registry.gauge("fleet_occupancy").set(0.95)
+    scaler.tick()
+    assert incidents == ["scale_up"]
+
+
+# --------------------------------------------- fleet elasticity (real)
+
+
+def test_fleet_add_and_remove_replica_through_drain_path():
+    fleet = fake_fleet(replicas=1)
+    try:
+        assert fleet.replica_count() == 1
+        name = fleet.add_replica()
+        assert name == "r1" and fleet.replica_count() == 2
+        assert "r1" in fleet._health.snapshot()["targets"]
+        # traffic lands on both
+        reqs = [fleet.submit(seq_of(4 + i % 3, offset=i)) for i in range(8)]
+        for r in reqs:
+            r.result(timeout=30)
+        removed = fleet.remove_replica()
+        assert removed in ("r0", "r1")
+        # the drain runs on the health tick; wait for the slot to leave
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if (fleet.replica_count() == 1
+                    and removed not in fleet._health.snapshot()["targets"]):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("retired replica never left the pool")
+        # survivors keep serving; nothing was lost
+        res = [fleet.submit(seq_of(5, offset=i)).result(timeout=30)
+               for i in range(4)]
+        assert all(r.coords is not None for r in res)
+        st = fleet.stats()
+        assert st["requests"]["failed"] == 0
+        assert st["requests"]["in_flight"] == 0
+    finally:
+        fleet.shutdown(timeout=30)
+
+
+def test_remove_replica_refusals():
+    fleet = fake_fleet(replicas=1)
+    try:
+        with pytest.raises(ScaleRejectedError, match="below one"):
+            fleet.remove_replica()
+        with pytest.raises(ScaleRejectedError, match="no live replica"):
+            fleet.add_replica()
+            fleet.remove_replica("nope")
+    finally:
+        fleet.shutdown(timeout=30)
+
+
+def test_remove_replica_refused_while_pool_unhealthy():
+    """The drain-refused-while-unhealthy pin: autoscale shrink (victim
+    unspecified) is refused while any replica is failure-drained."""
+    inj = plan(Fault("kill_replica", replica="r0", at=0)).injector()
+    fleet = fake_fleet(inj, replicas=2, reprobe_interval_s=30.0)
+    try:
+        # drive traffic until r0 is drained
+        reqs = [fleet.submit(seq_of(4 + i % 3, offset=i)) for i in range(4)]
+        for r in reqs:
+            r.result(timeout=30)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if fleet.stats()["health"]["targets"]["r0"]["state"] == "down":
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("r0 never drained")
+        with pytest.raises(ScaleRejectedError, match="down"):
+            fleet.remove_replica()
+        # explicit-name removal of the DEAD replica is allowed (cleanup)
+        fleet.remove_replica("r0")
+    finally:
+        fleet.shutdown(timeout=30)
+
+
+def test_kill_replica_races_autoscale_down_without_double_drain():
+    """The race the satellite pins: a kill_replica failure-drain and an
+    autoscale retirement of the SAME replica interleave — the engine is
+    torn down once, every request stays terminal, and the slot leaves
+    the pool exactly once."""
+    inj = plan(Fault("kill_replica", replica="r1", at=0)).injector()
+    fleet = fake_fleet(inj, replicas=3, reprobe_interval_s=30.0,
+                       requeue_limit=3)
+    try:
+        reqs = [fleet.submit(seq_of(4 + i % 3, offset=i)) for i in range(9)]
+        # retire r1 by name while its kill-driven failure drain races us
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                fleet.remove_replica("r1")
+                break
+            except ScaleRejectedError:
+                time.sleep(0.01)  # already gone mid-race: also fine
+                if "r1" not in fleet._health.snapshot()["targets"]:
+                    break
+        for r in reqs:
+            assert r.result(timeout=30).coords is not None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            snap = fleet.stats()
+            if ("r1" not in snap["replicas"]
+                    and "r1" not in snap["health"]["targets"]):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("r1 never fully left the pool")
+        st = fleet.stats()
+        assert st["requests"]["failed"] == 0
+        assert st["requests"]["in_flight"] == 0
+        assert fleet.replica_count() == 2
+        # fresh traffic still serves on the survivors
+        assert fleet.submit(seq_of(6)).result(timeout=30).coords is not None
+    finally:
+        fleet.shutdown(timeout=30)
+
+
+def test_rolling_update_is_zero_downtime():
+    """Weight/config deploys ride the drain path one replica at a time:
+    traffic submitted across the update all completes, every replica
+    restarts exactly once, and the new params_tag is live (fresh cache
+    keyspace)."""
+    fleet = fake_fleet(replicas=2, probe_interval_s=0,
+                       reprobe_interval_s=0.02)
+    try:
+        stop = threading.Event()
+        outcomes = []
+
+        def traffic():
+            i = 0
+            while not stop.is_set():
+                try:
+                    outcomes.append(
+                        fleet.submit(seq_of(4 + i % 3, offset=i))
+                        .result(timeout=30))
+                except ServingError as e:  # pragma: no cover — the assert
+                    outcomes.append(e)     # below makes this loud
+                i += 1
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        summary = fleet.rolling_update(params_tag="deploy-v2",
+                                       timeout_s=30.0)
+        stop.set()
+        t.join(30)
+        assert set(summary) == {"r0", "r1"}
+        assert all(restarts >= 1 for restarts in summary.values())
+        assert all(not isinstance(o, ServingError) for o in outcomes)
+        # both replicas are healthy behind fresh engines on the new tag
+        for rep in fleet._replicas.values():
+            assert rep.cfg.params_tag == "deploy-v2"
+            assert rep.engine is not None
+        # a replica the autoscaler adds AFTER the deploy must spawn on
+        # the new tag too (it reads the fleet's serving-cfg template)
+        added = fleet.add_replica()
+        assert fleet._replicas[added].cfg.params_tag == "deploy-v2"
+        assert fleet.stats()["requests"]["failed"] == 0
+    finally:
+        fleet.shutdown(timeout=30)
+
+
+def test_rolling_update_requires_tag_with_params():
+    fleet = fake_fleet(replicas=1)
+    try:
+        with pytest.raises(ValueError, match="params_tag"):
+            fleet.rolling_update(params={"w": np.zeros(2)})
+        with pytest.raises(ValueError, match="nothing to update"):
+            fleet.rolling_update()
+    finally:
+        fleet.shutdown(timeout=30)
+
+
+def test_health_monitor_retire_unregisters_after_drain():
+    t = [0.0]
+    events = []
+    mon = HealthMonitor(probe_interval_s=0, reprobe_interval_s=1.0,
+                        fail_threshold=1, clock=lambda: t[0])
+    mon.register("a", probe=lambda: True,
+                 on_drain=lambda n, why: events.append(("drain", n, why)))
+    mon.retire("a", "scale_down")
+    assert mon.healthy_targets() == []    # out of rotation immediately
+    mon.tick(now=0.0)
+    assert events == [("drain", "a", "scale_down")]
+    assert "a" not in mon.snapshot()["targets"]
+    mon.retire("a")  # idempotent on a gone target
+    # retire on an ALREADY-DOWN target still runs one cleanup drain
+    mon.register("b", on_drain=lambda n, why: events.append(("drain", n)))
+    mon.record_failure("b")
+    mon.tick(now=1.0)                     # failure drain runs
+    mon.retire("b")
+    mon.tick(now=2.0)                     # cleanup drain + unregister
+    assert events.count(("drain", "b")) == 2
+    assert "b" not in mon.snapshot()["targets"]
+
+
+# ------------------------------------------------------- error taxonomy
+
+
+def test_new_error_codes_round_trip():
+    for cls, code in ((FeaturizeError, "featurize_failed"),
+                      (ScaleRejectedError, "scale_rejected")):
+        exc = cls("boom")
+        assert exc.code == code
+        payload = json.loads(json.dumps(exc.to_json()))
+        assert payload == {"code": code, "error": cls.__name__,
+                           "message": "boom"}
+
+
+def test_scale_rejected_lands_in_fleet_error_counts():
+    """A refused shrink (pool unhealthy) is a visible decision outcome:
+    the autoscaler counts it AND the fleet's per-code error counters
+    carry scale_rejected — exactly how a wedged control loop surfaces
+    on dashboards."""
+    inj = plan(Fault("kill_replica", replica="r0", at=0)).injector()
+    fleet = fake_fleet(inj, replicas=2, reprobe_interval_s=30.0)
+    scaler, _, _, t = mk_scaler(
+        fleet=fleet, registry=fleet.registry, up_sustain=1,
+        down_sustain=1, min_replicas=1, down_cooldown_s=0.0)
+    try:
+        for i in range(4):  # drive traffic until r0 drains
+            fleet.submit(seq_of(4 + i % 3, offset=i)).result(timeout=30)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if fleet.stats()["health"]["targets"]["r0"]["state"] == "down":
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("r0 never drained")
+        scaler.tick()  # idle fleet wants down; the unhealthy pool refuses
+        assert scaler.snapshot()["decisions"]["rejected"] == 1
+        assert fleet.stats()["errors"]["scale_rejected"] == 1
+        assert fleet.replica_count() == 2  # nothing was drained twice
+    finally:
+        fleet.shutdown(timeout=30)
+
+
+# ------------------------------------------------- acceptance (subprocess)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_serve_cli_disaggregated_chaos_acceptance(tmp_path):
+    """ISSUE 11 acceptance end to end through the real CLI: a demo
+    replay with the featurize tier + autoscaler under the committed
+    chaos plan completes with >=1 scale-up, >=1 scale-down, >=1
+    featurizer fault injected, 0 lost requests, and a flight-recorder
+    bundle capturing a scale event."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    stats_path = tmp_path / "stats.json"
+    flight_dir = tmp_path / "flight"
+    policy_path = tmp_path / "policy.json"
+    policy_path.write_text(json.dumps({
+        "up_queue_wait_p95_s": 0.5, "up_occupancy": 0.5, "up_burn": 2.0,
+        "up_sustain": 1, "down_sustain": 2,
+        "up_cooldown_s": 0.5, "down_cooldown_s": 2.0,
+    }))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "serve.py"),
+         "--demo", "20", "--buckets", "16,32",
+         "--dim", "16", "--depth", "1", "--heads", "2", "--dim-head", "8",
+         "--mds-iters", "2", "--max-batch", "2",
+         "--min-replicas", "1", "--max-replicas", "3",
+         "--featurize-workers", "2",
+         "--scale-policy", str(policy_path),
+         "--scale-grace", "20", "--ops-tick", "0.2",
+         "--request-timeout", "300", "--reprobe-interval", "0.3",
+         "--fault-plan",
+         os.path.join(repo, "docs", "examples", "disagg_chaos_plan.json"),
+         "--flight-dir", str(flight_dir),
+         "--stats-json", str(stats_path), "--seed", "0"],
+        capture_output=True, text=True, timeout=500,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
+    stats = json.loads(stats_path.read_text())
+    reqs = stats["requests"]
+    # 0 lost: every submission terminal, none failed
+    assert reqs["failed"] == 0 and reqs["in_flight"] == 0
+    assert reqs["completed"] >= 20
+    # >=1 scale-up and >=1 scale-down, never faster than hysteresis
+    dec = stats["autoscale"]["decisions"]
+    assert dec["up"] >= 1, stats["autoscale"]
+    assert dec["down"] >= 1, stats["autoscale"]
+    acted = [e for e in stats["autoscale"]["events"]
+             if e["action"] in ("up", "down")]
+    gaps = [b["ts"] - a["ts"] for a, b in zip(acted, acted[1:])]
+    assert all(g >= 0.5 for g in gaps), gaps
+    # >=1 featurizer fault: the worker death was injected and survived
+    feat = stats["featurize"]
+    assert feat["worker_deaths"] >= 1
+    assert feat["requests"]["requeued"] >= 1
+    assert "slow_featurize@0" in out.stdout  # plan delivery audit
+    # a flight-recorder bundle captured a scale event
+    bundles = [p for p in os.listdir(flight_dir)
+               if p.endswith(".json") and "scale_" in p]
+    assert bundles, os.listdir(flight_dir)
+    bundle = json.loads((flight_dir / bundles[0]).read_text())
+    assert bundle["incident"]["kind"].startswith("scale_")
+    assert "metrics" in bundle

@@ -529,9 +529,11 @@ def test_error_codes_are_stable_and_serializable():
 
     from alphafold2_tpu.serving import (
         CircuitOpenError,
+        FeaturizeError,
         HungBatchError,
         NoHealthyReplicaError,
         RequeueLimitError,
+        ScaleRejectedError,
     )
 
     expected = {
@@ -546,6 +548,8 @@ def test_error_codes_are_stable_and_serializable():
         HungBatchError: "hung_batch",
         NoHealthyReplicaError: "no_healthy_replica",
         RequeueLimitError: "requeue_limit",
+        FeaturizeError: "featurize_failed",
+        ScaleRejectedError: "scale_rejected",
     }
     assert len(set(expected.values())) == len(expected)  # codes distinct
     for cls, code in expected.items():
