@@ -18,7 +18,8 @@ finding carrying the exception head. Registered targets:
                verified-checkpoint save/restore (host-side construction
                checks — same gate, no shapes involved)
   telemetry.*  span tracer + chrome export, metric registry + Prometheus
-               round-trip, regression-gate verdicts (host-side, like
+               round-trip, regression-gate verdicts, goodput ledger +
+               federation + loss-curve gate (host-side, like
                reliability.*)
   presets.*    e2e train-state init for every tier; full e2e loss (fwd +
               structure module) at smoke shapes
@@ -570,6 +571,104 @@ def _targets() -> Dict[str, Callable[[], None]]:
         bad, rows = check({"metric": "smoke_steps_per_sec", "value": 0.5},
                           {"metric": "smoke_steps_per_sec", "value": 1.0})
         assert not bad and rows[0]["status"] == "regressed"
+
+    @register("telemetry.goodput")
+    def _telemetry_goodput():
+        # host-side like the other telemetry targets: ledger exclusive-
+        # time accounting + sums-to-wall invariant, detector firing, and
+        # a gather-injected 2-process federation round-trip
+        from alphafold2_tpu.telemetry import MetricRegistry
+        from alphafold2_tpu.telemetry.goodput import (
+            FederatedRegistryView,
+            GoodputLedger,
+            MetricFederation,
+            StragglerDetector,
+        )
+        from alphafold2_tpu.telemetry.registry import parse_prometheus_text
+
+        clk = [0.0]
+        reg = MetricRegistry()
+        led = GoodputLedger(reg, clock=lambda: clk[0])
+        with led.account("data_fetch"):
+            clk[0] += 1.0
+        with led.account("compile"):
+            clk[0] += 2.0
+            with led.account("assembly"):  # nested: exclusive-time split
+                clk[0] += 0.5
+        led.step_complete(0)
+        clk[0] += 0.5  # uncategorized -> idle
+        snap = led.snapshot()
+        # against the LIVE wall (snapshot's wall_s is the bucket sum, a
+        # tautology); the injected clock is frozen so this is exact
+        assert abs(sum(snap["buckets"].values()) - led.wall()) < 1e-9
+        assert abs(snap["buckets"]["assembly"] - 0.5) < 1e-9
+        assert abs(snap["buckets"]["compile"] - 2.0) < 1e-9
+        assert led.step_bucket() == "step"  # compiled after the first step
+
+        class _Rec:
+            kinds: list = []
+
+            def incident(self, kind, **attrs):
+                self.kinds.append(kind)
+
+        det = StragglerDetector(recorder=_Rec(), registry=reg,
+                                patience=2, min_seconds=0.001)
+        for s in range(2):
+            det.observe_pod(s, [
+                {"process": 0, "step_s": 0.1, "fetch_s": 0.01},
+                {"process": 1, "step_s": 0.5, "fetch_s": 0.01},
+            ])
+        assert "train_straggler" in _Rec.kinds
+
+        store = {}
+
+        def gather_for(i):
+            def gather(b):
+                store[i] = b
+                return [store.get(0, b), store.get(1, b)]
+
+            return gather
+
+        other = MetricRegistry()
+        other.gauge("train_goodput_ratio").set(0.7)
+        f0 = MetricFederation(reg, process_index=0, every=1,
+                              gather_fn=gather_for(0))
+        MetricFederation(other, process_index=1, every=1,
+                         gather_fn=gather_for(1)).tick(0)
+        f0.tick(0)
+        text = FederatedRegistryView(reg, f0).to_prometheus()
+        procs = {dict(k[1]).get("process")
+                 for k in parse_prometheus_text(text)
+                 if k[0] == "train_goodput_ratio"}
+        assert procs == {"0", "1"}, procs
+
+    @register("telemetry.loss_curve_gate")
+    def _telemetry_loss_curve():
+        import os
+        import tempfile
+
+        from alphafold2_tpu.telemetry.check import check, load_loss_curve
+
+        def write(vals):
+            fd, path = tempfile.mkstemp(suffix=".jsonl")
+            with os.fdopen(fd, "w") as fh:
+                for i, v in enumerate(vals):
+                    fh.write(json.dumps({"step": i, "loss": v}) + "\n")
+            return path
+
+        conv = write([3.0 / (1 + 0.2 * i) for i in range(40)])
+        div = write([3.0 / (1 + 0.2 * i) + (0.2 * max(0, i - 20)) ** 1.5
+                     for i in range(40)])
+        try:
+            ok, _ = check(load_loss_curve(conv), load_loss_curve(conv))
+            assert ok
+            bad, rows = check(load_loss_curve(div), load_loss_curve(conv))
+            assert not bad
+            assert any(r["metric"] == "loss_final"
+                       and r["status"] == "regressed" for r in rows)
+        finally:
+            os.unlink(conv)
+            os.unlink(div)
 
     # --- parallel / overlap -------------------------------------------------
     @register("parallel.partition_rules")

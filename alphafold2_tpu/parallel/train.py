@@ -104,6 +104,7 @@ def make_multihost_train_step(
     tp: bool = True,
     donate_state: bool = True,
     state_init: Callable = train_state_init,
+    telemetry=None,
 ):
     """The process-spanning train step: DP(xTPxSP) over ALL processes.
 
@@ -124,7 +125,13 @@ def make_multihost_train_step(
         `compat.make_array_from_process_local_data`);
       * params/optimizer state shard by the partition-rule registry
         (replicated for pure DP; "model"-axis rules under TP), identical
-        on every process.
+        on every process;
+      * `telemetry` (optional telemetry.TrainTelemetry): the returned
+        `assemble` accounts its wall time into the goodput ledger's
+        "assembly" bucket — the host-to-device/global-batch cost is a
+        named badput cause, not invisible step overhead (exclusive-time
+        accounting keeps it correct even when assembly runs inside the
+        step's own account, as the trainer CLIs' step wrappers do).
 
     Every process must call the returned step in lockstep with its own
     local shard (SPMD); metrics come back fully replicated, so
@@ -172,8 +179,15 @@ def make_multihost_train_step(
         state_init=state_init,
     )
 
+    if telemetry is None:
+        from alphafold2_tpu.telemetry.goodput import NULL_TRAIN_TELEMETRY
+
+        telemetry = NULL_TRAIN_TELEMETRY
+
     def assemble(local_batch):
-        return assemble_global_batch(local_batch, mesh, microbatched=True)
+        with telemetry.account("assembly"):
+            return assemble_global_batch(local_batch, mesh,
+                                         microbatched=True)
 
     return step, st_shardings, assemble, mesh
 

@@ -18,7 +18,8 @@ Hook sites (all optional, zero-cost when no injector is wired):
                         back), and `preempt` (SIGTERM-style, via a bound
                         PreemptionHandler).
   training/data.py      `resilient_batches(..., injector=...)` — delivers
-                        `data_error` at fetch index N.
+                        `data_error` (raise) and `slow_data` (stall the
+                        fetch `delay_s`) at fetch index N.
   training/checkpoint.py  `VerifiedCheckpointManager(fault_hook=
                         injector.checkpoint_hook())` — delivers
                         `ckpt_corrupt` (truncate / bit-corrupt /
@@ -65,6 +66,11 @@ FAULT_KINDS = (
     "preempt",          # SIGTERM-style preemption request at step `at`
     "ckpt_corrupt",     # damage the checkpoint written for step `at`
     "data_error",       # raise InjectedFault at batch fetch index `at`
+    "slow_data",        # sleep `delay_s` at batch fetch index `at` — a
+    #                     stalled input pipeline (slow FS / cold cache);
+    #                     the goodput ledger must book it as data-stall
+    #                     badput and the straggler detector must page
+    #                     train_data_stall, never crash the run
     "request_error",    # raise InjectedFault at serving dispatch index `at`
     "slow_request",     # sleep `delay_s` at serving dispatch index `at`
     "hung_request",     # sleep `hang_s` (watchdog fodder) at dispatch `at`
@@ -299,6 +305,11 @@ class FaultInjector:
     # -- hook: data pipeline (training/data.py) ------------------------------
 
     def before_batch(self, index: int):
+        f = self._take("slow_data", index)
+        if f is not None:
+            import time
+
+            time.sleep(f.delay_s)
         f = self._take("data_error", index)
         if f is not None:
             raise InjectedFault(f.describe())
@@ -456,7 +467,8 @@ def _check_main(argv=None) -> int:
             extra.append(f"replica={f.replica}")
         if f.kind == "ckpt_corrupt":
             extra.append(f"mode={f.mode}")
-        if f.kind in ("slow_request", "slow_replica", "slow_featurize"):
+        if f.kind in ("slow_request", "slow_replica", "slow_featurize",
+                      "slow_data"):
             extra.append(f"delay_s={f.delay_s}")
         if f.kind == "hung_request":
             extra.append(f"hang_s={f.hang_s}")

@@ -22,6 +22,10 @@ scaling results):
   * `ops_plane` — the LIVE operations plane: stdlib HTTP server
                   (`/metrics`, `/healthz`, `/statusz`) + the incident
                   flight recorder (`serve.py --ops-port/--flight-dir`).
+  * `goodput`   — the TRAINING observability plane: wall-clock goodput/
+                  badput ledger, pod-wide metric federation with a
+                  `process` label, straggler/data-stall detection, and
+                  the trainer ops-plane wiring (`train_*.py --ops-port`).
 
 Everything is disabled-by-default at the call sites: an engine or
 trainer built without a tracer/registry runs the shared no-op singletons
@@ -31,7 +35,23 @@ docs/OBSERVABILITY.md is the operator guide (span taxonomy, metric
 names, how to open traces, how the gate reads baselines).
 """
 
-from alphafold2_tpu.telemetry.logger import MetricsLogger
+from alphafold2_tpu.telemetry.goodput import (
+    BUCKETS,
+    NULL_TRAIN_TELEMETRY,
+    FederatedRegistryView,
+    GoodputLedger,
+    MetricFederation,
+    StragglerDetector,
+    TrainTelemetry,
+    add_observability_args,
+    build_train_telemetry,
+    observability_enabled,
+    relabeled_exposition,
+)
+from alphafold2_tpu.telemetry.logger import (
+    MetricsLogger,
+    per_process_metrics_path,
+)
 from alphafold2_tpu.telemetry.ops_plane import (
     FlightRecorder,
     OpsServer,
@@ -97,22 +117,31 @@ def finish_trace(tracer: Tracer, args):
 
 
 __all__ = [
+    "BUCKETS",
     "CompileTracker",
     "Counter",
+    "FederatedRegistryView",
     "FlightRecorder",
     "Gauge",
+    "GoodputLedger",
     "Histogram",
     "LatencyHistogram",
+    "MetricFederation",
     "MetricRegistry",
     "MetricsLogger",
     "NULL_REGISTRY",
     "NULL_TRACER",
+    "NULL_TRAIN_TELEMETRY",
     "OpsServer",
+    "StragglerDetector",
+    "TrainTelemetry",
     "SloConfig",
     "SloEngine",
     "SloObjective",
     "Tracer",
+    "add_observability_args",
     "add_telemetry_args",
+    "build_train_telemetry",
     "default_slo_config",
     "device_memory_gauges",
     "finish_trace",
@@ -120,9 +149,12 @@ __all__ = [
     "flops_gauges",
     "host_memory_gauges",
     "new_trace_id",
+    "observability_enabled",
     "ops_server_for_engine",
     "ops_server_for_fleet",
     "parse_prometheus_text",
+    "per_process_metrics_path",
     "profile_trace",
+    "relabeled_exposition",
     "tracer_from_args",
 ]

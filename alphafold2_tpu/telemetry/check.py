@@ -34,6 +34,13 @@ override per-run with --rule); metrics with no inferable direction are
 reported informationally, never gated — a gate that guesses directions
 would fail builds on improvements.
 
+`--loss-curve` switches the gate to CONVERGENCE mode: --current and
+--baseline are training metrics JSONL streams (MetricsLogger format),
+each reduced by `load_loss_curve` to smoothed final-window loss, slope,
+and best loss, then compared under the loss-curve direction rules — a
+diverging run fails the build exactly like a slow step would (ROADMAP
+item 1's "loss-curve telemetry wired into the regression gate").
+
 Exit codes: 0 = no regression (including "nothing comparable"),
 1 = at least one regression beyond tolerance, 2 = usage/artifact error.
 """
@@ -57,6 +64,37 @@ from alphafold2_tpu.telemetry.registry import flatten_snapshot
 #: not with how fast the system was — gating them would fail comparisons
 #: between runs of different length at identical performance.
 _RULES: Tuple[Tuple[str, str, float], ...] = (
+    # training-plane efficiency rules come BEFORE the volume-ignore
+    # block deliberately: badput seconds end in `_total`-style names but
+    # ARE the gated quantity on fixed-length goodput legs (a rise in
+    # data-stall badput at identical steps is precisely the regression).
+    # badput/stall precede the ratio rule, and the ratio rule is the
+    # FULL `goodput_ratio` token: the train_goodput leg prefixes every
+    # metric with the leg name, so a bare *goodput* would claim its
+    # badput/wall rows too and gate them backwards
+    # incident/event VOLUME counters (train_incidents_total{kind=
+    # "train_data_stall"}, flight_incidents_total) must stay
+    # informational even though their kind labels contain "stall":
+    # they scale with run length and chaos plans, not speed
+    ("*incidents_total*", "ignore", 0.0),
+    ("*badput*", "lower", 0.25),
+    # 25%, not the 5-10% of the steady-state throughput rules: the
+    # chip-free train_goodput leg's ratio is compile-dominated on a CPU
+    # host (machine-speed noise), while a structural regression — a
+    # re-serialized pipeline, a checkpoint stampede — moves it far more
+    ("*goodput_ratio*", "higher", 0.25),
+    ("*stall*", "lower", 0.25),
+    ("*skew*", "lower", 0.25),
+    # loss-curve gate metrics (--loss-curve mode). The raw signed slope
+    # is reported but NOT gated: a healthy converged baseline has slope
+    # near (or crossing) zero, where relative change is noise-or-
+    # infinite; the gated trend is the dimensionless end/start ratio of
+    # the smoothed final window, which a divergence moves far past any
+    # smoothing jitter
+    ("*loss_final*", "lower", 0.10),
+    ("*loss_best*", "lower", 0.10),
+    ("*loss_trend*", "lower", 0.10),
+    ("*loss_slope*", "ignore", 0.0),
     ("*count*", "ignore", 0.0),
     ("*window*", "ignore", 0.0),
     ("*.sum", "ignore", 0.0),
@@ -210,6 +248,86 @@ def load_metrics(path_or_dict) -> Dict[str, float]:
     return flatten_snapshot(d)
 
 
+def load_loss_curve(path, *, key: str = "loss",
+                    window: Optional[int] = None,
+                    smooth: float = 0.9) -> Dict[str, float]:
+    """A training-metrics JSONL stream -> the loss-curve gate metrics.
+
+    Reads the `MetricsLogger` JSONL format (scalar records; `event`
+    records skipped), EMA-smooths the `key` series, and reduces it to:
+
+      * `loss_final`  — mean smoothed loss over the final window
+        (default: the last quarter of the curve, >= 3 points);
+      * `loss_trend`  — smoothed final-window END / START ratio:
+        ~<= 1 for plateau-or-improving, > 1 diverging. This is the
+        gated slope signal — dimensionless and bounded away from the
+        zero crossing, where the raw slope's relative change is
+        noise-or-infinite;
+      * `loss_slope`  — least-squares slope (loss per step) of the
+        smoothed final window: negative = still improving, positive =
+        diverging (reported for operators; deliberately not gated —
+        see `_RULES`);
+      * `loss_best`   — the best (minimum) smoothed loss anywhere on the
+        curve — a run that improved then blew up keeps a good best but a
+        bad final, so the pair separates divergence from plateau;
+      * `points_count` — curve length (informational: *count* rule).
+
+    Gated like any perf leg via the `*loss_final*` / `*loss_trend*` /
+    `*loss_best*` direction rules — convergence quality regresses a
+    build exactly the way a slow step does.
+    """
+    steps: List[float] = []
+    values: List[float] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line: same tolerance as sweep rows
+            if not isinstance(rec, dict) or "event" in rec:
+                continue
+            v = rec.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                steps.append(float(rec.get("step", len(steps))))
+                values.append(float(v))
+    if len(values) < 3:
+        raise ValueError(
+            f"{path}: found {len(values)} {key!r} points; a loss curve "
+            f"needs at least 3 (is this a metrics JSONL, and is "
+            f"--loss-key right?)"
+        )
+    if not 0.0 <= smooth < 1.0:
+        raise ValueError(f"smooth must be in [0, 1), got {smooth}")
+    if window is not None and window < 1:
+        # ValueError (not a raw ZeroDivisionError / silently sign-flipped
+        # means) so the CLI reports it as the documented exit code 2
+        raise ValueError(f"window must be >= 1, got {window}")
+    smoothed, ema = [], values[0]
+    for v in values:
+        ema = smooth * ema + (1.0 - smooth) * v
+        smoothed.append(ema)
+    w = window if window is not None else max(3, len(values) // 4)
+    w = min(w, len(values))
+    tail_x, tail_y = steps[-w:], smoothed[-w:]
+    mx = sum(tail_x) / w
+    my = sum(tail_y) / w
+    var = sum((x - mx) ** 2 for x in tail_x)
+    slope = (
+        sum((x - mx) * (y - my) for x, y in zip(tail_x, tail_y)) / var
+        if var > 0 else 0.0
+    )
+    return {
+        "loss_final": my,
+        "loss_trend": tail_y[-1] / max(abs(tail_y[0]), 1e-12),
+        "loss_slope": slope,
+        "loss_best": min(smoothed),
+        "points_count": float(len(values)),
+    }
+
+
 def compare(current: Dict[str, float], baseline: Dict[str, float],
             tolerance: Optional[float] = None,
             rules=_RULES) -> List[dict]:
@@ -283,6 +401,20 @@ def main(argv=None) -> int:
     ap.add_argument("--baseline", required=True,
                     help="reference snapshot (BASELINE.json / BENCH_*.json "
                          "/ a previous stats-json)")
+    ap.add_argument("--loss-curve", action="store_true",
+                    help="treat --current/--baseline as training metrics "
+                         "JSONL streams and gate CONVERGENCE: smoothed "
+                         "final-window loss, slope, and best loss "
+                         "compared under the loss-curve direction rules")
+    ap.add_argument("--loss-key", default="loss",
+                    help="JSONL field holding the curve (--loss-curve "
+                         "mode; default: loss)")
+    ap.add_argument("--loss-window", type=int, default=None,
+                    help="final-window size in points (--loss-curve "
+                         "mode; default: the last quarter of the curve)")
+    ap.add_argument("--loss-smooth", type=float, default=0.9,
+                    help="EMA smoothing factor for the curve "
+                         "(--loss-curve mode)")
     ap.add_argument("--tolerance", type=float, default=None,
                     help="override every rule's relative tolerance")
     ap.add_argument("--rule", action="append", default=[],
@@ -298,7 +430,16 @@ def main(argv=None) -> int:
 
     rules = tuple(_parse_rule(s) for s in args.rule) + _RULES
     try:
-        passed, rows = check(args.current, args.baseline,
+        if args.loss_curve:
+            current = load_loss_curve(
+                args.current, key=args.loss_key,
+                window=args.loss_window, smooth=args.loss_smooth)
+            baseline = load_loss_curve(
+                args.baseline, key=args.loss_key,
+                window=args.loss_window, smooth=args.loss_smooth)
+        else:
+            current, baseline = args.current, args.baseline
+        passed, rows = check(current, baseline,
                              tolerance=args.tolerance, rules=rules)
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"telemetry.check: cannot load snapshots: {e}",

@@ -1,0 +1,803 @@
+"""Training observability plane: goodput ledger, pod federation, stragglers.
+
+ScaleFold (arxiv 2404.11068) attributes its 10-hour AlphaFold training
+to systematically finding data-stall and non-compute badput BEFORE
+optimizing, and FastFold (arxiv 2203.00854) drives its parallelism
+choices from per-phase time breakdowns. Until this module the trainers
+had the opposite posture to serving: a proc-0-only metrics JSONL, no
+live endpoint, no accounting of where wall clock goes, and no visibility
+into which host of a pod is the straggler. Four cooperating pieces close
+that gap:
+
+`GoodputLedger` — classifies every wall-clock second of a training run
+into named buckets (`BUCKETS`): data fetch, global-batch assembly,
+compile, step execute, eval, checkpoint, restore, preemption drain, and
+idle (the explicit remainder, so the buckets ALWAYS sum to wall clock —
+the invariant the chaos matrix pins). Accounting is exclusive-time: a
+nested `account()` (the pod path's batch assembly runs inside the step
+dispatch) attributes to the inner bucket and subtracts from the outer.
+Exposes lifetime goodput ratio (productive step seconds / wall),
+badput-by-cause, per-step fetch/step histograms, and analytic
+FLOPs-per-second / MFU (utils/flops.py numbers — XLA's own count is
+scan-blind) as registry metrics, plus a progress watchdog
+(`health(horizon)`: "down" when no step completed within the horizon —
+the trainer `/healthz` 503).
+
+`MetricFederation` — the pod-wide view. Each telemetry tick EVERY
+process serializes {its Prometheus exposition, last step/fetch seconds}
+and the payloads are allgathered (`compat.process_allgather`) so process
+0 can serve one `/metrics` with a `process` label on every sample.
+Ticks are COLLECTIVE: they must run from the training loop at the same
+step on every process (never from the HTTP ticker thread — a background
+collective would race the train step's own collectives).
+
+`StragglerDetector` — consumes the federated per-process step/fetch
+times: publishes cross-process skew gauges (max/median) and, when one
+host's step time (-> `train_straggler`) or fetch time / local fetch
+share (-> `train_data_stall`) diverges past a threshold for `patience`
+consecutive observations, files a flight-recorder incident.
+
+`TrainTelemetry` — the bundle the trainer loops actually thread through
+(`run_resilient(..., telemetry=)`, both CLI plain loops): `account()`
+passthrough, per-step bookkeeping, federation cadence, and the ops-plane
+lifecycle. `build_train_telemetry` wires all of it from the shared
+`add_observability_args` flag block (`--ops-port`, `--flight-dir`, ...).
+
+docs/OBSERVABILITY.md "The training plane" is the operator guide;
+docs/OPERATIONS.md maps `train_straggler` / `train_data_stall` to their
+first diagnostic steps.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from alphafold2_tpu.telemetry.registry import (
+    NULL_REGISTRY,
+    MetricRegistry,
+    parse_prometheus_text,
+    render_labels,
+)
+
+#: the ledger's bucket taxonomy. "idle" is never accounted directly —
+#: it is the explicit remainder (wall minus every accounted second), so
+#: the buckets sum to wall clock BY CONSTRUCTION and a double-counting
+#: bug shows up as negative idle (clamped, asserted in tests).
+BUCKETS = (
+    "data_fetch",   # host-side batch fetch/assembly (the data pipeline)
+    "assembly",     # host-to-device / global-batch assembly (pod path)
+    "compile",      # first-step jit trace+compile wall time
+    "step",         # step dispatch + device execution (the productive bucket)
+    "eval",         # held-out eval forward
+    "checkpoint",   # checkpoint save/verify
+    "restore",      # crash-recovery episodes (restart + restore)
+    "preempt",      # preemption drain: final save before Preempted
+    "idle",         # everything else (supervisor overhead, logging, gaps)
+)
+
+#: buckets counted as productive in the goodput ratio. Compile, eval and
+#: checkpoints are overhead a perfect run amortizes to ~zero (ScaleFold
+#: moves eval off the training stream for exactly this reason).
+GOODPUT_BUCKETS = ("step",)
+
+
+class GoodputLedger:
+    """Wall-clock bucket accounting for one training run (module docstring).
+
+    Accounting calls (`account`, `step_complete`) belong to the training
+    loop thread; readers (`snapshot`, `health`, the registry gauges) may
+    run on the ops-plane HTTP/ticker threads — the internal lock covers
+    that split, not concurrent accounting from two threads.
+
+    Args:
+      registry: metric sink (`NULL_REGISTRY` = totals only, no metrics).
+      clock: injectable monotonic clock (tests drive time explicitly).
+      process_index: stamped into `snapshot()` for the federation payload.
+    """
+
+    def __init__(self, registry: MetricRegistry = NULL_REGISTRY, *,
+                 clock: Callable[[], float] = time.perf_counter,
+                 process_index: int = 0):
+        self.registry = registry
+        self.process_index = process_index
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._t0 = clock()
+        self._buckets: Dict[str, float] = {
+            b: 0.0 for b in BUCKETS if b != "idle"
+        }
+        self._stack: List[list] = []   # [bucket, t_enter, child_seconds]
+        self._step_acc: Dict[str, float] = {}  # since last step_complete
+        self._steps = 0
+        self._compiled = False
+        self._last_step_s = 0.0
+        self._last_fetch_s = 0.0
+        self._last_progress = self._t0
+        self._step_flops: Optional[float] = None
+        self._peak_flops: Optional[float] = None
+
+    # ---------------------------------------------------------- accounting
+
+    @contextlib.contextmanager
+    def account(self, bucket: str):
+        """Attribute the enclosed wall time to `bucket` (exclusive-time:
+        a nested account claims its own seconds from the enclosing one)."""
+        if bucket not in BUCKETS or bucket == "idle":
+            raise ValueError(f"unknown ledger bucket {bucket!r}; "
+                             f"expected one of {BUCKETS[:-1]}")
+        frame = [bucket, self._clock(), 0.0]
+        self._stack.append(frame)
+        try:
+            yield
+        finally:
+            now = self._clock()
+            self._stack.pop()
+            total = now - frame[1]
+            self_dt = max(0.0, total - frame[2])
+            with self._lock:
+                self._buckets[bucket] += self_dt
+                self._step_acc[bucket] = (
+                    self._step_acc.get(bucket, 0.0) + self_dt
+                )
+            if self._stack:
+                self._stack[-1][2] += total
+
+    def step_bucket(self) -> str:
+        """Bucket for the next step execution: "compile" until the first
+        step completes (its wall time IS the jit trace+compile event),
+        "step" after."""
+        return "step" if self._compiled else "compile"
+
+    def step_complete(self, step: int) -> Dict[str, float]:
+        """One optimizer step finished: fold the per-step accumulation
+        into histograms/gauges and reset the progress watchdog. Returns
+        {"step_s", "fetch_s"} (this step's execute and data-fetch
+        seconds) — the federation payload and the stall detector's
+        input."""
+        now = self._clock()
+        with self._lock:
+            acc, self._step_acc = self._step_acc, {}
+            step_s = acc.get("step", 0.0) + acc.get("compile", 0.0)
+            fetch_s = acc.get("data_fetch", 0.0)
+            self._steps += 1
+            self._compiled = True
+            self._last_step_s = step_s
+            self._last_fetch_s = fetch_s
+            self._last_progress = now
+        self.registry.counter(
+            "train_steps_total", help="completed optimizer steps").inc()
+        self.registry.histogram(
+            "train_step_seconds",
+            help="per-step execute wall seconds (compile included at "
+                 "step 0)").observe(step_s)
+        self.registry.histogram(
+            "train_fetch_seconds",
+            help="per-step host data-fetch wall seconds").observe(fetch_s)
+        self.publish()
+        return {"step_s": step_s, "fetch_s": fetch_s}
+
+    def set_workload(self, step_flops: float,
+                     peak_flops: Optional[float] = None):
+        """Arm the MFU math: analytic FLOPs of one optimizer step
+        (utils/flops.py train_step_flops) and, when known, the chip's
+        peak FLOP/s (None = publish achieved FLOP/s only — an honest
+        absence beats an MFU against a guessed peak)."""
+        with self._lock:
+            self._step_flops = float(step_flops)
+            self._peak_flops = (
+                float(peak_flops) if peak_flops else None
+            )
+
+    # ------------------------------------------------------------- reading
+
+    @property
+    def last_step_seconds(self) -> float:
+        with self._lock:
+            return self._last_step_s
+
+    @property
+    def last_fetch_seconds(self) -> float:
+        with self._lock:
+            return self._last_fetch_s
+
+    def wall(self) -> float:
+        return self._clock() - self._t0
+
+    def totals(self) -> Dict[str, float]:
+        """{bucket: seconds} including the idle remainder — sums to
+        `wall()` by construction (idle clamps at 0, so an accounting
+        overlap bug surfaces as sum > wall, which the tests assert
+        against)."""
+        with self._lock:
+            out = dict(self._buckets)
+        out["idle"] = max(0.0, self.wall() - sum(out.values()))
+        return out
+
+    def goodput_ratio(self) -> float:
+        wall = self.wall()
+        if wall <= 0:
+            return 0.0
+        totals = self.totals()
+        return sum(totals[b] for b in GOODPUT_BUCKETS) / wall
+
+    def badput(self) -> Dict[str, float]:
+        """{cause: seconds} — every non-productive bucket, idle included."""
+        return {b: s for b, s in self.totals().items()
+                if b not in GOODPUT_BUCKETS}
+
+    def flops_per_sec(self) -> Optional[float]:
+        with self._lock:
+            step_flops, steps = self._step_flops, self._steps
+        wall = self.wall()
+        if step_flops is None or wall <= 0:
+            return None
+        return steps * step_flops / wall
+
+    def mfu(self) -> Optional[float]:
+        achieved = self.flops_per_sec()
+        with self._lock:
+            peak = self._peak_flops
+        if achieved is None or peak is None or peak <= 0:
+            return None
+        return achieved / peak
+
+    def publish(self):
+        """Write the ledger state into the registry (called on every
+        step_complete and every ops tick — so, like snapshot(), it is
+        built from ONE totals read: every gauge of a publish describes
+        the same instant, and the per-step hot path takes the lock
+        once, not seven times)."""
+        reg = self.registry
+        totals = self.totals()
+        wall = sum(totals.values())
+        with self._lock:
+            steps = self._steps
+            step_flops, peak = self._step_flops, self._peak_flops
+        reg.gauge("train_wall_seconds",
+                  help="run wall-clock seconds (ledger lifetime)"
+                  ).set(wall)
+        for bucket, s in totals.items():
+            reg.gauge("train_bucket_seconds",
+                      help="wall seconds by ledger bucket (sums to "
+                           "train_wall_seconds)", bucket=bucket).set(s)
+        productive = sum(totals[b] for b in GOODPUT_BUCKETS)
+        reg.gauge("train_goodput_ratio",
+                  help="productive step seconds / wall seconds"
+                  ).set(productive / wall if wall > 0 else 0.0)
+        for cause, s in totals.items():
+            if cause in GOODPUT_BUCKETS:
+                continue
+            reg.gauge("train_badput_seconds",
+                      help="non-productive wall seconds by cause",
+                      cause=cause).set(s)
+        if step_flops is not None and wall > 0:
+            achieved = steps * step_flops / wall
+            reg.gauge("train_model_flops_per_sec",
+                      help="analytic achieved model FLOP/s "
+                           "(utils/flops.py, steps x step_flops / wall)"
+                      ).set(achieved)
+            if peak:
+                reg.gauge("train_mfu",
+                          help="achieved / peak FLOP/s (requires a "
+                               "declared peak)").set(achieved / peak)
+
+    def snapshot(self) -> dict:
+        """JSON-ready ledger dump (the trainer `/statusz` payload).
+        Every field derives from ONE totals read: `wall_s` is the bucket
+        sum and the ratio divides by that same sum, so the sums-to-wall
+        invariant — and the ratio's denominator — hold EXACTLY within
+        one snapshot (a live `wall()` read microseconds later would
+        already disagree), and the hot callers (every /statusz request,
+        every flight-recorder bundle) take the lock once, not seven
+        times."""
+        totals = self.totals()
+        wall = sum(totals.values())
+        with self._lock:
+            steps = self._steps
+            last_step_s, last_fetch_s = self._last_step_s, self._last_fetch_s
+            step_flops, peak = self._step_flops, self._peak_flops
+        out = {
+            "process": self.process_index,
+            "wall_s": wall,
+            "buckets": totals,
+            "goodput_ratio": (
+                sum(totals[b] for b in GOODPUT_BUCKETS) / wall
+                if wall > 0 else 0.0
+            ),
+            "badput_s": {b: s for b, s in totals.items()
+                         if b not in GOODPUT_BUCKETS},
+            "steps": steps,
+            "last_step_s": last_step_s,
+            "last_fetch_s": last_fetch_s,
+        }
+        if step_flops is not None and wall > 0:
+            achieved = steps * step_flops / wall
+            out["model_flops_per_sec"] = achieved
+            if peak:
+                out["mfu"] = achieved / peak
+        return out
+
+    def health(self, horizon_s: float = 600.0) -> dict:
+        """Progress-watchdog liveness: "down" when no step completed
+        within `horizon_s` (measured from ledger start before the first
+        step, so a wedged first compile eventually pages too). The ops
+        plane maps "down" to HTTP 503."""
+        with self._lock:
+            age = self._clock() - self._last_progress
+            steps = self._steps
+        stalled = age > horizon_s
+        return {
+            "status": "down" if stalled else "ok",
+            "steps": steps,
+            "last_step_age_s": age,
+            "horizon_s": horizon_s,
+        }
+
+
+# --- pod-wide federation ------------------------------------------------------
+
+
+def _allgather_bytes(payload: bytes) -> List[bytes]:
+    """Every process's payload, via two `compat.process_allgather` calls
+    (sizes first, then max-padded uint8 rows — payload lengths differ per
+    process). COLLECTIVE: all processes must call with the same cadence.
+    Single-process this degenerates to [payload]."""
+    from alphafold2_tpu import compat
+
+    data = np.frombuffer(payload, np.uint8)
+    sizes = np.asarray(
+        compat.process_allgather(np.asarray([data.size]), tiled=True)
+    ).reshape(-1)
+    padded = np.zeros((1, int(sizes.max())), np.uint8)
+    padded[0, : data.size] = data
+    rows = np.asarray(compat.process_allgather(padded, tiled=True))
+    return [rows[i, : int(sizes[i])].tobytes() for i in range(len(sizes))]
+
+
+def relabeled_exposition(text: str, **labels) -> str:
+    """Re-emit a Prometheus text exposition with `labels` merged into
+    every sample (comment lines dropped — the merged pod view is served
+    untyped; `parse_prometheus_text` and real scrapers both accept it)."""
+    samples = parse_prometheus_text(text)
+    extra = tuple((k, str(v)) for k, v in labels.items())
+    lines = []
+    for (name, key) in sorted(samples):
+        merged = tuple(sorted(dict(key + extra).items()))
+        lines.append(f"{name}{render_labels(merged)} {samples[(name, key)]}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class MetricFederation:
+    """Allgathers per-process telemetry to every process each tick.
+
+    `tick(step)` is a COLLECTIVE operation: every process of the pod must
+    call it at the same training step (the trainer loops do, on the
+    `every`-step cadence via `TrainTelemetry.step_complete`). The HTTP
+    side only ever reads the last gathered state under a lock.
+    """
+
+    def __init__(self, registry: MetricRegistry, *,
+                 ledger: Optional[GoodputLedger] = None,
+                 process_index: Optional[int] = None,
+                 every: int = 10,
+                 gather_fn: Callable[[bytes], List[bytes]] = _allgather_bytes):
+        if every < 1:
+            raise ValueError(f"every must be >= 1, got {every}")
+        if process_index is None:
+            import jax
+
+            process_index = jax.process_index()
+        self.registry = registry
+        self.ledger = ledger
+        self.process_index = process_index
+        self.every = every
+        self._gather = gather_fn
+        self._lock = threading.Lock()
+        self._rows: List[dict] = []
+        self._last_tick_step: Optional[int] = None
+
+    def due(self, step: int) -> bool:
+        return step % self.every == 0
+
+    def tick(self, step: int) -> List[dict]:
+        """Gather every process's payload; returns the decoded rows
+        (sorted by process index). COLLECTIVE — see class docstring."""
+        payload = {
+            "process": self.process_index,
+            "step": step,
+            "prom": self.registry.to_prometheus(),
+        }
+        if self.ledger is not None:
+            payload["step_s"] = self.ledger.last_step_seconds
+            payload["fetch_s"] = self.ledger.last_fetch_seconds
+            payload["goodput"] = self.ledger.goodput_ratio()
+        rows = [json.loads(b.decode("utf-8"))
+                for b in self._gather(json.dumps(payload).encode("utf-8"))]
+        rows.sort(key=lambda r: r.get("process", 0))
+        with self._lock:
+            self._rows = rows
+            self._last_tick_step = step
+        return rows
+
+    def rows(self) -> List[dict]:
+        with self._lock:
+            return list(self._rows)
+
+    def remote_exposition(self) -> str:
+        """The last-gathered samples of every OTHER process, each labeled
+        with its `process` index (this process's samples are served live
+        by `FederatedRegistryView`)."""
+        parts = []
+        for row in self.rows():
+            if row.get("process") == self.process_index:
+                continue
+            parts.append(relabeled_exposition(
+                row.get("prom", ""), process=row.get("process", "?")))
+        return "".join(parts)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "processes": [r.get("process") for r in self._rows],
+                "last_tick_step": self._last_tick_step,
+                "every": self.every,
+            }
+
+
+class FederatedRegistryView:
+    """Registry adapter for the trainer `OpsServer`: mutators and
+    snapshots delegate to the LOCAL registry; `/metrics` exposition is
+    the local samples (live, labeled `process=<self>`) plus every other
+    process's last-federated samples — one scrape, whole pod."""
+
+    def __init__(self, local: MetricRegistry, federation: MetricFederation):
+        self._local = local
+        self._federation = federation
+
+    def counter(self, name, help="", **labels):
+        return self._local.counter(name, help=help, **labels)
+
+    def gauge(self, name, help="", **labels):
+        return self._local.gauge(name, help=help, **labels)
+
+    def histogram(self, name, help="", **labels):
+        return self._local.histogram(name, help=help, **labels)
+
+    def collect(self):
+        return self._local.collect()
+
+    def snapshot(self):
+        return self._local.snapshot()
+
+    def to_prometheus(self) -> str:
+        own = relabeled_exposition(
+            self._local.to_prometheus(),
+            process=self._federation.process_index,
+        )
+        return own + self._federation.remote_exposition()
+
+
+# --- straggler / data-stall detection ----------------------------------------
+
+
+class StragglerDetector:
+    """Fires flight-recorder incidents when training time diverges.
+
+    Two failure shapes, each needing `patience` CONSECUTIVE bad
+    observations (one slow garbage-collection pause must not page):
+
+      * `train_straggler` — pod skew: one process's step time exceeds
+        `skew_threshold` x the pod median (`observe_pod`, fed from the
+        federation rows).
+      * `train_data_stall` — the input pipeline is the bottleneck:
+        locally, fetch time exceeds `stall_fraction` of the step's
+        fetch+execute wall (`observe_local`); on a pod, one process's
+        FETCH time exceeds the skew threshold vs the median
+        (`observe_pod`).
+
+    Sub-`min_seconds` medians/fetches never trigger (microsecond noise
+    on tiny test models is not a straggler). Incidents fire ONCE per
+    streak (re-armed when the signal recovers); `registry` gets the skew
+    gauges and a stalled-steps counter.
+    """
+
+    def __init__(self, *, recorder=None,
+                 registry: MetricRegistry = NULL_REGISTRY,
+                 skew_threshold: float = 2.0, stall_fraction: float = 0.5,
+                 patience: int = 3, min_seconds: float = 0.005):
+        if skew_threshold <= 1.0:
+            raise ValueError(
+                f"skew_threshold must be > 1, got {skew_threshold}")
+        if not 0.0 < stall_fraction < 1.0:
+            raise ValueError(
+                f"stall_fraction must be in (0, 1), got {stall_fraction}")
+        if patience < 1:
+            raise ValueError(f"patience must be >= 1, got {patience}")
+        self.recorder = recorder
+        self.registry = registry
+        self.skew_threshold = skew_threshold
+        self.stall_fraction = stall_fraction
+        self.patience = patience
+        self.min_seconds = min_seconds
+        self._streaks: Dict[tuple, int] = {}
+
+    def _observe(self, key: tuple, bad: bool, kind: str, **attrs):
+        streak = self._streaks.get(key, 0) + 1 if bad else 0
+        self._streaks[key] = streak
+        if streak != self.patience:  # fire once per streak, at patience
+            return
+        self.registry.counter(
+            "train_incidents_total",
+            help="straggler/data-stall detections", kind=kind).inc()
+        if self.recorder is not None:
+            self.recorder.incident(
+                kind, patience=self.patience, **attrs)
+
+    def observe_local(self, step: int, *, fetch_s: float, step_s: float):
+        """Single-process data-stall check on one completed step."""
+        total = fetch_s + step_s
+        bad = (fetch_s > self.min_seconds
+               and total > 0
+               and fetch_s / total > self.stall_fraction)
+        self._observe(("local_stall",), bad, "train_data_stall",
+                      step=step, fetch_s=fetch_s, step_s=step_s,
+                      fetch_fraction=(fetch_s / total if total else 0.0))
+
+    def observe_pod(self, step: int, rows: List[dict]):
+        """Cross-process skew check on one federation tick. `rows` are
+        the federation payloads ({"process", "step_s", "fetch_s"})."""
+        if len(rows) < 2:
+            return
+        skew_help = "worst-process / median-process time this tick"
+        step_skew = self.registry.gauge("train_step_time_skew",
+                                        help=skew_help)
+        fetch_skew = self.registry.gauge("train_fetch_time_skew",
+                                         help=skew_help)
+        for field, kind, gauge in (
+            ("step_s", "train_straggler", step_skew),
+            ("fetch_s", "train_data_stall", fetch_skew),
+        ):
+            vals = [(r.get("process", i), float(r.get(field, 0.0)))
+                    for i, r in enumerate(rows)]
+            times = sorted(v for _, v in vals)
+            # LOWER median: on a 2-process pod the straggler must be
+            # judged against its healthy peer, not against itself
+            median = times[(len(times) - 1) // 2]
+            worst_proc, worst = max(vals, key=lambda pv: pv[1])
+            # significance rides the WORST time (a 0.2 s stall against a
+            # near-zero healthy median IS a straggler), and the ratio's
+            # denominator floors at min_seconds so it stays finite
+            skew = worst / max(median, self.min_seconds)
+            gauge.set(skew)
+            bad = worst > self.min_seconds and skew > self.skew_threshold
+            self._observe((kind, "pod"), bad, kind,
+                          step=step, process=worst_proc, seconds=worst,
+                          median_s=median, skew=skew, field=field)
+
+
+# --- trainer wiring -----------------------------------------------------------
+
+
+class TrainTelemetry:
+    """The per-run observability bundle the trainer loops thread through.
+
+    `enabled=False` (the NULL_TRAIN_TELEMETRY singleton) makes every
+    hook a no-op — an uninstrumented run pays one boolean test per site,
+    the same contract as NULL_TRACER/NULL_REGISTRY.
+    """
+
+    def __init__(self, *, ledger: Optional[GoodputLedger] = None,
+                 federation: Optional[MetricFederation] = None,
+                 detector: Optional[StragglerDetector] = None,
+                 recorder=None, ops=None, logger=None,
+                 enabled: bool = True):
+        self.enabled = enabled
+        self.ledger = ledger if ledger is not None else GoodputLedger()
+        self.federation = federation
+        self.detector = detector
+        self.recorder = recorder
+        self.ops = ops
+        self.logger = logger
+
+    def account(self, bucket: str):
+        if not self.enabled:
+            return contextlib.nullcontext()
+        return self.ledger.account(bucket)
+
+    def step_bucket(self) -> str:
+        return self.ledger.step_bucket() if self.enabled else "step"
+
+    def step_complete(self, step: int):
+        """Per-step bookkeeping + the COLLECTIVE federation cadence: on a
+        pod every process reaches this at the same step, so the gather
+        inside stays in lockstep with the train step's own collectives."""
+        if not self.enabled:
+            return
+        times = self.ledger.step_complete(step)
+        if self.detector is not None:
+            self.detector.observe_local(step, **times)
+        if self.federation is not None and self.federation.due(step):
+            rows = self.federation.tick(step)
+            if (self.detector is not None
+                    and self.federation.process_index == 0):
+                self.detector.observe_pod(step, rows)
+
+    def health(self, horizon_s: float = 600.0) -> dict:
+        return self.ledger.health(horizon_s)
+
+    def statusz(self) -> dict:
+        # NO flight-recorder block here: this payload mounts as the ops
+        # server's stats_fn, and OpsServer.statusz() already serves the
+        # same recorder under its own top-level "flight_recorder" key —
+        # embedding it twice would hand operators two copies to diverge
+        out = {"goodput": self.ledger.snapshot()}
+        if self.logger is not None and hasattr(self.logger, "tail"):
+            out["loss_tail"] = self.logger.tail()
+        if self.federation is not None:
+            out["federation"] = self.federation.snapshot()
+        return out
+
+    def close(self):
+        """Final publish + ops-plane shutdown (idempotent). Deliberately
+        NO final federation tick: close() also runs on the crash/preempt
+        paths, where a collective would hang the surviving processes."""
+        if not self.enabled:
+            return
+        self.ledger.publish()
+        if self.ops is not None:
+            self.ops.stop()
+            self.ops = None
+        snap = self.ledger.snapshot()
+        buckets = "  ".join(
+            f"{b} {s:.1f}s" for b, s in sorted(snap["buckets"].items())
+            if s > 0.05
+        )
+        print(f"goodput {snap['goodput_ratio']:.1%} over "
+              f"{snap['wall_s']:.1f}s wall ({snap['steps']} steps): "
+              f"{buckets}")
+
+
+#: shared disabled bundle, the analog of NULL_TRACER / NULL_REGISTRY
+NULL_TRAIN_TELEMETRY = TrainTelemetry(enabled=False)
+
+
+def add_observability_args(ap):
+    """The trainer live-observability argparse block shared by
+    train_pre.py and train_end2end.py — one place to add the next knob."""
+    ap.add_argument("--ops-port", type=int, default=None, metavar="PORT",
+                    help="serve the live trainer ops plane on this port "
+                         "(/metrics, /healthz progress watchdog, /statusz "
+                         "goodput ledger + loss tail); 0 = ephemeral "
+                         "(printed); unset = off. Pod runs offset a "
+                         "fixed port by the process rank; process 0 "
+                         "serves the federated pod view")
+    ap.add_argument("--ops-port-file", default=None, metavar="PATH",
+                    help="write the bound ops port here (for parent "
+                         "processes driving --ops-port 0); on a pod only "
+                         "process 0 — the federated view — writes it")
+    ap.add_argument("--flight-dir", default=None, metavar="DIR",
+                    help="arm the training flight recorder: straggler / "
+                         "data-stall incidents snapshot forensic bundles "
+                         "here")
+    ap.add_argument("--progress-horizon-s", type=float, default=600.0,
+                    help="/healthz turns 503 when no step completed "
+                         "within this many seconds")
+    ap.add_argument("--federate-every", type=int, default=10,
+                    help="pod runs: allgather per-process telemetry to "
+                         "process 0 every N steps (a collective — keep "
+                         "modest)")
+    ap.add_argument("--peak-tflops", type=float, default=None,
+                    help="declared accelerator peak TFLOP/s for the "
+                         "train_mfu gauge (unset = publish achieved "
+                         "FLOP/s only)")
+
+
+def observability_enabled(args) -> bool:
+    """Whether the flags ask for the live plane (the trainers enable the
+    metric registry when this OR tracing is on)."""
+    return (getattr(args, "ops_port", None) is not None
+            or getattr(args, "flight_dir", None) is not None)
+
+
+def build_train_telemetry(args, *, registry: MetricRegistry,
+                          tracer=None, logger=None,
+                          step_flops: Optional[float] = None,
+                          process_index: Optional[int] = None,
+                          process_count: Optional[int] = None) -> TrainTelemetry:
+    """Wire the full training observability plane from the shared flag
+    block. Returns NULL_TRAIN_TELEMETRY when nothing was asked for and
+    the registry is disabled (the zero-cost default path)."""
+    from alphafold2_tpu.telemetry.ops_plane import FlightRecorder, OpsServer
+    from alphafold2_tpu.telemetry.profiling import (
+        device_memory_gauges,
+        host_memory_gauges,
+    )
+    from alphafold2_tpu.telemetry.trace import NULL_TRACER
+
+    if not observability_enabled(args) and not registry.enabled:
+        return NULL_TRAIN_TELEMETRY
+    if process_index is None or process_count is None:
+        import jax
+
+        process_index = jax.process_index()
+        process_count = jax.process_count()
+
+    tracer = tracer if tracer is not None else NULL_TRACER
+    ledger = GoodputLedger(registry, process_index=process_index)
+    if step_flops is not None:
+        peak = getattr(args, "peak_tflops", None)
+        ledger.set_workload(step_flops,
+                            peak_flops=peak * 1e12 if peak else None)
+
+    recorder = None
+    if getattr(args, "flight_dir", None):
+        flight_dir = args.flight_dir
+        if process_count > 1:
+            # per-process subdirectory: bundle names carry only a
+            # per-process sequence number, so two processes writing the
+            # same directory (shared storage is the normal pod setup)
+            # would silently os.replace each other's forensic evidence
+            import os
+
+            flight_dir = os.path.join(flight_dir, f"p{process_index}")
+        recorder = FlightRecorder(
+            flight_dir, tracer=tracer, registry=registry,
+            stats_fn=ledger.snapshot)
+    detector = StragglerDetector(recorder=recorder, registry=registry)
+
+    federation = None
+    if process_count > 1:
+        federation = MetricFederation(
+            registry, ledger=ledger, process_index=process_index,
+            every=getattr(args, "federate_every", 10))
+
+    telemetry = TrainTelemetry(
+        ledger=ledger, federation=federation, detector=detector,
+        recorder=recorder, logger=logger)
+
+    if getattr(args, "ops_port", None) is not None:
+        view = (FederatedRegistryView(registry, federation)
+                if federation is not None and process_index == 0
+                else registry)
+        horizon = getattr(args, "progress_horizon_s", 600.0)
+        # pods: every process mounts its own local plane. A FIXED port
+        # offsets by rank (co-hosted processes — the CPU-pod test
+        # topology — would otherwise all bind the same socket and every
+        # process after the first would die at construction); port 0
+        # stays ephemeral everywhere.
+        port = args.ops_port
+        if port and process_count > 1:
+            port += process_index
+        ops = OpsServer(
+            registry=view,
+            health_fn=lambda: telemetry.health(horizon),
+            stats_fn=telemetry.statusz,
+            tracer=tracer, recorder=recorder,
+            port=port,
+        )
+        # the ticker thread samples host/device memory between steps —
+        # training runs were blind to RSS/HBM growth between checkpoints
+        ops.add_tick(lambda: host_memory_gauges(registry))
+        ops.add_tick(lambda: device_memory_gauges(registry))
+        ops.add_tick(ledger.publish)
+        ops.start()
+        print(f"trainer ops plane on {ops.url} "
+              f"(/metrics /healthz /statusz)")
+        if getattr(args, "ops_port_file", None) and process_index == 0:
+            # process 0 only: its plane serves the FEDERATED pod view,
+            # and a shared filesystem must not race N writers onto one
+            # path (last writer would win with a local-only port)
+            import os
+
+            tmp = args.ops_port_file + ".tmp"
+            with open(tmp, "w") as fh:
+                fh.write(str(ops.port))
+            os.replace(tmp, args.ops_port_file)  # readers never see ""
+        telemetry.ops = ops
+    return telemetry

@@ -9,24 +9,52 @@ structured side.
 
 from __future__ import annotations
 
+import collections
 import json
+import os
+import threading
 import time
 import warnings
-from typing import Optional
+from typing import List, Optional
 
 import jax
 import numpy as np
 
 
-class MetricsLogger:
-    """Step-cadence scalar logging with throughput tracking."""
+def per_process_metrics_path(path: str, process_index: int) -> str:
+    """The per-process sidecar path for a pod run: process 0 keeps the
+    requested path (the curve scripts' historical stream), process i > 0
+    writes `<stem>.p<i><ext>` — so federation's live pod view has a
+    durable on-disk twin instead of a proc-0-only blind spot."""
+    if process_index == 0:
+        return path
+    stem, ext = os.path.splitext(path)
+    return f"{stem}.p{process_index}{ext}"
 
-    def __init__(self, jsonl_path: Optional[str] = None, print_every: int = 10):
+
+class MetricsLogger:
+    """Step-cadence scalar logging with throughput tracking.
+
+    `process_index` (pod runs) stamps every record with its writer's
+    rank; `tail()` serves the recent scalar records (the trainer
+    `/statusz` loss-curve tail) from a bounded in-memory ring.
+    """
+
+    def __init__(self, jsonl_path: Optional[str] = None, print_every: int = 10,
+                 process_index: Optional[int] = None,
+                 tail_window: int = 256):
         self.jsonl_path = jsonl_path
         self.print_every = print_every
+        self.process_index = process_index
         self._file = open(jsonl_path, "a") if jsonl_path else None
         self._t_last = time.perf_counter()
         self._step_last: Optional[int] = None
+        # the tail is written by the training thread and read by the ops
+        # plane's HTTP thread (/statusz loss tail): iterating a deque
+        # during a concurrent append raises RuntimeError, so both sides
+        # take the lock
+        self._tail = collections.deque(maxlen=tail_window)
+        self._tail_lock = threading.Lock()
 
     @staticmethod
     def _scalar(key: str, v) -> float:
@@ -64,6 +92,10 @@ class MetricsLogger:
             self._t_last, self._step_last = now, step
 
         record = {"step": step, **{k: round(v, 6) for k, v in vals.items()}}
+        if self.process_index is not None:
+            record["process_index"] = self.process_index
+        with self._tail_lock:
+            self._tail.append(record)
         if self._file is not None:
             self._file.write(json.dumps(record) + "\n")
             self._file.flush()
@@ -80,12 +112,21 @@ class MetricsLogger:
         Always printed: events are rare and operationally load-bearing.
         """
         record = {"step": step, "event": kind, **fields}
+        if self.process_index is not None:
+            record["process_index"] = self.process_index
         if self._file is not None:
             self._file.write(json.dumps(record) + "\n")
             self._file.flush()
         parts = "  ".join(f"{k}={v}" for k, v in fields.items())
         print(f"step {step}  [{kind}]  {parts}")
         return record
+
+    def tail(self, n: Optional[int] = None) -> List[dict]:
+        """The most recent scalar records (newest last) — the live
+        loss-curve tail the trainer ops plane serves on /statusz."""
+        with self._tail_lock:
+            records = list(self._tail)
+        return records[-n:] if n is not None else records
 
     def close(self):
         # idempotent: context-manager exit followed by an explicit close()

@@ -43,7 +43,11 @@ incident of its own (suppressed bundles are still ring events and
 counted).
 
 Wiring: `serve.py --ops-port/--flight-dir/--slo-config`, helpers
-`ops_server_for_engine` / `ops_server_for_fleet` below.
+`ops_server_for_engine` / `ops_server_for_fleet` below; the TRAINERS
+mount the same server through `telemetry.goodput.build_train_telemetry`
+(`train_pre.py` / `train_end2end.py --ops-port`, with the goodput
+ledger's progress watchdog as `/healthz` and — on a pod — the federated
+`process`-labeled registry view as `/metrics`).
 docs/OBSERVABILITY.md "The operations plane" is the operator guide;
 docs/OPERATIONS.md maps each alert to its first diagnostic step.
 """
@@ -71,6 +75,9 @@ KNOWN_INCIDENT_KINDS = (
     "scale_up",         # autoscaler grew the replica pool
     "scale_down",       # autoscaler retired a replica
     "featurize_worker_death",  # a featurize worker thread died (respawned)
+    "train_straggler",  # one pod process's step time diverged from the rest
+    "train_data_stall",  # the input pipeline stalled training (local fetch
+    #                      share or pod fetch skew past threshold)
 )
 
 

@@ -105,6 +105,7 @@ def run_resilient(
     logger=None,
     preemption=None,
     tracer=None,
+    telemetry=None,
 ):
     """Supervised training loop with rollback and checkpoint-restore retry.
 
@@ -143,10 +144,20 @@ def run_resilient(
         asynchronously, so train.step measures dispatch and
         train.metrics_fetch absorbs the device execution it waits on —
         together they are the true step wall time.
+      telemetry: optional telemetry.TrainTelemetry; the goodput ledger
+        accounts every phase into its wall-clock buckets (fetch ->
+        data_fetch, step dispatch + metrics sync -> compile on the first
+        step then step, checkpoint/restore/preempt likewise) and
+        `step_complete` drives the per-step histograms, stall detection,
+        and — on a pod — the COLLECTIVE federation tick (safe here
+        precisely because every process runs this loop in lockstep).
 
     Returns the final state.
     """
+    from alphafold2_tpu.telemetry.goodput import NULL_TRAIN_TELEMETRY
+
     tracer = tracer if tracer is not None else NULL_TRACER
+    telemetry = telemetry if telemetry is not None else NULL_TRAIN_TELEMETRY
     start = int(np.asarray(jax.device_get(state["step"])))
     target = start + steps
     restarts = 0
@@ -180,7 +191,8 @@ def run_resilient(
 
             if mgr is not None:
                 with tracer.span("train.preempt_checkpoint",
-                                 cat="reliability", step=step):
+                                 cat="reliability", step=step), \
+                        telemetry.account("preempt"):
                     mgr.save(state, force=True)
                     mgr.wait()
                     mgr.close()
@@ -191,22 +203,32 @@ def run_resilient(
         if step >= target:
             break
         try:
-            with tracer.span("train.fetch", cat="train", step=step):
+            with tracer.span("train.fetch", cat="train", step=step), \
+                    telemetry.account("data_fetch"):
                 batch = fetch(step)
-            with tracer.span("train.step", cat="train", step=step):
+            # the first step's dispatch blocks through trace+compile, so
+            # its wall time books into the ledger's compile bucket; the
+            # metrics sync is the same bucket — dispatch + sync together
+            # are the true step wall (the span-taxonomy note below)
+            step_bucket = telemetry.step_bucket()
+            with tracer.span("train.step", cat="train", step=step), \
+                    telemetry.account(step_bucket):
                 new_state, metrics = step_fn(state, batch, make_rng(step))
             # the guard's finiteness check is the step's one device sync
-            with tracer.span("train.metrics_fetch", cat="train", step=step):
+            with tracer.span("train.metrics_fetch", cat="train",
+                             step=step), telemetry.account(step_bucket):
                 state, ok = guard.check(new_state, metrics)
             if ok:
                 # a successful step clears the restart budget: the limit is
                 # on CONSECUTIVE failures, not failures over the run's life
                 restarts = 0
+                telemetry.step_complete(step)
                 if on_metrics is not None:
                     on_metrics(step, metrics)
                 if mgr is not None:
                     with tracer.span("train.checkpoint", cat="train",
-                                     step=step):
+                                     step=step), \
+                            telemetry.account("checkpoint"):
                         mgr.save(state)
             else:
                 print(f"step {step}: non-finite loss — rolled back, retrying")
@@ -227,7 +249,8 @@ def run_resilient(
             # killed the step, where the state came back from, how long
             # the restore cost
             with tracer.span("train.restore", cat="reliability", step=step,
-                             cause=type(e).__name__) as rsp:
+                             cause=type(e).__name__) as rsp, \
+                    telemetry.account("restore"):
                 if mgr is not None and mgr.latest_step() is not None:
                     from alphafold2_tpu.training.checkpoint import abstract_like
 

@@ -380,6 +380,90 @@ print(json.dumps({
 """
 
 
+# Chip-free training-goodput leg (ISSUE 12): a short REAL run_resilient
+# training run (tiny model) under the goodput ledger, with a
+# deterministic slow_data fault plan stalling several fetches — so the
+# leg proves badput ATTRIBUTION, not just a ratio: the injected stall
+# must land in the data_fetch bucket, page a train_data_stall incident,
+# and the buckets must sum to wall clock within 1%. Records
+#   goodput_ratio            (telemetry.check *goodput* higher-better)
+#   data_stall_badput_s      (*badput*/*stall* lower-better)
+# so a pipeline regression that re-introduces data stalls gates
+# automatically once recorded.
+GOODPUT_WORKER = r"""
+import json, sys, tempfile
+spec = json.loads(sys.argv[1])
+import jax
+import numpy as np
+
+from alphafold2_tpu.models import Alphafold2Config
+from alphafold2_tpu.reliability import Fault, FaultPlan
+from alphafold2_tpu.telemetry import MetricRegistry
+from alphafold2_tpu.telemetry.goodput import (
+    GoodputLedger, StragglerDetector, TrainTelemetry,
+)
+from alphafold2_tpu.telemetry.ops_plane import FlightRecorder
+from alphafold2_tpu.training import (
+    DataConfig, TrainConfig, make_train_step, resilient_batches,
+    run_resilient, synthetic_microbatch_fn, train_state_init,
+    with_fault_injection,
+)
+
+steps = spec.get("steps", 8)
+delay = spec.get("stall_delay_s", 0.1)
+cfg = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8, max_seq_len=32)
+tcfg = TrainConfig(learning_rate=1e-3, grad_accum=1)
+dcfg = DataConfig(batch_size=1, max_len=16, seed=0)
+
+plan = FaultPlan(faults=(
+    Fault("slow_data", at=2, count=max(2, steps // 2), delay_s=delay),
+))
+injector = plan.injector()
+registry = MetricRegistry()
+ledger = GoodputLedger(registry)
+flight_dir = tempfile.mkdtemp()
+recorder = FlightRecorder(flight_dir, registry=registry,
+                          stats_fn=ledger.snapshot, min_interval_s=0)
+detector = StragglerDetector(recorder=recorder, registry=registry,
+                             patience=2, stall_fraction=0.5,
+                             min_seconds=0.001)
+telemetry = TrainTelemetry(ledger=ledger, detector=detector,
+                           recorder=recorder)
+
+fetch = resilient_batches(synthetic_microbatch_fn(dcfg, tcfg.grad_accum),
+                          injector=injector)
+state = train_state_init(jax.random.PRNGKey(0), cfg, tcfg)
+step_fn = with_fault_injection(
+    jax.jit(make_train_step(cfg, tcfg)), injector)
+base_rng = jax.random.PRNGKey(1)
+state = run_resilient(
+    step_fn, state, fetch, steps=steps,
+    make_rng=lambda i: jax.random.fold_in(base_rng, i),
+    telemetry=telemetry,
+)
+
+snap = ledger.snapshot()
+assert injector.exhausted(), "slow_data plan never fully delivered"
+live_wall = ledger.wall()  # NOT snap["wall_s"] (that IS the bucket sum):
+# only a live reading catches double-accounting inflating the sum
+assert abs(sum(snap["buckets"].values()) - live_wall) \
+    <= 0.01 * live_wall, (snap, live_wall)
+stall_s = snap["buckets"]["data_fetch"]
+assert stall_s >= delay, ("injected stall not booked as data-stall "
+                          "badput", stall_s)
+bundles = recorder.snapshot()["bundles"]
+assert any("train_data_stall" in b for b in bundles), bundles
+print(json.dumps({
+    "goodput_ratio": round(snap["goodput_ratio"], 4),
+    "data_stall_badput_s": round(stall_s, 3),
+    "wall_s": round(snap["wall_s"], 3),
+    "steps_per_sec": round(steps / snap["wall_s"], 3),
+    "n_steps": steps,
+    "platform": jax.devices()[0].platform,
+}))
+"""
+
+
 # Communication-compute overlap A/B (the multi-chip distribution story,
 # ISSUE 5): times the double-buffered vs synchronous schedules of the two
 # overlapped paths — ring attention and the backward-overlapped DP-accum
@@ -739,10 +823,15 @@ def main():
     # next healthy chip measures it automatically).
     # featurize_overlap (ISSUE 11) is chip-free like quant_parity: the
     # disaggregated-serving overlap ratio records on any host.
+    # train_goodput (ISSUE 12) likewise: the goodput ledger's attribution
+    # proof (injected data stall -> data_fetch badput + incident) is
+    # structural, not chip-speed-dependent.
     for name, spec, worker, timeout in (
         ("quant_parity", {"depth": args.depth}, QUANT_PARITY_WORKER, 900),
         ("featurize_overlap", {"n": 24, "featurize_delay_s": 0.08},
          FEATURIZE_WORKER, 900),
+        ("train_goodput", {"steps": 8, "stall_delay_s": 0.1},
+         GOODPUT_WORKER, 900),
         ("quant_int8_on",
          {"depth": args.depth, "weight_dtype": "int8", "require_tpu": True},
          QUANT_WORKER, 2100),

@@ -24,8 +24,13 @@ import jax  # noqa: E402
 
 from alphafold2_tpu.models import Alphafold2Config, RefinerConfig
 from alphafold2_tpu.telemetry import (
+    MetricRegistry,
+    add_observability_args,
     add_telemetry_args,
+    build_train_telemetry,
     finish_trace,
+    observability_enabled,
+    per_process_metrics_path,
     tracer_from_args,
 )
 from alphafold2_tpu.training import (
@@ -119,6 +124,7 @@ def main():
     ap.add_argument("--ckpt-every", type=int, default=25)
     add_resilience_args(ap)  # --max-restarts / --ckpt-verify / --fault-plan
     add_telemetry_args(ap)   # --trace-out / --trace-max-spans
+    add_observability_args(ap)  # --ops-port / --flight-dir / --federate-every
     ap.add_argument("--eval-every", type=int, default=0, help="0 = no eval")
     ap.add_argument("--metrics-jsonl", default=None, help="JSONL metrics stream")
     ap.add_argument("--profile-dir", default=None, help="jax.profiler trace dir")
@@ -288,6 +294,34 @@ def main():
                          "are exclusive: the segmented chain donates state "
                          "internally, which invalidates the supervisor's "
                          "rollback reference")
+    # --- live training observability (built BEFORE the step so the pod
+    # path can account global-batch assembly into the goodput ledger) ----
+    if args.metrics_jsonl and procs > 1:
+        # per-process sidecars (metrics.p<i>.jsonl): federation's live
+        # pod view gets a durable on-disk twin per host
+        args.metrics_jsonl = per_process_metrics_path(
+            args.metrics_jsonl, jax.process_index())
+    from alphafold2_tpu.utils import MetricsLogger
+
+    logger = MetricsLogger(
+        jsonl_path=args.metrics_jsonl, print_every=10,
+        process_index=jax.process_index() if procs > 1 else None)
+    tracer = tracer_from_args(args)  # NULL_TRACER unless --trace-out
+    registry = MetricRegistry(
+        enabled=tracer.enabled or observability_enabled(args))
+    from alphafold2_tpu.utils.flops import train_step_flops
+
+    telemetry = build_train_telemetry(
+        args, registry=registry, tracer=tracer, logger=logger,
+        # pair side is the x3-elongated backbone; MSA columns stay at the
+        # CROP length (data.py builds msa as (b, rows, max_len) — same
+        # accounting as scripts/bench_decompose.py)
+        step_flops=train_step_flops(
+            ecfg.model, 3 * args.max_len,
+            args.msa_rows if args.features == "msa" else 0,
+            args.max_len, grad_accum=tcfg.grad_accum),
+    )
+
     if procs > 1:
         # pod path: DP over a process-spanning mesh; per-process pipelines
         # feed local shards, assembled into global arrays every step
@@ -306,7 +340,7 @@ def main():
         jitted, st_shardings, assemble, _mh_mesh = make_multihost_train_step(
             ecfg, tcfg, example_local,
             loss_fn=e2e_loss_fn, state_init=e2e_train_state_init,
-            tp=False, donate_state=not resilient,
+            tp=False, donate_state=not resilient, telemetry=telemetry,
         )
         state = host_to_global(state, st_shardings)
 
@@ -318,8 +352,6 @@ def main():
                 yield process_shard(b, axis=1)
 
         batches = _local(batches)
-        if args.metrics_jsonl and jax.process_index() != 0:
-            args.metrics_jsonl = None  # one metrics file, written by proc 0
     elif args.sp_shards:
         from alphafold2_tpu.parallel import make_mesh, make_sp_train_step, sp_e2e_loss_fn
 
@@ -344,7 +376,7 @@ def main():
                              donate_argnums=() if resilient else (0,))
 
     from alphafold2_tpu.training import predict_structure
-    from alphafold2_tpu.utils import MetricsLogger, structure_eval
+    from alphafold2_tpu.utils import structure_eval
 
     # eval must see the SAME feature inputs training does — evaluating a
     # sequence-only forward of an MSA/ESM-trained model would report
@@ -372,9 +404,6 @@ def main():
     prof_beg = start + 1 if args.steps > 1 else start
     prof_end = prof_beg + max(1, args.profile_steps)
     profiling = False
-
-    logger = MetricsLogger(jsonl_path=args.metrics_jsonl, print_every=10)
-    tracer = tracer_from_args(args)  # NULL_TRACER unless --trace-out
 
     if resilient:
         # supervised loop: StepGuard rollback + checkpoint-restore restarts
@@ -415,7 +444,7 @@ def main():
                 make_rng=lambda i: jax.random.fold_in(base_rng, i),
                 mgr=mgr, on_metrics=logger.log,
                 max_restarts=max_restarts, logger=logger,
-                preemption=handler, tracer=tracer,
+                preemption=handler, tracer=tracer, telemetry=telemetry,
             )
         except Preempted as e:
             # checkpointed + closed by the loop; exit 0 — not a failure
@@ -423,6 +452,7 @@ def main():
             return
         finally:
             handler.uninstall()
+            telemetry.close()
             logger.close()
             finish_trace(tracer, args)  # a preempted run keeps its trace
         if injector is not None and not injector.exhausted():
@@ -439,18 +469,24 @@ def main():
             # per-step key derived from the step index: identical schedule
             # whether the run is fresh or resumed
             step_rng = jax.random.fold_in(base_rng, step)
-            with tracer.span("train.fetch", cat="train", step=step):
+            with tracer.span("train.fetch", cat="train", step=step), \
+                    telemetry.account("data_fetch"):
                 batch = next(batches)
-            with tracer.span("train.step", cat="train", step=step):
+            step_bucket = telemetry.step_bucket()
+            with tracer.span("train.step", cat="train", step=step), \
+                    telemetry.account(step_bucket):
                 state, metrics = train_step(state, batch, step_rng)
             # logger.log is the step's device sync: this span absorbs the
             # async-dispatched execution train.step only launched
-            with tracer.span("train.metrics_fetch", cat="train", step=step):
+            with tracer.span("train.metrics_fetch", cat="train",
+                             step=step), telemetry.account(step_bucket):
                 logger.log(step, metrics)
+            telemetry.step_complete(step)
             if args.eval_every and (step + 1) % args.eval_every == 0:
                 # structure quality on the last microbatch (the reference's
                 # metrics library, finally wired into a loop)
-                with tracer.span("train.eval", cat="train", step=step):
+                with tracer.span("train.eval", cat="train", step=step), \
+                        telemetry.account("eval"):
                     mb = {k: v[-1] for k, v in batch.items()}
                     out = eval_fwd(
                         state["params"], mb["seq"], mb["mask"], step_rng,
@@ -465,7 +501,8 @@ def main():
                 logger.log(step, scores)  # into the JSONL stream too
                 print("eval  " + "  ".join(f"{k} {v:.4f}" for k, v in scores.items()))
             if mgr is not None:
-                with tracer.span("train.checkpoint", cat="train", step=step):
+                with tracer.span("train.checkpoint", cat="train",
+                                 step=step), telemetry.account("checkpoint"):
                     mgr.save(state)  # save_interval_steps gates the cadence
             if profiling and step + 1 >= prof_end:
                 jax.profiler.stop_trace()
@@ -475,6 +512,7 @@ def main():
             jax.profiler.stop_trace()
         # a crashed or interrupted run keeps its trace — the moment it is
         # most wanted (same stance as the resilient branch)
+        telemetry.close()
         finish_trace(tracer, args)
     logger.close()
     finish(mgr, state)

@@ -24,10 +24,14 @@ from alphafold2_tpu.models import Alphafold2Config
 from alphafold2_tpu.telemetry import (
     CompileTracker,
     MetricRegistry,
+    add_observability_args,
     add_telemetry_args,
+    build_train_telemetry,
     device_memory_gauges,
     finish_trace,
     flops_gauges,
+    observability_enabled,
+    per_process_metrics_path,
     tracer_from_args,
 )
 from alphafold2_tpu.utils import MetricsLogger
@@ -72,6 +76,7 @@ def main():
     ap.add_argument("--ckpt-every", type=int, default=50)
     add_resilience_args(ap)  # --max-restarts / --ckpt-verify / --fault-plan
     add_telemetry_args(ap)   # --trace-out / --trace-max-spans
+    add_observability_args(ap)  # --ops-port / --flight-dir / --federate-every
     ap.add_argument("--metrics-log", default=None, help="JSONL metrics file")
     ap.add_argument("--eval-every", type=int, default=0,
                     help="evaluate held-out distogram loss every N steps "
@@ -241,6 +246,32 @@ def main():
     else:
         batches = stack_microbatches(it, tcfg.grad_accum)
 
+    # --- live training observability (built BEFORE the step so the pod
+    # path can account global-batch assembly into the goodput ledger) ----
+    if args.metrics_log and procs > 1:
+        # per-process sidecars (metrics.p<i>.jsonl): the pod's metrics
+        # stream is no longer a proc-0-only blind spot — federation's
+        # live view gets a durable on-disk twin per host
+        args.metrics_log = per_process_metrics_path(
+            args.metrics_log, jax.process_index())
+    logger = MetricsLogger(
+        args.metrics_log,
+        process_index=jax.process_index() if procs > 1 else None)
+    tracer = tracer_from_args(args)  # NULL_TRACER unless --trace-out
+    # metric registry: live when tracing (the sidecar dump) OR when the
+    # ops plane / flight recorder is mounted; no-op otherwise
+    registry = MetricRegistry(
+        enabled=tracer.enabled or observability_enabled(args))
+    compile_tracker = CompileTracker(registry, tracer=tracer,
+                                     prefix="train_compile")
+    from alphafold2_tpu.utils.flops import train_step_flops
+
+    telemetry = build_train_telemetry(
+        args, registry=registry, tracer=tracer, logger=logger,
+        step_flops=train_step_flops(cfg, args.max_len, 0, 0,
+                                    grad_accum=tcfg.grad_accum),
+    )
+
     assemble = None
     if procs > 1:
         # pod path: the DP(xTP) step over a process-spanning mesh. The
@@ -259,7 +290,7 @@ def main():
         )
         jitted, st_shardings, assemble, _mh_mesh = make_multihost_train_step(
             cfg, tcfg, example_local, tp=False,
-            donate_state=not resilient,
+            donate_state=not resilient, telemetry=telemetry,
         )
         # params replicate identically on every process (same seed /
         # same restored bytes); each process feeds its own shards — no
@@ -274,8 +305,6 @@ def main():
                 yield process_shard(b, axis=1)
 
         batches = _local(batches)
-        if args.metrics_log and jax.process_index() != 0:
-            args.metrics_log = None  # one metrics file, written by proc 0
     elif args.sp_shards:
         # sequence-parallel trunk: the pair grid (not the batch) shards —
         # the regime where crops outgrow one chip (parallel/sp_trunk.py)
@@ -295,15 +324,6 @@ def main():
             make_train_step(cfg, tcfg),
             donate_argnums=() if resilient else (0,),
         )
-    logger = MetricsLogger(args.metrics_log)
-    tracer = tracer_from_args(args)  # NULL_TRACER unless --trace-out
-    # harness profiling registry (no-op without --trace-out): first-step
-    # compile wall time, analytic FLOP gauges, device-memory gauges —
-    # dumped as a sidecar next to the trace
-    registry = MetricRegistry(enabled=tracer.enabled)
-    compile_tracker = CompileTracker(registry, tracer=tracer,
-                                     prefix="train_compile")
-
     if resilient:
         # supervised loop: StepGuard rollback + checkpoint-restore restarts
         # + preemption-safe shutdown (+ the --fault-plan chaos hooks)
@@ -342,7 +362,7 @@ def main():
                 make_rng=lambda i: jax.random.fold_in(base_rng, i),
                 mgr=mgr, on_metrics=logger.log,
                 max_restarts=max_restarts, logger=logger,
-                preemption=handler, tracer=tracer,
+                preemption=handler, tracer=tracer, telemetry=telemetry,
             )
         except Preempted as e:
             # checkpointed + closed by the loop; exit 0 — not a failure
@@ -350,6 +370,7 @@ def main():
             return
         finally:
             handler.uninstall()
+            telemetry.close()
             logger.close()
             finish_trace(tracer, args)  # a preempted run keeps its trace
         if injector is not None and not injector.exhausted():
@@ -399,35 +420,43 @@ def main():
             # per-step key derived from the step index: identical schedule
             # whether the run is fresh or resumed
             step_rng = jax.random.fold_in(base_rng, step)
-            with tracer.span("train.fetch", cat="train", step=step):
+            with tracer.span("train.fetch", cat="train", step=step), \
+                    telemetry.account("data_fetch"):
                 batch = next(batches)
             batch.pop("bucket", None)  # shape bookkeeping, not model input
+            step_bucket = telemetry.step_bucket()
             if step == start and tracer.enabled:
                 # the first call blocks through trace+compile before the
                 # async dispatch: its wall time IS the harness-jit
                 # compile event
                 with compile_tracker.track(kind="train_step"):
-                    with tracer.span("train.step", cat="train", step=step):
+                    with tracer.span("train.step", cat="train", step=step), \
+                            telemetry.account(step_bucket):
                         state, metrics = train_step(state, batch, step_rng)
             else:
-                with tracer.span("train.step", cat="train", step=step):
+                with tracer.span("train.step", cat="train", step=step), \
+                        telemetry.account(step_bucket):
                     state, metrics = train_step(state, batch, step_rng)
             if eval_loss_fn is not None and (step + 1) % args.eval_every == 0:
                 metrics = dict(metrics)
-                with tracer.span("train.eval", cat="train", step=step):
+                with tracer.span("train.eval", cat="train", step=step), \
+                        telemetry.account("eval"):
                     metrics[eval_key] = eval_loss_fn(state["params"],
                                                      eval_batch)
             # logger.log is the step's device sync: the span absorbs the
             # async-dispatched execution train.step only launched
-            with tracer.span("train.metrics_fetch", cat="train", step=step):
+            with tracer.span("train.metrics_fetch", cat="train",
+                             step=step), telemetry.account(step_bucket):
                 logger.log(step, metrics)
+            telemetry.step_complete(step)
             if step % 10 == 0 or step == start + args.steps - 1:
                 dt = time.time() - t0
                 print(f"step {step}  loss {float(metrics['loss']):.4f}  "
                       f"grad_norm {float(metrics['grad_norm']):.3f}  "
                       f"({dt:.1f}s elapsed)")
             if mgr is not None:
-                with tracer.span("train.checkpoint", cat="train", step=step):
+                with tracer.span("train.checkpoint", cat="train",
+                                 step=step), telemetry.account("checkpoint"):
                     mgr.save(state)  # save_interval_steps gates the cadence
         finish(mgr, state)
     finally:
@@ -447,6 +476,8 @@ def main():
             with open(sidecar, "w") as fh:
                 _json.dump(registry.snapshot(), fh, indent=2)
             print(f"wrote {sidecar}")
+        telemetry.close()
+        logger.close()
         finish_trace(tracer, args)
     print("done")
 
