@@ -1,7 +1,7 @@
 """af2lint: in-repo static analysis for a JAX codebase that cannot afford
 runtime discovery of statically detectable breakage.
 
-Seven passes, each a module in this package:
+Eight passes, each a module in this package:
 
   * ``compat``   — AST linter: no `jax.experimental.*` access and no
                    drift-table symbol outside `alphafold2_tpu/compat.py`
@@ -32,7 +32,11 @@ Seven passes, each a module in this package:
   * ``metrics``  — metric-name drift: every name registered at a
                    `.counter(`/`.gauge(`/`.histogram(` call site must be
                    documented in docs/OBSERVABILITY.md's inventory block
-                   and vice versa (metrics_lint.py).
+                   and vice versa (metrics_lint.py);
+  * ``dispatch`` — kernel-dispatch monopoly: every registered hot op has
+                   an `xla_ref` arm and a chip-free parity test, no
+                   direct kernel imports outside ops/, no AF2_* env
+                   reads outside ops/knobs.py (dispatch_lint.py).
 
 CLI: ``python -m alphafold2_tpu.analysis --strict`` (docs/STATIC_ANALYSIS.md).
 """
@@ -92,6 +96,12 @@ def _run_metrics(root, files=None, **_):
     return run(root, files=files)
 
 
+def _run_dispatch(root, files=None, **_):
+    from alphafold2_tpu.analysis.dispatch_lint import run
+
+    return run(root, files=files)
+
+
 # name -> runner(root, files=..., axes=...) -> list[Finding]
 PASSES = {
     "compat": _run_compat,
@@ -101,12 +111,14 @@ PASSES = {
     "overlap": _run_overlap,
     "schedule": _run_schedule,
     "metrics": _run_metrics,
+    "dispatch": _run_dispatch,
 }
 
 # passes that verify whole programs rather than the given files: dropped
 # from file-scoped invocations unless explicitly selected ("metrics"
 # rides here for its docs side: a one-file invocation cannot judge
-# whether a documented name is registered ELSEWHERE)
+# whether a documented name is registered ELSEWHERE; "dispatch" still
+# runs its AST checks file-scoped, so it stays OUT of this set)
 _REPO_WIDE = ("smoke", "overlap", "schedule", "metrics")
 
 

@@ -19,7 +19,7 @@ def main(argv=None) -> int:
         prog="python -m alphafold2_tpu.analysis",
         description="af2lint: JAX-aware static analysis "
         "(compat / trace / sharding / smoke / overlap / schedule / "
-        "metrics)",
+        "metrics / dispatch)",
     )
     ap.add_argument(
         "paths",

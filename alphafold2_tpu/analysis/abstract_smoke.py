@@ -11,7 +11,8 @@ instead of minutes into a paid TPU reservation.
 Each target is a named thunk; a target that raises becomes one SMOKE001
 finding carrying the exception head. Registered targets:
 
-  ops.*        flash / blockwise / dense / axial attention, feed-forward
+  ops.*        flash / blockwise / dense / axial attention, feed-forward,
+               the kernel dispatch registry (ops.dispatch)
   model.*      alphafold2 init+apply at smoke shapes
   serving.*    the serving pipeline + the engine's bucketed batch shapes
   reliability.* fault-plan parse/roundtrip, circuit-breaker transitions,
@@ -284,6 +285,35 @@ def _targets() -> Dict[str, Callable[[], None]]:
         jax.eval_shape(run_xla, abstract((4, 32)), abstract((32, 16)))
         jax.eval_shape(
             lambda w: quantize_weight(w), abstract((3, 32, 16))
+        )
+
+    @register("ops.dispatch")
+    def _dispatch():
+        # registry construction + resolution for every op on every
+        # platform (host arithmetic — no tracing), the introspection
+        # table/tag, and a dispatch-routed op under eval_shape: the
+        # whole resolve path must be trace-safe (ints and env only, no
+        # device reads inside jit)
+        from alphafold2_tpu.ops import dispatch
+        from alphafold2_tpu.ops.flash import flash_attention
+
+        for op in dispatch.ops():
+            spec = dispatch.get(op)
+            arm_names = set(spec.arm_names())
+            assert "xla_ref" in arm_names, op
+            for platform in ("tpu", "gpu", "cpu"):
+                arm = dispatch.resolve(op, request="auto",
+                                       platform=platform, **spec.probe)
+                assert arm in arm_names, (op, platform, arm)
+            # forcing the reference arm never depends on shape support
+            assert dispatch.resolve(op, request=False, platform="cpu",
+                                    **spec.probe) == "xla_ref"
+        assert dispatch.resolution_table()
+        assert dispatch.resolution_tag().startswith("dispatch[")
+        jax.eval_shape(
+            lambda q, k, v: flash_attention(q, k, v, use_kernel="auto"),
+            abstract((2, 16, 2, 8)), abstract((2, 24, 2, 8)),
+            abstract((2, 24, 2, 8)),
         )
 
     @register("serving.quant_residency")

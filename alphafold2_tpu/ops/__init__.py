@@ -4,6 +4,11 @@ KV-compressed / block-sparse), and feed-forward blocks.
 Everything here is a pure function over explicit parameter pytrees — the
 TPU-native answer to the reference's `torch.nn.Module` ops layer
 (reference alphafold2_pytorch/alphafold2.py:30-286).
+
+Hot ops (flash/fused attention, quant matmul, sparse attention, the
+ring hop) resolve their backend arm — pallas_tpu / gpu / xla_ref —
+through ONE registry, `ops/dispatch.py` (`resolve`), with every AF2_*
+env knob defined once in `ops/knobs.py`.
 """
 
 from alphafold2_tpu.ops.core import (
@@ -26,6 +31,11 @@ from alphafold2_tpu.ops.feedforward import (
     feed_forward_init,
     feed_forward_apply,
 )
+from alphafold2_tpu.ops.dispatch import (
+    resolution_table,
+    resolution_tag,
+    resolve,
+)
 from alphafold2_tpu.ops.flash import blockwise_attention, flash_attention
 from alphafold2_tpu.ops.quant import (
     dequantize_tree,
@@ -38,6 +48,9 @@ from alphafold2_tpu.ops.quant import (
 )
 
 __all__ = [
+    "resolution_table",
+    "resolution_tag",
+    "resolve",
     "dequantize_tree",
     "dequantize_weight",
     "quant_matmul",

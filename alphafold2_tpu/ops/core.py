@@ -124,19 +124,14 @@ def pallas_interpret() -> bool:
     BlockSpec/layout errors without a chip); "1"/"true" forces interpret
     mode (kernel debugging on a TPU host); ""/unset falls through to the
     platform default (so `AF2_PALLAS_INTERPRET= cmd` blanks an inherited
-    value); anything else raises.
+    value); anything else raises (parsed in ops/knobs.py — the one home
+    for every AF2_* knob).
     """
-    import os
-
     import jax
 
-    forced = os.environ.get("AF2_PALLAS_INTERPRET")
-    if forced:  # empty string = unset, like AF2_DISABLE_FLASH_KERNEL
-        if forced.lower() in ("0", "false"):
-            return False
-        if forced.lower() in ("1", "true"):
-            return True
-        raise ValueError(
-            f"AF2_PALLAS_INTERPRET must be 0/false or 1/true, got {forced!r}"
-        )
+    from alphafold2_tpu.ops.knobs import pallas_interpret_override
+
+    forced = pallas_interpret_override()
+    if forced is not None:
+        return forced
     return jax.devices()[0].platform != "tpu"

@@ -1,0 +1,509 @@
+"""The kernel dispatch surface: one registry, every hot op, every backend.
+
+HelixFold (arxiv 2207.05477) ran the same model fast on a different
+hardware stack by putting one dispatch surface over per-hardware
+kernels; FastFold (arxiv 2203.00854) chose the execution strategy per
+workload shape. This module is that surface for this repo: every hot op
+(dense/fused flash attention, the int8 fused-dequant matmul, block-
+sparse attention, the ring-attention hop) registers named ARMS —
+
+  * ``pallas_tpu`` — the Pallas Mosaic kernel (interpret mode off-TPU,
+    which is what the chip-free parity tier exercises);
+  * ``gpu``        — the GPU arm. Pallas-Triton lowering for these
+    kernels is not available on this JAX build
+    (`pallas_triton_lowerable`), so the arm is the optimized-XLA
+    blockwise path (the `streamed_fused_attention`-style streaming
+    recurrence) — XLA's GPU fusion pipeline keeps it memory-bounded,
+    and a Triton kernel can slot into the same arm name later;
+  * ``xla_ref``    — the pure-XLA reference arm: runs anywhere,
+    bit-stable, the parity oracle every kernel arm is pinned against.
+
+and resolution happens in ONE place (`resolve`): platform detection ->
+shape gate -> env override. The override generalizes the tri-state
+pattern that used to live in three hand-rolled copies
+(ops/flash.py `kernel_dispatch`, ops/quant.py `quant_dispatch`,
+ops/sparse.py's inline auto block):
+
+  * a caller's ``use_kernel=True/False`` still forces the kernel/XLA arm
+    (loud `ValueError` when forcing an unsupported shape — forcing must
+    never silently fall back);
+  * ``AF2_KERNEL_BACKEND=<arm>`` forces one arm globally,
+    ``AF2_KERNEL_BACKEND_<OP>`` per op (op name upper-cased); ``off``
+    means the op's ``xla_ref`` arm, ``auto``/unset keeps the heuristic
+    (ops/knobs.py `kernel_backend_override`);
+  * legacy per-op knobs (``AF2_QUANT_KERNEL=force/off``, the
+    ``AF2_DISABLE_*_KERNEL`` kill-switches, ``AF2_FLASH_AUTO_MIN_J``)
+    keep their documented meaning — they feed the same single resolver.
+
+`flash_attention()` / `linear()` / `sparse_attention_apply()` /
+`ring_attention()` call sites are unchanged: the op modules ask this
+registry which arm to run and keep their own wiring. af2lint's
+``dispatch`` pass enforces the monopoly: every registered op has an
+``xla_ref`` arm and a registered chip-free parity test, no module
+outside ``ops/`` imports a kernel module directly, and no module
+outside ``ops/knobs.py`` parses an AF2_* env var.
+
+Introspection: ``python -m alphafold2_tpu.ops.dispatch --check`` prints
+the op x arm x resolved-on-this-host table (pinned by
+tests/test_dispatch.py); `resolution_tag()` is the serving config-tag
+fragment that keeps replicas on different arms out of one result-cache
+keyspace (serving/engine.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+
+from alphafold2_tpu.ops import knobs
+
+__all__ = [
+    "ARM_GPU",
+    "ARM_PALLAS_TPU",
+    "ARM_XLA_REF",
+    "Arm",
+    "OpSpec",
+    "get",
+    "main",
+    "ops",
+    "pallas_triton_lowerable",
+    "resolution_table",
+    "resolution_tag",
+    "resolve",
+]
+
+ARM_PALLAS_TPU = "pallas_tpu"
+ARM_GPU = "gpu"
+ARM_XLA_REF = "xla_ref"
+
+# platforms jax reports for the GPU backends
+_GPU_PLATFORMS = ("gpu", "cuda", "rocm")
+
+# measured crossover for the block-sparse kernel (v5e @ block=128:
+# kernel 2.2x faster at n=8192, XLA ~1.3x faster at n=2048 — ops/sparse.py)
+_SPARSE_KERNEL_MIN_N = 4096
+
+
+def pallas_triton_lowerable() -> bool:
+    """Whether this host can LOWER the flash-family kernels through
+    Pallas-Triton. The jax 0.4.x build in this image has no GPU client,
+    so the probe is honest-but-static: False until a CUDA/ROCm backend
+    is present. When it flips, a Triton kernel can register under the
+    existing ``gpu`` arm name — dispatch, env overrides, bench legs, and
+    the parity tier all apply unchanged."""
+    try:
+        return any(d.platform in _GPU_PLATFORMS for d in jax.devices())
+    except RuntimeError:  # no backend at all
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class Arm:
+    """One backend arm of one op.
+
+    `supported(platform, **shapes) -> bool` is the shape/dtype gate —
+    pure host arithmetic (no tracing), so resolution is free and works
+    under `jax.eval_shape`."""
+
+    name: str
+    supported: Callable[..., bool]
+    note: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class OpSpec:
+    """One hot op's dispatch contract.
+
+    `auto(platform, shapes) -> arm name` is the heuristic used when
+    nothing forces an arm; `probe` is the representative shape set the
+    introspection table / serving tag resolve at; `parity_test` names
+    the chip-free parity test function in tests/test_dispatch.py that
+    pins kernel-arm == xla_ref (af2lint's dispatch pass fails CI when
+    the op has none); `legacy_override` adapts a pre-registry env knob
+    (e.g. AF2_QUANT_KERNEL) into the common override channel."""
+
+    name: str
+    arms: Tuple[Arm, ...]
+    auto: Callable[[str, dict], str]
+    probe: Dict[str, object]
+    parity_test: str
+    kernel_arm: str = ARM_PALLAS_TPU
+    legacy_override: Optional[Callable[[], Optional[str]]] = None
+    unsupported_msg: Optional[Callable[[str, dict], str]] = None
+
+    def arm(self, name: str) -> Optional[Arm]:
+        for a in self.arms:
+            if a.name == name:
+                return a
+        return None
+
+    def arm_names(self) -> Tuple[str, ...]:
+        return tuple(a.name for a in self.arms)
+
+
+_REGISTRY: Dict[str, OpSpec] = {}
+
+
+def register(spec: OpSpec) -> OpSpec:
+    if spec.name in _REGISTRY:
+        raise ValueError(f"op {spec.name!r} already registered")
+    if spec.arm(ARM_XLA_REF) is None:
+        # the invariant the dispatch lint enforces repo-wide; refuse to
+        # construct a registry that could not pass it
+        raise ValueError(
+            f"op {spec.name!r} must register an {ARM_XLA_REF} arm"
+        )
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def ops() -> Tuple[str, ...]:
+    """Registered op names, in registration order."""
+    return tuple(_REGISTRY)
+
+
+def get(op: str) -> OpSpec:
+    try:
+        return _REGISTRY[op]
+    except KeyError:
+        raise ValueError(
+            f"unknown dispatch op {op!r}; registered: {list(_REGISTRY)}"
+        ) from None
+
+
+def _platform() -> str:
+    return jax.devices()[0].platform
+
+
+def resolve(op: str, request="auto", platform: Optional[str] = None,
+            **shapes) -> str:
+    """THE resolution point: (op, shapes, platform, env) -> arm name.
+
+    `request` is the call-site tri-state (the old `use_kernel`): True
+    forces the op's kernel arm, False forces `xla_ref`, "auto" consults
+    the env override (AF2_KERNEL_BACKEND_<OP> > AF2_KERNEL_BACKEND >
+    the op's legacy knob) and then the platform/shape heuristic. Forcing
+    an unknown arm or an unsupported shape raises — a forced arm that
+    silently fell back would record one arm's numbers under another's
+    name."""
+    spec = get(op)
+    if platform is None:
+        platform = _platform()
+
+    forced: Optional[str] = None
+    if request is True:
+        forced = spec.kernel_arm
+    elif request is False:
+        forced = ARM_XLA_REF
+    elif request == "auto":
+        override = knobs.kernel_backend_override(op)
+        if override is None and spec.legacy_override is not None:
+            override = spec.legacy_override()
+        if override == "off":
+            forced = ARM_XLA_REF
+        elif override is not None:
+            forced = override
+    else:
+        raise ValueError(
+            f"use_kernel must be True/False/'auto', got {request!r}"
+        )
+
+    if forced is not None:
+        arm = spec.arm(forced)
+        if arm is None:
+            raise ValueError(
+                f"{op}: unknown backend arm {forced!r} "
+                f"(registered: {list(spec.arm_names())}; set "
+                f"AF2_KERNEL_BACKEND[_{op.upper()}] to one of these, "
+                f"'off', or 'auto')"
+            )
+        if not arm.supported(platform, **shapes):
+            if spec.unsupported_msg is not None:
+                raise ValueError(spec.unsupported_msg(forced, shapes))
+            raise ValueError(
+                f"{op}: forced arm {forced!r} does not support "
+                f"{shapes} on platform {platform!r}"
+            )
+        return forced
+
+    arm_name = spec.auto(platform, shapes)
+    assert spec.arm(arm_name) is not None, (op, arm_name)
+    return arm_name
+
+
+# ---------------------------------------------------------------------------
+# registered ops
+# ---------------------------------------------------------------------------
+
+
+def _always(platform, **shapes) -> bool:
+    return True
+
+
+def _flash_supported(platform, *, i, j, dh, **_):
+    from alphafold2_tpu.ops import flash_kernel
+
+    return flash_kernel.supported(i, j, dh)
+
+
+def _fused_supported(platform, *, i, j, dh, **_):
+    from alphafold2_tpu.ops import flash_kernel
+
+    return flash_kernel.supported_fused(i, j, dh)
+
+
+def _flash_unsupported_msg(arm, s):
+    return (
+        f"flash kernel does not support shapes i={s.get('i')}, "
+        f"j={s.get('j')}, dh={s.get('dh')} (row-vector VMEM bound / lane "
+        f"alignment, see ops/flash_kernel.py supported)"
+    )
+
+
+def _flash_family_auto(supported):
+    """The measured flash heuristic, shared by the dense, fused, and
+    ring-hop ops: Pallas on TPU for supported shapes past the short-j
+    crossover (AF2_FLASH_AUTO_MIN_J, kill-switch honored), the GPU arm
+    on GPU platforms, XLA streaming elsewhere."""
+
+    def auto(platform: str, s: dict) -> str:
+        # knobs parse FIRST, unconditionally: a typo'd value must raise
+        # on every host, not only where the knob would have mattered
+        disabled = knobs.flash_kernel_disabled()
+        min_j = knobs.flash_auto_min_j()
+        if (
+            platform == "tpu"
+            and not disabled
+            and s["j"] >= min_j
+            and supported(platform, **s)
+        ):
+            return ARM_PALLAS_TPU
+        if platform in _GPU_PLATFORMS:
+            return ARM_GPU
+        return ARM_XLA_REF
+
+    return auto
+
+
+register(OpSpec(
+    name="flash_attention",
+    arms=(
+        Arm(ARM_PALLAS_TPU, _flash_supported,
+            "ops/flash_kernel.py flash_attention_tpu (interpret off-TPU)"),
+        Arm(ARM_GPU, _always,
+            "XLA blockwise streaming (ops/flash.py blockwise_attention); "
+            "Pallas-Triton slot when lowerable"),
+        Arm(ARM_XLA_REF, _always,
+            "ops/flash.py blockwise_attention — the parity oracle"),
+    ),
+    auto=_flash_family_auto(_flash_supported),
+    probe={"i": 1152, "j": 4096, "dh": 64},
+    parity_test="test_parity_flash_attention",
+    unsupported_msg=_flash_unsupported_msg,
+))
+
+register(OpSpec(
+    name="fused_attention",
+    arms=(
+        Arm(ARM_PALLAS_TPU, _fused_supported,
+            "ops/flash_kernel.py flash_attention_fused (2-D pair bias + "
+            "in-kernel gate)"),
+        Arm(ARM_GPU, _always,
+            "ops/flash.py streamed_fused_attention — the fusion-tuned "
+            "blockwise path"),
+        Arm(ARM_XLA_REF, _always,
+            "ops/flash.py streamed_fused_attention / gate epilogue"),
+    ),
+    auto=_flash_family_auto(_fused_supported),
+    probe={"i": 1152, "j": 4096, "dh": 64},
+    parity_test="test_parity_fused_attention",
+    unsupported_msg=_flash_unsupported_msg,
+))
+
+
+def _quant_supported(platform, *, m, k, n, x_dtype, **_):
+    from alphafold2_tpu.ops.quant_kernel import supported_quant
+
+    return supported_quant(m, k, n, x_dtype)
+
+
+def _quant_auto(platform: str, s: dict) -> str:
+    disabled = knobs.quant_kernel_disabled()  # parse on every host
+    if (
+        platform == "tpu"
+        and not disabled
+        and _quant_supported(platform, **s)
+    ):
+        return ARM_PALLAS_TPU
+    if platform in _GPU_PLATFORMS:
+        return ARM_GPU
+    return ARM_XLA_REF
+
+
+def _quant_legacy_override() -> Optional[str]:
+    ov = knobs.quant_kernel_override()  # AF2_QUANT_KERNEL force/off/auto
+    if ov is None:
+        return None
+    return ARM_PALLAS_TPU if ov else "off"
+
+
+def _quant_unsupported_msg(arm, s):
+    import jax.numpy as jnp
+
+    return (
+        f"quant kernel does not support m={s.get('m')}, k={s.get('k')}, "
+        f"n={s.get('n')}, x_dtype={jnp.dtype(s.get('x_dtype')).name} "
+        f"(f32/bf16 activations, dims <= 2^24 — see ops/quant_kernel.py "
+        f"supported_quant)"
+    )
+
+
+register(OpSpec(
+    name="quant_matmul",
+    arms=(
+        Arm(ARM_PALLAS_TPU, _quant_supported,
+            "ops/quant_kernel.py quant_matmul_tpu — int8 tiles cross HBM, "
+            "dequant in the epilogue"),
+        Arm(ARM_GPU, _always,
+            "ops/quant.py quant_matmul_xla (XLA fuses dequant+matmul on "
+            "GPU; Triton slot when lowerable)"),
+        Arm(ARM_XLA_REF, _always,
+            "ops/quant.py quant_matmul_xla — materialized-dequant "
+            "reference"),
+    ),
+    auto=_quant_auto,
+    probe={"m": 4096, "k": 512, "n": 512, "x_dtype": "float32"},
+    parity_test="test_parity_quant_matmul",
+    legacy_override=_quant_legacy_override,
+    unsupported_msg=_quant_unsupported_msg,
+))
+
+
+def _sparse_auto(platform: str, s: dict) -> str:
+    disabled = knobs.flash_kernel_disabled()  # the shared kill-switch;
+    # parsed on every host so a typo'd value raises everywhere
+    if (
+        platform == "tpu"
+        and not disabled
+        and s["n"] >= _SPARSE_KERNEL_MIN_N
+    ):
+        return ARM_PALLAS_TPU
+    if platform in _GPU_PLATFORMS:
+        return ARM_GPU
+    return ARM_XLA_REF
+
+
+register(OpSpec(
+    name="sparse_attention",
+    arms=(
+        Arm(ARM_PALLAS_TPU, _always,
+            "ops/sparse_kernel.py block_sparse_attention_tpu (blocks "
+            "stream; no per-row residency bound)"),
+        Arm(ARM_GPU, _always,
+            "ops/sparse.py block_sparse_attention — XLA block-gather"),
+        Arm(ARM_XLA_REF, _always,
+            "ops/sparse.py block_sparse_attention — the parity oracle"),
+    ),
+    auto=_sparse_auto,
+    probe={"n": 2048},
+    parity_test="test_parity_sparse_attention",
+))
+
+register(OpSpec(
+    name="merge_lse",
+    arms=(
+        Arm(ARM_PALLAS_TPU, _flash_supported,
+            "ops/flash_kernel.py flash_attention_lse per hop, log-space "
+            "merge (ops/flash.py merge_lse)"),
+        Arm(ARM_GPU, _always,
+            "XLA stream_block hop recurrence (the blockwise streaming "
+            "path)"),
+        Arm(ARM_XLA_REF, _always,
+            "ops/flash.py stream_block hop recurrence"),
+    ),
+    auto=_flash_family_auto(_flash_supported),
+    probe={"i": 512, "j": 512, "dh": 64},
+    parity_test="test_parity_merge_lse",
+    unsupported_msg=_flash_unsupported_msg,
+))
+
+
+# ---------------------------------------------------------------------------
+# introspection: the op x arm x resolved table, the serving tag, the CLI
+# ---------------------------------------------------------------------------
+
+
+def resolution_table(platform: Optional[str] = None):
+    """[(op, probe, {arm: supported@probe}, resolved-or-error)] for this
+    host (or an explicit `platform`), honoring the live env overrides —
+    exactly what `resolve` would do at each op's probe shapes."""
+    if platform is None:
+        platform = _platform()
+    rows = []
+    for name, spec in _REGISTRY.items():
+        supp = {
+            a.name: bool(a.supported(platform, **spec.probe))
+            for a in spec.arms
+        }
+        try:
+            resolved = resolve(name, request="auto", platform=platform,
+                               **spec.probe)
+        except ValueError as e:  # forced-unknown / forced-unsupported env
+            resolved = f"ERROR: {e}"
+        rows.append((name, dict(spec.probe), supp, resolved))
+    return rows
+
+
+def resolution_tag(platform: Optional[str] = None) -> str:
+    """The backend-arm fragment of the serving config tag: which arm each
+    registered op resolves to on this host under the live env. Two
+    replicas whose envs force different arms get different tags, so the
+    result LRU / AOT-executable keyspace never aliases across arms
+    (rounding differs between a kernel and its XLA twin). A malformed
+    override propagates as ValueError — an engine must not build with an
+    unresolvable dispatch env."""
+    if platform is None:
+        platform = _platform()
+    parts = []
+    for name, spec in _REGISTRY.items():
+        arm = resolve(name, request="auto", platform=platform, **spec.probe)
+        parts.append(f"{name}={arm}")
+    return f"dispatch[{platform}](" + ",".join(parts) + ")"
+
+
+def main(argv=None) -> int:
+    """CLI: ``python -m alphafold2_tpu.ops.dispatch --check``."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m alphafold2_tpu.ops.dispatch",
+        description="kernel dispatch registry introspection",
+    )
+    ap.add_argument("--check", action="store_true",
+                    help="print the op x arm x resolved-on-this-host "
+                         "table (the only mode; --check makes intent "
+                         "explicit in runbooks)")
+    ap.add_argument("--platform", default=None,
+                    help="resolve for an explicit platform instead of "
+                         "this host's (tpu/gpu/cpu)")
+    args = ap.parse_args(argv)
+
+    platform = args.platform or _platform()
+    print(f"kernel dispatch registry @ platform={platform} "
+          f"(pallas_triton_lowerable={pallas_triton_lowerable()})")
+    for name, probe, supp, resolved in resolution_table(platform):
+        probe_s = " ".join(f"{k}={v}" for k, v in probe.items())
+        supp_s = " ".join(
+            f"{arm}={'yes' if ok else 'no'}" for arm, ok in supp.items()
+        )
+        print(f"  {name:<17} probe[{probe_s}]  {supp_s}  -> {resolved}")
+    print(f"  tag: {resolution_tag(platform)}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -304,98 +304,59 @@ def streamed_fused_attention(q, k, v, key_bias, pair_bias, gate, scale,
     return out.astype(q.dtype)
 
 
-def kernel_env_disabled() -> bool:
-    """AF2_DISABLE_FLASH_KERNEL kill-switch, shared by BOTH Pallas kernels
-    (dense flash here, block-sparse in ops/sparse.py): bench.py's
-    kernel-off retry must leave no Pallas in the program. "0"/"false"/""
-    mean enabled."""
-    import os
-
-    return os.environ.get(
-        "AF2_DISABLE_FLASH_KERNEL", ""
-    ).lower() not in ("", "0", "false")
-
-
-def gate_epilogue_unfused() -> bool:
-    """AF2_UNFUSE_GATE_EPILOGUE: keep the Pallas kernel for the attention
-    CORE but apply the sigmoid output gate as a separate XLA epilogue
-    (restoring the out-read/multiply/write HBM pass the fused kernel
-    removes). This is the control arm that ISOLATES the epilogue fusion:
-    kernel-on-gated vs kernel-off-gated also carries the whole
-    kernel-core-vs-XLA-streaming delta (measured separately, PERF.md
-    session 4), so bench_sweep's fused_gate_off leg sets this instead of
-    the kill-switch. Trace-time read, like the kill-switch. Gate-only —
-    a 2-D pair bias cannot unfuse onto the plain kernel (the bias shapes
-    the softmax itself; the plain kernel only takes key-side bias)."""
-    import os
-
-    return os.environ.get(
-        "AF2_UNFUSE_GATE_EPILOGUE", ""
-    ).lower() not in ("", "0", "false")
-
-
-# Minimum key length for the Pallas kernel in "auto" mode. Measured on-chip
-# (PERF_SWEEP.jsonl 2026-07-31, depth-12 north-star e2e): blanket kernel
-# dispatch costs 14% end-to-end vs XLA streaming (27.75 vs 24.43 s/step) —
-# at the short-axis self/axial shapes (i=j=1152, many small grid steps) the
-# kernel is grid-overhead-bound, while the long-j streaming shapes NEED it
-# (the XLA streaming program's compile exceeded 550 s there, PERF.md).
-# "auto" therefore prefers XLA streaming below this key length. Pending
-# qb-target tuning legs that may flip the short-j verdict, the threshold is
-# overridable: AF2_FLASH_AUTO_MIN_J=0 force-prefers the kernel everywhere
-# supported (scripts/bench_sweep.py uses this for its kernel-on legs).
-_AUTO_MIN_J = 4096
-
-
-def auto_min_j() -> int:
-    import os
-
-    raw = os.environ.get("AF2_FLASH_AUTO_MIN_J", "")
-    if not raw:
-        return _AUTO_MIN_J
-    try:
-        return int(raw)
-    except ValueError:
-        raise ValueError(
-            f"AF2_FLASH_AUTO_MIN_J must be an integer, got {raw!r}"
-        ) from None
+# The env knobs this module used to parse inline live in ops/knobs.py
+# now (one validated definition per knob); the names are re-exported for
+# existing importers (ops/sparse.py, tests). No env logic here — the
+# af2lint `dispatch` pass enforces that.
+from alphafold2_tpu.ops.knobs import (  # noqa: E402
+    FLASH_AUTO_MIN_J_DEFAULT as _AUTO_MIN_J,
+    flash_auto_min_j as auto_min_j,
+    flash_kernel_disabled as kernel_env_disabled,
+    gate_epilogue_unfused,
+)
 
 
 def kernel_dispatch(i: int, j: int, dh: int, use_kernel,
                     fused: bool = False) -> bool:
-    """Resolve the tri-state `use_kernel` into a concrete decision.
+    """Resolve the tri-state `use_kernel` into a concrete kernel decision.
 
-    THE single gate for the Pallas dense kernel — flash_attention and
-    ring_attention (parallel/sequence.py) both route here, so the
-    AF2_DISABLE_FLASH_KERNEL escape hatch and the loud unsupported-shape
-    error hold everywhere. True forces the kernel (ValueError on
-    unsupported shapes — forcing must not silently fall back), False
-    forces XLA streaming, "auto" = kernel on TPU for supported shapes with
-    j >= auto_min_j() (the measured short-j crossover — see _AUTO_MIN_J),
-    honoring the env kill-switch ("0"/"false" mean enabled). `fused`
-    selects the fused-epilogue kernel's shape gate (supported_fused: 2-D
-    pair bias / in-kernel gating, ops/flash_kernel.py).
+    Thin adapter over the ONE resolution point, ops/dispatch.py
+    `resolve` — flash_attention and ring_attention
+    (parallel/sequence.py) both route here, so the
+    AF2_DISABLE_FLASH_KERNEL escape hatch, the AF2_KERNEL_BACKEND[_<OP>]
+    overrides, and the loud unsupported-shape error hold everywhere.
+    True forces the kernel (ValueError on unsupported shapes — forcing
+    must not silently fall back), False forces XLA streaming, "auto" =
+    the registry heuristic (kernel on TPU for supported shapes with
+    j >= auto_min_j(), the measured short-j crossover). `fused` selects
+    the fused-epilogue op (its shape gate is `supported_fused`:
+    2-D pair bias / in-kernel gating, ops/flash_kernel.py).
     """
+    from alphafold2_tpu.ops import dispatch
+
+    op = "fused_attention" if fused else "flash_attention"
+    return (
+        dispatch.resolve(op, request=use_kernel, i=i, j=j, dh=dh)
+        == dispatch.ARM_PALLAS_TPU
+    )
+
+
+def hop_attention_lse(qf, kf, vf, bias, scale):
+    """One ring hop's normalized (out, lse) through the Pallas kernel —
+    the `merge_lse` op's kernel arm, wrapped here so
+    parallel/sequence.py never imports a kernel module directly (the
+    dispatch lint's import monopoly).
+
+    qf/kf/vf: (BH, n, dh) folded layout; bias: (BH, nk) additive f32.
+    The kernel marks zero-mass rows with +inf lse (its backward
+    convention); for cross-hop combination zero mass must weigh ZERO —
+    flipped to -inf here (the `merge_lse` contract). Returns
+    (out f32, lse f32)."""
     from alphafold2_tpu.ops import flash_kernel
 
-    shape_ok = (
-        flash_kernel.supported_fused if fused else flash_kernel.supported
-    )
-    if kernel_env_disabled() and use_kernel == "auto":
-        use_kernel = False
-    if use_kernel is True and not shape_ok(i, j, dh):
-        raise ValueError(
-            f"flash kernel does not support shapes i={i}, j={j}, dh={dh} "
-            f"(row-vector VMEM bound / lane alignment, see "
-            f"ops/flash_kernel.py supported)"
-        )
-    on_tpu = jax.devices()[0].platform == "tpu"
-    return use_kernel is True or (
-        use_kernel == "auto"
-        and on_tpu
-        and j >= auto_min_j()
-        and shape_ok(i, j, dh)
-    )
+    out_h, lse_h = flash_kernel.flash_attention_lse(qf, kf, vf, bias, scale)
+    lse_h = jnp.where(jnp.isposinf(lse_h), _NEG_INF, lse_h)
+    return out_h.astype(jnp.float32), lse_h
 
 
 def flash_attention(q, k, v, key_bias=None, *, pair_bias=None, gate=None,

@@ -269,61 +269,30 @@ def iter_linear_dicts(params, path: str = ""):
 # ---------------------------------------------------------------------------
 
 
-def quant_kernel_env_disabled() -> bool:
-    """AF2_DISABLE_QUANT_KERNEL kill-switch (auto mode only), same
-    contract as AF2_DISABLE_FLASH_KERNEL: ""/"0"/"false" mean enabled."""
-    import os
-
-    return os.environ.get(
-        "AF2_DISABLE_QUANT_KERNEL", ""
-    ).lower() not in ("", "0", "false")
-
-
-def quant_kernel_override():
-    """AF2_QUANT_KERNEL sweep override for auto-mode dispatch:
-    "force" -> kernel everywhere (loud error on unsupported shapes, like
-    use_kernel=True), "off" -> XLA reference arm, ""/"auto" -> the
-    platform/shape heuristic. scripts/bench_sweep.py's quant legs pin
-    their arms with this so both arms run the SAME attention-kernel
-    policy and differ only in the weight path."""
-    import os
-
-    raw = os.environ.get("AF2_QUANT_KERNEL", "").lower()
-    if raw in ("", "auto"):
-        return None
-    if raw == "force":
-        return True
-    if raw == "off":
-        return False
-    raise ValueError(
-        f"AF2_QUANT_KERNEL must be force, off, or auto/empty, got {raw!r}"
-    )
+# env parsing lives in ops/knobs.py now (one validated definition per
+# knob); re-exported for existing importers. No env logic here — the
+# af2lint `dispatch` pass enforces that.
+from alphafold2_tpu.ops.knobs import (  # noqa: E402
+    quant_kernel_disabled as quant_kernel_env_disabled,
+    quant_kernel_override,
+)
 
 
 def quant_dispatch(m: int, k: int, n: int, x_dtype, use_kernel) -> bool:
     """Resolve tri-state `use_kernel` into a concrete kernel decision —
-    the `kernel_dispatch` pattern (ops/flash.py). True forces the kernel
-    (ValueError on unsupported shapes/dtypes — forcing must not silently
-    fall back), False forces the XLA dequant arm, "auto" = kernel on TPU
-    for supported shapes, honoring the env kill-switch and the
-    AF2_QUANT_KERNEL sweep override."""
-    from alphafold2_tpu.ops.quant_kernel import supported_quant
+    a thin adapter over the ONE resolution point (ops/dispatch.py
+    `resolve`, op "quant_matmul"). True forces the kernel (ValueError on
+    unsupported shapes/dtypes — forcing must not silently fall back),
+    False forces the XLA dequant arm, "auto" = the registry heuristic
+    (kernel on TPU for supported shapes), honoring the env kill-switch,
+    the legacy AF2_QUANT_KERNEL sweep override, and the
+    AF2_KERNEL_BACKEND[_QUANT_MATMUL] overrides."""
+    from alphafold2_tpu.ops import dispatch
 
-    if use_kernel == "auto":
-        ov = quant_kernel_override()
-        if ov is not None:
-            use_kernel = ov
-        elif quant_kernel_env_disabled():
-            use_kernel = False
-    if use_kernel is True and not supported_quant(m, k, n, x_dtype):
-        raise ValueError(
-            f"quant kernel does not support m={m}, k={k}, n={n}, "
-            f"x_dtype={jnp.dtype(x_dtype).name} (f32/bf16 activations, "
-            f"dims <= 2^24 — see ops/quant_kernel.py supported_quant)"
-        )
-    on_tpu = jax.devices()[0].platform == "tpu"
-    return use_kernel is True or (
-        use_kernel == "auto" and on_tpu and supported_quant(m, k, n, x_dtype)
+    return (
+        dispatch.resolve("quant_matmul", request=use_kernel,
+                         m=m, k=k, n=n, x_dtype=x_dtype)
+        == dispatch.ARM_PALLAS_TPU
     )
 
 

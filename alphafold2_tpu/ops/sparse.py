@@ -195,20 +195,18 @@ def sparse_attention_apply(
     ~1.3x faster at n=2048 — crossover around n=4096).
     """
     b, n, _ = x.shape
-    if isinstance(use_kernel, str):
-        if use_kernel != "auto":
-            raise ValueError(f"use_kernel must be True/False/'auto', got {use_kernel!r}")
-        from alphafold2_tpu.ops.flash import kernel_env_disabled
+    # ONE resolution point (ops/dispatch.py, op "sparse_attention"):
+    # the shared AF2_DISABLE_FLASH_KERNEL kill-switch covers every
+    # flash-family Pallas arm, AF2_KERNEL_BACKEND[_SPARSE_ATTENTION]
+    # forces an arm, and auto picks the kernel only on real TPUs past
+    # the measured n >= 4096 crossover (off-TPU it would run in the
+    # Pallas interpreter, orders of magnitude slower than the XLA path)
+    from alphafold2_tpu.ops import dispatch
 
-        # the shared AF2_DISABLE_FLASH_KERNEL kill-switch covers BOTH
-        # Pallas kernels; auto otherwise picks the kernel only on real
-        # TPUs (off-TPU it would run in the Pallas interpreter, orders of
-        # magnitude slower than the XLA path)
-        use_kernel = (
-            not kernel_env_disabled()
-            and n >= 4096
-            and jax.devices()[0].platform == "tpu"
-        )
+    use_kernel = (
+        dispatch.resolve("sparse_attention", request=use_kernel, n=n)
+        == dispatch.ARM_PALLAS_TPU
+    )
     dtype = cfg.dtype
     bs = scfg.block_size
 

@@ -43,20 +43,20 @@ def initialize_from_env(
     """Join the multi-host runtime if one is configured; else no-op.
 
     Reads AF2_COORDINATOR / AF2_NUM_PROCESSES / AF2_PROCESS_ID (explicit
-    args win), or AF2_AUTO_INIT=1 for TPU-pod auto-detection. Must run
+    args win), or AF2_AUTO_INIT=1 for TPU-pod auto-detection — all
+    parsed by ops/knobs.py, the one home for every AF2_* knob. Must run
     before any backend-initializing JAX call. Returns True when the
     distributed runtime was initialized.
     """
-    coordinator = coordinator or os.environ.get("AF2_COORDINATOR")
-    if num_processes is None:
-        num_processes = int(os.environ.get("AF2_NUM_PROCESSES", "0") or 0)
-    if process_id is None:
-        pid_env = os.environ.get("AF2_PROCESS_ID")
-        process_id = int(pid_env) if pid_env is not None else None
+    from alphafold2_tpu.ops import knobs
 
-    will_init = (coordinator and num_processes > 1) or (
-        os.environ.get("AF2_AUTO_INIT") == "1"
-    )
+    coordinator = coordinator or knobs.coordinator()
+    if num_processes is None:
+        num_processes = knobs.num_processes()
+    if process_id is None:
+        process_id = knobs.process_id()
+
+    will_init = (coordinator and num_processes > 1) or knobs.auto_init()
     if will_init and compat.backend_initialized():
         # joining AFTER backend init would leave this process on its
         # local-only device view while claiming pod membership — every
@@ -83,7 +83,7 @@ def initialize_from_env(
             local_device_ids=local_device_ids,
         )
         return True
-    if os.environ.get("AF2_AUTO_INIT") == "1":
+    if knobs.auto_init():
         jax.distributed.initialize()  # TPU-pod metadata auto-detection
         return True
     return False

@@ -43,7 +43,8 @@ OVERLAP_ENV = "AF2_COMM_OVERLAP"
 
 def overlap_enabled(override=None) -> bool:
     """Resolve the overlap knob: an explicit True/False wins; None reads
-    `AF2_COMM_OVERLAP` (default ON — "0"/"false"/"off" disable).
+    `AF2_COMM_OVERLAP` (default ON — "0"/"false"/"off" disable; parsed
+    in ops/knobs.py, the one home for every AF2_* knob).
 
     Read at TRACE time: a jitted program bakes the schedule in, so A/B
     harnesses must set the env before tracing (the dryrun and sweep legs
@@ -51,7 +52,9 @@ def overlap_enabled(override=None) -> bool:
     """
     if override is not None:
         return bool(override)
-    return os.environ.get(OVERLAP_ENV, "1").lower() not in ("0", "false", "off")
+    from alphafold2_tpu.ops.knobs import comm_overlap_enabled
+
+    return comm_overlap_enabled()
 
 
 # --- gradient bucketing -----------------------------------------------------

@@ -35,9 +35,10 @@ import jax
 import jax.numpy as jnp
 
 from alphafold2_tpu import compat
+from alphafold2_tpu.ops import dispatch as _dispatch
 from alphafold2_tpu.ops.flash import (
     flash_attention as _flash_attention,
-    kernel_dispatch as _kernel_dispatch,
+    hop_attention_lse as _hop_attention_lse,
     merge_lse as _merge_lse,
     stream_block as _stream_block,
 )
@@ -104,9 +105,13 @@ def ring_attention(q, k, v, axis_name: str, mask=None, use_kernel="auto",
     )
     perm = [(i, (i + 1) % num_shards) for i in range(num_shards)]
 
-    # the SHARED gate (ops/flash.py): honors AF2_DISABLE_FLASH_KERNEL and
-    # raises loudly when forcing an unsupported shape
-    if _kernel_dispatch(n_local, nk_local, d, use_kernel):
+    # the SHARED resolution point (ops/dispatch.py, op "merge_lse" — the
+    # ring hop's registered name): honors AF2_DISABLE_FLASH_KERNEL and
+    # the AF2_KERNEL_BACKEND[_MERGE_LSE] overrides, and raises loudly
+    # when forcing an unsupported shape
+    if _dispatch.resolve(
+        "merge_lse", request=use_kernel, i=n_local, j=nk_local, dh=d
+    ) == _dispatch.ARM_PALLAS_TPU:
         return _ring_attention_kernel(
             q, k, v, bias, axis_name, scale, num_shards, perm, overlap
         )
@@ -169,9 +174,10 @@ def _ring_attention_kernel(q, k, v, bias, axis_name, scale, num_shards, perm,
     and hops merge in log space (ops/flash.py merge_lse — the shared hop
     interface). The communication pattern is identical to the XLA path
     (P-1 neighbor ppermutes, double-buffered when `overlap`), only the
-    per-hop compute is fused."""
-    from alphafold2_tpu.ops.flash_kernel import flash_attention_lse
-
+    per-hop compute is fused. The kernel entry is ops/flash.py
+    `hop_attention_lse` (zero-mass lse sign flip included) — this module
+    never imports a kernel module directly (the dispatch lint's import
+    monopoly)."""
     b, n_local, h, d = q.shape
 
     def fold(t):
@@ -180,14 +186,9 @@ def _ring_attention_kernel(q, k, v, bias, axis_name, scale, num_shards, perm,
     qf = fold(q)
 
     def hop_compute(kf, vf, bias_blk):
-        out_h, lse_h = flash_attention_lse(
+        return _hop_attention_lse(
             qf, kf, vf, jnp.repeat(bias_blk, h, axis=0), scale
         )
-        # the kernel marks zero-mass rows with +inf lse (backward
-        # convention); for cross-hop combination zero mass must weigh
-        # ZERO — flip to -inf (the merge_lse contract)
-        lse_h = jnp.where(jnp.isposinf(lse_h), _NEG_INF, lse_h)
-        return out_h.astype(jnp.float32), lse_h
 
     kf0, vf0 = fold(k), fold(v)
 

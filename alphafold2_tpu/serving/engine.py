@@ -55,6 +55,9 @@ from alphafold2_tpu.serving.bucketing import (
     BucketLadder,
     pad_batch,
 )
+from alphafold2_tpu.ops.dispatch import (
+    resolution_tag as dispatch_resolution_tag,
+)
 from alphafold2_tpu.serving.cache import ResultCache, request_key
 from alphafold2_tpu.reliability.breaker import CircuitBreaker
 from alphafold2_tpu.serving.errors import (
@@ -330,9 +333,17 @@ class ServingEngine:
         # the AOT executables, and the fleet's shared-tag bit-exactness
         # pin must never alias results across them (tests/test_serving.py
         # pins all three)
+        # ... and the RESOLVED kernel backend arms (ops/dispatch.py):
+        # a kernel arm and its XLA twin agree only to rounding, so two
+        # replicas whose envs force different arms (AF2_KERNEL_BACKEND*)
+        # must never share one result-cache / executable keyspace.
+        # Resolved once at build — the same trace-time-baked contract as
+        # the env knobs themselves (tests/test_serving.py pins the
+        # aliasing both ways).
+        self._dispatch_tag = dispatch_resolution_tag()
         self._config_tag = repr((
             model_cfg, cfg.mds_iters, cfg.mds_init, cfg.seed, cfg.msa_rows,
-            cfg.params_tag, self._ladder.buckets,
+            cfg.params_tag, self._ladder.buckets, self._dispatch_tag,
         ))
 
         self._executables = {}
@@ -629,6 +640,10 @@ class ServingEngine:
         snap["max_batch"] = self.cfg.max_batch
         snap["closed"] = self._closed
         snap["weights"] = dict(self._weight_residency)
+        # which backend arm each hot op resolved to at build (part of the
+        # config tag — operators reading stats() can see WHY two replicas
+        # refuse to share a cache keyspace)
+        snap["dispatch"] = self._dispatch_tag
         if self._breaker is not None:
             snap["breaker"] = self._breaker.snapshot()
         # the unified telemetry view: every registry metric (per-bucket

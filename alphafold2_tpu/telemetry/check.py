@@ -20,12 +20,15 @@ Accepted snapshot formats (auto-detected, see `load_metrics`):
     nothing and passes, loudly);
   * sweep artifacts (PERF_SWEEP.jsonl / a single sweep row): JSON-lines
     of {"bench": leg, "result": {...}} — every leg's numeric results
-    key under "<leg>.<metric>" (latest row per leg wins; error/skip
-    rows are dropped), so e.g. `branch_parallel_on.sec_per_step` and
-    `fused_gate_on.sec_per_step` gate automatically once a chip records
-    them. Multi-line workers record LIST results (the micro kernel
-    grid): each element keys under "<leg>.<its string fields>.<metric>"
-    and gates like any scalar leg;
+    key under "<leg>.<metric>", PLATFORM-QUALIFIED to
+    "<leg>.<platform>[.<backend_arm>].<metric>" when the row carries
+    the cross-backend matrix fields (latest row per leg+platform+arm
+    wins; error/skip rows are dropped), so e.g.
+    `disp_flash_attention_xla_ref.cpu.xla_ref.sec_per_iter` gates
+    against CPU baselines ONLY — a CPU row can never diff against a TPU
+    row of the same leg. Multi-line workers record LIST results (the
+    micro kernel grid): each element keys under
+    "<leg>.<its string fields>.<metric>" and gates like any scalar leg;
   * any nested dict of numerics (engine stats / registry snapshots),
     flattened to dotted paths.
 
@@ -159,15 +162,27 @@ def rule_for(name: str, rules=_RULES) -> Optional[Tuple[str, float]]:
 def _sweep_rows_to_metrics(rows) -> Dict[str, float]:
     """Sweep rows ({"bench": leg, "result": {...}}) -> flat metrics.
 
-    Later rows win per leg (a re-run supersedes its predecessor); rows
-    with a null/error result or a structured skip contribute nothing.
-    Multi-line workers (the micro kernel grid) record a LIST result —
-    each element gates too, qualified by ALL its string fields joined
-    in key-sorted order (dir/path/platform/shape -> e.g. `micro_kernel
-    .fwd.kernel.tpu.B32_n1152_h8_dh64.sec_per_iter`), and regression-
-    gates like any scalar leg; publish exactly that produced name into
-    BASELINE.json (compare() intersects names), not a hand-reordered
-    one."""
+    Later rows win per (leg, platform, arm) — a re-run supersedes its
+    predecessor; rows with a null/error result or a structured skip
+    contribute nothing. Multi-line workers (the micro kernel grid)
+    record a LIST result — each element gates too, qualified by ALL its
+    string fields joined in key-sorted order (dir/path/platform/shape ->
+    e.g. `micro_kernel.fwd.kernel.tpu.B32_n1152_h8_dh64.sec_per_iter`),
+    and regression-gates like any scalar leg; publish exactly that
+    produced name into BASELINE.json (compare() intersects names), not
+    a hand-reordered one.
+
+    PLATFORM QUALIFICATION (the cross-backend matrix contract): a scalar
+    result carrying BOTH the `platform` and `backend_arm` string fields
+    keys under `<leg>.<platform>.<backend_arm>.<metric>` — so a CPU
+    `xla_ref` row can NEVER gate against a TPU `pallas_tpu` baseline of
+    the same leg (disjoint names fall out of compare()'s intersection),
+    and the same leg accumulates one gateable trajectory PER backend.
+    Rows recorded before the matrix existed carry no `backend_arm`
+    field (some carry `platform` alone) and keep their historical
+    unqualified names — requiring both fields is what keeps published
+    baselines of those legs gating until the leg re-records under the
+    matrix contract."""
     flat: Dict[str, float] = {}
 
     def add(prefix: str, res: dict, qualify: bool) -> None:
@@ -176,13 +191,18 @@ def _sweep_rows_to_metrics(rows) -> Dict[str, float]:
         if qualify:
             # list elements need distinct names: qualify by the
             # element's string fields (stable — worker grids are
-            # deterministic code). Scalar dict results keep their
-            # historical unqualified names.
+            # deterministic code); platform/backend_arm are among them.
             ident = ".".join(
                 res[k] for k in sorted(res) if isinstance(res[k], str)
             )
             if ident:
                 prefix = f"{prefix}.{ident}"
+        elif (isinstance(res.get("platform"), str)
+                and isinstance(res.get("backend_arm"), str)):
+            # scalar matrix rows: platform + arm qualification only —
+            # the rest of their historical names must stay stable
+            prefix = (f"{prefix}.{res['platform']}"
+                      f".{res['backend_arm']}")
         for k, v in res.items():
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 flat[f"{prefix}.{k}"] = float(v)

@@ -132,9 +132,17 @@ state, loss = compiled(state, batch, jax.random.PRNGKey(2))
 loss = float(np.asarray(loss))
 dt = time.perf_counter() - t0
 assert np.isfinite(loss), loss
+# the cross-backend matrix contract: every row records WHICH arm ran —
+# resolved by the registry at the axial folded shape this leg's
+# attention actually hits (crop*3 x crop*3), under the leg's env policy
+from alphafold2_tpu.ops import dispatch as _dispatch
+backend_arm = _dispatch.resolve("flash_attention", request="auto",
+                                i=crop * 3, j=crop * 3,
+                                dh=ecfg.model.dim_head)
 print(json.dumps({"sec_per_step": round(dt, 2), "loss": round(loss, 4),
                   "weight_hbm_bytes": weight_hbm_bytes,
-                  "platform": jax.devices()[0].platform}))
+                  "platform": jax.devices()[0].platform,
+                  "backend_arm": backend_arm}))
 """
 
 
@@ -205,9 +213,22 @@ for i in range(iters):
 c.block_until_ready()
 dt = (time.perf_counter() - t0) / iters
 assert np.isfinite(np.asarray(c)).all()
+# record which arm actually served the weight path (the int8 arm pins
+# AF2_QUANT_KERNEL=force above, so the resolver must answer pallas_tpu
+# or raise; the f32 arm has no quant op in the program — record the
+# attention arm it rode instead)
+from alphafold2_tpu.ops import dispatch as _dispatch
+if spec["weight_dtype"] == "int8":
+    backend_arm = _dispatch.resolve("quant_matmul", request="auto",
+                                    m=L, k=cfg.dim, n=cfg.dim,
+                                    x_dtype=jnp.float32)
+else:
+    backend_arm = _dispatch.resolve("flash_attention", request="auto",
+                                    i=L * 3, j=L * 3, dh=cfg.dim_head)
 print(json.dumps({"sec_per_iter": round(dt, 3),
                   "weight_hbm_bytes": weight_hbm_bytes,
-                  "platform": jax.devices()[0].platform}))
+                  "platform": jax.devices()[0].platform,
+                  "backend_arm": backend_arm}))
 """
 
 
@@ -238,7 +259,14 @@ from alphafold2_tpu.ops.quant import (
 )
 from alphafold2_tpu.training import north_star_e2e_config
 
-out = {"platform": jax.devices()[0].platform}
+from alphafold2_tpu.ops import dispatch as _dispatch
+
+out = {"platform": jax.devices()[0].platform,
+       # which arm the quant matmuls below actually resolve to on this
+       # host (cross-backend matrix field — platform-qualifies the row)
+       "backend_arm": _dispatch.resolve("quant_matmul", request="auto",
+                                        m=32, k=32, n=32,
+                                        x_dtype=jnp.float32)}
 
 # 1) residency at the NORTH-STAR preset — pure shape arithmetic
 ecfg, crop, msa_rows = north_star_e2e_config(spec.get("depth", 12))
@@ -369,6 +397,7 @@ exec_busy = summary.get("serving.execute", {}).get("total_s", 0.0)
 fleet.shutdown(drain=True)
 assert feat_busy > 0 and exec_busy > 0, (feat_busy, exec_busy)
 ratio = (feat_busy + exec_busy) / wall
+from alphafold2_tpu.ops import dispatch as _dispatch
 print(json.dumps({
     "featurize_overlap_ratio": round(ratio, 3),
     "featurize_busy_s": round(feat_busy, 3),
@@ -376,6 +405,8 @@ print(json.dumps({
     "wall_s": round(wall, 3),
     "n_requests": n,
     "platform": jax.devices()[0].platform,
+    "backend_arm": _dispatch.resolve("flash_attention", request="auto",
+                                     i=32, j=32, dh=8),
 }))
 """
 
@@ -453,6 +484,7 @@ assert stall_s >= delay, ("injected stall not booked as data-stall "
                           "badput", stall_s)
 bundles = recorder.snapshot()["bundles"]
 assert any("train_data_stall" in b for b in bundles), bundles
+from alphafold2_tpu.ops import dispatch as _dispatch
 print(json.dumps({
     "goodput_ratio": round(snap["goodput_ratio"], 4),
     "data_stall_badput_s": round(stall_s, 3),
@@ -460,7 +492,145 @@ print(json.dumps({
     "steps_per_sec": round(steps / snap["wall_s"], 3),
     "n_steps": steps,
     "platform": jax.devices()[0].platform,
+    "backend_arm": _dispatch.resolve("flash_attention", request="auto",
+                                     i=16, j=16, dh=8),
 }))
+"""
+
+
+# Cross-backend dispatch matrix (ISSUE 13 tentpole): one leg per
+# (hot op, backend arm) over the ops/dispatch.py registry. The arm is
+# pinned via AF2_KERNEL_BACKEND_<OP> and VERIFIED against the resolver
+# (a leg that silently resolved elsewhere would record one arm's numbers
+# under another's name — the worker asserts instead). xla_ref legs run
+# on ANY host — which is the point: the CPU-degraded tunnel finally
+# produces real, platform-qualified timed rows (telemetry.check keys
+# them `<leg>.<platform>.<backend_arm>.<metric>`, so they gate against
+# CPU baselines only). pallas_tpu / gpu legs carry require_platform and
+# record structured skips until that hardware answers — armed, never
+# silenced (skips are not "done").
+DISPATCH_WORKER = r"""
+import json, sys, time, os
+spec = json.loads(sys.argv[1])
+op, arm = spec["op"], spec["arm"]
+os.environ["AF2_KERNEL_BACKEND_" + op.upper()] = arm
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+platform = jax.devices()[0].platform
+base = {"op": op, "backend_arm": arm, "platform": platform}
+need = spec.get("require_platform")
+# "gpu" must admit every GPU spelling jax reports (cuda/rocm on newer
+# builds) — the registry's own platform set, mirrored in the worker
+satisfied = {"gpu": ("gpu", "cuda", "rocm")}.get(need, (need,))
+if need and platform not in satisfied:
+    print(json.dumps({**base, "skipped": f"leg requires a {need} device"}))
+    sys.exit(0)
+
+from alphafold2_tpu.ops import dispatch
+
+iters = spec.get("iters", 5)
+key = jax.random.PRNGKey(0)
+
+
+def timeit(fn, *args):
+    compiled = jax.jit(fn).lower(*args).compile()
+    np.asarray(jax.tree_util.tree_leaves(compiled(*args))[0])  # warmup
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = compiled(*args)
+    jax.tree_util.tree_leaves(out)[0].block_until_ready()
+    return (time.perf_counter() - t0) / iters
+
+
+if op in ("flash_attention", "fused_attention"):
+    from alphafold2_tpu.ops.flash import flash_attention
+
+    B, i, j, h, dh = 8, 512, 512, 8, 64
+    ks = jax.random.split(key, 5)
+    q = jax.random.normal(ks[0], (B, i, h, dh))
+    k = jax.random.normal(ks[1], (B, j, h, dh))
+    v = jax.random.normal(ks[2], (B, j, h, dh))
+    resolved = dispatch.resolve(op, request="auto", i=i, j=j, dh=dh)
+    assert resolved == arm, (resolved, arm)
+    if op == "flash_attention":
+        dt = timeit(lambda q, k, v: flash_attention(q, k, v), q, k, v)
+    else:
+        pair_bias = jax.random.normal(ks[3], (B, h, i, j))
+        gate = jax.random.normal(ks[4], (B, i, h, dh))
+        dt = timeit(
+            lambda q, k, v, pb, g: flash_attention(
+                q, k, v, pair_bias=pb, gate=g), q, k, v, pair_bias, gate)
+    shape = f"B{B}_i{i}_j{j}_h{h}_dh{dh}"
+elif op == "quant_matmul":
+    from alphafold2_tpu.ops.quant import quant_matmul, quantize_weight
+
+    m, kk, n = 2048, 512, 512
+    x = jax.random.normal(key, (m, kk))
+    qw, scale = quantize_weight(
+        jax.random.normal(jax.random.PRNGKey(1), (kk, n)))
+    resolved = dispatch.resolve(op, request="auto", m=m, k=kk, n=n,
+                                x_dtype=x.dtype)
+    assert resolved == arm, (resolved, arm)
+    dt = timeit(lambda x, qw, s: quant_matmul(x, qw, s), x, qw, scale)
+    shape = f"m{m}_k{kk}_n{n}"
+elif op == "sparse_attention":
+    from alphafold2_tpu.ops.attention import AttentionConfig, attention_init
+    from alphafold2_tpu.ops.sparse import SparseConfig, sparse_attention_apply
+
+    n, dim = 1024, 128
+    cfg = AttentionConfig(dim=dim, heads=4, dim_head=32)
+    scfg = SparseConfig(block_size=16, max_seq_len=2048)
+    params = attention_init(key, cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, n, dim))
+    resolved = dispatch.resolve(op, request="auto", n=n)
+    assert resolved == arm, (resolved, arm)
+    dt = timeit(
+        lambda p, x: sparse_attention_apply(p, cfg, scfg, x), params, x)
+    shape = f"n{n}_dim{dim}_bs{scfg.block_size}"
+elif op == "merge_lse":
+    # one simulated 2-hop ring on plain arrays: exactly the per-hop
+    # compute each arm runs inside parallel/sequence.py's fori_loop,
+    # without needing a mesh on this host
+    from alphafold2_tpu.ops.flash import (
+        hop_attention_lse, merge_lse, stream_block)
+
+    BH, n, dh = 16, 512, 64
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (BH, n, dh))
+    k1, k2 = jnp.split(jax.random.normal(ks[1], (BH, 2 * n, dh)), 2, axis=1)
+    v1, v2 = jnp.split(jax.random.normal(ks[2], (BH, 2 * n, dh)), 2, axis=1)
+    bias = jnp.zeros((BH, n), jnp.float32)
+    scale = dh ** -0.5
+    resolved = dispatch.resolve(op, request="auto", i=n, j=n, dh=dh)
+    assert resolved == arm, (resolved, arm)
+    if resolved == "pallas_tpu":
+        def hops(q, k1, v1, k2, v2, bias):
+            out, lse = hop_attention_lse(q, k1, v1, bias, scale)
+            out2, lse2 = hop_attention_lse(q, k2, v2, bias, scale)
+            return merge_lse(out, lse, out2, lse2)[0]
+    else:
+        # the stream_block recurrence both XLA-family arms run
+        def hops(q, k1, v1, k2, v2, bias):
+            q4 = q.reshape(BH, n, 1, dh)
+            m0 = jnp.full((BH, 1, n), float("-inf"), jnp.float32)
+            l0 = jnp.zeros((BH, 1, n), jnp.float32)
+            a0 = jnp.zeros((BH, 1, n, dh), jnp.float32)
+            m, l, a = stream_block(q4, k1.reshape(BH, n, 1, dh),
+                                   v1.reshape(BH, n, 1, dh), bias,
+                                   m0, l0, a0, scale)
+            m, l, a = stream_block(q4, k2.reshape(BH, n, 1, dh),
+                                   v2.reshape(BH, n, 1, dh), bias,
+                                   m, l, a, scale)
+            return a / jnp.where(l > 0, l, 1.0)[..., None]
+    dt = timeit(hops, q, k1, v1, k2, v2, bias)
+    shape = f"BH{BH}_n{n}_dh{dh}_hops2"
+else:
+    raise ValueError(f"unknown dispatch op {op!r}")
+
+print(json.dumps({**base, "sec_per_iter": round(dt, 5), "shape": shape,
+                  "iters": iters}))
 """
 
 
@@ -498,8 +668,15 @@ from alphafold2_tpu.training import (
 )
 from alphafold2_tpu.training.harness import train_state_init
 
+from alphafold2_tpu.ops import dispatch as _dispatch
+
 iters = spec.get("iters", 10)
-out = {"devices": n_dev, "overlap": spec["overlap"]}
+out = {"devices": n_dev, "overlap": spec["overlap"],
+       "platform": jax.devices()[0].platform,
+       # the per-hop arm the ring legs below resolve to (per-shard key
+       # length 512) — the cross-backend matrix field
+       "backend_arm": _dispatch.resolve("merge_lse", request="auto",
+                                        i=512, j=512, dh=64)}
 
 # ring attention: per-shard 512 keys x 8 heads x 64 dh — big enough that
 # the per-hop transfer is bandwidth-bound, P-1 hops around the full ring
@@ -621,6 +798,30 @@ def run_and_record(name, code_or_path, argv, timeout, extra=None):
     return True, res
 
 
+# the ops/dispatch.py registry, mirrored here so the orchestrator never
+# imports jax (worker isolation — a wedged backend must not take the
+# sweep down). Drift is loud, not silent: each worker asserts
+# dispatch.resolve(op, ...) == the leg's pinned arm, so a renamed or
+# removed op fails its leg instead of recording misattributed rows.
+DISPATCH_OPS = ("flash_attention", "fused_attention", "quant_matmul",
+                "sparse_attention", "merge_lse")
+
+
+def dispatch_matrix_legs():
+    """(name, spec) for the op x arm cross-backend matrix: xla_ref runs
+    on ANY host (real CPU rows today); pallas_tpu / gpu legs stay armed
+    behind structured skips until that hardware answers a probe."""
+    legs = []
+    for op in DISPATCH_OPS:
+        legs.append((f"disp_{op}_xla_ref", {"op": op, "arm": "xla_ref"}))
+        legs.append((f"disp_{op}_pallas_tpu",
+                     {"op": op, "arm": "pallas_tpu",
+                      "require_platform": "tpu"}))
+        legs.append((f"disp_{op}_gpu",
+                     {"op": op, "arm": "gpu", "require_platform": "gpu"}))
+    return legs
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -628,6 +829,10 @@ def main():
     ap.add_argument("--depth", type=int, default=12)
     ap.add_argument("--skip-micro", action="store_true",
                     help="e2e knob sweep only")
+    ap.add_argument("--dispatch-only", action="store_true",
+                    help="run only the cross-backend dispatch matrix "
+                         "(op x arm) legs — chip-free xla_ref rows "
+                         "record on any host")
     ap.add_argument("--xla-micro", action="store_true",
                     help="also run the XLA-streaming micro leg (known to "
                          "compile >550s at the chunk shape — see PERF.md; "
@@ -668,6 +873,24 @@ def main():
                     key = done_key(e.get("bench"), e.get("spec"))
                     done.add(key)
                     prior[key] = e["result"]
+
+    # 1d) cross-backend dispatch matrix (ISSUE 13). In --dispatch-only
+    # mode it is the whole run; otherwise it runs AFTER the e2e legs
+    # (healthy-tunnel minutes go to the big measurements first).
+    def run_dispatch_matrix():
+        for name, spec in dispatch_matrix_legs():
+            if done_key(name, spec) in done:
+                print(f"skip {name}: already recorded in {OUT}", flush=True)
+                continue
+            ok, _ = run_and_record(name, DISPATCH_WORKER,
+                                   [json.dumps(spec)], timeout=900,
+                                   extra={"spec": spec})
+            if not ok:
+                sys.exit(3)  # wedged-tunnel code: watchers retry later
+
+    if args.dispatch_only:
+        run_dispatch_matrix()
+        return
 
     # 1) e2e step-time sweep FIRST: it is the sweep's purpose, and a hang
     # in any later micro leg must not cost these measurements. Order is
@@ -846,6 +1069,9 @@ def main():
                                timeout=timeout, extra={"spec": spec})
         if not ok:
             sys.exit(3)  # wedged-tunnel code: watchers retry later
+
+    # 1d) the cross-backend dispatch matrix (see run_dispatch_matrix)
+    run_dispatch_matrix()
 
     # 2) kernel microbench + block-size tuning at the chunk shape the model
     # actually calls (attn_batch_chunk=32 folded rows x 8 heads): the

@@ -1,0 +1,596 @@
+"""The kernel dispatch surface (ops/dispatch.py + ops/knobs.py, PR 13).
+
+Three layers of pinning:
+
+  * **Chip-free parity tier** — for every registered op, the kernel arm
+    (Pallas interpret mode on this CPU host) must equal the `xla_ref`
+    arm, over f32/bf16 and at least one PADDED shape (not a block
+    multiple). These are the tests the af2lint `dispatch` pass requires
+    every op to register — an op without one fails CI.
+  * **Resolution semantics** — the ONE resolver's contract: caller
+    forcing, AF2_KERNEL_BACKEND global/per-op overrides, legacy knob
+    adaptation, loud errors on unknown arms / unsupported shapes, and
+    the introspection CLI output.
+  * **The lint pass itself** — fires on fixture violations (missing
+    xla_ref arm, unregistered parity test, kernel import outside ops/,
+    AF2_* env read outside knobs.py) and stays silent on this repo.
+
+Plus the cross-backend bench-matrix contract: sweep rows carrying
+platform/backend_arm fields gate platform-qualified — a CPU row can
+NEVER diff against a TPU row of the same leg.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu.ops import dispatch, knobs
+from alphafold2_tpu.ops.flash import (
+    blockwise_attention,
+    flash_attention,
+    hop_attention_lse,
+    merge_lse,
+    stream_block,
+    streamed_fused_attention,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ALL_BACKEND_ENVS = (
+    ["AF2_KERNEL_BACKEND"]
+    + [f"AF2_KERNEL_BACKEND_{op.upper()}" for op in dispatch.ops()]
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_backend_env(monkeypatch):
+    """No inherited override may leak into resolution asserts."""
+    for name in _ALL_BACKEND_ENVS + ["AF2_QUANT_KERNEL",
+                                     "AF2_DISABLE_FLASH_KERNEL",
+                                     "AF2_DISABLE_QUANT_KERNEL",
+                                     "AF2_FLASH_AUTO_MIN_J"]:
+        monkeypatch.delenv(name, raising=False)
+    yield
+
+
+# ---------------------------------------------------------------------------
+# chip-free parity tier: kernel arm (interpret) == xla_ref, f32/bf16 +
+# one padded shape — registered with the dispatch lint per op
+# ---------------------------------------------------------------------------
+
+
+def _qkv(B, i, j, h, dh, dtype, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    q = jax.random.normal(ks[0], (B, i, h, dh), dtype)
+    k = jax.random.normal(ks[1], (B, j, h, dh), dtype)
+    v = jax.random.normal(ks[2], (B, j, h, dh), dtype)
+    mask = jax.random.bernoulli(ks[3], 0.85, (B, j)).at[:, 0].set(True)
+    bias = jnp.where(mask, 0.0, float("-inf")).astype(jnp.float32)
+    return q, k, v, bias
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("i,j", [(32, 48), (24, 37)])  # 37: padded shape
+def test_parity_flash_attention(monkeypatch, dtype, i, j):
+    q, k, v, bias = _qkv(2, i, j, 2, 8, dtype)
+    outs = {}
+    for arm in ("pallas_tpu", "xla_ref", "gpu"):
+        monkeypatch.setenv("AF2_KERNEL_BACKEND_FLASH_ATTENTION", arm)
+        assert dispatch.resolve("flash_attention", request="auto",
+                                i=i, j=j, dh=8) == arm
+        outs[arm] = np.asarray(
+            flash_attention(q, k, v, bias, use_kernel="auto"), np.float32
+        )
+    atol = 2e-5 if dtype == jnp.float32 else 2e-2
+    np.testing.assert_allclose(outs["pallas_tpu"], outs["xla_ref"],
+                               atol=atol)
+    # the gpu arm is the XLA streaming path: exact vs xla_ref
+    np.testing.assert_allclose(outs["gpu"], outs["xla_ref"], atol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("i,j", [(24, 24), (19, 29)])  # 19/29: padded
+def test_parity_fused_attention(monkeypatch, dtype, i, j):
+    B, h, dh = 2, 2, 8
+    q, k, v, bias = _qkv(B, i, j, h, dh, dtype, seed=1)
+    ks = jax.random.split(jax.random.PRNGKey(7), 2)
+    pair_bias = jax.random.normal(ks[0], (B, h, i, j), jnp.float32)
+    gate = jax.random.normal(ks[1], (B, i, h, dh), dtype)
+    outs = {}
+    for arm in ("pallas_tpu", "xla_ref"):
+        monkeypatch.setenv("AF2_KERNEL_BACKEND_FUSED_ATTENTION", arm)
+        assert dispatch.resolve("fused_attention", request="auto",
+                                i=i, j=j, dh=dh) == arm
+        outs[arm] = np.asarray(
+            flash_attention(q, k, v, bias, pair_bias=pair_bias, gate=gate,
+                            use_kernel="auto"),
+            np.float32,
+        )
+    atol = 5e-5 if dtype == jnp.float32 else 3e-2
+    np.testing.assert_allclose(outs["pallas_tpu"], outs["xla_ref"],
+                               atol=atol)
+
+
+@pytest.mark.parametrize("x_dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("m,k,n", [(16, 32, 24), (13, 40, 21)])  # padded
+def test_parity_quant_matmul(monkeypatch, x_dtype, m, k, n):
+    from alphafold2_tpu.ops.quant import quant_matmul, quantize_weight
+
+    ks = jax.random.split(jax.random.PRNGKey(2), 2)
+    x = jax.random.normal(ks[0], (m, k), x_dtype)
+    qw, scale = quantize_weight(jax.random.normal(ks[1], (k, n)))
+    outs = {}
+    for arm in ("pallas_tpu", "xla_ref"):
+        monkeypatch.setenv("AF2_KERNEL_BACKEND_QUANT_MATMUL", arm)
+        assert dispatch.resolve("quant_matmul", request="auto",
+                                m=m, k=k, n=n, x_dtype=x.dtype) == arm
+        outs[arm] = np.asarray(quant_matmul(x, qw, scale), np.float32)
+    atol = 5e-4 if x_dtype == jnp.float32 else 5e-2
+    np.testing.assert_allclose(outs["pallas_tpu"], outs["xla_ref"],
+                               atol=atol)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("n", [64, 50])  # 50: pads to the 16-block grid
+def test_parity_sparse_attention(monkeypatch, dtype, n):
+    from alphafold2_tpu.ops.attention import AttentionConfig, attention_init
+    from alphafold2_tpu.ops.sparse import SparseConfig, sparse_attention_apply
+
+    cfg = AttentionConfig(dim=16, heads=2, dim_head=8, dtype=dtype)
+    scfg = SparseConfig(block_size=16, num_local_blocks=2,
+                        num_random_blocks=1, max_seq_len=128)
+    params = attention_init(jax.random.PRNGKey(3), cfg)
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(1, n, 16), dtype)
+    mask = jnp.asarray(rs.rand(1, n) > 0.1)
+    outs = {}
+    for arm in ("pallas_tpu", "xla_ref"):
+        monkeypatch.setenv("AF2_KERNEL_BACKEND_SPARSE_ATTENTION", arm)
+        assert dispatch.resolve("sparse_attention", request="auto",
+                                n=n) == arm
+        outs[arm] = np.asarray(
+            sparse_attention_apply(params, cfg, scfg, x, mask=mask),
+            np.float32,
+        )
+    atol = 1e-4 if dtype == jnp.float32 else 5e-2
+    np.testing.assert_allclose(outs["pallas_tpu"], outs["xla_ref"],
+                               atol=atol)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("n,nk", [(32, 32), (24, 19)])  # 19: padded hop
+def test_parity_merge_lse(monkeypatch, dtype, n, nk):
+    """The ring hop's two arms compute one hop + log-space merge vs the
+    stream_block recurrence over the same two K/V blocks — and both
+    match full attention over the concatenated keys (the ring
+    invariant)."""
+    BH, dh = 4, 8
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (BH, n, dh), dtype)
+    k = jax.random.normal(ks[1], (BH, 2 * nk, dh), dtype)
+    v = jax.random.normal(ks[2], (BH, 2 * nk, dh), dtype)
+    k1, k2 = jnp.split(k, 2, axis=1)
+    v1, v2 = jnp.split(v, 2, axis=1)
+    bias = jnp.zeros((BH, nk), jnp.float32)
+    scale = dh ** -0.5
+
+    # pallas_tpu arm: per-hop fused (out, lse), merged in log space
+    monkeypatch.setenv("AF2_KERNEL_BACKEND_MERGE_LSE", "pallas_tpu")
+    assert dispatch.resolve("merge_lse", request="auto",
+                            i=n, j=nk, dh=dh) == "pallas_tpu"
+    o1, l1 = hop_attention_lse(q, k1, v1, bias, scale)
+    o2, l2 = hop_attention_lse(q, k2, v2, bias, scale)
+    out_kernel, _ = merge_lse(o1, l1, o2, l2)
+
+    # xla_ref arm: the stream_block recurrence over the same hops
+    monkeypatch.setenv("AF2_KERNEL_BACKEND_MERGE_LSE", "xla_ref")
+    assert dispatch.resolve("merge_lse", request="auto",
+                            i=n, j=nk, dh=dh) == "xla_ref"
+    q4 = q.reshape(BH, n, 1, dh)
+    m0 = jnp.full((BH, 1, n), float("-inf"), jnp.float32)
+    l0 = jnp.zeros((BH, 1, n), jnp.float32)
+    a0 = jnp.zeros((BH, 1, n, dh), jnp.float32)
+    m, l, a = stream_block(q4, k1.reshape(BH, nk, 1, dh),
+                           v1.reshape(BH, nk, 1, dh), bias, m0, l0, a0,
+                           scale)
+    m, l, a = stream_block(q4, k2.reshape(BH, nk, 1, dh),
+                           v2.reshape(BH, nk, 1, dh), bias, m, l, a, scale)
+    out_xla = (a / jnp.where(l > 0, l, 1.0)[..., None])[:, 0]
+
+    atol = 2e-5 if dtype == jnp.float32 else 2e-2
+    np.testing.assert_allclose(np.asarray(out_kernel, np.float32),
+                               np.asarray(out_xla, np.float32), atol=atol)
+
+    # the ring invariant: both equal full attention over [k1; k2]
+    full = np.asarray(blockwise_attention(
+        q4, k.reshape(BH, 2 * nk, 1, dh), v.reshape(BH, 2 * nk, 1, dh),
+        jnp.zeros((BH, 2 * nk), jnp.float32),
+    )[:, :, 0], np.float32)
+    np.testing.assert_allclose(np.asarray(out_xla, np.float32), full,
+                               atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# resolution semantics
+# ---------------------------------------------------------------------------
+
+
+def test_registry_shape():
+    assert dispatch.ops() == ("flash_attention", "fused_attention",
+                              "quant_matmul", "sparse_attention",
+                              "merge_lse")
+    for op in dispatch.ops():
+        spec = dispatch.get(op)
+        assert "xla_ref" in spec.arm_names()
+        assert spec.parity_test.startswith("test_parity_")
+    with pytest.raises(ValueError, match="unknown dispatch op"):
+        dispatch.get("nonesuch")
+
+
+def test_caller_forcing_wins():
+    # True -> kernel arm anywhere; False -> xla_ref anywhere
+    assert dispatch.resolve("flash_attention", request=True,
+                            platform="cpu", i=16, j=16, dh=8) == "pallas_tpu"
+    assert dispatch.resolve("flash_attention", request=False,
+                            platform="tpu", i=16, j=1 << 20,
+                            dh=64) == "xla_ref"
+    with pytest.raises(ValueError, match="use_kernel must be"):
+        dispatch.resolve("flash_attention", request="banana",
+                         platform="cpu", i=16, j=16, dh=8)
+
+
+def test_forced_unsupported_raises():
+    with pytest.raises(ValueError, match="flash kernel does not support"):
+        dispatch.resolve("flash_attention", request=True, platform="cpu",
+                         i=16, j=16, dh=7)
+    with pytest.raises(ValueError, match="quant kernel does not support"):
+        dispatch.resolve("quant_matmul", request=True, platform="cpu",
+                         m=8, k=16, n=8, x_dtype=jnp.float16)
+
+
+def test_env_override_precedence(monkeypatch):
+    shapes = dict(i=128, j=128, dh=64)
+    # global forces every op
+    monkeypatch.setenv("AF2_KERNEL_BACKEND", "pallas_tpu")
+    assert dispatch.resolve("flash_attention", platform="cpu",
+                            **shapes) == "pallas_tpu"
+    # per-op wins over global
+    monkeypatch.setenv("AF2_KERNEL_BACKEND_FLASH_ATTENTION", "xla_ref")
+    assert dispatch.resolve("flash_attention", platform="cpu",
+                            **shapes) == "xla_ref"
+    assert dispatch.resolve("merge_lse", platform="cpu",
+                            **shapes) == "pallas_tpu"  # global still holds
+    # off == the xla_ref arm; auto == back to the heuristic
+    monkeypatch.setenv("AF2_KERNEL_BACKEND_FLASH_ATTENTION", "off")
+    assert dispatch.resolve("flash_attention", platform="tpu", i=128,
+                            j=1 << 20, dh=64) == "xla_ref"
+    monkeypatch.setenv("AF2_KERNEL_BACKEND", "auto")
+    monkeypatch.setenv("AF2_KERNEL_BACKEND_FLASH_ATTENTION", "auto")
+    assert dispatch.resolve("flash_attention", platform="cpu",
+                            **shapes) == "xla_ref"
+    # an explicit per-op "auto" RESTORES the heuristic under a global
+    # override (the combination per-op-wins exists for)
+    monkeypatch.setenv("AF2_KERNEL_BACKEND", "pallas_tpu")
+    monkeypatch.setenv("AF2_KERNEL_BACKEND_FLASH_ATTENTION", "auto")
+    assert dispatch.resolve("flash_attention", platform="cpu",
+                            **shapes) == "xla_ref"   # cpu heuristic
+    assert dispatch.resolve("merge_lse", platform="cpu",
+                            **shapes) == "pallas_tpu"  # global still forces
+    # unknown arm names fail loudly, listing the registered arms
+    monkeypatch.setenv("AF2_KERNEL_BACKEND_FLASH_ATTENTION", "cuda12")
+    with pytest.raises(ValueError, match="unknown backend arm"):
+        dispatch.resolve("flash_attention", platform="cpu", **shapes)
+
+
+def test_env_forcing_unsupported_shape_raises(monkeypatch):
+    monkeypatch.setenv("AF2_KERNEL_BACKEND", "pallas_tpu")
+    with pytest.raises(ValueError, match="does not support"):
+        dispatch.resolve("flash_attention", platform="cpu",
+                         i=16, j=16, dh=7)
+
+
+def test_auto_heuristics_per_platform():
+    long_j = dict(i=1152, j=4096, dh=64)
+    short_j = dict(i=1152, j=1152, dh=64)
+    assert dispatch.resolve("flash_attention", platform="tpu",
+                            **long_j) == "pallas_tpu"
+    assert dispatch.resolve("flash_attention", platform="tpu",
+                            **short_j) == "xla_ref"  # measured crossover
+    assert dispatch.resolve("flash_attention", platform="gpu",
+                            **long_j) == "gpu"
+    assert dispatch.resolve("flash_attention", platform="cpu",
+                            **long_j) == "xla_ref"
+    assert dispatch.resolve("sparse_attention", platform="tpu",
+                            n=8192) == "pallas_tpu"
+    assert dispatch.resolve("sparse_attention", platform="tpu",
+                            n=2048) == "xla_ref"
+    assert dispatch.resolve("quant_matmul", platform="tpu", m=64, k=64,
+                            n=64, x_dtype=jnp.float32) == "pallas_tpu"
+    assert dispatch.resolve("quant_matmul", platform="gpu", m=64, k=64,
+                            n=64, x_dtype=jnp.float32) == "gpu"
+
+
+def test_kill_switches_still_downgrade_auto(monkeypatch):
+    long_j = dict(i=1152, j=4096, dh=64)
+    monkeypatch.setenv("AF2_DISABLE_FLASH_KERNEL", "1")
+    assert dispatch.resolve("flash_attention", platform="tpu",
+                            **long_j) == "xla_ref"
+    assert dispatch.resolve("sparse_attention", platform="tpu",
+                            n=8192) == "xla_ref"
+    monkeypatch.setenv("AF2_DISABLE_QUANT_KERNEL", "1")
+    assert dispatch.resolve("quant_matmul", platform="tpu", m=64, k=64,
+                            n=64, x_dtype=jnp.float32) == "xla_ref"
+    # forcing still wins over the kill-switch
+    assert dispatch.resolve("flash_attention", request=True,
+                            platform="cpu", i=16, j=16, dh=8) == "pallas_tpu"
+
+
+def test_legacy_quant_knob_adapts(monkeypatch):
+    shapes = dict(m=8, k=16, n=8, x_dtype=jnp.float32)
+    monkeypatch.setenv("AF2_QUANT_KERNEL", "force")
+    assert dispatch.resolve("quant_matmul", platform="cpu",
+                            **shapes) == "pallas_tpu"
+    monkeypatch.setenv("AF2_QUANT_KERNEL", "off")
+    assert dispatch.resolve("quant_matmul", platform="tpu",
+                            **shapes) == "xla_ref"
+    # the new knob outranks the legacy one
+    monkeypatch.setenv("AF2_KERNEL_BACKEND_QUANT_MATMUL", "pallas_tpu")
+    assert dispatch.resolve("quant_matmul", platform="tpu",
+                            **shapes) == "pallas_tpu"
+
+
+def test_resolution_tag_and_table(monkeypatch):
+    tag = dispatch.resolution_tag(platform="cpu")
+    assert tag.startswith("dispatch[cpu](")
+    for op in dispatch.ops():
+        assert f"{op}=xla_ref" in tag
+    # env overrides change the tag (the serving aliasing lever)
+    monkeypatch.setenv("AF2_KERNEL_BACKEND", "pallas_tpu")
+    assert dispatch.resolution_tag(platform="cpu") != tag
+    monkeypatch.delenv("AF2_KERNEL_BACKEND")
+    rows = dispatch.resolution_table(platform="tpu")
+    assert [r[0] for r in rows] == list(dispatch.ops())
+    by_op = {r[0]: r for r in rows}
+    _, probe, supp, resolved = by_op["flash_attention"]
+    assert supp["xla_ref"] and supp["pallas_tpu"]
+    assert resolved == "pallas_tpu"  # long-j probe on TPU
+    # a malformed forced env shows up as an ERROR row, not a crash
+    monkeypatch.setenv("AF2_KERNEL_BACKEND", "cuda12")
+    rows = dispatch.resolution_table(platform="cpu")
+    assert all(r[3].startswith("ERROR:") for r in rows)
+
+
+def test_check_cli_output_pinned(capsys):
+    assert dispatch.main(["--check", "--platform", "cpu"]) == 0
+    out = capsys.readouterr().out
+    assert "kernel dispatch registry @ platform=cpu" in out
+    for op in dispatch.ops():
+        assert op in out
+    assert out.count("-> xla_ref") == len(dispatch.ops())
+    assert "tag: dispatch[cpu](" in out
+
+
+# ---------------------------------------------------------------------------
+# knobs: strict parsing + the generated docs table
+# ---------------------------------------------------------------------------
+
+
+def test_knob_strict_values(monkeypatch):
+    monkeypatch.setenv("AF2_DISABLE_FLASH_KERNEL", "flase")  # the typo
+    with pytest.raises(ValueError, match="AF2_DISABLE_FLASH_KERNEL"):
+        knobs.flash_kernel_disabled()
+    monkeypatch.setenv("AF2_DISABLE_FLASH_KERNEL", "0")
+    assert not knobs.flash_kernel_disabled()
+    monkeypatch.setenv("AF2_DISABLE_FLASH_KERNEL", "yes")
+    assert knobs.flash_kernel_disabled()
+    monkeypatch.setenv("AF2_FLASH_AUTO_MIN_J", "many")
+    with pytest.raises(ValueError, match="AF2_FLASH_AUTO_MIN_J"):
+        knobs.flash_auto_min_j()
+    monkeypatch.delenv("AF2_FLASH_AUTO_MIN_J")
+    assert knobs.flash_auto_min_j() == knobs.FLASH_AUTO_MIN_J_DEFAULT
+    monkeypatch.setenv("AF2_QUANT_KERNEL", "bogus")
+    with pytest.raises(ValueError, match="AF2_QUANT_KERNEL"):
+        knobs.quant_kernel_override()
+    monkeypatch.setenv("AF2_COMM_OVERLAP", "off")
+    assert not knobs.comm_overlap_enabled()
+    monkeypatch.delenv("AF2_COMM_OVERLAP")
+    assert knobs.comm_overlap_enabled()  # default ON
+
+
+def test_knob_registry_covers_every_accessor():
+    names = {k.name for k in knobs.KNOBS}
+    for expected in ("AF2_KERNEL_BACKEND", "AF2_KERNEL_BACKEND_<OP>",
+                     "AF2_DISABLE_FLASH_KERNEL", "AF2_DISABLE_QUANT_KERNEL",
+                     "AF2_FLASH_AUTO_MIN_J", "AF2_QUANT_KERNEL",
+                     "AF2_UNFUSE_GATE_EPILOGUE", "AF2_PALLAS_INTERPRET",
+                     "AF2_COMM_OVERLAP", "AF2_COORDINATOR",
+                     "AF2_NUM_PROCESSES", "AF2_PROCESS_ID",
+                     "AF2_AUTO_INIT"):
+        assert expected in names, expected
+
+
+def test_knob_table_in_docs_is_generated():
+    """docs/OPERATIONS.md's env-knob block must EQUAL generate_table():
+    the table is generated, not hand-maintained — regenerate with
+    `python -m alphafold2_tpu.ops.knobs` after editing the registry."""
+    path = os.path.join(REPO_ROOT, "docs", "OPERATIONS.md")
+    text = open(path).read()
+    begin, end = "<!-- af2knobs:begin -->", "<!-- af2knobs:end -->"
+    assert begin in text and end in text, "knob table markers missing"
+    block = text.split(begin, 1)[1].split(end, 1)[0].strip()
+    assert block == knobs.generate_table().strip()
+
+
+# ---------------------------------------------------------------------------
+# the af2lint dispatch pass
+# ---------------------------------------------------------------------------
+
+
+class _FakeSpec:
+    def __init__(self, name, arms, parity_test):
+        self.name = name
+        self._arms = arms
+        self.parity_test = parity_test
+
+    def arm_names(self):
+        return tuple(self._arms)
+
+
+class TestDispatchLint:
+    def test_repo_is_clean(self):
+        from alphafold2_tpu.analysis.dispatch_lint import run
+
+        findings = run(REPO_ROOT)
+        assert findings == [], [f.render() for f in findings]
+
+    def test_pass_registered(self):
+        from alphafold2_tpu.analysis import PASSES, run_passes
+
+        assert "dispatch" in PASSES
+        assert run_passes(REPO_ROOT, select=("dispatch",)) == []
+
+    def test_missing_xla_ref_arm_fires(self, tmp_path):
+        from alphafold2_tpu.analysis.dispatch_lint import check_registry
+
+        reg = [_FakeSpec("my_op", ("pallas_tpu",), "test_parity_flash_attention")]
+        codes = {f.code for f in check_registry(
+            REPO_ROOT, registry=reg)}
+        assert codes == {"DISPATCH001"}
+
+    def test_unregistered_parity_test_fires(self):
+        from alphafold2_tpu.analysis.dispatch_lint import check_registry
+
+        reg = [_FakeSpec("my_op", ("pallas_tpu", "xla_ref"), ""),
+               _FakeSpec("other", ("xla_ref",), "test_parity_nonesuch")]
+        codes = sorted(f.code for f in check_registry(REPO_ROOT,
+                                                      registry=reg))
+        assert codes == ["DISPATCH002", "DISPATCH002"]
+
+    def test_live_registry_parity_tests_exist(self):
+        from alphafold2_tpu.analysis.dispatch_lint import check_registry
+
+        assert check_registry(REPO_ROOT) == []
+
+    def test_kernel_import_outside_ops_fires(self, tmp_path):
+        from alphafold2_tpu.analysis.dispatch_lint import check_sources
+
+        pkg = tmp_path / "alphafold2_tpu" / "parallel"
+        pkg.mkdir(parents=True)
+        bad = pkg / "rogue.py"
+        bad.write_text(
+            "from alphafold2_tpu.ops.flash_kernel import flash_attention_tpu\n"
+            "from alphafold2_tpu.ops import sparse_kernel\n"
+        )
+        codes = [f.code for f in check_sources(tmp_path, files=[bad])]
+        assert codes == ["DISPATCH003", "DISPATCH003"]
+
+    def test_env_read_outside_knobs_fires(self, tmp_path):
+        from alphafold2_tpu.analysis.dispatch_lint import check_sources
+
+        pkg = tmp_path / "alphafold2_tpu" / "serving"
+        pkg.mkdir(parents=True)
+        bad = pkg / "rogue.py"
+        bad.write_text(
+            "import os\n"
+            "A = os.environ.get('AF2_SOMETHING', '')\n"
+            "B = os.getenv('AF2_OTHER')\n"
+            "C = os.environ['AF2_THIRD']\n"
+            "os.environ['AF2_WRITE_OK'] = '1'\n"   # writes are fine
+            "D = os.environ.get('NOT_OURS')\n"     # non-AF2 is fine
+        )
+        codes = [f.code for f in check_sources(tmp_path, files=[bad])]
+        assert codes == ["DISPATCH004", "DISPATCH004", "DISPATCH004"]
+
+    def test_knobs_and_ops_are_exempt(self, tmp_path):
+        from alphafold2_tpu.analysis.dispatch_lint import check_sources
+
+        ops_dir = tmp_path / "alphafold2_tpu" / "ops"
+        ops_dir.mkdir(parents=True)
+        knobs_py = ops_dir / "knobs.py"
+        knobs_py.write_text(
+            "import os\nA = os.environ.get('AF2_SOMETHING', '')\n"
+        )
+        kernel_user = ops_dir / "flash.py"
+        kernel_user.write_text(
+            "from alphafold2_tpu.ops import flash_kernel\n"
+        )
+        assert check_sources(
+            tmp_path, files=[knobs_py, kernel_user]) == []
+
+
+# ---------------------------------------------------------------------------
+# the cross-backend bench matrix contract (telemetry.check)
+# ---------------------------------------------------------------------------
+
+
+class TestPlatformQualifiedGate:
+    def test_rows_qualify_by_platform_and_arm(self):
+        from alphafold2_tpu.telemetry.check import load_metrics
+
+        got = load_metrics({
+            "bench": "disp_flash_attention_xla_ref",
+            "result": {"op": "flash_attention", "backend_arm": "xla_ref",
+                       "platform": "cpu", "sec_per_iter": 0.35},
+        })
+        assert got == {
+            "disp_flash_attention_xla_ref.cpu.xla_ref.sec_per_iter": 0.35,
+        }
+
+    def test_cpu_row_cannot_gate_against_tpu_row(self, tmp_path):
+        """THE satellite pin: the same leg measured on two platforms
+        shares no metric name, so telemetry.check can never diff a CPU
+        row against a TPU baseline (and vice versa)."""
+        from alphafold2_tpu.telemetry.check import check, load_metrics
+
+        def sweep(name, platform, arm, secs):
+            p = tmp_path / name
+            p.write_text(json.dumps({
+                "bench": "disp_flash_attention_xla_ref",
+                "result": {"platform": platform, "backend_arm": arm,
+                           "sec_per_iter": secs},
+            }) + "\n")
+            return str(p)
+
+        cur = sweep("cur.jsonl", "cpu", "xla_ref", 99.0)  # 10x "slower"
+        base = sweep("base.jsonl", "tpu", "pallas_tpu", 9.0)
+        cur_m, base_m = load_metrics(cur), load_metrics(base)
+        assert not (set(cur_m) & set(base_m))
+        passed, rows = check(cur, base)
+        assert passed and rows == []  # nothing comparable, nothing gated
+        # same platform+arm DOES gate — the trajectory is per-backend
+        base2 = sweep("base2.jsonl", "cpu", "xla_ref", 9.0)
+        passed, rows = check(cur, base2)
+        assert not passed
+        assert rows[0]["metric"] == (
+            "disp_flash_attention_xla_ref.cpu.xla_ref.sec_per_iter")
+
+    def test_legacy_rows_keep_unqualified_names(self):
+        from alphafold2_tpu.telemetry.check import load_metrics
+
+        got = load_metrics({"bench": "e2e_auto",
+                            "result": {"sec_per_step": 24.4}})
+        assert got == {"e2e_auto.sec_per_step": 24.4}
+        # rows recorded BEFORE the matrix carry platform alone (the
+        # PR 8/11/12 chip-free legs): they must also keep their
+        # historical names, or every published baseline of those legs
+        # silently stops gating — qualification requires BOTH fields
+        got = load_metrics({
+            "bench": "featurize_overlap",
+            "result": {"platform": "cpu",
+                       "featurize_overlap_ratio": 2.19},
+        })
+        assert got == {"featurize_overlap.featurize_overlap_ratio": 2.19}
+
+
+def test_serving_stats_surface_dispatch_tag():
+    """The resolved-arm tag must be operator-visible (stats()) and part
+    of the engine config tag — the full aliasing pin lives in
+    tests/test_serving.py::test_config_tag_covers_backend_arm."""
+    tag = dispatch.resolution_tag()
+    assert tag.startswith("dispatch[")
+    for op in dispatch.ops():
+        assert f"{op}=" in tag

@@ -845,6 +845,43 @@ def test_config_tag_covers_trunk_schedule_and_fused_gate(tiny_params):
         base.shutdown(drain=False)
 
 
+def test_config_tag_covers_backend_arm(tiny_params, monkeypatch):
+    """PR 13 satellite: the engine config tag covers the RESOLVED kernel
+    backend arms (ops/dispatch.py resolution_tag), like trunk_schedule /
+    attn_gate / weight_dtype before it — two replicas whose envs force
+    different arms must never alias one result-cache / AOT-executable
+    keyspace (a kernel arm and its XLA twin agree only to rounding).
+    Same env => same tag (the fleet's shared-tag bit-exactness pin
+    depends on that direction too)."""
+    scfg = serving_cfg(buckets=(8,))
+    monkeypatch.delenv("AF2_KERNEL_BACKEND", raising=False)
+    monkeypatch.delenv("AF2_KERNEL_BACKEND_QUANT_MATMUL", raising=False)
+    engines = []
+    try:
+        base = ServingEngine(tiny_params, TINY, scfg)
+        engines.append(base)
+        twin = ServingEngine(tiny_params, TINY, scfg)
+        engines.append(twin)
+        assert twin._config_tag == base._config_tag
+
+        monkeypatch.setenv("AF2_KERNEL_BACKEND_QUANT_MATMUL", "pallas_tpu")
+        per_op = ServingEngine(tiny_params, TINY, scfg)
+        engines.append(per_op)
+        assert per_op._config_tag != base._config_tag
+
+        monkeypatch.setenv("AF2_KERNEL_BACKEND", "pallas_tpu")
+        global_arm = ServingEngine(tiny_params, TINY, scfg)
+        engines.append(global_arm)
+        assert global_arm._config_tag not in (base._config_tag,
+                                              per_op._config_tag)
+        # the arm choice is operator-visible in stats()
+        assert global_arm.stats()["dispatch"].startswith("dispatch[")
+        assert "quant_matmul=pallas_tpu" in per_op.stats()["dispatch"]
+    finally:
+        for eng in engines:
+            eng.shutdown(drain=False)
+
+
 # ------------------------------------------- multi-precision residency
 
 
