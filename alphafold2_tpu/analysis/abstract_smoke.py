@@ -478,6 +478,110 @@ def _targets() -> Dict[str, Callable[[], None]]:
         assert fleet.n == 1, fleet.n
         assert len(scaler.scale_events()) == 2
 
+    @register("serving.sp_pipeline")
+    def _serving_sp_pipeline():
+        # the SP serving arm's executable under eval_shape (ISSUE 14):
+        # the chip-free schedule plan picks per bucket, and the planned
+        # SP apply traces the bucket-shaped serving forward over a
+        # model-axis mesh — both dynamic-axial cuts
+        from alphafold2_tpu.models import Alphafold2Config, alphafold2_init
+        from alphafold2_tpu.parallel import make_mesh
+        from alphafold2_tpu.serving import sp_arm
+        from alphafold2_tpu.serving.pipeline import predict_structure
+
+        cfg = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8,
+                               max_seq_len=32)
+        params = jax.eval_shape(lambda k: alphafold2_init(k, cfg), key)
+        # planning is pure shard-count arithmetic + eval_shape pricing
+        plan = sp_arm.plan_bucket_schedules(
+            cfg, buckets=(16, 32), batch=2, msa_rows=0, shards=2,
+            hbm_bytes=float(1 << 40), overrides={32: "sp_seq"})
+        assert plan[32].schedule == "sp_seq"
+        assert plan[16].schedule == "dense"
+        assert plan[32].pair_bytes < sp_arm.schedule_residency(
+            cfg, bucket=32, batch=2, msa_rows=0, schedule="dense",
+            shards=2).pair_bytes
+        # the trace itself runs at whatever mesh this host can provision
+        # (the tier-1 suite forces 8 virtual CPU devices; a bare CLI run
+        # degrades to a 1-shard mesh — same program, same trace checks)
+        mesh = make_mesh({"sp": 2 if len(jax.devices()) >= 2 else 1})
+        sp_apply = sp_arm.make_sp_apply_fn(mesh, "sp_seq")
+        jax.eval_shape(
+            lambda p, t, m: predict_structure(
+                p, cfg, t, mask=m, mds_iters=2, mds_init="classical",
+                model_apply_fn=sp_apply,
+            ),
+            params, abstract((2, 32), jnp.int32), abstract((2, 32), jnp.bool_),
+        )
+        msa_apply = sp_arm.make_sp_apply_fn(mesh, "sp_msa")
+        jax.eval_shape(
+            lambda p, t, m, ms, mm: predict_structure(
+                p, cfg, t, mask=m, msa=ms, msa_mask=mm,
+                mds_iters=2, mds_init="classical",
+                model_apply_fn=msa_apply,
+            ),
+            params, abstract((2, 16), jnp.int32), abstract((2, 16), jnp.bool_),
+            abstract((2, 2, 16), jnp.int32), abstract((2, 2, 16), jnp.bool_),
+        )
+
+    @register("serving.capability_routing")
+    def _capability_routing():
+        # the length-adaptive router over stub engines (ISSUE 14): short
+        # work lands on the cheap pool, long work on the wide pool, and a
+        # sequence past every pool's ceiling sheds with the sharp
+        # sequence_too_long code instead of dying in dispatch
+        import numpy as np
+
+        from alphafold2_tpu.models import Alphafold2Config
+        from alphafold2_tpu.serving import (
+            FleetConfig,
+            PoolSpec,
+            SequenceTooLongError,
+            ServingConfig,
+            ServingEngine,
+            ServingFleet,
+        )
+
+        tiny = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8,
+                                max_seq_len=32)
+
+        class Stub(ServingEngine):
+            def _call_executable(self, bucket, tokens, mask, msa=None,
+                                 msa_mask=None):
+                B, Lb = tokens.shape
+                return {
+                    "coords": np.zeros((B, Lb, 3), np.float32),
+                    "confidence": np.full((B, Lb), 0.5, np.float32),
+                    "stress": np.zeros((B,), np.float32),
+                }
+
+        fleet = ServingFleet(
+            {}, tiny,
+            ServingConfig(buckets=(8, 16), max_batch=2, max_wait_s=0.0,
+                          cache_capacity=0),
+            FleetConfig(probe_interval_s=0, pools=(
+                PoolSpec("short", buckets=(8, 16)),
+                PoolSpec("long", buckets=(8, 16, 32)),
+            )),
+            engine_factory=lambda n, c, h: Stub({}, tiny, c, fault_hook=h),
+        )
+        try:
+            a = fleet.predict("ACDEFGHIKL", timeout=30)          # L=10
+            b = fleet.predict("ACDEFGHIKLMNPQRSTVWYACDEF", timeout=30)
+            st = fleet.stats()
+            assert st["replicas"][a.replica]["pool"] == "short"
+            assert st["replicas"][b.replica]["pool"] == "long"
+            assert st["replicas"][b.replica]["capability"]["max_len"] == 32
+            try:
+                fleet.submit("A" * 40)
+                raise AssertionError("40-mer must shed: no pool ceiling "
+                                     "covers it")
+            except SequenceTooLongError as e:
+                assert e.code == "sequence_too_long"
+            assert fleet.stats()["shed"]["too_long"] == 1
+        finally:
+            fleet.shutdown()
+
     # --- reliability --------------------------------------------------------
     # host-side subsystems: no shapes to eval, but the same failure class —
     # an import- or construction-time regression in the chaos layer must

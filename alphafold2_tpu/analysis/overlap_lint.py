@@ -411,6 +411,41 @@ def worker_main() -> None:
         analyze_schedule(txt), expected_permutes=6
     )
 
+    # --- the SERVING-shaped SP program (ISSUE 14) --------------------------
+    # exactly what a ServingEngine bucket executable runs under the SP arm:
+    # embedder -> sp_seq trunk -> distogram head -> MDS, batch-shaped. The
+    # ring cross-attention inside must keep the same overlap property the
+    # bare trunk has — the serving wrapper (padding, masks, the replicated
+    # head) must not reserialize the schedule.
+    from alphafold2_tpu.models import alphafold2_init
+    from alphafold2_tpu.serving.pipeline import predict_structure
+    from alphafold2_tpu.serving.sp_arm import make_sp_apply_fn
+
+    # depth 2, NOT 1: the distogram head consumes only the pair stream,
+    # so the LAST layer's MSA<-pair ring is dead code the compiler
+    # eliminates — layer 1's ring is the live site under test (exactly
+    # the structure of any real multi-layer serving model)
+    serve_cfg = Alphafold2Config(dim=16, depth=2, heads=2, dim_head=8,
+                                 max_seq_len=2 * _N_DEV)
+    serve_params = alphafold2_init(jax.random.PRNGKey(1), serve_cfg)
+    sp_apply = make_sp_apply_fn(mesh, "sp_seq", axis_name="seq",
+                                overlap=True)
+    tok = jax.ShapeDtypeStruct((2, 2 * _N_DEV), jnp.int32)
+    msk = jax.ShapeDtypeStruct((2, 2 * _N_DEV), jnp.bool_)
+    msa_s = jax.ShapeDtypeStruct((2, _N_DEV, 2 * _N_DEV), jnp.int32)
+    msam_s = jax.ShapeDtypeStruct((2, _N_DEV, 2 * _N_DEV), jnp.bool_)
+    txt = export_text(
+        lambda p, t, m, ms, mm: predict_structure(
+            p, serve_cfg, t, mask=m, msa=ms, msa_mask=mm,
+            mds_iters=2, mds_init="classical", model_apply_fn=sp_apply,
+        ),
+        serve_params, tok, msk, msa_s, msam_s,
+    )
+    # same single ring site as the bare trunk: 3 buffers x 2 static sites
+    problems["serving_sp_overlap"] = check_overlapped_sp_trunk(
+        analyze_schedule(txt), expected_permutes=6
+    )
+
     # --- DP-overlap train step, both schedules -----------------------------
     dp_mesh = make_mesh({"data": _N_DEV})
     cfg = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8,

@@ -50,7 +50,11 @@ from alphafold2_tpu.parallel.sequence import (
     tied_row_attention_sharded,
     ulysses_attention,
 )
-from alphafold2_tpu.parallel.sp_trunk import alphafold2_apply_sp, sp_trunk_apply
+from alphafold2_tpu.parallel.sp_trunk import (
+    alphafold2_apply_sp,
+    msa_sharded_trunk_apply,
+    sp_trunk_apply,
+)
 from alphafold2_tpu.parallel.pipeline import (
     alphafold2_apply_pp,
     pipeline_trunk_apply,
@@ -64,6 +68,7 @@ from alphafold2_tpu.parallel.distributed import (
 
 __all__ = [
     "sp_trunk_apply",
+    "msa_sharded_trunk_apply",
     "alphafold2_apply_sp",
     "alphafold2_apply_pp",
     "pipeline_trunk_apply",

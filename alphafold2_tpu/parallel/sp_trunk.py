@@ -440,6 +440,130 @@ def sp_layer_apply(layer, cfg: Alphafold2Config, x, m, x_mask, msa_mask, axis_na
     return x, m
 
 
+def msa_sharded_layer_apply(layer, cfg: Alphafold2Config, x, m, x_mask,
+                            msa_mask, axis_name):
+    """One trunk layer with ONLY the MSA row axis sharded (deterministic).
+
+    The FastFold (arxiv 2203.00854) observation behind dynamic axial
+    parallelism: shard whichever axis dominates residency. `sp_layer_apply`
+    shards the SEQUENCE (pair-grid rows + MSA rows together) — the right
+    cut when the O(L^2) pair grid is the problem. This twin shards the MSA
+    ROW axis alone and keeps the pair grid fully resident: the right cut
+    when a deep alignment (rows >> L) dominates and the pair grid still
+    fits one chip — pair-side ops run replicated (identical on every
+    shard), the MSA stream's memory and attention FLOPs divide by the
+    shard count, and cross-attention needs one all_gather of the (by
+    assumption small-L) per-shard MSA rows instead of any ring.
+
+    x: (b, n, n, d) FULL pair grid (replicated); m: (b, r_local, c, d)
+    resident MSA row shard. Math matches the replicated sequential layer
+    per valid position (key-side masking differences do not arise — the
+    cross ops here are the replicated ones, only the MSA self-attention
+    goes through the sharded tied/transpose path)."""
+    from alphafold2_tpu.models.trunk import (
+        cross_apply_grids,
+        prenorm_axial_apply,
+        prenorm_ff_apply,
+    )
+
+    self_cfg = cfg.self_attn_config()
+    x = prenorm_axial_apply(
+        layer["seq_attn"], self_cfg, x, mask=x_mask,
+    ) + x
+    m = m + _msa_self_attention(
+        layer["msa_attn"]["attn"], cfg,
+        layer_norm(layer["msa_attn"]["norm"], m), axis_name, msa_mask,
+    )
+    # pair<-MSA cross: the MSA stream is the small one by schedule choice —
+    # gather it whole, then run the REPLICATED cross (exact reference math)
+    m_full, mm_full = _gather_msa(m, msa_mask, axis_name)
+    x = cross_apply_grids(
+        layer["seq_cross"], cfg, x, m_full, x_mask, mm_full, None,
+        "pair_from_msa",
+    ) + x
+    # MSA<-pair cross: queries are the resident rows; the pair context is
+    # fully resident, so this is the replicated cross on a row slice (the
+    # column fold is row-count agnostic)
+    m = cross_apply_grids(
+        layer["msa_cross"], cfg, m, x, msa_mask, x_mask, None,
+        "msa_from_pair",
+    ) + m
+    x = prenorm_ff_apply(layer["seq_ff"], cfg, x) + x
+    m = prenorm_ff_apply(layer["msa_ff"], cfg, m) + m
+    return x, m
+
+
+def msa_sharded_trunk_apply(
+    layers,
+    cfg: Alphafold2Config,
+    x,
+    m,
+    mesh: Mesh,
+    *,
+    axis_name: str = "seq",
+    x_mask=None,
+    msa_mask=None,
+):
+    """Run the sequential trunk with ONLY the MSA rows sharded.
+
+    Args (global, unsharded layouts — shard_map splits the MSA rows):
+      x: (b, n, n, d) pair grid, REPLICATED on every shard;
+      m: (b, rows, cols, d) MSA, rows sharded (rows % axis size == 0;
+         cols % axis size == 0 for the along-rows transpose pass).
+
+    The "shard MSA rows" arm of the serving schedule choice
+    (serving/sp_arm.py): per-chip MSA residency and MSA-attention FLOPs
+    divide by the shard count while the pair grid stays whole — pair ops
+    are replicated compute, bit-identical across shards. Deterministic
+    path only; no sparse layers; requires an MSA stream (there is nothing
+    to shard without one). Returns (x, m) in global layouts."""
+    if any(cfg.layer_sparse):
+        raise ValueError("sparse layers are not sequence-parallel; use the "
+                         "replicated trunk")
+    if m is None:
+        raise ValueError(
+            "msa_sharded_trunk_apply shards the MSA row axis; with no MSA "
+            "stream there is nothing to shard — use the replicated trunk "
+            "or sp_trunk_apply"
+        )
+    shards = mesh.shape[axis_name]
+    if m.shape[1] % shards != 0:
+        raise ValueError(
+            f"MSA rows ({m.shape[1]}) must divide by the "
+            f"'{axis_name}' mesh axis ({shards})"
+        )
+    if m.shape[2] % shards != 0:
+        raise ValueError(
+            f"MSA cols ({m.shape[2]}) must divide by the "
+            f"'{axis_name}' mesh axis ({shards}) — the along-rows "
+            f"attention pass transposes the sharded axis onto the columns"
+        )
+
+    spec_m = P(None, axis_name)
+    in_specs = (
+        P(),
+        spec_m,
+        P() if x_mask is not None else None,
+        spec_m if msa_mask is not None else None,
+    )
+
+    @functools.partial(
+        compat.shard_map,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(P(), spec_m),
+        check_vma=False,
+    )
+    def run(x, m, x_mask, msa_mask):
+        for layer in layers:
+            x, m = msa_sharded_layer_apply(
+                layer, cfg, x, m, x_mask, msa_mask, axis_name
+            )
+        return x, m
+
+    return run(x, m, x_mask, msa_mask)
+
+
 def sp_trunk_apply(
     layers,
     cfg: Alphafold2Config,
@@ -535,20 +659,30 @@ def alphafold2_apply_sp(
     templates=None,
     templates_mask=None,
     overlap=None,
+    schedule: str = "sp_seq",
 ):
-    """FULL-model forward with the trunk sequence-parallel over the mesh.
+    """FULL-model forward with the trunk sharded over the mesh.
 
     Embeddings, the (optional) template tower, and the distogram head run
     replicated — they are a negligible share of the FLOPs and memory; the
-    trunk (where the pair grid lives) runs under shard_map with its row
-    axes sharded. Parity with the replicated `alphafold2_apply` is tested
-    full-model on the 8-device mesh (tests/test_sp_trunk.py).
+    trunk (where the pair grid lives) runs under shard_map with the
+    chosen axis sharded. Parity with the replicated `alphafold2_apply` is
+    tested full-model on the 8-device mesh (tests/test_sp_trunk.py).
 
-    Works with a token MSA (rows sharded) or msa=None (pair-grid-only
-    distogram pretraining — the MSA branch is skipped, reference
-    alphafold2.py:311). The embedds path is unsupported (its substitute
-    stream has no row axis to shard). Requires the sequential trunk and the
-    sp_trunk_apply constraints (deterministic, no sparse layers).
+    `schedule` is the dynamic-axial-parallelism cut (FastFold, arxiv
+    2203.00854; serving/sp_arm.py picks it per length bucket):
+      * "sp_seq" — shard the SEQUENCE: pair-grid rows + MSA rows over the
+        mesh axis (`sp_trunk_apply`) — the long-sequence schedule, the
+        O(L^2) pair grid divides by the shard count;
+      * "sp_msa" — shard the MSA ROWS only (`msa_sharded_trunk_apply`) —
+        the deep-alignment schedule, the pair grid stays whole.
+
+    Works with a token MSA (rows sharded) or msa=None under "sp_seq"
+    (pair-grid-only distogram pretraining — the MSA branch is skipped,
+    reference alphafold2.py:311). The embedds path is unsupported (its
+    substitute stream has no row axis to shard). Requires the sequential
+    trunk and the per-schedule constraints (deterministic, no sparse
+    layers).
     """
     from alphafold2_tpu.models.alphafold2 import alphafold2_apply
 
@@ -557,9 +691,18 @@ def alphafold2_apply_sp(
             "sequence-parallel trunk uses the sequential layer list; "
             "set reversible=False (memory scales via sharding instead)"
         )
+    if schedule not in ("sp_seq", "sp_msa"):
+        raise ValueError(
+            f"schedule must be 'sp_seq' or 'sp_msa', got {schedule!r}"
+        )
 
     def trunk_fn(layers, cfg_, x, m, x_mask, m_mask, rng):
         del rng  # deterministic path (sp_trunk_apply contract)
+        if schedule == "sp_msa":
+            return msa_sharded_trunk_apply(
+                layers, cfg_, x, m, mesh,
+                axis_name=axis_name, x_mask=x_mask, msa_mask=m_mask,
+            )
         return sp_trunk_apply(
             layers, cfg_, x, m, mesh,
             axis_name=axis_name, x_mask=x_mask, msa_mask=m_mask,

@@ -40,6 +40,7 @@ from alphafold2_tpu.serving.errors import (
     RequestTimeoutError,
     RequestTooLongError,
     RequeueLimitError,
+    SequenceTooLongError,
     ScaleRejectedError,
     ServingError,
 )
@@ -52,7 +53,14 @@ from alphafold2_tpu.serving.featurize import (
 from alphafold2_tpu.serving.fleet import (
     FleetConfig,
     FleetRequest,
+    PoolSpec,
     ServingFleet,
+)
+from alphafold2_tpu.serving.sp_arm import (
+    SP_SCHEDULES,
+    choose_schedule,
+    plan_bucket_schedules,
+    schedule_residency,
 )
 from alphafold2_tpu.serving.metrics import ServingMetrics
 
@@ -78,6 +86,11 @@ __all__ = [
     "featurize_request",
     "FleetConfig",
     "FleetRequest",
+    "PoolSpec",
+    "SP_SCHEDULES",
+    "choose_schedule",
+    "plan_bucket_schedules",
+    "schedule_residency",
     "PredictionResult",
     "ReplicaAutoscaler",
     "ScalePolicy",
@@ -97,6 +110,7 @@ __all__ = [
     "RequestTimeoutError",
     "RequestTooLongError",
     "RequeueLimitError",
+    "SequenceTooLongError",
     "ScaleRejectedError",
     "ServingError",
 ]

@@ -207,6 +207,14 @@ class AdmissionController:
         with self._lock:
             return len(self._entries)
 
+    def entries(self) -> list:
+        """Snapshot of the queued entries (dispatch order, no removal) —
+        the fleet's per-capability-pool depth gauges group this by each
+        entry's target pool, so a shed can quote the CAPABLE pool's
+        backlog instead of the global queue's."""
+        with self._lock:
+            return [e[2] for e in self._entries]
+
     def drain(self) -> list:
         """Remove and return every queued entry (fleet shutdown path)."""
         with self._lock:

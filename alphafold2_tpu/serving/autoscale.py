@@ -148,14 +148,26 @@ class ReplicaAutoscaler:
       fault_hook: chaos seam (`FaultInjector.autoscale_hook()`): called
         with the tick index; a returned "up"/"down" is a FORCED demand
         (bypasses sustain, still subject to cooldown/min/max).
+      pool: "" (default) scales the whole fleet off the fleet-wide
+        signals — the homogeneous PR-11 behavior. A capability-pool name
+        scopes EVERYTHING to that pool: signals read the pool-labeled
+        families (`fleet_pool_queue_depth` / `fleet_pool_occupancy` /
+        `fleet_pool_queue_wait_seconds` p95), actions call
+        `add_replica(pool=)` / `remove_replica(pool=)`, and the size
+        check uses `replica_count(pool)` — so a heterogeneous fleet runs
+        one autoscaler per pool and a saturated SP pool grows while the
+        idle dense pool shrinks, independently (ROADMAP item 4b). The
+        SLO fast-burn trigger stays fleet-wide (objectives are
+        fleet-level) but only fires a pool whose own queue is live.
     """
 
     def __init__(self, fleet, policy: ScalePolicy, *,
                  registry: Optional[MetricRegistry] = None,
                  clock=time.monotonic, incident_hook=None, fault_hook=None,
-                 max_events: int = 256):
+                 max_events: int = 256, pool: str = ""):
         self.fleet = fleet
         self.policy = policy
+        self.pool = pool
         self.registry = registry if registry is not None else fleet.registry
         self._clock = clock
         self._incident_hook = incident_hook
@@ -167,10 +179,12 @@ class ReplicaAutoscaler:
         self._last_action: Optional[str] = None
         self._last_action_at: Optional[float] = None
         self._events = collections.deque(maxlen=max_events)
+        pool_label = {"pool": pool} if pool else {}
         self._decisions = {
             name: self.registry.counter(
                 "autoscale_decisions_total",
-                help="autoscaler decisions by outcome", action=name)
+                help="autoscaler decisions by outcome", action=name,
+                **pool_label)
             for name in ("up", "down", "rejected", "suppressed")
         }
         # pool size itself is the fleet's gauge (fleet_replicas, set by
@@ -196,14 +210,28 @@ class ReplicaAutoscaler:
                     if all(dict(key).get(k) == v for k, v in want.items())]
             return max(vals, default=0.0)
 
+        # pool-scoped: the pool-labeled families (ServingFleet
+        # sample_gauges / _try_dispatch publish them) — never the global
+        # ones, which mix every pool's traffic together
+        if self.pool:
+            depth_name, occ_name = ("fleet_pool_queue_depth",
+                                    "fleet_pool_occupancy")
+            wait_name, want = ("fleet_pool_queue_wait_seconds",
+                               {"pool": self.pool})
+        else:
+            depth_name, occ_name = "fleet_queue_depth", "fleet_occupancy"
+            wait_name, want = "fleet_queue_wait_seconds", {}
+
         p95 = 0.0
-        fam = fams.get("fleet_queue_wait_seconds")
+        fam = fams.get(wait_name)
         if fam is not None and fam[0] == "histogram":
-            p95 = max((m.percentile(95.0) for m in fam[1].values()),
+            p95 = max((m.percentile(95.0) for key, m in fam[1].items()
+                       if all(dict(key).get(k) == v
+                              for k, v in want.items())),
                       default=0.0)
         return {
-            "queue_depth": max_gauge("fleet_queue_depth"),
-            "occupancy": max_gauge("fleet_occupancy"),
+            "queue_depth": max_gauge(depth_name, **want),
+            "occupancy": max_gauge(occ_name, **want),
             "queue_wait_p95": p95,
             "burn_fast": max_gauge("slo_burn_rate", window="fast"),
         }
@@ -290,7 +318,8 @@ class ReplicaAutoscaler:
                            reason=f"{action} inside {cooldown}s cooldown",
                            forced=bool(forced))
                 return
-            n = self.fleet.replica_count()
+            n = (self.fleet.replica_count(self.pool) if self.pool
+                 else self.fleet.replica_count())
             if action == "up" and n >= self.policy.max_replicas:
                 self._decisions["suppressed"].inc()
                 self._note(now, "suppressed", sig, reason="at_max",
@@ -305,9 +334,11 @@ class ReplicaAutoscaler:
         # wait on health machinery
         try:
             if action == "up":
-                name = self.fleet.add_replica()
+                name = (self.fleet.add_replica(pool=self.pool)
+                        if self.pool else self.fleet.add_replica())
             else:
-                name = self.fleet.remove_replica()
+                name = (self.fleet.remove_replica(pool=self.pool)
+                        if self.pool else self.fleet.remove_replica())
         except ScaleRejectedError as e:
             self._decisions["rejected"].inc()
             count_err = getattr(self.fleet, "_count_error", None)
@@ -321,13 +352,16 @@ class ReplicaAutoscaler:
             self._last_action, self._last_action_at = action, now
             self._up_streak = self._down_streak = 0
             self._decisions[action].inc()
-            n_after = self.fleet.replica_count()
+            n_after = (self.fleet.replica_count(self.pool) if self.pool
+                       else self.fleet.replica_count())
             self._note(now, action, sig, replica=name, replicas=n_after,
                        forced=bool(forced))
         if self._incident_hook is not None:
             try:
                 self._incident_hook(f"scale_{action}", replica=name,
-                                    replicas=n_after, **sig)
+                                    replicas=n_after,
+                                    **({"pool": self.pool} if self.pool
+                                       else {}), **sig)
             except Exception:  # noqa: BLE001 — observability must never
                 # take the control loop down
                 traceback.print_exc()
@@ -381,8 +415,10 @@ class ReplicaAutoscaler:
         with self._lock:
             return {
                 "policy": dataclasses.asdict(self.policy),
+                "pool": self.pool,
                 "ticks": self._ticks,
-                "replicas": self.fleet.replica_count(),
+                "replicas": (self.fleet.replica_count(self.pool)
+                             if self.pool else self.fleet.replica_count()),
                 "last_action": self._last_action,
                 "last_action_age_s": (
                     None if self._last_action_at is None

@@ -30,7 +30,7 @@ from typing import Sequence, Tuple
 import numpy as np
 
 from alphafold2_tpu.constants import PAD_TOKEN_ID
-from alphafold2_tpu.serving.errors import RequestTooLongError
+from alphafold2_tpu.serving.errors import SequenceTooLongError
 
 # ladder for real traffic: fine-grained at the short end where most
 # sequences live, coarse past the median protein length
@@ -59,15 +59,18 @@ class BucketLadder:
         return self.buckets[-1]
 
     def bucket_for(self, length: int) -> int:
-        """Smallest bucket that fits `length`; raises RequestTooLongError
-        past the top of the ladder (an explicit rejection the client can
-        route to a bigger deployment, not a silent truncation)."""
+        """Smallest bucket that fits `length`; raises SequenceTooLongError
+        (stable code `sequence_too_long`) past the top of the ladder — an
+        explicit rejection the client can route to a bigger deployment,
+        not a silent truncation. The fleet's length-adaptive router uses
+        the UNION ladder here, so "too long" always means "no capability
+        pool can serve it", the same signal the single engine raises."""
         if length <= 0:
             raise ValueError(f"sequence length must be positive, got {length}")
         for b in self.buckets:
             if length <= b:
                 return b
-        raise RequestTooLongError(
+        raise SequenceTooLongError(
             f"sequence length {length} exceeds the largest bucket "
             f"{self.max_len} (ladder: {self.buckets})"
         )

@@ -106,6 +106,18 @@ class ServingConfig:
     watchdog_timeout_s: Optional[float] = None  # hung-batch watchdog: a
     #                              dispatch exceeding this fails its batch
     #                              instead of wedging the worker (None = off)
+    # SP serving arm (serving/sp_arm.py; ROADMAP item 4a): >1 runs each
+    # bucket's trunk over a model-axis mesh of this many devices, with a
+    # per-bucket FastFold-style schedule (dense / sp_msa / sp_seq) picked
+    # by the residency heuristic below. 0 = dense everywhere (the
+    # pre-SP engine, bit-identical).
+    sp_shards: int = 0
+    sp_hbm_gb: float = 16.0      # per-chip HBM budget the schedule
+    #                              heuristic prices buckets against
+    #                              (planning estimate, not an allocator)
+    sp_schedules: Tuple[Tuple[int, str], ...] = ()  # per-bucket overrides
+    #                              ((bucket, schedule), ...) — win over
+    #                              the heuristic, loud when infeasible
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -126,6 +138,30 @@ class ServingConfig:
             raise ValueError(
                 f"watchdog_timeout_s must be positive or None, got "
                 f"{self.watchdog_timeout_s}"
+            )
+        if self.sp_shards < 0 or self.sp_shards == 1:
+            raise ValueError(
+                f"sp_shards must be 0 (dense) or >= 2, got {self.sp_shards}"
+            )
+        if self.sp_hbm_gb <= 0:
+            raise ValueError(
+                f"sp_hbm_gb must be positive, got {self.sp_hbm_gb}"
+            )
+        from alphafold2_tpu.serving.sp_arm import SP_SCHEDULES
+
+        object.__setattr__(
+            self, "sp_schedules",
+            tuple(sorted((int(b), str(s)) for b, s in self.sp_schedules)))
+        for _bucket, sched in self.sp_schedules:
+            if sched not in SP_SCHEDULES:
+                raise ValueError(
+                    f"sp_schedules entry {sched!r} is not a schedule; "
+                    f"known: {SP_SCHEDULES}"
+                )
+        if self.sp_schedules and not self.sp_shards:
+            raise ValueError(
+                "sp_schedules given but sp_shards=0 — per-bucket schedule "
+                "overrides only apply to the SP arm"
             )
         if self.mds_init == "random" and self.cache_capacity:
             # random MDS inits draw from a per-dispatch key, so identical
@@ -313,6 +349,31 @@ class ServingEngine:
         self.cfg = cfg
         self.model_cfg = model_cfg
         self._model_apply_fn = model_apply_fn
+        # SP serving arm (serving/sp_arm.py): a model-axis mesh plus a
+        # per-bucket schedule plan, priced chip-free at build. The plan is
+        # part of the config tag below — schedules differ in float
+        # association (ring/psum accumulation order), so results must
+        # never alias across plans.
+        self._sp_mesh = None
+        self._sp_plan = {}
+        if cfg.sp_shards:
+            if model_apply_fn is not None:
+                raise ValueError(
+                    "sp_shards and model_apply_fn are mutually exclusive: "
+                    "the SP arm builds its own per-bucket trunk override"
+                )
+            from alphafold2_tpu.serving import sp_arm
+
+            self._sp_mesh = sp_arm.build_sp_mesh(cfg.sp_shards)
+            self._sp_plan = sp_arm.plan_bucket_schedules(
+                model_cfg,
+                buckets=self._ladder.buckets,
+                batch=cfg.max_batch,
+                msa_rows=cfg.msa_rows,
+                shards=cfg.sp_shards,
+                hbm_bytes=cfg.sp_hbm_gb * (1 << 30),
+                overrides=dict(cfg.sp_schedules),
+            )
         # precision arm (serving/quant_residency.py): weight_dtype="int8"
         # places the per-channel-PTQ tree on device instead of the fp32
         # master — quantized once per residency tag process-wide, so a
@@ -341,9 +402,14 @@ class ServingEngine:
         # the env knobs themselves (tests/test_serving.py pins the
         # aliasing both ways).
         self._dispatch_tag = dispatch_resolution_tag()
+        # ... and the SP plan: two engines whose buckets take different
+        # schedules (dense vs ring-accumulated sp_seq vs psum-ordered
+        # sp_msa) agree only to rounding — never one cache keyspace
         self._config_tag = repr((
             model_cfg, cfg.mds_iters, cfg.mds_init, cfg.seed, cfg.msa_rows,
             cfg.params_tag, self._ladder.buckets, self._dispatch_tag,
+            cfg.sp_shards,
+            tuple((b, r.schedule) for b, r in sorted(self._sp_plan.items())),
         ))
 
         self._executables = {}
@@ -597,6 +663,17 @@ class ServingEngine:
     def compile_count(self) -> int:
         return self.metrics.compile_count
 
+    def capability(self) -> dict:
+        """The replica capability tag (ROADMAP item 4b): what traffic this
+        engine can physically serve — the fleet's length-adaptive router
+        and `stats()["replicas"]` both read it, so an operator can see WHY
+        a request landed where it did."""
+        return {
+            "weight_dtype": self.model_cfg.weight_dtype,
+            "sp_shards": self.cfg.sp_shards,
+            "max_len": self._ladder.max_len,
+        }
+
     def retry_after_estimate(self) -> float:
         """Backoff advice for shed clients: batch-assembly wait plus the
         backlog's drain time at the observed p50 — clamped so a cold
@@ -644,6 +721,18 @@ class ServingEngine:
         # config tag — operators reading stats() can see WHY two replicas
         # refuse to share a cache keyspace)
         snap["dispatch"] = self._dispatch_tag
+        snap["capability"] = self.capability()
+        if self.cfg.sp_shards:
+            # the per-bucket schedule plan + its chip-free residency
+            # pricing: what the heuristic decided and what it priced
+            snap["sp"] = {
+                "shards": self.cfg.sp_shards,
+                "hbm_budget_bytes": int(self.cfg.sp_hbm_gb * (1 << 30)),
+                "schedules": {
+                    str(b): r.as_dict()
+                    for b, r in sorted(self._sp_plan.items())
+                },
+            }
         if self._breaker is not None:
             snap["breaker"] = self._breaker.snapshot()
         # the unified telemetry view: every registry metric (per-bucket
@@ -714,6 +803,14 @@ class ServingEngine:
             B, rows = self.cfg.max_batch, self.cfg.msa_rows
             mcfg, iters, init = self.model_cfg, self.cfg.mds_iters, self.cfg.mds_init
             apply_fn = self._model_apply_fn
+            plan = self._sp_plan.get(bucket)
+            if plan is not None and plan.schedule != "dense":
+                # the SP arm: this bucket's trunk runs the planned
+                # dynamic-axial cut over the model-axis mesh
+                from alphafold2_tpu.serving import sp_arm
+
+                apply_fn = sp_arm.make_sp_apply_fn(
+                    self._sp_mesh, plan.schedule)
 
             def run(params, tokens, mask, key, msa=None, msa_mask=None):
                 out = predict_structure(

@@ -66,6 +66,22 @@ class RequestTooLongError(ServingError):
     code = "request_too_long"
 
 
+class SequenceTooLongError(RequestTooLongError):
+    """The sequence exceeds EVERY bucket ceiling this deployment can
+    serve: the single engine's ladder, or — in a heterogeneous fleet —
+    the largest-capability pool's ladder. A subclass of
+    `RequestTooLongError` so existing catch sites keep working, with its
+    OWN stable code: the length-adaptive router's "no capable replica"
+    path and the single-engine ladder rejection both raise exactly this
+    class, so clients and dashboards see one sharp `sequence_too_long`
+    signal (plus `fleet_shed_total{reason="too_long"}` fleet-side)
+    wherever an unservable length is rejected. Deliberate code rename
+    from the pre-PR-14 `request_too_long` (docs/SERVING.md changelog
+    note)."""
+
+    code = "sequence_too_long"
+
+
 class QueueFullError(ServingError):
     """The bounded request queue is at capacity. Backpressure is explicit:
     the caller decides whether to retry, shed, or escalate — the engine

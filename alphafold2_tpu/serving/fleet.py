@@ -80,6 +80,7 @@ from alphafold2_tpu.serving.errors import (
     RequestTimeoutError,
     RequeueLimitError,
     ScaleRejectedError,
+    SequenceTooLongError,
     ServingError,
 )
 from alphafold2_tpu.serving.featurize import (
@@ -101,6 +102,72 @@ _REPLICA_FAULT_ERRORS = (
 )
 
 DEGRADED = "degraded"  # reserved tier name (not a health-managed replica)
+
+DEFAULT_POOL = "default"  # implicit pool name for homogeneous fleets
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolSpec:
+    """One capability pool: replicas sharing a (weight_dtype x sp_shards
+    x bucket ceiling) capability tag (ROADMAP item 4b — the
+    generalization of PR 8's multi-precision residency into
+    heterogeneous-replica residency).
+
+    The fleet routes each request to the CHEAPEST pool whose ceiling
+    covers its length — pools are preferred in (bucket-ceiling ascending,
+    declaration order), so short sequences land on dense/int8 replicas
+    and only the lengths that need it reach the SP-sharded pool.
+    `weight_dtype`/`buckets` left at their defaults inherit the fleet's
+    base configs; the SP knobs are POOL-OWNED — with pools configured the
+    base ServingConfig must keep sp_shards=0 (the fleet rejects the
+    ambiguous combination loudly)."""
+
+    name: str
+    replicas: int = 1
+    weight_dtype: str = ""       # "int8"/"f32"; "" inherits the model cfg
+    sp_shards: int = 0           # >1: this pool's engines run the SP arm
+    buckets: Optional[tuple] = None  # pool bucket ladder; None inherits
+    sp_schedules: tuple = ()     # per-bucket SP overrides ((bucket,
+    #                              schedule), ...); () defers to the base
+    #                              config's overrides (ladder-filtered)
+    #                              and the residency heuristic
+
+    def __post_init__(self):
+        if not self.name or self.name == DEGRADED:
+            raise ValueError(
+                f"pool name must be non-empty and not {DEGRADED!r}, "
+                f"got {self.name!r}"
+            )
+        if self.replicas < 1:
+            raise ValueError(
+                f"pool {self.name!r}: replicas must be >= 1, "
+                f"got {self.replicas}"
+            )
+        if self.weight_dtype not in ("", "f32", "int8"):
+            raise ValueError(
+                f"pool {self.name!r}: weight_dtype must be '', 'f32', or "
+                f"'int8', got {self.weight_dtype!r}"
+            )
+        if self.sp_shards < 0 or self.sp_shards == 1:
+            raise ValueError(
+                f"pool {self.name!r}: sp_shards must be 0 or >= 2, "
+                f"got {self.sp_shards}"
+            )
+        if self.buckets is not None:
+            object.__setattr__(
+                self, "buckets", tuple(int(b) for b in self.buckets))
+            if not self.buckets:
+                raise ValueError(
+                    f"pool {self.name!r}: buckets must be None (inherit) "
+                    f"or non-empty"
+                )
+        object.__setattr__(
+            self, "sp_schedules",
+            tuple((int(b), str(s)) for b, s in self.sp_schedules))
+        if self.sp_schedules and not self.sp_shards:
+            raise ValueError(
+                f"pool {self.name!r}: sp_schedules without sp_shards"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -136,10 +203,22 @@ class FleetConfig:
     featurize_workers: int = 0
     featurize_queue: int = 128
     featurize_retry_limit: int = 1    # worker-death requeues per job
+    # Heterogeneous capability pools (ROADMAP item 4b): () = one implicit
+    # pool of `replicas` base-config engines (the pre-pool fleet,
+    # behavior-identical). Non-empty REPLACES `replicas`: each PoolSpec
+    # sizes and capability-tags its own slice of the fleet, routing
+    # prefers the cheapest capable pool, and the per-pool autoscalers
+    # scale each pool off its own queue-wait signal.
+    pools: tuple = ()
 
     def __post_init__(self):
         if self.replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if self.pools:
+            object.__setattr__(self, "pools", tuple(self.pools))
+            names = [p.name for p in self.pools]
+            if len(set(names)) != len(names):
+                raise ValueError(f"duplicate pool name in {names}")
         if self.requeue_limit < 0:
             raise ValueError(
                 f"requeue_limit must be >= 0, got {self.requeue_limit}"
@@ -179,6 +258,7 @@ class FleetRequest:
         # requeues onto other replicas all carry ONE id
         self.trace_id = trace_id or new_trace_id()
         self.requeues = 0
+        self.pool = None         # preferred capability pool (set at admit)
         self.failed_on = set()   # replica names this request failed on
         self.last_error: Optional[BaseException] = None
         self._event = threading.Event()
@@ -227,10 +307,12 @@ class _Replica:
     """One engine slot; the engine reference swaps across drain/restart
     cycles (guarded by the fleet lock)."""
 
-    def __init__(self, name: str, index: int, cfg: ServingConfig):
+    def __init__(self, name: str, index: int, cfg: ServingConfig,
+                 pool: str = DEFAULT_POOL):
         self.name = name
         self.index = index       # monotone creation index (victim ranking)
         self.cfg = cfg           # live: rolling updates swap it in place
+        self.pool = pool         # capability pool this slot belongs to
         self.factory = None      # () -> ServingEngine; reads self.cfg
         self.engine: Optional[ServingEngine] = None
         self.retiring = False    # deliberate removal in progress
@@ -238,6 +320,21 @@ class _Replica:
         self.dispatches = 0
         self.probe_counter = 0
         self.restarts = 0
+
+
+class _Pool:
+    """Runtime view of one capability pool (spec + derived capability)."""
+
+    def __init__(self, spec: PoolSpec, rank: int, ladder: BucketLadder):
+        self.spec = spec
+        self.name = spec.name
+        self.rank = rank          # routing preference (ceiling-ascending)
+        self.ladder = ladder
+        self.service_ema_s: Optional[float] = None  # drain-rate EMA
+
+    @property
+    def max_len(self) -> int:
+        return self.ladder.max_len
 
 
 class ServingFleet:
@@ -276,7 +373,40 @@ class ServingFleet:
         self._serving_cfg = serving_cfg
         self._model_apply_fn = model_apply_fn
         self._injector = injector
-        self._ladder = BucketLadder(serving_cfg.buckets)
+        # ---- capability pools (ROADMAP item 4b) ----
+        # no explicit pools = ONE implicit pool of base-config replicas
+        # (the pre-pool fleet, behavior-identical); explicit pools replace
+        # `replicas` and give the router a capability table. Preference is
+        # (bucket ceiling ascending, declaration order): short work lands
+        # on the cheapest capable pool, the SP pool keeps its headroom.
+        self._implicit_pools = not fleet_cfg.pools
+        if fleet_cfg.pools and serving_cfg.sp_shards:
+            # with pools configured, the SP knob belongs to the PoolSpecs
+            # (each pool declares its own sp_shards/sp_schedules): a base
+            # sp_shards would silently apply to the degraded tier but not
+            # the pools — reject the ambiguity instead of guessing
+            raise ValueError(
+                "ServingConfig.sp_shards and FleetConfig.pools are "
+                "mutually exclusive — declare sp_shards per PoolSpec"
+            )
+        specs = fleet_cfg.pools or (
+            PoolSpec(DEFAULT_POOL, replicas=fleet_cfg.replicas),)
+        base_buckets = serving_cfg.buckets
+        ordered = sorted(
+            enumerate(specs),
+            key=lambda iv: (max(iv[1].buckets or base_buckets), iv[0]),
+        )
+        self._pools = {}
+        for rank, (_, spec) in enumerate(ordered):
+            self._pools[spec.name] = _Pool(
+                spec, rank, BucketLadder(spec.buckets or base_buckets))
+        # the union ladder: featurization + the too-long check run against
+        # what the WHOLE fleet can serve — `bucket_for` past its top is the
+        # sharp sequence_too_long signal (no capable pool exists)
+        union = sorted({b for p in self._pools.values()
+                        for b in p.ladder.buckets})
+        self._ladder = BucketLadder(tuple(union))
+        self._replica_pool = {}   # replica name -> pool name (never reused)
         self._tracer = tracer if tracer is not None else NULL_TRACER
         self.registry = registry if registry is not None else MetricRegistry()
         self._incident_hook = incident_hook
@@ -324,6 +454,39 @@ class ServingFleet:
         self._replicas_gauge = self.registry.gauge(
             "fleet_replicas", help="current (non-retiring) replica count")
 
+        # ---- per-capability-pool telemetry (the length-adaptive router's
+        # observability + the per-pool autoscalers' signals) ----
+        self._routed = {}         # pool -> fleet_routed_total counter (lazy)
+        self._pool_wait = {
+            name: self.registry.histogram(
+                "fleet_pool_queue_wait_seconds",
+                help="admission wait of requests dispatched to this "
+                     "capability pool (p95 is the per-pool autoscaling "
+                     "signal)", pool=name)
+            for name in self._pools
+        }
+        self._pool_depth_g = {
+            name: self.registry.gauge(
+                "fleet_pool_queue_depth",
+                help="queued requests whose preferred capability pool is "
+                     "this one (sampled each ops tick)", pool=name)
+            for name in self._pools
+        }
+        self._pool_occ_g = {
+            name: self.registry.gauge(
+                "fleet_pool_occupancy",
+                help="dispatched requests per slot of this pool's healthy "
+                     "capacity", pool=name)
+            for name in self._pools
+        }
+        self._pool_reps_g = {
+            name: self.registry.gauge(
+                "fleet_pool_replicas",
+                help="current (non-retiring) replicas in this capability "
+                     "pool", pool=name)
+            for name in self._pools
+        }
+
         # ---- replicas + health ----
         self._admission = AdmissionController(
             AdmissionConfig(capacity=fleet_cfg.queue_capacity))
@@ -335,8 +498,11 @@ class ServingFleet:
         self._replicas = {}
         self._replica_seq = 0
         self._autoscaler = None
-        for _ in range(fleet_cfg.replicas):
-            self._spawn_replica()
+        self._pool_autoscalers = {}
+        self._last_gauge_sample = -1.0  # sample_gauges dedupe timestamp
+        for pool in self._pools.values():
+            for _ in range(pool.spec.replicas):
+                self._spawn_replica(pool.name)
 
         # ---- CPU featurization tier (serving/featurize.py) ----
         self._featurize: Optional[FeaturizePool] = None
@@ -364,13 +530,18 @@ class ServingFleet:
         if fleet_cfg.degraded_weight_dtype == "int8":
             self._degraded_model_cfg = dataclasses.replace(
                 model_cfg, weight_dtype="int8")
+        # the degraded tier serves only lengths ITS ladder (the base
+        # serving config's) covers — with wider capability pools
+        # configured, a long request must shed rather than silently land
+        # on a tier that cannot bucket it
+        self._degraded_ladder = BucketLadder(serving_cfg.buckets)
         if (fleet_cfg.degraded_mds_iters
                 or fleet_cfg.degraded_weight_dtype == "int8"):
             dcfg = serving_cfg
             if fleet_cfg.degraded_mds_iters:
                 dcfg = dataclasses.replace(
                     serving_cfg, mds_iters=fleet_cfg.degraded_mds_iters)
-            self._degraded_rep = _Replica(DEGRADED, -1, dcfg)
+            self._degraded_rep = _Replica(DEGRADED, -1, dcfg, pool=DEGRADED)
             self._degraded_rep.factory = self._make_factory(
                 self._degraded_rep)
             self._degraded_rep.engine = self._degraded_rep.factory()
@@ -382,9 +553,54 @@ class ServingFleet:
 
     # ------------------------------------------------------------ factories
 
+    def _pool_serving_cfg(self, pool: "_Pool") -> ServingConfig:
+        """The pool's ServingConfig, derived LIVE from the fleet template
+        (so rolling updates that retag the template reach every pool).
+        The implicit pool inherits the base config untouched."""
+        base = self._serving_cfg
+        if self._implicit_pools:
+            return base
+        spec = pool.spec
+        buckets = spec.buckets or base.buckets
+        # per-bucket SP overrides: the pool's own first, else the base
+        # config's filtered to this pool's ladder; a dense pool carries
+        # none (sp_schedules without sp_shards is a config error)
+        if not spec.sp_shards:
+            sp_scheds = ()
+        elif spec.sp_schedules:
+            sp_scheds = spec.sp_schedules
+        else:
+            sp_scheds = tuple((b, s) for b, s in base.sp_schedules
+                              if b in buckets)
+        return dataclasses.replace(
+            base, buckets=buckets, sp_shards=spec.sp_shards,
+            sp_schedules=sp_scheds)
+
+    def _pool_model_cfg(self, pool: "_Pool"):
+        """The pool's Alphafold2Config (weight-precision arm), derived
+        LIVE from the fleet master config."""
+        if self._implicit_pools or not pool.spec.weight_dtype:
+            return self._model_cfg
+        return dataclasses.replace(
+            self._model_cfg, weight_dtype=pool.spec.weight_dtype)
+
+    def _pool_capability(self, pool: "_Pool") -> dict:
+        """The pool's capability tag (what its engines CAN serve) — the
+        router's table, surfaced in stats()/statusz so an operator can
+        see why a request went where it did."""
+        cfg = self._pool_serving_cfg(pool)
+        return {
+            "weight_dtype": self._pool_model_cfg(pool).weight_dtype,
+            "sp_shards": cfg.sp_shards,
+            "max_len": pool.max_len,
+        }
+
     def _default_factory(self, name, cfg, fault_hook):
-        model_cfg = (self._degraded_model_cfg if name == DEGRADED
-                     else self._model_cfg)
+        if name == DEGRADED:
+            model_cfg = self._degraded_model_cfg
+        else:
+            model_cfg = self._pool_model_cfg(
+                self._pools[self._replica_pool[name]])
         return ServingEngine(
             self._params, model_cfg, cfg,
             model_apply_fn=self._model_apply_fn,
@@ -411,21 +627,27 @@ class ServingFleet:
 
         return build
 
-    def _spawn_replica(self) -> _Replica:
-        """Create, build, and register one replica (ctor + add_replica).
-        Builds the engine OUTSIDE the fleet lock (it may compile)."""
+    def _spawn_replica(self, pool_name: str) -> _Replica:
+        """Create, build, and register one replica in `pool_name`
+        (ctor + add_replica). Builds the engine OUTSIDE the fleet lock
+        (it may compile)."""
         with self._lock:
+            pool = self._pools[pool_name]
             i = self._replica_seq
             self._replica_seq += 1
             name = f"r{i}"
             rcfg = dataclasses.replace(
-                self._serving_cfg,
+                self._pool_serving_cfg(pool),
                 breaker_jitter=(self.cfg.breaker_jitter
                                 if self._serving_cfg.breaker_threshold
                                 else 0.0),
                 breaker_jitter_seed=i,
             )
-            rep = _Replica(name, i, rcfg)
+            rep = _Replica(name, i, rcfg, pool=pool_name)
+            # registered BEFORE the engine builds: the default factory
+            # resolves the pool's model config through this map (names
+            # are never reused, so entries never need removal)
+            self._replica_pool[name] = pool_name
             rep.factory = self._make_factory(rep)
         rep.engine = rep.factory()
         with self._lock:
@@ -483,17 +705,35 @@ class ServingFleet:
 
             if features is None and self._featurize is None:
                 # no tier: featurize inline on the submit thread (the
-                # pre-tier contract — same function, same errors)
+                # pre-tier contract — same function, same errors). The
+                # ladder is the UNION over capability pools, so its
+                # too-long rejection means NO pool can serve this length
+                # — the sharp sequence_too_long shed, identical to the
+                # single-engine ladder path.
                 try:
                     features = featurize_request(
                         seq, msa, msa_mask,
                         ladder=self._ladder,
                         msa_rows=self._serving_cfg.msa_rows,
                     )
+                except SequenceTooLongError as e:
+                    self._shed_too_long(e)
+                    raise
                 except ServingError as e:
                     self._count_error(e)
                     raise
             if features is not None:
+                if features.length > self._ladder.max_len:
+                    # a client-built bundle is untrusted: a length past
+                    # every pool's ceiling must shed HERE with the sharp
+                    # code, not die later as a replica-attributed
+                    # dispatch failure
+                    e = SequenceTooLongError(
+                        f"sequence length {features.length} exceeds every "
+                        f"capability pool's bucket ceiling "
+                        f"({self._ladder.max_len})")
+                    self._shed_too_long(e)
+                    raise e
                 entry = FleetRequest(features.seq, msa, msa_mask,
                                      resolve_priority(priority), deadline,
                                      trace_id=trace_id, features=features)
@@ -525,30 +765,87 @@ class ServingFleet:
                 raise
             return entry
 
+    def _shed_too_long(self, exc: SequenceTooLongError):
+        """Synchronous-path accounting for the sharp too-long shed: the
+        submission is counted submitted AND shed (terminal) so in_flight
+        arithmetic balances, with the dedicated shed reason + error code
+        an operator's dashboard keys on."""
+        self._counts["submitted"].inc()
+        self._counts["shed"].inc()
+        self._shed_counter("too_long").inc()
+        self._count_error(exc)
+
     def _on_featurized(self, entry: FleetRequest, bundle, exc):
         """Featurize-pool completion (pool worker thread): attach the
         features and offer the entry to the admission queue, or resolve
         it with the featurization error. Never raises."""
         if exc is not None:
-            self._resolve_failed(entry, exc)
+            if isinstance(exc, SequenceTooLongError):
+                # same sharp signal as the synchronous paths — the tier
+                # moves featurization across threads, never the taxonomy
+                self._resolve_shed(entry, "too_long", exc)
+            else:
+                self._resolve_failed(entry, exc)
             return
         entry.features = bundle
         entry.seq = bundle.seq
         self._admit(entry, raise_on_full=False)
 
+    def _preferred_pool_name(self, length: int) -> Optional[str]:
+        """First capability pool (preference order: ceiling ascending,
+        declaration order) whose bucket ceiling covers `length` — the
+        router's primary target and the depth-accounting key."""
+        for pool in sorted(self._pools.values(), key=lambda p: p.rank):
+            if pool.max_len >= length:
+                return pool.name
+        return None
+
+    def _pool_retry_after(self, pool_name: Optional[str],
+                          depth: Optional[int] = None) -> float:
+        """Backoff advice quoting the CAPABLE pool's backlog: depth of
+        queued entries targeting that pool x its drain-rate EMA (same
+        formula, cold default, and AdmissionConfig clamps as the global
+        estimate — one tuning surface). The global estimate would lie
+        whenever one pool is saturated and another idle — a
+        long-sequence shed must quote the SP pool's horizon, not the
+        idle dense pool's. `depth` lets a caller that already grouped
+        the queue (stats) skip the per-pool scan."""
+        pool = self._pools.get(pool_name) if pool_name else None
+        if pool is None:
+            return self._admission.retry_after_s()
+        if depth is None:
+            depth = sum(1 for e in self._admission.entries()
+                        if getattr(e, "pool", None) == pool.name)
+        acfg = self._admission.cfg
+        est = (pool.service_ema_s or 1.0) * max(1, depth)
+        return float(min(acfg.max_retry_after_s,
+                         max(acfg.min_retry_after_s, est)))
+
     def _admit(self, entry: FleetRequest, *, raise_on_full: bool):
         """Offer an accepted entry to the admission queue; shed/eviction
         accounting in one place for the sync and async entry paths."""
+        # tag the preferred capability pool (features are always attached
+        # by now — sync paths featurize before admitting, the tier admits
+        # from its completion callback): per-pool depth gauges and
+        # pool-quoted retry_after_s key on it
+        length = (entry.features.length if entry.features is not None
+                  else len(entry.seq))
+        entry.pool = self._preferred_pool_name(length)
         try:
             evicted = self._admission.offer(entry)
         except QueueFullError as e:
             # the entry stays counted as submitted: shed is its terminal
             # outcome, so in_flight arithmetic balances
+            if not self._implicit_pools:
+                e = QueueFullError(
+                    f"{e} (capable pool {entry.pool!r})",
+                    retry_after_s=self._pool_retry_after(entry.pool),
+                )
             if raise_on_full:
                 self._shed_counter("queue_full").inc()
                 self._counts["shed"].inc()
                 self._count_error(e)
-                raise
+                raise e from None
             self._resolve_shed(entry, "queue_full", e)
             return
         if evicted is not None:
@@ -557,7 +854,12 @@ class ServingFleet:
                 QueueFullError(
                     "evicted by a higher-priority arrival under "
                     "overload; retry with backoff",
-                    retry_after_s=self._admission.retry_after_s(),
+                    # the EVICTED entry's own capable pool, not the
+                    # arrival's: its retry lands back in that pool's line
+                    retry_after_s=(
+                        self._pool_retry_after(evicted.pool)
+                        if not self._implicit_pools
+                        else self._admission.retry_after_s()),
                 ))
         # close the TOCTOU window against shutdown() (the engine's
         # stance, engine.py): if the ROUTER is stopping (or crashed —
@@ -587,19 +889,39 @@ class ServingFleet:
 
     # -------------------------------------------------------- elasticity
 
-    def replica_count(self) -> int:
-        """Non-retiring full replicas (the autoscaler's pool size)."""
-        with self._lock:
-            return sum(1 for r in self._replicas.values() if not r.retiring)
+    def _resolve_pool_name(self, pool: Optional[str]) -> str:
+        """Default to the sole pool; with several, the caller must say
+        which capability pool a scale action targets."""
+        if pool is None:
+            if len(self._pools) == 1:
+                return next(iter(self._pools))
+            raise ScaleRejectedError(
+                f"fleet has capability pools {sorted(self._pools)} — "
+                f"scale actions must name one (pool=...)")
+        if pool not in self._pools:
+            raise ScaleRejectedError(
+                f"no capability pool named {pool!r}; known: "
+                f"{sorted(self._pools)}")
+        return pool
 
-    def add_replica(self) -> str:
-        """Grow the pool by one replica (autoscale scale-up). Returns the
-        new replica's name. Raises ScaleRejectedError when the fleet is
-        closed or the engine fails to build — a failed grow must be a
-        visible decision outcome, not a zombie slot."""
+    def replica_count(self, pool: Optional[str] = None) -> int:
+        """Non-retiring full replicas — fleet-wide, or one capability
+        pool's slice (the per-pool autoscaler's pool size)."""
+        with self._lock:
+            return sum(1 for r in self._replicas.values()
+                       if not r.retiring
+                       and (pool is None or r.pool == pool))
+
+    def add_replica(self, pool: Optional[str] = None) -> str:
+        """Grow the pool by one replica (autoscale scale-up). `pool`
+        names the capability pool to grow (optional with one pool).
+        Returns the new replica's name. Raises ScaleRejectedError when
+        the fleet is closed or the engine fails to build — a failed grow
+        must be a visible decision outcome, not a zombie slot."""
         if self._closed:
             raise ScaleRejectedError("fleet is shut down")
-        rep = self._spawn_replica()
+        pool = self._resolve_pool_name(pool)
+        rep = self._spawn_replica(pool)
         if rep.engine is None:
             # take the stillborn slot back out through the normal path
             rep.retiring = True
@@ -608,28 +930,34 @@ class ServingFleet:
                 f"replica {rep.name} engine failed to build")
         return rep.name
 
-    def remove_replica(self, name: Optional[str] = None) -> str:
-        """Shrink the pool by one replica through the HealthMonitor
+    def remove_replica(self, name: Optional[str] = None,
+                       pool: Optional[str] = None) -> str:
+        """Shrink the fleet by one replica through the HealthMonitor
         drain path (autoscale scale-down): the victim stops taking
         traffic immediately, its queued work fails back through the
         requeue path onto the survivors (nothing is lost), and the
         health tick unregisters it after the drain runs. `name=None`
-        picks the least-loaded healthy replica (newest on ties).
+        picks the least-loaded healthy replica (newest on ties) within
+        `pool` (or fleet-wide with one pool).
 
-        Raises ScaleRejectedError when: the fleet is closed; the pool
-        would drop below one replica; `name` is unknown or already
-        retiring; or (victim unspecified) any replica is DOWN — draining
-        on top of failure-drained capacity would amplify the outage, so
-        autoscale shrink is refused while the pool is unhealthy."""
+        Raises ScaleRejectedError when: the fleet is closed; the victim's
+        capability pool would drop below one replica (a pool emptied of
+        capacity silently narrows what the FLEET can serve); `name` is
+        unknown or already retiring; or (victim unspecified) any replica
+        in the target pool is DOWN — draining on top of failure-drained
+        capacity would amplify the outage."""
         with self._lock:
             if self._closed:
                 raise ScaleRejectedError("fleet is shut down")
-            live = [r for r in self._replicas.values() if not r.retiring]
-            if len(live) <= 1:
-                raise ScaleRejectedError(
-                    "refusing to shrink below one replica")
-            healthy = set(self._health.healthy_targets())
             if name is None:
+                pool = self._resolve_pool_name(pool)
+                live = [r for r in self._replicas.values()
+                        if not r.retiring and r.pool == pool]
+                if len(live) <= 1:
+                    raise ScaleRejectedError(
+                        f"refusing to shrink pool {pool!r} below one "
+                        f"replica")
+                healthy = set(self._health.healthy_targets())
                 down = sorted(r.name for r in live if r.name not in healthy)
                 if down:
                     raise ScaleRejectedError(
@@ -642,25 +970,57 @@ class ServingFleet:
                 if victim is None or victim.retiring:
                     raise ScaleRejectedError(
                         f"no live replica named {name!r}")
+                peers = sum(1 for r in self._replicas.values()
+                            if not r.retiring and r.pool == victim.pool)
+                if peers <= 1:
+                    raise ScaleRejectedError(
+                        f"refusing to shrink pool {victim.pool!r} below "
+                        f"one replica")
             victim.retiring = True
         self._health.retire(victim.name, "scale_down")
         return victim.name
 
     def attach_autoscaler(self, autoscaler):
         """Bind a ReplicaAutoscaler so `stats()` carries its snapshot
-        (the acceptance surface) and shutdown() stops its ticker."""
-        self._autoscaler = autoscaler
+        (the acceptance surface) and shutdown() stops its ticker. A
+        pool-scoped autoscaler (ReplicaAutoscaler(pool=...)) registers
+        under its pool; the fleet holds one per capability pool plus at
+        most one fleet-wide scaler."""
+        pool = getattr(autoscaler, "pool", "") or ""
+        if pool:
+            self._pool_autoscalers[pool] = autoscaler
+        else:
+            self._autoscaler = autoscaler
 
     def sample_gauges(self):
         """Ticker hook (ops plane / autoscaler): publish the LIVE queue
         and occupancy signals as registry gauges — until this hook,
         queue depth and the drain-rate EMA were visible only inside
         `stats()` snapshots, so a `/metrics` scrape between requests
-        never saw queue pressure."""
+        never saw queue pressure.
+
+        Cheap-dedupe guard: with per-pool autoscalers every pool's
+        ticker calls this at the same cadence, and each pass takes the
+        fleet lock + scans the admission queue — K pools must not mean
+        K redundant sweeps per tick. Calls within 50 ms of the last
+        full sample are no-ops (the signals cannot meaningfully change
+        faster than the tick cadences that consume them)."""
+        now = time.monotonic()
+        with self._lock:
+            # check-and-set under the lock: two pool tickers firing at
+            # the same instant must not both pass the guard
+            if now - self._last_gauge_sample < 0.05:
+                return
+            self._last_gauge_sample = now
         snap = self._admission.snapshot()
         self._queue_depth_gauge.set(snap["depth"])
         self._service_ema_gauge.set(snap["service_ema_s"] or 0.0)
         healthy = set(self._health.healthy_targets())
+        depth_by_pool = {}
+        for e in self._admission.entries():
+            p = getattr(e, "pool", None)
+            if p is not None:
+                depth_by_pool[p] = depth_by_pool.get(p, 0) + 1
         with self._lock:
             live = [r for r in self._replicas.values() if not r.retiring]
             n_live = len(live)
@@ -668,8 +1028,24 @@ class ServingFleet:
                             if r.name in healthy)
             slots = sum(r.cfg.max_batch for r in live
                         if r.name in healthy)
+            per_pool = {}
+            for name in self._pools:
+                p_live = [r for r in live if r.pool == name]
+                per_pool[name] = (
+                    len(p_live),
+                    sum(r.in_flight for r in p_live if r.name in healthy),
+                    sum(r.cfg.max_batch for r in p_live
+                        if r.name in healthy),
+                )
         self._replicas_gauge.set(n_live)
         self._occupancy_gauge.set(in_flight / slots if slots else 0.0)
+        # the per-capability-pool view: each pool autoscaler reads ITS
+        # queue depth / occupancy / size, so a saturated SP pool scales
+        # without the idle dense pool's signals diluting the decision
+        for name, (n_p, inf_p, slots_p) in per_pool.items():
+            self._pool_reps_g[name].set(n_p)
+            self._pool_occ_g[name].set(inf_p / slots_p if slots_p else 0.0)
+            self._pool_depth_g[name].set(depth_by_pool.get(name, 0))
         if self._featurize is not None:
             self._featurize.sample_gauges()
 
@@ -813,13 +1189,49 @@ class ServingFleet:
                          in self._health.snapshot()["targets"].items()}
         for rep in reps + ([degraded] if degraded else []):
             engine = rep.engine
+            pool = self._pools.get(rep.pool)
+            # capability visibility (ISSUE 14 satellite): the live
+            # engine's own tag when it exists, else the pool's derived
+            # one — so /statusz always shows WHY the router considers
+            # this replica for a given length
+            if engine is not None:
+                capability = engine.capability()
+            elif pool is not None:
+                capability = self._pool_capability(pool)
+            else:  # degraded tier mid-restart
+                capability = {
+                    "weight_dtype": self._degraded_model_cfg.weight_dtype,
+                    "sp_shards": rep.cfg.sp_shards,
+                    "max_len": self._degraded_ladder.max_len,
+                }
             replicas[rep.name] = {
                 "state": (DEGRADED if rep.name == DEGRADED
                           else health_states.get(rep.name, "retired")),
+                "pool": rep.pool,
+                "capability": capability,
                 "in_flight": rep.in_flight,
                 "dispatches": rep.dispatches,
                 "restarts": rep.restarts,
                 "engine": engine.stats() if engine is not None else None,
+            }
+        pools = {}
+        # ONE queue snapshot grouped by pool (not a full scan per pool):
+        # stats() sits on the observability hot path (/statusz, the
+        # stats-flusher thread, polling tests)
+        depth_by_pool = {}
+        for e in self._admission.entries():
+            p = getattr(e, "pool", None)
+            if p is not None:
+                depth_by_pool[p] = depth_by_pool.get(p, 0) + 1
+        for name, pool in self._pools.items():
+            pools[name] = {
+                "rank": pool.rank,
+                "capability": self._pool_capability(pool),
+                "replicas": sum(1 for r in reps
+                                if r.pool == name and not r.retiring),
+                "service_ema_s": pool.service_ema_s,
+                "retry_after_s": self._pool_retry_after(
+                    name, depth=depth_by_pool.get(name, 0)),
             }
         out = {
             "closed": self._closed,
@@ -830,6 +1242,7 @@ class ServingFleet:
             "latency": self._latency.snapshot(),
             "admission": self._admission.snapshot(),
             "replicas": replicas,
+            "pools": pools,
             "health": self._health.snapshot(),
             "telemetry": {
                 "metrics": self.registry.snapshot(),
@@ -840,6 +1253,11 @@ class ServingFleet:
             out["featurize"] = self._featurize.stats()
         if self._autoscaler is not None:
             out["autoscale"] = self._autoscaler.snapshot()
+        if self._pool_autoscalers:
+            out["autoscale_pools"] = {
+                pool: sc.snapshot()
+                for pool, sc in sorted(self._pool_autoscalers.items())
+            }
         return out
 
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
@@ -854,6 +1272,8 @@ class ServingFleet:
             # also checks _closed; stopping the fallback thread is belt
             # and braces)
             self._autoscaler.stop()
+        for scaler in self._pool_autoscalers.values():
+            scaler.stop()
         if self._featurize is not None:
             # featurize first: its pending jobs resolve their entries
             # (drain=True runs them through admission; anything the
@@ -922,16 +1342,29 @@ class ServingFleet:
                              requeues=entry.requeues)
         overloaded = (self.cfg.degrade_depth > 0
                       and self._admission.depth() >= self.cfg.degrade_depth)
+        # length-adaptive routing (ROADMAP item 4b): only replicas whose
+        # capability pool's bucket ceiling covers the request are
+        # candidates, preferred cheapest-pool-first (pool rank = ceiling
+        # ascending, declaration order) then least-loaded — short work
+        # lands on dense/int8 replicas, the SP pool keeps its headroom
+        # for the lengths only it can serve
+        length = (entry.features.length if entry.features is not None
+                  else len(entry.seq))
         healthy = self._health.healthy_targets()
         with self._lock:
             # .get: a replica retired by the autoscaler may briefly
             # linger in the health view (or vice versa) mid-transition
             ranked = sorted(
                 (r for r in (self._replicas.get(n) for n in healthy)
-                 if r is not None and not r.retiring),
-                key=lambda r: r.in_flight,
+                 if r is not None and not r.retiring
+                 and self._pools[r.pool].max_len >= length),
+                key=lambda r: (self._pools[r.pool].rank, r.in_flight),
             )
             degraded = self._degraded_rep
+        if degraded is not None and self._degraded_ladder.max_len < length:
+            # the degraded tier's ladder cannot bucket this request —
+            # never a candidate, whatever the overload state
+            degraded = None
         # failover exclusion: a replica this request already FAILED on is
         # the worst candidate, not an equal one — prefer untried healthy
         # replicas, fall to the degraded tier when none remain, and only
@@ -946,14 +1379,15 @@ class ServingFleet:
             targets = targets + [degraded]
         targets = targets + stale
         if not targets:
-            # every full replica is down and there is no degraded tier:
-            # answer NOW with the re-probe horizon instead of letting the
-            # request age out silently
+            # every CAPABLE replica is down (config-level incapacity —
+            # a length past every pool's ceiling — already shed at submit
+            # with sequence_too_long): answer NOW with the re-probe
+            # horizon instead of letting the request age out silently
             self._resolve_shed(
                 entry, "no_healthy_replica",
                 NoHealthyReplicaError(
-                    "every replica is down and no degraded tier is "
-                    "configured",
+                    f"every replica capable of length {length} is down "
+                    f"and no degraded tier covers it",
                     retry_after_s=self.cfg.reprobe_interval_s))
             return
         for rep in targets:
@@ -1019,6 +1453,14 @@ class ServingFleet:
         with self._lock:
             rep.in_flight += 1
             rep.dispatches += 1
+        # routed accounting: which capability pool actually took it, and
+        # that pool's queue-wait distribution (the per-pool autoscaling
+        # signal — a saturated pool's wait climbs even while another
+        # pool's sits at zero)
+        self._routed_counter(rep.pool).inc()
+        hist = self._pool_wait.get(rep.pool)
+        if hist is not None:
+            hist.observe(now - entry.enqueued_at)
         dispatched_at = now
         inner.add_done_callback(
             lambda r, e=entry, rp=rep, t=dispatched_at:
@@ -1038,7 +1480,16 @@ class ServingFleet:
         if exc is None:
             if not degraded:
                 self._health.record_success(rep.name)
-            self._admission.note_served(time.monotonic() - dispatched_at)
+            service_s = time.monotonic() - dispatched_at
+            self._admission.note_served(service_s)
+            pool = self._pools.get(rep.pool)
+            if pool is not None:
+                # per-pool drain-rate EMA: what pool-quoted retry_after_s
+                # estimates are built from
+                with self._lock:
+                    pool.service_ema_s = (
+                        service_s if pool.service_ema_s is None
+                        else 0.2 * service_s + 0.8 * pool.service_ema_s)
             if entry._finish(result=result, replica=rep.name,
                              degraded=degraded,
                              latency_s=time.monotonic() - entry.enqueued_at):
@@ -1082,6 +1533,20 @@ class ServingFleet:
                     "fleet_shed_total", help="load shed by reason",
                     reason=reason)
                 self._shed_reasons[reason] = counter
+            return counter
+
+    def _routed_counter(self, pool: str):
+        """fleet_routed_total{pool} — lazy so the degraded tier (not a
+        capability pool) gets its own row on first spill."""
+        with self._lock:
+            counter = self._routed.get(pool)
+            if counter is None:
+                counter = self.registry.counter(
+                    "fleet_routed_total",
+                    help="requests dispatched per capability pool "
+                         "(degraded-tier spills under pool=degraded)",
+                    pool=pool)
+                self._routed[pool] = counter
             return counter
 
     def _count_error(self, exc):

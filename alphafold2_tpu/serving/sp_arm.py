@@ -1,0 +1,307 @@
+"""The SP serving arm: schedule choice + residency pricing per bucket.
+
+Serving was crop-bounded by construction: every bucket executable ran the
+replicated trunk, so a request had to fit ONE chip's HBM and a sequence
+past the largest bucket simply died. The sequence-parallel trunk and ring
+attention (PRs 5/7) already existed — for training. This module wires
+them into the serving path (ROADMAP item 4a): with
+`ServingConfig.sp_shards > 1` the engine builds bucket executables whose
+trunk runs over a model-axis mesh, and THIS module decides, per length
+bucket, which FastFold-style dynamic-axial-parallelism cut to take
+(arxiv 2203.00854 — shard whichever axis dominates):
+
+  `"dense"`   the replicated trunk — no collectives, the right answer for
+              every bucket that fits one chip;
+  `"sp_msa"`  shard the MSA ROW axis only (`msa_sharded_trunk_apply`):
+              MSA residency and attention FLOPs divide by the shard
+              count, the pair grid stays whole — the deep-alignment cut,
+              cheaper in communication than sp_seq (no pair-side
+              all_to_all transposes, no ring);
+  `"sp_seq"`  shard the SEQUENCE (pair rows + MSA rows, `sp_trunk_apply`
+              with ring cross-attention resolving its hop merge through
+              ops/dispatch.py like every other hot op): the O(L^2) pair
+              grid divides by the shard count — the long-sequence cut.
+
+The heuristic (`choose_schedule`) prices each candidate's per-chip
+residency CHIP-FREE — every byte count comes from `jax.eval_shape`
+structs, never a live allocation — and picks the cheapest-communication
+schedule that fits the per-chip budget (`ServingConfig.sp_hbm_gb`):
+dense < sp_msa < sp_seq. Per-bucket overrides
+(`ServingConfig.sp_schedules`) win over the heuristic and fail LOUDLY
+when infeasible (a non-dividing bucket must be a config error, not a
+silent dense fallback that OOMs on chip).
+
+The priced "residency" is the executable's dominant live set: the model
+weight tree (int8-priced under the quantized arm), the trunk's two
+residual streams at a documented live-copy multiplier, and the distogram
+logits (the head runs replicated after the sharded trunk — counted
+full-size on every chip, deliberately conservative). It is a routing/
+planning estimate with the same contract as PR 8's weight-residency
+pricing, not an allocator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from alphafold2_tpu.models import Alphafold2Config
+
+#: schedule names, in preference order (cheapest communication first) —
+#: `choose_schedule` picks the first feasible one that fits the budget
+SP_SCHEDULES = ("dense", "sp_msa", "sp_seq")
+
+#: live copies of each residual stream priced per trunk position: the
+#: stream itself, its pre-norm copy, the attention/FF block output, and
+#: one workspace tile — the documented planning multiplier (residual
+#: rematerialization and fusion change the exact number; 4 is the
+#: conservative figure the A/B legs validate on chip)
+LIVE_COPIES = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduleResidency:
+    """Per-chip priced residency of one (bucket, schedule) executable."""
+
+    schedule: str
+    weight_bytes: int
+    pair_bytes: int      # pair residual stream x LIVE_COPIES, per chip
+    msa_bytes: int       # MSA residual stream x LIVE_COPIES, per chip
+    logits_bytes: int    # distogram head output (replicated; conservative)
+    feasible: bool       # divisibility constraints hold for this shape
+
+    @property
+    def total_bytes(self) -> int:
+        return (self.weight_bytes + self.pair_bytes + self.msa_bytes
+                + self.logits_bytes)
+
+    def as_dict(self) -> dict:
+        return {
+            "schedule": self.schedule,
+            "weight_bytes": int(self.weight_bytes),
+            "pair_bytes": int(self.pair_bytes),
+            "msa_bytes": int(self.msa_bytes),
+            "logits_bytes": int(self.logits_bytes),
+            "total_bytes": int(self.total_bytes),
+            "feasible": bool(self.feasible),
+        }
+
+
+def _struct_bytes(tree) -> int:
+    return int(sum(
+        leaf.size * jnp.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree_util.tree_leaves(tree)
+    ))
+
+
+def weight_residency_bytes(model_cfg: Alphafold2Config) -> int:
+    """Per-chip resident weight bytes, priced chip-free via eval_shape —
+    the int8 arm prices the PTQ tree (serving/quant_residency.py places
+    exactly that on device), f32 prices the master tree."""
+    from alphafold2_tpu.models import alphafold2_init
+    from alphafold2_tpu.ops.quant import quantize_tree, tree_weight_bytes
+
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    f32_cfg = (dataclasses.replace(model_cfg, weight_dtype="f32")
+               if model_cfg.weight_dtype != "f32" else model_cfg)
+    tree = jax.eval_shape(lambda k: alphafold2_init(k, f32_cfg), key)
+    if model_cfg.weight_dtype == "int8":
+        tree = jax.eval_shape(quantize_tree, tree)
+    return int(tree_weight_bytes(tree))
+
+
+def _feasible(schedule: str, bucket: int, msa_rows: int, shards: int) -> bool:
+    if schedule == "dense":
+        return True
+    if schedule == "sp_seq":
+        # pair rows divide; MSA rows too when an MSA stream is served
+        return bucket % shards == 0 and (
+            msa_rows == 0 or msa_rows % shards == 0)
+    if schedule == "sp_msa":
+        # needs an MSA to shard; rows divide, and cols (= bucket) divide
+        # for the along-rows transpose pass (msa_sharded_trunk_apply)
+        return (msa_rows > 0 and msa_rows % shards == 0
+                and bucket % shards == 0)
+    raise ValueError(
+        f"unknown SP schedule {schedule!r}; known: {SP_SCHEDULES}")
+
+
+def schedule_residency(
+    model_cfg: Alphafold2Config,
+    *,
+    bucket: int,
+    batch: int,
+    msa_rows: int,
+    schedule: str,
+    shards: int,
+    weight_bytes: Optional[int] = None,
+) -> ScheduleResidency:
+    """Price one (bucket, schedule) executable's per-chip residency.
+
+    Every byte count derives from `jax.eval_shape` structs (abstract
+    zeros at the executable's real shapes/dtypes) — nothing allocates.
+    `weight_bytes` can be passed in so a ladder-wide planning pass prices
+    the tree once.
+    """
+    if schedule not in SP_SCHEDULES:
+        raise ValueError(
+            f"unknown SP schedule {schedule!r}; known: {SP_SCHEDULES}")
+    s_pair = shards if schedule == "sp_seq" else 1
+    s_msa = shards if schedule in ("sp_seq", "sp_msa") else 1
+    dtype = model_cfg.dtype
+
+    def streams():
+        pair = jnp.zeros(
+            (batch, max(1, bucket // s_pair), bucket, model_cfg.dim), dtype)
+        msa = (jnp.zeros(
+            (batch, max(1, msa_rows // s_msa), bucket, model_cfg.dim), dtype)
+            if msa_rows else jnp.zeros((0,), dtype))
+        logits = jnp.zeros(
+            (batch, bucket, bucket, model_cfg.num_buckets), jnp.float32)
+        return pair, msa, logits
+
+    pair_s, msa_s, logits_s = jax.eval_shape(streams)
+    if weight_bytes is None:
+        weight_bytes = weight_residency_bytes(model_cfg)
+    return ScheduleResidency(
+        schedule=schedule,
+        weight_bytes=weight_bytes,
+        pair_bytes=_struct_bytes(pair_s) * LIVE_COPIES,
+        msa_bytes=_struct_bytes(msa_s) * LIVE_COPIES,
+        logits_bytes=_struct_bytes(logits_s),
+        feasible=_feasible(schedule, bucket, msa_rows, shards),
+    )
+
+
+def choose_schedule(
+    model_cfg: Alphafold2Config,
+    *,
+    bucket: int,
+    batch: int,
+    msa_rows: int,
+    shards: int,
+    hbm_bytes: float,
+    weight_bytes: Optional[int] = None,
+) -> ScheduleResidency:
+    """The length/HBM heuristic: cheapest-communication schedule that fits.
+
+    Candidates run in `SP_SCHEDULES` preference order (dense -> sp_msa ->
+    sp_seq); infeasible cuts (non-dividing bucket/rows, no MSA to shard)
+    are skipped. If NOTHING fits the budget the most-sharded feasible
+    candidate is returned (`feasible` stays True but its total exceeds
+    `hbm_bytes` — the engine surfaces the overage in `stats()["sp"]`
+    rather than refusing to serve: the budget is a planning estimate).
+    """
+    if weight_bytes is None:
+        weight_bytes = weight_residency_bytes(model_cfg)
+    best = None
+    for schedule in SP_SCHEDULES:
+        res = schedule_residency(
+            model_cfg, bucket=bucket, batch=batch, msa_rows=msa_rows,
+            schedule=schedule, shards=shards, weight_bytes=weight_bytes,
+        )
+        if not res.feasible:
+            continue
+        if res.total_bytes <= hbm_bytes:
+            return res
+        best = res  # later candidates shard more: keep the last feasible
+    # "dense" is unconditionally feasible, so best is always set: the
+    # worst case is an over-budget plan, never an empty one
+    assert best is not None
+    return best
+
+
+def plan_bucket_schedules(
+    model_cfg: Alphafold2Config,
+    *,
+    buckets: Tuple[int, ...],
+    batch: int,
+    msa_rows: int,
+    shards: int,
+    hbm_bytes: float,
+    overrides: Optional[Mapping[int, str]] = None,
+) -> Dict[int, ScheduleResidency]:
+    """bucket -> priced schedule for the whole ladder (engine build time).
+
+    `overrides` (from `ServingConfig.sp_schedules`) win over the
+    heuristic; an override naming an unknown bucket or an infeasible
+    schedule raises — a mis-keyed override must never silently leave the
+    heuristic's choice in force.
+    """
+    overrides = dict(overrides or {})
+    unknown = set(overrides) - set(buckets)
+    if unknown:
+        raise ValueError(
+            f"sp_schedules overrides name bucket(s) {sorted(unknown)} not "
+            f"on the ladder {tuple(buckets)}"
+        )
+    weight_bytes = weight_residency_bytes(model_cfg)
+    plan: Dict[int, ScheduleResidency] = {}
+    for bucket in buckets:
+        forced = overrides.get(bucket)
+        if forced is not None:
+            res = schedule_residency(
+                model_cfg, bucket=bucket, batch=batch, msa_rows=msa_rows,
+                schedule=forced, shards=shards, weight_bytes=weight_bytes,
+            )
+            if not res.feasible:
+                raise ValueError(
+                    f"sp_schedules forces {forced!r} for bucket {bucket}, "
+                    f"but that cut is infeasible at msa_rows={msa_rows}, "
+                    f"shards={shards} (divisibility)"
+                )
+            plan[bucket] = res
+        else:
+            plan[bucket] = choose_schedule(
+                model_cfg, bucket=bucket, batch=batch, msa_rows=msa_rows,
+                shards=shards, hbm_bytes=hbm_bytes,
+                weight_bytes=weight_bytes,
+            )
+    return plan
+
+
+def build_sp_mesh(shards: int, *, axis_name: str = "sp"):
+    """The serving model-axis mesh: `shards` devices on one axis. Raises
+    with sizing advice when the host exposes fewer devices."""
+    from alphafold2_tpu.parallel import make_mesh
+
+    n = len(jax.devices())
+    if n < shards:
+        raise ValueError(
+            f"sp_shards={shards} needs {shards} devices, host exposes {n} "
+            f"— size sp_shards to the accelerator count (or provision the "
+            f"virtual CPU platform for chip-free work)"
+        )
+    return make_mesh({axis_name: shards})
+
+
+def make_sp_apply_fn(mesh, schedule: str, *, axis_name: str = "sp",
+                     overlap=None):
+    """Trunk-forward override for `serving.pipeline.predict_structure`
+    running the chosen SP cut over `mesh`. Returns None for "dense" (the
+    pipeline's stock replicated apply)."""
+    if schedule == "dense":
+        return None
+    if schedule not in SP_SCHEDULES:
+        raise ValueError(
+            f"unknown SP schedule {schedule!r}; known: {SP_SCHEDULES}")
+    from alphafold2_tpu.parallel import alphafold2_apply_sp
+
+    def apply_fn(params, cfg, tokens, msa, *, mask=None, msa_mask=None,
+                 embedds=None, templates=None, templates_mask=None):
+        if embedds is not None:
+            raise ValueError(
+                "the SP serving arm shards token/MSA row axes; the embedds "
+                "substitute stream has none — serve embedds dense"
+            )
+        return alphafold2_apply_sp(
+            params, cfg, tokens, msa, mesh,
+            axis_name=axis_name, mask=mask, msa_mask=msa_mask,
+            templates=templates, templates_mask=templates_mask,
+            overlap=overlap, schedule=schedule,
+        )
+
+    return apply_fn

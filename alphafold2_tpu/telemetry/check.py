@@ -80,6 +80,16 @@ _RULES: Tuple[Tuple[str, str, float], ...] = (
     # informational even though their kind labels contain "stall":
     # they scale with run length and chaos plans, not speed
     ("*incidents_total*", "ignore", 0.0),
+    # length-adaptive fleet routing (ISSUE 14): routed counts/fractions
+    # are traffic COMPOSITION, not speed — a trace with more long
+    # sequences legitimately routes more to the SP pool. Placed before
+    # the volume-ignores only for documentation locality; same verdict.
+    ("*routed*", "ignore", 0.0),
+    # per-capability-pool queue wait (the per-pool autoscaling signal):
+    # lower is better, and it must gate even though the global
+    # *_seconds* rule would also catch it — the pool label is the point
+    # (a saturated SP pool hides inside a healthy global p95)
+    ("*pool_queue_wait*", "lower", 0.25),
     ("*badput*", "lower", 0.25),
     # 25%, not the 5-10% of the steady-state throughput rules: the
     # chip-free train_goodput leg's ratio is compile-dominated on a CPU

@@ -498,6 +498,159 @@ print(json.dumps({
 """
 
 
+# SP serving arm A/B (ISSUE 14 tentpole): the SAME serving-shaped
+# bucket executable (engine AOT path: padded batch -> trunk -> distogram
+# -> MDS) with the trunk dense vs sequence-parallel over an sp_shards
+# mesh. TPU-only (require_tpu: a CPU ring measures nothing about ICI);
+# additionally skips when the host exposes fewer devices than the mesh
+# needs. The on-arm FORCES sp_seq at the bucket via the per-bucket
+# override so the 16 GB heuristic cannot silently serve the dense twin
+# under the SP leg's name.
+SERVE_SP_WORKER = r"""
+import json, sys, time, os
+spec = json.loads(sys.argv[1])
+import jax
+import numpy as np
+
+platform = jax.devices()[0].platform
+if spec.get("require_tpu") and platform != "tpu":
+    print(json.dumps({"skipped": "leg requires a TPU device",
+                      "platform": platform}))
+    sys.exit(0)
+shards = spec["sp_shards"] if spec["sp_on"] else 0
+if shards and len(jax.devices()) < shards:
+    print(json.dumps({"skipped": f"SP mesh needs {shards} devices",
+                      "platform": platform,
+                      "devices": len(jax.devices())}))
+    sys.exit(0)
+
+import dataclasses
+import jax.numpy as jnp
+from alphafold2_tpu.models import alphafold2_init
+from alphafold2_tpu.serving import ServingConfig, ServingEngine
+from alphafold2_tpu.training import north_star_e2e_config
+from alphafold2_tpu.constants import AA_ORDER
+from alphafold2_tpu.ops import dispatch as _dispatch
+
+bucket = spec["bucket"]
+ecfg, crop, msa_rows = north_star_e2e_config(spec["depth"])
+cfg = dataclasses.replace(ecfg.model, max_seq_len=bucket)
+params = alphafold2_init(jax.random.PRNGKey(0), cfg)
+scfg = ServingConfig(
+    buckets=(bucket,), max_batch=1, mds_iters=25, cache_capacity=0,
+    precompile=True, request_timeout_s=None,
+    sp_shards=shards,
+    sp_schedules=(((bucket, "sp_seq"),) if shards else ()),
+)
+t0 = time.perf_counter()
+eng = ServingEngine(params, cfg, scfg)
+compile_s = time.perf_counter() - t0
+rs = np.random.RandomState(0)
+seqs = ["".join(AA_ORDER[i] for i in rs.randint(0, 20, bucket))
+        for _ in range(spec.get("iters", 3) + 1)]
+try:
+    eng.predict(seqs[0])  # warmup dispatch
+    t0 = time.perf_counter()
+    for s in seqs[1:]:
+        res = eng.predict(s)
+    dt = (time.perf_counter() - t0) / (len(seqs) - 1)
+    assert np.isfinite(res.coords).all()
+    sp_stats = eng.stats().get("sp")
+finally:
+    eng.shutdown()
+out = {"sec_per_iter": round(dt, 3), "bucket": bucket,
+       "sp_shards": shards, "compile_s": round(compile_s, 1),
+       "platform": platform,
+       "backend_arm": _dispatch.resolve(
+           "flash_attention", request="auto", i=bucket, j=bucket,
+           dh=cfg.dim_head)}
+if sp_stats:
+    plan = sp_stats["schedules"][str(bucket)]
+    assert plan["schedule"] == "sp_seq", plan
+    out["sp_total_bytes"] = plan["total_bytes"]
+print(json.dumps(out))
+"""
+
+
+# Chip-free routed-fleet leg (ISSUE 14): the length-adaptive router end
+# to end on the virtual CPU mesh — a mixed-length trace over a real
+# two-pool fleet (dense short pool + sp_seq long pool), asserting every
+# in-ladder request completes on its expected pool with ZERO too_long
+# failures, and recording the per-pool queue-wait signals the per-pool
+# autoscalers consume. Runs on ANY host (pins JAX_PLATFORMS=cpu + the
+# 8-device virtual platform, like the overlap lint): the row is real
+# today, not armed.
+SERVE_ROUTED_WORKER = r"""
+import json, sys, time, os
+spec = json.loads(sys.argv[1])
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import jax
+import numpy as np
+
+from alphafold2_tpu.models import Alphafold2Config, alphafold2_init
+from alphafold2_tpu.serving import (
+    FleetConfig, PoolSpec, SequenceTooLongError, ServingConfig,
+    ServingFleet,
+)
+from alphafold2_tpu.constants import AA_ORDER
+
+cfg = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8,
+                       max_seq_len=32)
+params = alphafold2_init(jax.random.PRNGKey(0), cfg)
+scfg = ServingConfig(buckets=(8, 16), max_batch=2, max_wait_s=0.01,
+                     mds_iters=4, request_timeout_s=None)
+fleet = ServingFleet(
+    params, cfg, scfg,
+    FleetConfig(probe_interval_s=0, reprobe_interval_s=30.0,
+                default_timeout_s=None,
+                pools=(PoolSpec("short", replicas=1, buckets=(8, 16)),
+                       PoolSpec("long", replicas=1, sp_shards=2,
+                                buckets=(8, 16, 32)))))
+rs = np.random.RandomState(0)
+n = spec.get("n", 16)
+lens = [int(rs.randint(4, 17)) if i % 2 else int(rs.randint(17, 33))
+        for i in range(n)]
+t0 = time.perf_counter()
+reqs = []
+shed = 0
+for i, L in enumerate(lens + [40]):  # the 40-mer must shed, not fail
+    seq = "".join(AA_ORDER[j] for j in rs.randint(0, 20, L))
+    try:
+        reqs.append((L, fleet.submit(seq)))
+    except SequenceTooLongError:
+        shed += 1
+by_pool = {"short": 0, "long": 0}
+for L, r in reqs:
+    res = r.result(timeout=600)
+    st = fleet.stats()["replicas"][res.replica]
+    expect = "short" if L <= 16 else "long"
+    assert st["pool"] == expect, (L, res.replica, st["pool"])
+    by_pool[expect] += 1
+wall = time.perf_counter() - t0
+stats = fleet.stats()
+hists = stats["telemetry"]["metrics"]["histograms"]
+waits = {name: hists.get(
+    f'fleet_pool_queue_wait_seconds{{pool="{name}"}}', {})
+    for name in ("short", "long")}
+assert stats["requests"]["failed"] == 0, stats["requests"]
+assert stats["shed"].get("too_long", 0) == 1 and shed == 1
+fleet.shutdown()
+out = {"sec_per_iter": round(wall / len(reqs), 3),
+       "routed_short": by_pool["short"], "routed_long": by_pool["long"],
+       "routed_long_frac": round(by_pool["long"] / len(reqs), 3),
+       "too_long_shed": shed,
+       "platform": "cpu", "backend_arm": "xla_ref"}
+for name, w in waits.items():
+    if isinstance(w, dict) and w.get("p95") is not None:
+        out[f"pool_queue_wait_p95_{name}"] = round(w["p95"], 4)
+print(json.dumps(out))
+"""
+
+
 # Cross-backend dispatch matrix (ISSUE 13 tentpole): one leg per
 # (hot op, backend arm) over the ops/dispatch.py registry. The arm is
 # pinned via AF2_KERNEL_BACKEND_<OP> and VERIFIED against the resolver
@@ -833,6 +986,11 @@ def main():
                     help="run only the cross-backend dispatch matrix "
                          "(op x arm) legs — chip-free xla_ref rows "
                          "record on any host")
+    ap.add_argument("--serving-only", action="store_true",
+                    help="run only the ISSUE-14 serving legs: the "
+                         "chip-free routed-fleet row (records on any "
+                         "host) plus the serve_sp_on/off A/B (TPU-only, "
+                         "structured skip elsewhere)")
     ap.add_argument("--xla-micro", action="store_true",
                     help="also run the XLA-streaming micro leg (known to "
                          "compile >550s at the chunk shape — see PERF.md; "
@@ -887,6 +1045,36 @@ def main():
                                    extra={"spec": spec})
             if not ok:
                 sys.exit(3)  # wedged-tunnel code: watchers retry later
+
+    # 1e) SP serving arm + routed fleet (ISSUE 14): serve_routed is
+    # chip-free (real row on any host); the serve_sp A/B times the
+    # serving-shaped SP-vs-dense executable on TPU only (structured skip
+    # elsewhere — armed, never marked done). The on-arm forces sp_seq at
+    # the bucket; the off-arm is the dense twin of the SAME bucket.
+    def serving_legs():
+        return (
+            ("serve_routed", {"n": 16}, SERVE_ROUTED_WORKER, 900),
+            ("serve_sp_on",
+             {"depth": args.depth, "bucket": 1024, "sp_shards": 4,
+              "sp_on": True, "require_tpu": True}, SERVE_SP_WORKER, 2100),
+            ("serve_sp_off",
+             {"depth": args.depth, "bucket": 1024, "sp_shards": 4,
+              "sp_on": False, "require_tpu": True}, SERVE_SP_WORKER, 2100),
+        )
+
+    def run_serving_legs():
+        for name, spec, worker, timeout in serving_legs():
+            if done_key(name, spec) in done:
+                print(f"skip {name}: already recorded in {OUT}", flush=True)
+                continue
+            ok, _ = run_and_record(name, worker, [json.dumps(spec)],
+                                   timeout=timeout, extra={"spec": spec})
+            if not ok:
+                sys.exit(3)  # wedged-tunnel code: watchers retry later
+
+    if args.serving_only:
+        run_serving_legs()
+        return
 
     if args.dispatch_only:
         run_dispatch_matrix()
@@ -1072,6 +1260,9 @@ def main():
 
     # 1d) the cross-backend dispatch matrix (see run_dispatch_matrix)
     run_dispatch_matrix()
+
+    # 1e) SP serving + routed fleet (see serving_legs above)
+    run_serving_legs()
 
     # 2) kernel microbench + block-size tuning at the chunk shape the model
     # actually calls (attn_batch_chunk=32 folded rows x 8 heads): the

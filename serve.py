@@ -133,6 +133,16 @@ def main():
     ap.add_argument("--mds-iters", type=int, default=32)
     ap.add_argument("--mds-init", choices=("random", "classical"),
                     default="classical")
+    # SP serving arm (serving/sp_arm.py; docs/SERVING.md "Length-adaptive
+    # routing")
+    ap.add_argument("--sp-shards", type=int, default=0,
+                    help="run each bucket's trunk sequence-parallel over "
+                         "this many devices (0 = dense): per-bucket "
+                         "schedule (dense / sp_msa / sp_seq) picked by "
+                         "the chip-free residency heuristic")
+    ap.add_argument("--sp-hbm-gb", type=float, default=16.0,
+                    help="per-chip HBM budget the SP schedule heuristic "
+                         "prices buckets against")
     ap.add_argument("--precompile", action="store_true",
                     help="AOT-compile every bucket before taking traffic")
     ap.add_argument("--breaker-threshold", type=int, default=0,
@@ -177,6 +187,16 @@ def main():
                     help="consecutive replica failures that drain it")
     # disaggregated serving (serving/featurize.py + serving/autoscale.py;
     # docs/SERVING.md "The featurization tier")
+    ap.add_argument("--pools", default=None, metavar="POOLS_JSON",
+                    help="heterogeneous capability pools (length-adaptive "
+                         "routing): a JSON list of PoolSpec dicts — "
+                         '[{"name":"short","replicas":2,"weight_dtype":'
+                         '"int8","buckets":[64,128,256]},{"name":"long",'
+                         '"replicas":1,"sp_shards":4,"buckets":[256,512,'
+                         '1024]}] — inline or a file path. Selects the '
+                         "fleet tier; short requests route to the "
+                         "cheapest capable pool, sequences past every "
+                         "pool's ceiling shed with sequence_too_long")
     ap.add_argument("--featurize-workers", type=int, default=0,
                     help="CPU featurization worker threads in front of "
                          "the admission queue (0 = featurize inline); "
@@ -317,19 +337,57 @@ def main():
     from alphafold2_tpu.utils import MetricsLogger
 
     buckets = tuple(sorted({int(b) for b in args.buckets.split(",")}))
+
+    # heterogeneous capability pools (serving/fleet.py PoolSpec;
+    # docs/SERVING.md "Length-adaptive routing") — parsed BEFORE the
+    # model config: the positional table must cover the widest pool
+    # ladder, and the demo trace should span it
+    pools = ()
+    if args.pools:
+        from alphafold2_tpu.serving import PoolSpec
+
+        raw = args.pools
+        if os.path.exists(raw):
+            with open(raw) as fh:
+                raw = fh.read()
+        try:
+            pool_dicts = json.loads(raw)
+        except ValueError as e:
+            ap.error(f"--pools is neither a file nor valid JSON: {e}")
+        if not isinstance(pool_dicts, list) or not pool_dicts:
+            ap.error("--pools must be a non-empty JSON list of pool dicts")
+        try:
+            # `is not None`, not truthiness: an (erroneous) empty buckets
+            # list must reach PoolSpec's non-empty validation and error,
+            # not silently decay into "inherit the base ladder"
+            pools = tuple(
+                PoolSpec(**{**d, "buckets": tuple(d["buckets"])
+                            if d.get("buckets") is not None else None})
+                for d in pool_dicts)
+        except (TypeError, ValueError) as e:
+            ap.error(f"--pools: {e}")
+    if pools and args.sp_shards:
+        ap.error("--sp-shards and --pools are mutually exclusive: with "
+                 "pools configured, declare sp_shards per pool in the "
+                 "pools JSON")
+    union_buckets = tuple(sorted(
+        set(buckets).union(*[p.buckets or buckets for p in pools])))
+
     records = (
-        demo_records(args.demo, buckets, args.seed)
+        demo_records(args.demo, union_buckets, args.seed)
         if args.demo is not None
         else read_fasta(args.fasta)
     )
-    print(f"{len(records)} request(s), bucket ladder {buckets}")
+    print(f"{len(records)} request(s), bucket ladder {buckets}"
+          + (f", pools {[p.name for p in pools]} "
+             f"(union ladder {union_buckets})" if pools else ""))
 
     cfg = Alphafold2Config(
         dim=args.dim,
         depth=args.depth,
         heads=args.heads,
         dim_head=args.dim_head,
-        max_seq_len=args.max_seq_len or max(64, buckets[-1]),
+        max_seq_len=args.max_seq_len or max(64, union_buckets[-1]),
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         # engine build quantizes at this knob (serving/quant_residency.py);
         # checkpoints stay fp32 masters — PTQ happens at serve time
@@ -390,7 +448,7 @@ def main():
     autoscale_armed = args.max_replicas is not None
     min_replicas = args.min_replicas if args.min_replicas is not None else 1
     fleet_mode = (args.replicas > 1 or autoscale_armed
-                  or args.featurize_workers > 0)
+                  or args.featurize_workers > 0 or bool(pools))
     initial_replicas = args.replicas
     if autoscale_armed:
         if args.max_replicas < min_replicas:
@@ -409,6 +467,8 @@ def main():
         seed=args.seed,
         precompile=args.precompile,
         params_tag=params_tag,
+        sp_shards=args.sp_shards,
+        sp_hbm_gb=args.sp_hbm_gb,
         breaker_threshold=args.breaker_threshold,
         breaker_reset_s=args.breaker_reset,
         watchdog_timeout_s=(
@@ -448,6 +508,7 @@ def main():
                 fail_threshold=args.fail_threshold,
                 featurize_workers=args.featurize_workers,
                 featurize_queue=args.featurize_queue,
+                pools=pools,
             ),
             injector=injector,
             tracer=tracer,
@@ -479,6 +540,7 @@ def main():
 
     # --- elastic replica autoscaler (serving/autoscale.py) --------------
     scaler = scale_policy = None
+    pool_scalers = []
     if autoscale_armed:
         from alphafold2_tpu.serving import ReplicaAutoscaler, ScalePolicy
 
@@ -488,12 +550,29 @@ def main():
         scale_policy = _dc.replace(scale_policy,
                                    min_replicas=min_replicas,
                                    max_replicas=args.max_replicas)
-        scaler = ReplicaAutoscaler(
-            engine, scale_policy,
-            incident_hook=recorder.incident if recorder else None,
-            fault_hook=injector.autoscale_hook() if injector else None,
-        )
-        print(f"autoscaler: replicas in "
+        if pools:
+            # heterogeneous fleet: ONE autoscaler per capability pool,
+            # each reading its pool-labeled queue-wait/occupancy signals
+            # — a saturated SP pool grows while the dense pool idles
+            # down, independently (the CLI bounds apply per pool)
+            pool_scalers = [
+                ReplicaAutoscaler(
+                    engine, scale_policy, pool=spec.name,
+                    incident_hook=recorder.incident if recorder else None,
+                    fault_hook=(injector.autoscale_hook()
+                                if injector else None),
+                )
+                for spec in pools
+            ]
+        else:
+            scaler = ReplicaAutoscaler(
+                engine, scale_policy,
+                incident_hook=recorder.incident if recorder else None,
+                fault_hook=injector.autoscale_hook() if injector else None,
+            )
+        print(f"autoscaler"
+              + (f" (per-pool x{len(pool_scalers)})" if pools else "")
+              + f": replicas in "
               f"[{scale_policy.min_replicas}, "
               f"{scale_policy.max_replicas}], "
               f"up @ p95>={scale_policy.up_queue_wait_p95_s}s | "
@@ -536,13 +615,13 @@ def main():
             with open(tmp, "w") as fh:
                 fh.write(str(ops.port))
             os.replace(tmp, args.ops_port_file)  # readers never see ""
-    if scaler is not None:
+    for sc in ([scaler] if scaler is not None else []) + pool_scalers:
         # the autoscaler always gets its OWN control thread (same
         # cadence as the ops ticker): a scale-up's engine build can
         # compile for seconds, and riding the shared OpsTicker would
         # stall SLO evaluation / flight-recorder polling / gauge
         # sampling during exactly the overload it is reacting to
-        scaler.start(args.ops_tick)
+        sc.start(args.ops_tick)
 
     stats_stop = threading.Event()
     stats_thread = None
@@ -652,13 +731,14 @@ def main():
                 bfactors=100.0 * np.asarray(res.confidence),
             )
 
-    if scaler is not None and args.scale_grace > 0:
+    if (scaler is not None or pool_scalers) and args.scale_grace > 0:
         # idle grace: the replay has drained — keep ticking so the
         # autoscaler can observe the idle pool and scale back down
         # before shutdown (the demo's scale-down leg)
+        floor = scale_policy.min_replicas * max(1, len(pool_scalers))
         grace_deadline = time.time() + args.scale_grace
         while time.time() < grace_deadline:
-            if engine.replica_count() <= scale_policy.min_replicas:
+            if engine.replica_count() <= floor:
                 break
             time.sleep(0.1)
     if slo is not None:
@@ -703,15 +783,20 @@ def main():
                   f"{freqs.get('requeued', 0)} requeued), "
                   f"{feat.get('worker_deaths', 0)} worker death(s), "
                   f"busy {feat.get('busy_seconds', 0.0):.2f}s")
-        if scaler is not None:
-            ev = scaler.scale_events()
+        for sc in ([scaler] if scaler is not None else []) + pool_scalers:
+            ev = sc.scale_events()
             ups = sum(1 for e in ev if e["action"] == "up")
             downs = sum(1 for e in ev if e["action"] == "down")
-            dec = scaler.snapshot()["decisions"]
-            print(f"autoscaler: {ups} scale-up(s), {downs} "
+            dec = sc.snapshot()["decisions"]
+            label = f" [{sc.pool}]" if sc.pool else ""
+            print(f"autoscaler{label}: {ups} scale-up(s), {downs} "
                   f"scale-down(s), {dec.get('suppressed', 0)} "
                   f"suppressed, {dec.get('rejected', 0)} rejected; "
-                  f"replicas now {engine.replica_count()}")
+                  f"replicas now "
+                  f"{engine.replica_count(sc.pool) if sc.pool else engine.replica_count()}")
+        if pools and stats.get("shed", {}).get("too_long"):
+            print(f"too-long sheds: {stats['shed']['too_long']} "
+                  f"(sequence past every pool ceiling)")
         if stats["errors"]:
             print(f"errors by code: {stats['errors']}")
         if injector is not None:

@@ -810,3 +810,141 @@ def test_serve_cli_disaggregated_chaos_acceptance(tmp_path):
     bundle = json.loads((flight_dir / bundles[0]).read_text())
     assert bundle["incident"]["kind"].startswith("scale_")
     assert "metrics" in bundle
+
+
+# ------------------------------------------ per-pool autoscaling (ISSUE 14)
+
+
+class PooledStubFleet:
+    """Scaling target with capability pools: per-pool counts + recorded
+    (action, pool) pairs — what the pool-scoped autoscaler must drive."""
+
+    _closed = False
+
+    def __init__(self, registry):
+        self.registry = registry
+        self.counts = {"short": 1, "long": 1}
+        self.actions = []
+
+    def sample_gauges(self):
+        pass
+
+    def replica_count(self, pool=None):
+        if pool is None:
+            return sum(self.counts.values())
+        return self.counts[pool]
+
+    def add_replica(self, pool=None):
+        assert pool in self.counts, pool
+        self.counts[pool] += 1
+        self.actions.append(("up", pool))
+        return f"r{sum(self.counts.values())}"
+
+    def remove_replica(self, name=None, pool=None):
+        assert pool in self.counts, pool
+        self.counts[pool] -= 1
+        self.actions.append(("down", pool))
+        return "r0"
+
+
+def test_pool_scoped_autoscalers_act_independently():
+    """ISSUE 14: two pool autoscalers over one registry — the saturated
+    pool scales up off ITS pool-labeled queue-wait/occupancy signals
+    while the idle pool scales down off its own, neither reading the
+    other's (or the global) families."""
+    registry = MetricRegistry()
+    # global families present and HOT: a pool scaler must not read them
+    registry.gauge("fleet_queue_depth").set(9)
+    registry.gauge("fleet_occupancy").set(1.0)
+    depth = {p: registry.gauge("fleet_pool_queue_depth", pool=p)
+             for p in ("short", "long")}
+    occ = {p: registry.gauge("fleet_pool_occupancy", pool=p)
+           for p in ("short", "long")}
+    wait = {p: registry.histogram("fleet_pool_queue_wait_seconds", pool=p)
+            for p in ("short", "long")}
+    fleet = PooledStubFleet(registry)
+    t = [0.0]
+    policy = ScalePolicy(min_replicas=1, max_replicas=3, up_sustain=2,
+                         down_sustain=2, up_cooldown_s=0.0,
+                         down_cooldown_s=0.0)
+    scalers = {p: ReplicaAutoscaler(fleet, policy, registry=registry,
+                                    clock=lambda: t[0], pool=p)
+               for p in ("short", "long")}
+    assert fleet.replica_count("long") == 1
+    fleet.counts["short"] = 2  # headroom above min so idle-down can act
+    # the LONG pool is underwater (queue-wait p95 over threshold with a
+    # live queue); the SHORT pool is idle
+    depth["long"].set(5), occ["long"].set(1.0)
+    for _ in range(40):
+        wait["long"].observe(10.0)
+    depth["short"].set(0), occ["short"].set(0.0)
+    for _ in range(3):
+        for sc in scalers.values():
+            sc.tick()
+        t[0] += 1.0
+    assert ("up", "long") in fleet.actions
+    assert ("down", "short") in fleet.actions
+    assert ("up", "short") not in fleet.actions
+    assert ("down", "long") not in fleet.actions
+    assert fleet.counts["long"] >= 2 and fleet.counts["short"] == 1
+    # decisions are pool-labeled in the registry (no collision between
+    # the two scalers' counters)
+    counters = registry.snapshot()["counters"]
+    assert counters['autoscale_decisions_total{action="up",pool="long"}'] >= 1
+    assert counters[
+        'autoscale_decisions_total{action="down",pool="short"}'] >= 1
+    # snapshots carry the pool + the POOL's size, not the fleet's
+    assert scalers["long"].snapshot()["pool"] == "long"
+    assert scalers["long"].snapshot()["replicas"] == fleet.counts["long"]
+
+
+def test_pool_autoscalers_attach_and_surface_in_fleet_stats():
+    """A real (fake-engine) pooled fleet carries per-pool autoscaler
+    snapshots under stats()["autoscale_pools"], and shutdown stops their
+    fallback tickers."""
+    import numpy as np
+
+    from alphafold2_tpu.models import Alphafold2Config
+    from alphafold2_tpu.serving import (
+        FleetConfig,
+        PoolSpec,
+        ServingConfig,
+        ServingEngine,
+        ServingFleet,
+    )
+
+    big = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8,
+                           max_seq_len=32)
+
+    class Stub(ServingEngine):
+        def _call_executable(self, bucket, tokens, mask, msa=None,
+                             msa_mask=None):
+            B, Lb = tokens.shape
+            return {"coords": np.zeros((B, Lb, 3), np.float32),
+                    "confidence": np.full((B, Lb), 0.5, np.float32),
+                    "stress": np.zeros((B,), np.float32)}
+
+    fleet = ServingFleet(
+        {}, big,
+        ServingConfig(buckets=(8, 16), max_batch=2, max_wait_s=0.0,
+                      cache_capacity=0),
+        FleetConfig(probe_interval_s=0, pools=(
+            PoolSpec("short", buckets=(8, 16)),
+            PoolSpec("long", buckets=(8, 16, 32)),
+        )),
+        engine_factory=lambda n, c, h: Stub({}, big, c, fault_hook=h),
+    )
+    try:
+        scalers = [ReplicaAutoscaler(fleet, ScalePolicy(max_replicas=2),
+                                     pool=p)
+                   for p in ("short", "long")]
+        for sc in scalers:
+            sc.start(interval_s=30.0)
+        snap = fleet.stats()["autoscale_pools"]
+        assert set(snap) == {"short", "long"}
+        assert snap["long"]["pool"] == "long"
+        assert snap["long"]["replicas"] == 1
+    finally:
+        fleet.shutdown()
+    for sc in scalers:
+        assert sc._thread is None  # shutdown stopped the tickers

@@ -534,12 +534,16 @@ def test_error_codes_are_stable_and_serializable():
         NoHealthyReplicaError,
         RequeueLimitError,
         ScaleRejectedError,
+        SequenceTooLongError,
     )
 
     expected = {
         ServingError: "serving_error",
         InvalidSequenceError: "invalid_sequence",
         RequestTooLongError: "request_too_long",
+        # the ladder/router rejection (ISSUE 14): its own sharp code, a
+        # subclass of RequestTooLongError so legacy catch sites still work
+        SequenceTooLongError: "sequence_too_long",
         QueueFullError: "queue_full",
         RequestTimeoutError: "request_timeout",
         PredictionError: "prediction_failed",
@@ -605,7 +609,9 @@ def test_per_code_error_counts_surface_in_stats():
             eng.submit("")
         errors = eng.stats()["errors"]
         assert errors["invalid_sequence"] == 2
-        assert errors["request_too_long"] == 1
+        # ladder rejections carry the sharp sequence_too_long code — the
+        # SAME code the fleet router's no-capable-pool path sheds with
+        assert errors["sequence_too_long"] == 1
     finally:
         eng.shutdown()
     with pytest.raises(EngineClosedError):
@@ -986,3 +992,342 @@ def test_fleet_degraded_precision_tier(tiny_params):
 def test_fleet_config_validates_degraded_weight_dtype():
     with pytest.raises(ValueError, match="degraded_weight_dtype"):
         FleetConfig(degraded_weight_dtype="int4")
+
+
+# ---------------------------------- length-adaptive capability routing
+# (ISSUE 14: heterogeneous pools, per-pool signals, sharp too-long shed)
+
+
+from alphafold2_tpu.serving import (  # noqa: E402
+    PoolSpec,
+    SequenceTooLongError,
+)
+
+
+def pooled_fleet(call_hook=None, pools=None, scfg=None, **fleet_overrides):
+    """Fake-engine fleet over two capability pools: "short" (dense,
+    ceiling 16) and "long" (SP-tagged, ceiling 32)."""
+    base = dict(replicas=1, probe_interval_s=0, reprobe_interval_s=30.0,
+                pools=pools if pools is not None else (
+                    PoolSpec("short", replicas=1, buckets=(8, 16)),
+                    PoolSpec("long", replicas=1, buckets=(8, 16, 32)),
+                ))
+    base.update(fleet_overrides)
+    big = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8,
+                           max_seq_len=32)
+    scfg = serving_cfg() if scfg is None else scfg
+
+    def factory(name, cfg, fault_hook):
+        return FakeModelEngine({}, big, cfg, call_hook=call_hook,
+                               fault_hook=fault_hook)
+
+    return ServingFleet({}, big, scfg, FleetConfig(**base),
+                        engine_factory=factory)
+
+
+def test_routed_fleet_mixed_trace_lands_on_capable_pools():
+    """THE routing acceptance pin (fake engines; the real-model twin is
+    test_routed_fleet_real_engines_with_sp_pool): short requests land on
+    the dense pool, long ones on the SP pool, zero too_long failures for
+    in-ladder lengths, and the routed/pool telemetry shows it."""
+    fleet = pooled_fleet()
+    try:
+        short = [fleet.submit(seq_of(6 + i % 8, offset=i)) for i in range(5)]
+        long_ = [fleet.submit(seq_of(17 + i % 16, offset=i))
+                 for i in range(5)]
+        for r in short:
+            assert r.result(timeout=20).replica == "r0"
+        for r in long_:
+            assert r.result(timeout=20).replica == "r1"
+        st = fleet.stats()
+        assert st["requests"]["failed"] == 0
+        assert "too_long" not in st["shed"]
+        assert st["replicas"]["r0"]["pool"] == "short"
+        assert st["replicas"]["r1"]["pool"] == "long"
+        counters = st["telemetry"]["metrics"]["counters"]
+        assert counters['fleet_routed_total{pool="short"}'] == 5
+        assert counters['fleet_routed_total{pool="long"}'] == 5
+        hists = st["telemetry"]["metrics"]["histograms"]
+        assert hists['fleet_pool_queue_wait_seconds{pool="long"}'][
+            "count"] == 5
+    finally:
+        fleet.shutdown()
+
+
+def test_too_long_sheds_identically_across_every_path():
+    """ISSUE 14 satellite: a sequence above EVERY pool ceiling sheds with
+    the stable sequence_too_long code at the fleet front door — sync
+    path, featurize-tier async path, and pre-featurized-bundle path all
+    count fleet_shed_total{reason="too_long"} + the per-code error, and
+    the single engine raises the SAME class/code from its ladder."""
+    fleet = pooled_fleet()
+    try:
+        with pytest.raises(SequenceTooLongError) as ei:
+            fleet.submit(seq_of(33))
+        assert ei.value.code == "sequence_too_long"
+        assert ei.value.to_json()["code"] == "sequence_too_long"
+        # pre-featurized bundle path: same shed, not a dispatch failure
+        from alphafold2_tpu.serving import BucketLadder, featurize_request
+
+        bundle = featurize_request(seq_of(33), ladder=BucketLadder((64,)))
+        with pytest.raises(SequenceTooLongError):
+            fleet.submit("", features=bundle)
+        st = fleet.stats()
+        assert st["shed"]["too_long"] == 2
+        assert st["errors"]["sequence_too_long"] == 2
+        assert st["requests"]["shed"] == 2
+        assert st["requests"]["in_flight"] == 0
+        counters = st["telemetry"]["metrics"]["counters"]
+        assert counters['fleet_shed_total{reason="too_long"}'] == 2
+    finally:
+        fleet.shutdown()
+    # the featurize-tier ASYNC path resolves the future with the same code
+    fleet = pooled_fleet(featurize_workers=1)
+    try:
+        req = fleet.submit(seq_of(33))
+        with pytest.raises(SequenceTooLongError):
+            req.result(timeout=20)
+        st = fleet.stats()
+        assert st["shed"]["too_long"] == 1
+        assert st["errors"]["sequence_too_long"] == 1
+    finally:
+        fleet.shutdown()
+    # the single-engine path fails identically (class AND code)
+    eng = fake_engine()
+    try:
+        with pytest.raises(SequenceTooLongError) as ei:
+            eng.submit(seq_of(17))
+        assert ei.value.code == "sequence_too_long"
+        assert eng.stats()["errors"]["sequence_too_long"] == 1
+    finally:
+        eng.shutdown()
+
+
+def test_saturated_pool_shed_quotes_capable_pool_not_global():
+    """ISSUE 14 satellite: with one capability pool saturated and the
+    other idle, a queue-full shed must quote the CAPABLE pool's backlog
+    (depth x its drain EMA), not the global queue's — and an evicted
+    entry quotes ITS OWN pool. Both pools' replicas are wedged and their
+    engine queues filled, so admitted entries sit in the shared queue
+    where depth accounting is observable."""
+    release = threading.Event()
+
+    def hook(bucket, tokens, mask):
+        release.wait(20)
+
+    # max_batch=1/max_queue=1 engines: one in-flight + one queued per
+    # replica, then the shared admission queue (capacity 4) backs up
+    fleet = pooled_fleet(call_hook=hook,
+                         scfg=serving_cfg(max_batch=1, max_queue=1,
+                                          max_wait_s=0.0,
+                                          request_timeout_s=None),
+                         queue_capacity=4, dispatch_backoff_s=1.0,
+                         default_timeout_s=None)
+    try:
+        # wedge both pools: 2 requests each (1 dispatched, 1 in the
+        # replica queue)
+        pending = [fleet.submit(seq_of(6, offset=i)) for i in range(2)]
+        pending += [fleet.submit(seq_of(20, offset=i)) for i in range(2)]
+        deadline = time.monotonic() + 10
+        while fleet.stats()["admission"]["depth"] > 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        # now fill the SHARED queue: 3 long + 1 short queued
+        pending += [fleet.submit(seq_of(21 + i, offset=i)) for i in range(3)]
+        pending += [fleet.submit(seq_of(7))]
+        deadline = time.monotonic() + 10
+        while fleet.stats()["admission"]["depth"] < 4:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        fleet.sample_gauges()
+        gauges = fleet.stats()["telemetry"]["metrics"]["gauges"]
+        assert gauges['fleet_pool_queue_depth{pool="long"}'] == 3
+        assert gauges['fleet_pool_queue_depth{pool="short"}'] == 1
+        # a LONG arrival sheds quoting the long pool's depth (3 entries x
+        # the 1.0s cold-EMA default), NOT the global depth (4)
+        with pytest.raises(QueueFullError) as ei:
+            fleet.submit(seq_of(25))
+        assert ei.value.retry_after_s == pytest.approx(3.0)
+        assert "long" in str(ei.value)
+        # a SHORT interactive arrival evicts the newest batch-class... no
+        # batch entries exist; equal-class normal sheds too, quoting the
+        # SHORT pool's single-entry backlog
+        with pytest.raises(QueueFullError) as ei:
+            fleet.submit(seq_of(7, offset=3))
+        assert ei.value.retry_after_s == pytest.approx(1.0)
+        # eviction: an interactive LONG arrival displaces the newest
+        # normal entry, whose retry advice quotes the EVICTED entry's
+        # own pool
+        victim_req = fleet.submit(seq_of(26), priority="interactive")
+        pending.append(victim_req)
+        release.set()
+        evicted = [r for r in pending if r.done() and r._exc is not None]
+        assert len(evicted) == 1
+        exc = evicted[0]._exc
+        assert isinstance(exc, QueueFullError)
+        assert exc.retry_after_s is not None
+        for r in pending:
+            if r not in evicted:
+                r.result(timeout=30)
+        st = fleet.stats()
+        assert st["shed"].get("evicted", 0) == 1
+        assert st["requests"]["in_flight"] == 0
+    finally:
+        release.set()
+        fleet.shutdown()
+
+
+def test_idle_pool_keeps_serving_while_other_pool_saturated():
+    """One pool's saturation must not starve the other: with the long
+    pool wedged, short traffic completes promptly."""
+    release = threading.Event()
+    calls = []
+
+    def hook(bucket, tokens, mask):
+        if tokens.shape[1] > 16:  # only wedge the long pool's buckets
+            release.wait(20)
+        calls.append(bucket)
+
+    fleet = pooled_fleet(call_hook=hook,
+                         scfg=serving_cfg(max_batch=1, max_queue=4,
+                                          max_wait_s=0.0,
+                                          request_timeout_s=None),
+                         default_timeout_s=None)
+    try:
+        stuck = [fleet.submit(seq_of(20, offset=i)) for i in range(2)]
+        quick = [fleet.submit(seq_of(6, offset=i)) for i in range(3)]
+        for r in quick:
+            assert r.result(timeout=20).replica == "r0"
+        assert not any(r.done() for r in stuck)
+        release.set()
+        for r in stuck:
+            r.result(timeout=20)
+    finally:
+        release.set()
+        fleet.shutdown()
+
+
+def test_no_healthy_capable_replica_sheds_sharply():
+    """The long pool's only replica down => a long request sheds
+    no_healthy_replica (capability-scoped) while short traffic still
+    serves; the degraded tier is NOT a candidate for lengths past its
+    ladder."""
+    fleet = pooled_fleet(degraded_mds_iters=2, fail_threshold=1)
+    try:
+        # drain the long pool's replica through the health path
+        fleet._health.record_failure("r1", "prediction_failed")
+        deadline = time.monotonic() + 10
+        while fleet._replicas["r1"].engine is not None:
+            assert time.monotonic() < deadline, "r1 never drained"
+            time.sleep(0.02)
+        from alphafold2_tpu.serving import NoHealthyReplicaError
+
+        req = fleet.submit(seq_of(20))
+        with pytest.raises(NoHealthyReplicaError):
+            req.result(timeout=20)
+        # the degraded tier (base ladder, ceiling 16) never saw it
+        assert fleet.stats()["requests"]["completed"] == 0
+        # short traffic unaffected (and may legally spill to degraded)
+        r = fleet.predict(seq_of(6), timeout=20)
+        assert r.coords.shape == (6, 3)
+    finally:
+        fleet.shutdown()
+
+
+def test_pool_elasticity_and_capability_in_stats():
+    """add_replica/remove_replica are pool-scoped; a pool never shrinks
+    below one replica; stats()["pools"] carries rank + capability; and
+    ambiguous scale actions on a multi-pool fleet reject loudly."""
+    from alphafold2_tpu.serving import ScaleRejectedError
+
+    fleet = pooled_fleet()
+    try:
+        assert fleet.replica_count() == 2
+        assert fleet.replica_count("short") == 1
+        with pytest.raises(ScaleRejectedError, match="must name one"):
+            fleet.add_replica()
+        with pytest.raises(ScaleRejectedError, match="no capability pool"):
+            fleet.add_replica(pool="huge")
+        name = fleet.add_replica(pool="long")
+        assert fleet.replica_count("long") == 2
+        assert fleet._replicas[name].pool == "long"
+        with pytest.raises(ScaleRejectedError, match="below one"):
+            fleet.remove_replica(pool="short")
+        victim = fleet.remove_replica(pool="long")
+        assert victim in (name, "r1")
+        st = fleet.stats()
+        assert st["pools"]["short"]["capability"]["max_len"] == 16
+        assert st["pools"]["long"]["capability"]["max_len"] == 32
+        assert st["pools"]["short"]["rank"] < st["pools"]["long"]["rank"]
+        for rep_stats in st["replicas"].values():
+            assert set(rep_stats["capability"]) == {
+                "weight_dtype", "sp_shards", "max_len"}
+    finally:
+        fleet.shutdown()
+
+
+def test_pool_spec_validation():
+    with pytest.raises(ValueError, match="pool name"):
+        PoolSpec("")
+    with pytest.raises(ValueError, match="pool name"):
+        PoolSpec("degraded")
+    with pytest.raises(ValueError, match="replicas"):
+        PoolSpec("a", replicas=0)
+    with pytest.raises(ValueError, match="weight_dtype"):
+        PoolSpec("a", weight_dtype="fp8")
+    with pytest.raises(ValueError, match="sp_shards"):
+        PoolSpec("a", sp_shards=1)
+    with pytest.raises(ValueError, match="without sp_shards"):
+        PoolSpec("a", sp_schedules=((16, "sp_seq"),))
+    with pytest.raises(ValueError, match="duplicate pool name"):
+        FleetConfig(pools=(PoolSpec("a"), PoolSpec("a")))
+    # the SP knob is pool-owned once pools exist: a base sp_shards would
+    # silently apply to the degraded tier but not the pools
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ServingFleet(
+            {}, TINY, serving_cfg(sp_shards=2),
+            FleetConfig(probe_interval_s=0, pools=(PoolSpec("a"),)),
+            engine_factory=lambda n, c, h: None)
+
+
+def test_routed_fleet_real_engines_with_sp_pool(tiny_params):
+    """THE end-to-end routing acceptance pin with REAL engines: a dense
+    short pool and an SP-sharded long pool (sp_seq forced at its top
+    bucket) serve a mixed-length trace with zero too_long failures —
+    long requests land on the SP replica and the answers are finite."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices for the SP pool's mesh")
+    big = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8,
+                           max_seq_len=32)
+    params = alphafold2_init(jax.random.PRNGKey(0), big)
+    scfg = serving_cfg(buckets=(8, 16), max_batch=2,
+                       request_timeout_s=300.0)
+    fleet = ServingFleet(
+        params, big, scfg,
+        FleetConfig(probe_interval_s=0, default_timeout_s=300.0,
+                    pools=(
+                        PoolSpec("short", replicas=1, buckets=(8, 16)),
+                        PoolSpec("long", replicas=1, sp_shards=2,
+                                 buckets=(8, 16, 32),
+                                 sp_schedules=((32, "sp_seq"),)),
+                    )))
+    try:
+        trace = [(6, "short"), (20, "long"), (14, "short"), (32, "long")]
+        reqs = [(want, fleet.submit(seq_of(n, offset=i)))
+                for i, (n, want) in enumerate(trace)]
+        for want, r in reqs:
+            res = r.result(timeout=300)
+            assert np.isfinite(res.coords).all()
+            assert fleet.stats()["replicas"][res.replica]["pool"] == want
+        st = fleet.stats()
+        assert st["requests"]["failed"] == 0 and st["requests"]["shed"] == 0
+        # the SP replica's engine really carries the SP arm
+        long_rep = next(r for r in st["replicas"].values()
+                        if r["pool"] == "long")
+        assert long_rep["capability"]["sp_shards"] == 2
+        # the pool's own per-bucket override reached the engine: the
+        # long bucket's executable really runs the SP trunk
+        assert (long_rep["engine"]["sp"]["schedules"]["32"]["schedule"]
+                == "sp_seq")
+    finally:
+        fleet.shutdown()

@@ -1,0 +1,294 @@
+"""SP serving arm (ISSUE 14 tentpole): schedule pricing/heuristic, the
+MSA-row-sharded trunk twin, SP-vs-dense serving parity on the virtual
+mesh, and the chip-free residency acceptance pin (the long-bucket SP
+executable fits a per-chip budget the dense one provably exceeds).
+
+Parity compares ROTATION-INVARIANT quantities (pairwise-distance
+matrices, confidence, stress): an MDS embedding is defined only up to a
+rigid transform, and the classical init's eigenvector signs flip under
+the tiny cross-schedule float differences — coordinates may be a global
+rotation apart while the structure is identical (the same reflection
+ambiguity PR 1 fixed in the known-structure MDS test).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alphafold2_tpu.models import Alphafold2Config, alphafold2_init
+from alphafold2_tpu.models.trunk import sequential_trunk_apply, trunk_layer_init
+from alphafold2_tpu.parallel import make_mesh, msa_sharded_trunk_apply
+from alphafold2_tpu.serving import (
+    ServingConfig,
+    ServingEngine,
+    sp_arm,
+)
+
+N_DEV = 8
+TINY = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8, max_seq_len=16)
+#: a north-star-shaped config at a long bucket: big enough that the dense
+#: pair stream provably exceeds a realistic per-chip budget
+BIG = Alphafold2Config(dim=256, depth=12, heads=8, dim_head=64,
+                       max_seq_len=1024)
+
+
+def _dmat(coords):
+    return np.linalg.norm(coords[:, None] - coords[None, :], axis=-1)
+
+
+def _seq(n, offset=0):
+    from alphafold2_tpu.constants import AA_ORDER
+
+    aa = AA_ORDER.replace("W", "")
+    return "".join(aa[(offset + i) % len(aa)] for i in range(n))
+
+
+# ---------------------------------------------------- msa-sharded trunk
+
+
+@pytest.mark.parametrize(
+    "tie,mode",
+    [
+        (True, "flat"),
+        pytest.param(False, "aligned", marks=pytest.mark.slow),
+    ],
+)
+def test_msa_sharded_trunk_matches_replicated(tie, mode):
+    """The "shard MSA rows" dynamic-axial cut: pair grid replicated, MSA
+    rows sharded — must reproduce the replicated sequential trunk (the
+    cross ops ARE the replicated ones; only the MSA self-attention rides
+    the sharded tied/transpose path)."""
+    if len(jax.devices()) < N_DEV:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = dataclasses.replace(TINY, depth=2, msa_tie_row_attn=tie,
+                              cross_attn_mode=mode, max_seq_len=64)
+    keys = jax.random.split(jax.random.PRNGKey(0), 2 + cfg.depth)
+    layers = [trunk_layer_init(k, cfg) for k in keys[2:]]
+    x = jax.random.normal(keys[0], (1, 16, 16, 16))
+    m = jax.random.normal(keys[1], (1, 8, 16, 16))
+    x_mask = jnp.ones((1, 16, 16), bool).at[:, :, -3:].set(False)
+    msa_mask = jnp.ones((1, 8, 16), bool).at[:, :, -2:].set(False)
+    mesh = make_mesh({"seq": 4})
+
+    want_x, want_m = jax.jit(
+        lambda ls, a, b: sequential_trunk_apply(
+            ls, cfg, a, b, x_mask=x_mask, msa_mask=msa_mask)
+    )(layers, x, m)
+    got_x, got_m = jax.jit(
+        lambda ls, a, b: msa_sharded_trunk_apply(
+            ls, cfg, a, b, mesh, x_mask=x_mask, msa_mask=msa_mask)
+    )(layers, x, m)
+    np.testing.assert_allclose(np.asarray(got_x), np.asarray(want_x),
+                               atol=5e-4)
+    np.testing.assert_allclose(np.asarray(got_m), np.asarray(want_m),
+                               atol=5e-4)
+
+
+def test_msa_sharded_trunk_rejects_bad_shapes():
+    if len(jax.devices()) < N_DEV:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = make_mesh({"seq": 4})
+    layers = [trunk_layer_init(jax.random.PRNGKey(0), TINY)]
+    x = jnp.zeros((1, 16, 16, 16))
+    with pytest.raises(ValueError, match="nothing to shard"):
+        msa_sharded_trunk_apply(layers, TINY, x, None, mesh)
+    with pytest.raises(ValueError, match="rows"):
+        msa_sharded_trunk_apply(layers, TINY, x, jnp.zeros((1, 6, 16, 16)),
+                                mesh)
+    with pytest.raises(ValueError, match="cols"):
+        msa_sharded_trunk_apply(layers, TINY, x, jnp.zeros((1, 8, 6, 16)),
+                                mesh)
+
+
+# ----------------------------------------------- pricing + the heuristic
+
+
+def test_schedule_residency_prices_the_cut():
+    """sp_seq divides the pair stream by the shard count; sp_msa divides
+    only the MSA stream; weights and (conservatively) the head logits
+    stay full-size everywhere."""
+    dense = sp_arm.schedule_residency(
+        BIG, bucket=1024, batch=1, msa_rows=64, schedule="dense", shards=8)
+    seq = sp_arm.schedule_residency(
+        BIG, bucket=1024, batch=1, msa_rows=64, schedule="sp_seq", shards=8)
+    msa = sp_arm.schedule_residency(
+        BIG, bucket=1024, batch=1, msa_rows=64, schedule="sp_msa", shards=8)
+    assert seq.pair_bytes * 8 == dense.pair_bytes
+    assert seq.msa_bytes * 8 == dense.msa_bytes
+    assert msa.pair_bytes == dense.pair_bytes
+    assert msa.msa_bytes * 8 == dense.msa_bytes
+    assert dense.weight_bytes == seq.weight_bytes == msa.weight_bytes
+    assert dense.logits_bytes == seq.logits_bytes
+    assert seq.total_bytes < msa.total_bytes < dense.total_bytes
+    # int8 weight arm prices the PTQ tree, not the master
+    int8 = sp_arm.schedule_residency(
+        dataclasses.replace(BIG, weight_dtype="int8"),
+        bucket=256, batch=1, msa_rows=0, schedule="dense", shards=8)
+    f32 = sp_arm.schedule_residency(
+        BIG, bucket=256, batch=1, msa_rows=0, schedule="dense", shards=8)
+    assert int8.weight_bytes < f32.weight_bytes
+
+
+def test_residency_long_bucket_sp_fits_where_dense_cannot():
+    """THE chip-free acceptance pin: at the long bucket the dense
+    executable's priced per-chip residency exceeds a 4 GiB budget while
+    the 8-shard sp_seq executable fits it — and the heuristic therefore
+    schedules exactly that cut, with no override."""
+    budget = 4 * (1 << 30)
+    dense = sp_arm.schedule_residency(
+        BIG, bucket=1024, batch=1, msa_rows=0, schedule="dense", shards=8)
+    sp = sp_arm.schedule_residency(
+        BIG, bucket=1024, batch=1, msa_rows=0, schedule="sp_seq", shards=8)
+    assert dense.total_bytes > budget, "dense must provably NOT fit"
+    assert sp.total_bytes <= budget, "the SP cut must fit the same chip"
+    chosen = sp_arm.choose_schedule(
+        BIG, bucket=1024, batch=1, msa_rows=0, shards=8, hbm_bytes=budget)
+    assert chosen.schedule == "sp_seq"
+    # ...while the short bucket stays dense under the same budget
+    short = sp_arm.choose_schedule(
+        BIG, bucket=256, batch=1, msa_rows=0, shards=8, hbm_bytes=budget)
+    assert short.schedule == "dense"
+
+
+def test_choose_schedule_prefers_cheapest_feasible_cut():
+    # a deep alignment at a short bucket: the MSA stream dominates, and
+    # a budget that dense exceeds but a sharded-MSA cut fits selects
+    # sp_msa — the cheaper-communication cut (no pair collectives)
+    tight = 1 << 26  # 64 MiB
+    cfg = dataclasses.replace(TINY, dim=64, max_seq_len=256)
+    r = sp_arm.choose_schedule(cfg, bucket=64, batch=4, msa_rows=512,
+                               shards=8, hbm_bytes=float(tight))
+    assert r.schedule == "sp_msa"
+    # no MSA stream: sp_msa is infeasible, sp_seq is the only cut
+    r = sp_arm.choose_schedule(cfg, bucket=256, batch=4, msa_rows=0,
+                               shards=8, hbm_bytes=float(1))
+    assert r.schedule == "sp_seq"
+    # nothing divides: no sharded cut is feasible, so the planner falls
+    # back to dense with the overage VISIBLE (total > budget — the
+    # budget is a planning estimate, and stats()["sp"] surfaces the
+    # pricing for the operator to act on)
+    r = sp_arm.choose_schedule(cfg, bucket=255, batch=1, msa_rows=3,
+                               shards=8, hbm_bytes=float(1))
+    assert r.schedule == "dense" and r.total_bytes > 1
+
+
+def test_plan_overrides_win_and_fail_loudly():
+    plan = sp_arm.plan_bucket_schedules(
+        TINY, buckets=(8, 16), batch=2, msa_rows=0, shards=2,
+        hbm_bytes=float(1 << 40), overrides={16: "sp_seq"})
+    assert plan[16].schedule == "sp_seq"
+    assert plan[8].schedule == "dense"  # heuristic: everything fits
+    with pytest.raises(ValueError, match="not on the ladder"):
+        sp_arm.plan_bucket_schedules(
+            TINY, buckets=(8, 16), batch=2, msa_rows=0, shards=2,
+            hbm_bytes=float(1 << 40), overrides={32: "sp_seq"})
+    with pytest.raises(ValueError, match="infeasible"):
+        # sp_msa with no MSA stream cannot be forced
+        sp_arm.plan_bucket_schedules(
+            TINY, buckets=(8, 16), batch=2, msa_rows=0, shards=2,
+            hbm_bytes=float(1 << 40), overrides={16: "sp_msa"})
+
+
+def test_sp_config_validation():
+    with pytest.raises(ValueError, match="sp_shards"):
+        ServingConfig(sp_shards=1)
+    with pytest.raises(ValueError, match="sp_hbm_gb"):
+        ServingConfig(sp_shards=2, sp_hbm_gb=0.0)
+    with pytest.raises(ValueError, match="not a schedule"):
+        ServingConfig(sp_shards=2, sp_schedules=((16, "ring"),))
+    with pytest.raises(ValueError, match="sp_shards=0"):
+        ServingConfig(sp_schedules=((16, "sp_seq"),))
+    with pytest.raises(ValueError, match="unknown SP schedule"):
+        sp_arm.make_sp_apply_fn(None, "nope")
+    assert sp_arm.make_sp_apply_fn(None, "dense") is None
+    with pytest.raises(ValueError, match="devices"):
+        sp_arm.build_sp_mesh(10_000)
+
+
+def test_sp_apply_fn_rejects_embedds():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    fn = sp_arm.make_sp_apply_fn(make_mesh({"sp": 2}), "sp_seq")
+    with pytest.raises(ValueError, match="embedds"):
+        fn({}, TINY, jnp.zeros((1, 8), jnp.int32), None,
+           embedds=jnp.zeros((1, 8, 4)))
+
+
+# -------------------------------------------- engine-level SP serving
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return alphafold2_init(jax.random.PRNGKey(0), TINY)
+
+
+def test_sp_engine_matches_dense_engine_at_long_bucket(tiny_params):
+    """THE virtual-mesh parity acceptance pin: a real SP engine (sp_seq
+    forced at the top bucket) serves structures matching the dense
+    engine's to float tolerance — distance matrices, confidence, stress
+    (rotation-invariant; module docstring) — and the two engines never
+    alias one cache keyspace."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    scfg = dict(buckets=(8, 16), max_batch=2, mds_iters=4,
+                request_timeout_s=300.0)
+    dense = ServingEngine(tiny_params, TINY, ServingConfig(**scfg))
+    sp = ServingEngine(
+        tiny_params, TINY,
+        ServingConfig(**scfg, sp_shards=2, sp_schedules=((16, "sp_seq"),)))
+    try:
+        assert dense._config_tag != sp._config_tag
+        snap = sp.stats()
+        assert snap["sp"]["schedules"]["16"]["schedule"] == "sp_seq"
+        assert snap["sp"]["schedules"]["8"]["schedule"] == "dense"
+        assert snap["capability"]["sp_shards"] == 2
+        for i, n in enumerate((14, 16, 9)):
+            seq = _seq(n, offset=i)
+            a = dense.predict(seq)
+            b = sp.predict(seq)
+            assert b.bucket == a.bucket
+            np.testing.assert_allclose(_dmat(b.coords), _dmat(a.coords),
+                                       atol=2e-3)
+            np.testing.assert_allclose(b.confidence, a.confidence,
+                                       atol=5e-4)
+            assert abs(a.stress - b.stress) < 1e-3
+    finally:
+        dense.shutdown()
+        sp.shutdown()
+
+
+def test_sp_engine_msa_schedule_serves_msa_traffic(tiny_params):
+    """The sp_msa cut through the REAL engine path (fixed-row MSA
+    stream): parity with the dense MSA engine."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    scfg = dict(buckets=(8,), max_batch=2, mds_iters=4, msa_rows=2,
+                request_timeout_s=300.0)
+    dense = ServingEngine(tiny_params, TINY, ServingConfig(**scfg))
+    sp = ServingEngine(
+        tiny_params, TINY,
+        ServingConfig(**scfg, sp_shards=2, sp_schedules=((8, "sp_msa"),)))
+    try:
+        seq = _seq(8)
+        msa = np.tile(np.asarray(
+            [jax.numpy.asarray([1, 2, 3, 4, 5, 6, 7, 8])]), (2, 1))
+        a = dense.predict(seq, msa=msa)
+        b = sp.predict(seq, msa=msa)
+        np.testing.assert_allclose(_dmat(b.coords), _dmat(a.coords),
+                                   atol=2e-3)
+        np.testing.assert_allclose(b.confidence, a.confidence, atol=5e-4)
+    finally:
+        dense.shutdown()
+        sp.shutdown()
+
+
+def test_sp_engine_rejects_apply_fn_override(tiny_params):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ServingEngine(tiny_params, TINY,
+                      ServingConfig(buckets=(8,), sp_shards=2),
+                      model_apply_fn=lambda *a, **k: None)
