@@ -776,6 +776,72 @@ def _targets() -> Dict[str, Callable[[], None]]:
                  if k[0] == "train_goodput_ratio"}
         assert procs == {"0", "1"}, procs
 
+    @register("telemetry.cost_ledger")
+    def _telemetry_cost_ledger():
+        # host-side: the cost-plane algebra — analytic x measured join
+        # over an int8 and an SP cell, derived chip-seconds/MFU, pool
+        # service-rate model, publish round-trip
+        from alphafold2_tpu.telemetry import MetricRegistry
+        from alphafold2_tpu.telemetry.costs import ExecutableCostLedger
+
+        reg = MetricRegistry()
+        led = ExecutableCostLedger(reg)
+        led.set_peak(1e12)
+        k8 = led.register_cell(
+            pool="short", bucket=256, schedule="dense",
+            backend_arm="xla_ref", weight_dtype="int8",
+            forward_flops=2e9, residency_bytes=1 << 28, max_batch=4)
+        ksp = led.register_cell(
+            pool="long", bucket=1024, schedule="sp_seq",
+            backend_arm="pallas_tpu", weight_dtype="f32",
+            forward_flops=8e10, residency_bytes=1 << 30, chips=8,
+            max_batch=2)
+        led.observe_batch(k8, device_seconds=0.1, requests=4)
+        led.observe_batch(ksp, device_seconds=1.0, requests=2)
+        rows = {(c["pool"], c["bucket"]): c for c in led.cells()}
+        short = rows[("short", 256)]
+        assert abs(short["chip_seconds_per_request"] - 0.1 / 4) < 1e-9
+        assert abs(short["mfu"] - (4 * 2e9 / 0.1) / 1e12) < 1e-9
+        long_ = rows[("long", 1024)]
+        # the SP cell bills all 8 chips: 1.0s x 8 / 2 requests
+        assert abs(long_["chip_seconds_per_request"] - 4.0) < 1e-9
+        assert led.pool_rate_rps("short") == 40.0
+        assert led.pool_rate_rps("unmeasured") is None
+        led.publish()
+        gauges = reg.snapshot()["gauges"]
+        assert any(k.startswith("serve_chip_seconds_per_request")
+                   for k in gauges), sorted(gauges)
+
+    @register("serving.goodput")
+    def _serving_goodput():
+        # host-side: replica-second accounting, sums-to-wall via the
+        # explicit idle remainder, probe overlap subtraction, publish
+        from alphafold2_tpu.telemetry import MetricRegistry
+        from alphafold2_tpu.telemetry.costs import ServeGoodputLedger
+
+        clk = [0.0]
+        reg = MetricRegistry()
+        led = ServeGoodputLedger(reg, clock=lambda: clk[0])
+        led.register("r0", "short")
+        led.add("r0", "compile", 2.0)
+        led.add("r0", "execute", 3.0)
+        with led.probe_span("r0"):
+            clk[0] += 1.0
+            led.add("r0", "execute", 0.4)  # the probe's own dispatch
+        clk[0] += 9.0
+        totals = led.totals("r0")
+        assert abs(totals["probe"] - 0.6) < 1e-9  # round trip minus inner
+        assert abs(sum(totals.values()) - led.wall("r0")) < 1e-9
+        snap = led.snapshot()
+        assert abs(snap["replicas"]["r0"]["goodput_ratio"] - 3.4 / 10.0) \
+            < 1e-9
+        assert abs(snap["pools"]["short"]["goodput_ratio"] - 3.4 / 10.0) \
+            < 1e-9
+        led.publish()
+        gauges = reg.snapshot()["gauges"]
+        assert gauges['serve_goodput_ratio{pool="short",replica="r0"}'] \
+            == snap["replicas"]["r0"]["goodput_ratio"]
+
     @register("telemetry.loss_curve_gate")
     def _telemetry_loss_curve():
         import os

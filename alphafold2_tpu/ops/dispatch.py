@@ -473,6 +473,18 @@ def resolution_tag(platform: Optional[str] = None) -> str:
     return f"dispatch[{platform}](" + ",".join(parts) + ")"
 
 
+def resolved_arm(op: str, platform: Optional[str] = None) -> str:
+    """The arm one op resolves to on this host under the live env, at
+    its probe shapes — the per-op slice of `resolution_tag()`. The
+    serving cost ledger labels its cells with the flash_attention arm
+    (the headline hot op, the same convention bench rows use for
+    `backend_arm`)."""
+    if platform is None:
+        platform = _platform()
+    spec = get(op)
+    return resolve(op, request="auto", platform=platform, **spec.probe)
+
+
 def main(argv=None) -> int:
     """CLI: ``python -m alphafold2_tpu.ops.dispatch --check``."""
     import argparse

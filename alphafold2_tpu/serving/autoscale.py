@@ -68,7 +68,7 @@ from alphafold2_tpu.telemetry import MetricRegistry
 _POLICY_KEYS = {
     "min_replicas", "max_replicas", "up_queue_wait_p95_s", "up_burn",
     "up_occupancy", "down_occupancy", "up_sustain", "down_sustain",
-    "up_cooldown_s", "down_cooldown_s",
+    "up_cooldown_s", "down_cooldown_s", "up_headroom",
 }
 
 
@@ -82,6 +82,14 @@ class ScalePolicy:
     up_queue_wait_p95_s: float = 2.0   # queue-wait p95 with a live queue
     up_burn: float = 2.0               # fast-window SLO burn rate
     up_occupancy: float = 0.85         # dispatched work / healthy slots
+    up_headroom: float = 0.15          # MODEL trigger: scale up when the
+    #                                    cost-ledger capacity model says
+    #                                    fleet_pool_headroom_ratio fell to
+    #                                    this — a LEADING signal that fires
+    #                                    before queue-wait p95 (a lagging
+    #                                    symptom) crosses its threshold.
+    #                                    Inert until the gauge exists
+    #                                    (measured batches); 0 disables.
     # scale-down trigger (all, sustained `down_sustain` ticks):
     down_occupancy: float = 0.25       # ... with an EMPTY queue
     up_sustain: int = 2
@@ -111,6 +119,11 @@ class ScalePolicy:
             raise ValueError(
                 "thresholds must be positive, with "
                 "0 <= down_occupancy < up_occupancy <= 1"
+            )
+        if not 0 <= self.up_headroom < 1:
+            raise ValueError(
+                f"up_headroom must be in [0, 1) (0 disables), got "
+                f"{self.up_headroom}"
             )
 
     @classmethod
@@ -229,11 +242,27 @@ class ReplicaAutoscaler:
                        if all(dict(key).get(k) == v
                               for k, v in want.items())),
                       default=0.0)
+        # headroom (fleet_pool_headroom_ratio, the cost-ledger capacity
+        # model): None while the gauge is ABSENT — the trigger must stay
+        # inert until the pool has measured batches, and a
+        # default-to-zero here would read "no data" as "no headroom"
+        # and scale every cold fleet to max
+        headroom = None
+        fam = fams.get("fleet_pool_headroom_ratio")
+        if fam is not None:
+            pool_want = {"pool": self.pool} if self.pool else {}
+            vals = [m.value for key, m in fam[1].items()
+                    if all(dict(key).get(k) == v
+                           for k, v in pool_want.items())]
+            if vals:
+                # fleet-wide scaler: the TIGHTEST pool is the signal
+                headroom = min(vals)
         return {
             "queue_depth": max_gauge(depth_name, **want),
             "occupancy": max_gauge(occ_name, **want),
             "queue_wait_p95": p95,
             "burn_fast": max_gauge("slo_burn_rate", window="fast"),
+            "headroom": headroom,
         }
 
     # ---------------------------------------------------------------- tick
@@ -280,6 +309,13 @@ class ReplicaAutoscaler:
                  and sig["queue_wait_p95"] >= self.policy.up_queue_wait_p95_s)
                 or (live_queue and sig["burn_fast"] >= self.policy.up_burn)
                 or sig["occupancy"] >= self.policy.up_occupancy
+                # the capacity-MODEL trigger (deliberately queue-free:
+                # the whole point is to fire before queue symptoms —
+                # the gauge itself only exists once arrivals and
+                # measured batches armed the model)
+                or (self.policy.up_headroom > 0
+                    and sig["headroom"] is not None
+                    and sig["headroom"] <= self.policy.up_headroom)
             )
             # the idle test deliberately ignores queue-wait p95: it is a
             # sliding window and stays high long after a burst drains
@@ -369,7 +405,11 @@ class ReplicaAutoscaler:
     def _note(self, now, action, sig, **extra):
         self._events.append({
             "ts": now, "action": action,
-            "signals": {k: round(float(v), 4) for k, v in sig.items()},
+            # None = signal absent (headroom before the model arms);
+            # recorded as-is so the event log distinguishes "no data"
+            # from a measured zero
+            "signals": {k: (round(float(v), 4) if v is not None else None)
+                        for k, v in sig.items()},
             **extra,
         })
 

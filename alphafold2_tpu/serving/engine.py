@@ -57,6 +57,7 @@ from alphafold2_tpu.serving.bucketing import (
 )
 from alphafold2_tpu.ops.dispatch import (
     resolution_tag as dispatch_resolution_tag,
+    resolved_arm as dispatch_resolved_arm,
 )
 from alphafold2_tpu.serving.cache import ResultCache, request_key
 from alphafold2_tpu.reliability.breaker import CircuitBreaker
@@ -73,6 +74,10 @@ from alphafold2_tpu.serving.metrics import ServingMetrics
 from alphafold2_tpu.serving.pipeline import predict_structure
 from alphafold2_tpu.serving.quant_residency import resident_params
 from alphafold2_tpu.telemetry import NULL_TRACER, new_trace_id
+from alphafold2_tpu.telemetry.costs import (
+    ExecutableCostLedger,
+    ServeGoodputLedger,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -330,11 +335,31 @@ class ServingEngine:
         recorder's `incident` method plugs in here
         (telemetry/ops_plane.py). Exceptions from the hook are swallowed
         with a traceback: observability must never take the engine down.
+      pool_name: capability-pool label for the serving cost plane
+        (telemetry/costs.py) — the fleet passes each replica's pool;
+        single engines default to "default".
+      cost_ledger: shared `ExecutableCostLedger` (the fleet passes its
+        own so N replicas of a pool merge into one cell); None builds a
+        private ledger over this engine's registry, so single-engine
+        runs get the cost plane too. At build, one cell per bucket is
+        registered with the analytic forward FLOPs and the priced
+        residency; every successful dispatch feeds the measured EMA
+        (compile time excluded).
+      goodput: shared `ServeGoodputLedger`; None builds a private one.
+        The engine accounts execute (successful dispatch), compile (AOT
+        compiles), and requeue (device time burned by failed
+        dispatches); the fleet layers probe/drain on the same ledger.
+      flights: optional `telemetry.costs.FlightBook`. The FLEET keeps
+        the book itself (it sees the whole cross-replica flight); a
+        standalone engine given one records submit -> terminal exemplars
+        so `/explainz` works in single-engine mode too.
     """
 
     def __init__(self, params, model_cfg, cfg: ServingConfig = ServingConfig(),
                  *, model_apply_fn=None, metrics_logger=None, fault_hook=None,
-                 tracer=None, replica_name: str = "", incident_hook=None):
+                 tracer=None, replica_name: str = "", incident_hook=None,
+                 pool_name: str = "default", cost_ledger=None, goodput=None,
+                 flights=None):
         self._ladder = BucketLadder(cfg.buckets)
         if self._ladder.max_len > model_cfg.max_seq_len:
             raise ValueError(
@@ -444,6 +469,48 @@ class ServingEngine:
         # per-tag weight-bytes gauge: what THIS engine's config tag costs
         # in resident weight HBM (the int8 arm's headline residency win)
         self.metrics.set_weight_bytes(self._weight_residency)
+
+        # ---- serving cost plane (telemetry/costs.py) ----
+        # one cost-ledger cell per bucket: analytic forward FLOPs
+        # (utils/flops.py at the bucket's padded shape) + priced per-chip
+        # residency (the SAME sp_arm pricing the SP planner uses) join
+        # the measured EMA the dispatch path feeds below. Ledgers are
+        # shared when the fleet passes them (pool-wide cells / one
+        # per-replica economy); private otherwise so a standalone engine
+        # still answers "what does a request cost".
+        self.pool_name = pool_name
+        self._owns_costs = cost_ledger is None
+        self.costs = (cost_ledger if cost_ledger is not None
+                      else ExecutableCostLedger(self.metrics.registry))
+        self.goodput = (goodput if goodput is not None
+                        else ServeGoodputLedger(self.metrics.registry))
+        self.flights = flights
+        self._goodput_name = replica_name or "engine"
+        self.goodput.register(self._goodput_name, pool_name)
+        self._cost_cells = {}
+        from alphafold2_tpu.serving import sp_arm
+        from alphafold2_tpu.utils.flops import model_fwd_flops
+
+        backend_arm = dispatch_resolved_arm("flash_attention")
+        rows = cfg.msa_rows
+        for bucket in self._ladder.buckets:
+            plan = self._sp_plan.get(bucket)
+            schedule = plan.schedule if plan is not None else "dense"
+            chips = cfg.sp_shards if schedule != "dense" else 1
+            residency = sp_arm.schedule_residency(
+                model_cfg, bucket=bucket, batch=cfg.max_batch,
+                msa_rows=rows, schedule=schedule, shards=max(1, chips),
+                weight_bytes=self._weight_residency["weight_bytes"],
+            )
+            self._cost_cells[bucket] = self.costs.register_cell(
+                pool=pool_name, bucket=bucket, schedule=schedule,
+                backend_arm=backend_arm,
+                weight_dtype=model_cfg.weight_dtype,
+                forward_flops=model_fwd_flops(
+                    model_cfg, n=bucket, r=rows, c=bucket),
+                residency_bytes=residency.total_bytes,
+                chips=max(1, chips), max_batch=cfg.max_batch,
+            )
 
         self._closed = False
         self._drain_on_stop = True
@@ -556,6 +623,12 @@ class ServingEngine:
 
         key = request_key(seq, msa_arr, self._config_tag, msa_mask=msa_mask)
 
+        if self.flights is not None:
+            # cell_for carries pool/bucket/schedule/arm/dtype — the
+            # whole cost-cell identity this request will bill to
+            cell = self.cell_for(bucket) or {
+                "pool": self.pool_name, "bucket": bucket}
+            self.flights.begin(trace_id, length=len(seq), **cell)
         cached = self._cache.get(key)
         if cached is not None:
             # free path: never touches the queue, the scheduler, or the model
@@ -563,6 +636,9 @@ class ServingEngine:
             self.metrics.inc("cache_hits")
             self.metrics.inc("completed")
             self.metrics.latency.observe(0.0)
+            if self.flights is not None:
+                self.flights.finish(trace_id, "completed", from_cache=True,
+                                     replica=self.replica_name)
             req = ServingRequest(seq, tokens, msa_arr, msa_mask, key, bucket,
                                  deadline=None, trace_id=trace_id)
             # array aliasing with the cache entry is fine here: result()
@@ -580,7 +656,12 @@ class ServingEngine:
             existing = self._inflight.get(key)
             if existing is not None and not existing.done():
                 # identical query already pending: share its future (the
-                # shared request keeps the FIRST submitter's deadline)
+                # shared request keeps the FIRST submitter's deadline).
+                # THIS submitter's flight record seals here — only the
+                # first submitter's id rides the shared future's resolve
+                if self.flights is not None:
+                    self.flights.finish(trace_id, "coalesced",
+                                        onto=existing.trace_id)
                 self.metrics.inc("coalesced")
                 return existing
             if self._breaker is not None and not self._breaker.allow():
@@ -594,7 +675,7 @@ class ServingEngine:
                     f"circuit {snap['state']} after repeated dispatch "
                     f"failures (threshold {snap['threshold']}); retry "
                     f"after {self.cfg.breaker_reset_s}s"
-                ))
+                ), trace_id=trace_id)
             req = ServingRequest(seq, tokens, msa_arr, msa_mask, key, bucket,
                                  deadline, trace_id=trace_id)
             # count submitted BEFORE the worker can possibly complete the
@@ -611,6 +692,9 @@ class ServingEngine:
                     self._breaker.abandon_probe()
                 self.metrics.inc("rejected")
                 self.metrics.inc_error("queue_full")
+                if self.flights is not None:
+                    self.flights.finish(trace_id, "rejected",
+                                        code="queue_full")
                 raise QueueFullError(
                     f"request queue at capacity ({self.cfg.max_queue}); "
                     f"retry with backoff or raise ServingConfig.max_queue",
@@ -629,11 +713,16 @@ class ServingEngine:
             raise EngineClosedError("engine is shut down")
         return req
 
-    def _reject(self, exc: ServingError):
+    def _reject(self, exc: ServingError, trace_id: str = ""):
         """Count (terminal counter + stable per-code counter) and raise a
-        submit-time rejection."""
+        submit-time rejection. `trace_id` seals the flight record for
+        rejections that happen AFTER the record was born (breaker
+        fast-rejects); for earlier ones no record exists and finish is a
+        no-op."""
         self.metrics.inc("rejected")
         self.metrics.inc_error(exc)
+        if self.flights is not None and trace_id:
+            self.flights.finish(trace_id, "rejected", code=exc.code)
         raise exc from None
 
     def _incident(self, kind: str, **attrs):
@@ -674,6 +763,17 @@ class ServingEngine:
             "max_len": self._ladder.max_len,
         }
 
+    def cell_for(self, bucket: int) -> dict:
+        """The cost-ledger cell one bucket's executable bills to —
+        flight records and operators use it to answer "this request ran
+        WHICH executable, on which arm, at what precision"."""
+        key = self._cost_cells.get(bucket)
+        if key is None:
+            return {}
+        pool, b, schedule, arm, dtype = key
+        return {"pool": pool, "bucket": b, "schedule": schedule,
+                "backend_arm": arm, "weight_dtype": dtype}
+
     def retry_after_estimate(self) -> float:
         """Backoff advice for shed clients: batch-assembly wait plus the
         backlog's drain time at the observed p50 — clamped so a cold
@@ -705,8 +805,17 @@ class ServingEngine:
                 out["status"] = "degraded"
         return out
 
+    def sample_gauges(self):
+        """Ticker hook (ops plane): publish the cost-plane gauges when
+        this engine owns its ledgers (a fleet publishes the shared ones
+        from ITS sample_gauges)."""
+        if self._owns_costs:
+            self.costs.publish()
+            self.goodput.publish()
+
     def stats(self) -> dict:
         """JSON-ready health/stats snapshot."""
+        self.sample_gauges()
         snap = self.metrics.snapshot(self.cfg.max_batch)
         snap["queue"] = {
             "depth": self._queue.qsize(),
@@ -735,6 +844,13 @@ class ServingEngine:
             }
         if self._breaker is not None:
             snap["breaker"] = self._breaker.snapshot()
+        # the serving cost plane (telemetry/costs.py) — only when this
+        # engine OWNS its ledgers: a fleet replica's cells/accounts live
+        # in the FLEET's shared ledgers and its stats() would otherwise
+        # show every sibling's rows as its own
+        if self._owns_costs:
+            snap["costs"] = self.costs.snapshot()
+            snap["serve_goodput"] = self.goodput.snapshot()
         # the unified telemetry view: every registry metric (per-bucket
         # compile count/seconds gauges included) plus per-phase span
         # aggregates; empty-but-present under the no-op tracer so stats
@@ -788,6 +904,20 @@ class ServingEngine:
             with self._inflight_lock:
                 if self._inflight.get(req.cache_key) is req:
                     del self._inflight[req.cache_key]
+            if self.flights is not None:
+                # THE terminal chokepoint (worker, drain, abort, timeout
+                # paths all resolve through here): seal the exemplar
+                if exc is not None:
+                    self.flights.finish(
+                        req.trace_id, "failed",
+                        code=getattr(exc, "code", type(exc).__name__),
+                        replica=self.replica_name)
+                else:
+                    self.flights.finish(
+                        req.trace_id, "completed",
+                        replica=self.replica_name,
+                        latency_s=result.latency_s,
+                        batch_bucket=result.bucket)
         return finished
 
     # ------------------------------------------------- compile cache
@@ -829,7 +959,12 @@ class ServingEngine:
                 self._base_key.shape, self._base_key.dtype
             )
             # compile_span: per-bucket compile counter + wall-seconds
-            # gauges in the registry, and one `serving_compile` span
+            # gauges in the registry, and one `serving_compile` span.
+            # The goodput ledger gets the same wall under "compile" —
+            # accounted HERE (not in the dispatch timing below, which
+            # subtracts the compile tracker's delta) so precompile-at-
+            # build and first-call compiles land in one bucket.
+            t_compile = time.monotonic()
             with self.metrics.compile_span(bucket):
                 if rows:
                     s_msa = jax.ShapeDtypeStruct((B, rows, bucket), np.int32)
@@ -848,6 +983,8 @@ class ServingEngine:
                         .lower(self._params, s_tok, s_mask, s_key)
                         .compile()
                     )
+            self.goodput.add(self._goodput_name, "compile",
+                             time.monotonic() - t_compile)
             self._executables[bucket] = exe
             return exe
 
@@ -1070,6 +1207,8 @@ class ServingEngine:
             self._run_live(bucket, live, allow_split)
 
     def _run_live(self, bucket: int, live, allow_split: bool):
+        dispatch_t0 = None  # set iff the device call actually started
+        compile_s0 = 0.0
         try:
             # batch assembly sits INSIDE the guard: a request that breaks
             # host-side padding must fail like one that breaks the model
@@ -1081,12 +1220,31 @@ class ServingEngine:
             msa = msa_mask = None
             if self.cfg.msa_rows:
                 msa, msa_mask = self._pad_msa_batch(live, bucket)
+            # cost-plane timing: dispatch wall minus the compile
+            # tracker's delta = pure execute seconds — a bucket's first
+            # batch (30s+ of XLA on real models) must not poison the
+            # cost ledger's EMA or read as productive execute time
+            # (_executable_for accounts the compile bucket itself)
+            compile_s0 = self.metrics.compile_seconds_total()
+            dispatch_t0 = time.monotonic()
             out = self._dispatch(bucket, tokens, mask, msa, msa_mask,
                                  trace_ids=[r.trace_id for r in live])
+            exec_s = max(0.0, (time.monotonic() - dispatch_t0)
+                         - (self.metrics.compile_seconds_total()
+                            - compile_s0))
             coords = np.asarray(out["coords"])
             conf = np.asarray(out["confidence"])
             stress = np.asarray(out["stress"])
         except Exception as e:  # noqa: BLE001 — isolate, report, keep serving
+            if dispatch_t0 is not None:
+                # device time a FAILED dispatch burned: the failover
+                # bill ("requeue" badput — its requests requeue onto
+                # another replica or fail), never productive execute
+                self.goodput.add(
+                    self._goodput_name, "requeue",
+                    max(0.0, (time.monotonic() - dispatch_t0)
+                        - (self.metrics.compile_seconds_total()
+                           - compile_s0)))
             hung = isinstance(e, HungBatchError)
             if not hung and allow_split and len(live) > 1:
                 # a poison request must not take its batchmates down:
@@ -1116,6 +1274,12 @@ class ServingEngine:
 
         if self._breaker is not None:
             self._breaker.record_success()
+        # the cost plane's measured column + the goodput execute bucket
+        # (accounted BEFORE the requests resolve, so a probe blocking on
+        # its result observes this accounting inside its probe_span)
+        self.goodput.add(self._goodput_name, "execute", exec_s)
+        self.costs.observe_batch(self._cost_cells[bucket],
+                                 device_seconds=exec_s, requests=len(live))
         done_at = time.monotonic()
         with self._tracer.span("serving.respond", cat="serving",
                                bucket=bucket, n=len(live),

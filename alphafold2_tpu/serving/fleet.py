@@ -90,6 +90,11 @@ from alphafold2_tpu.serving.featurize import (
     featurize_request,
 )
 from alphafold2_tpu.telemetry import NULL_TRACER, MetricRegistry, new_trace_id
+from alphafold2_tpu.telemetry.costs import (
+    ExecutableCostLedger,
+    FlightBook,
+    ServeGoodputLedger,
+)
 
 #: replica errors that justify trying ANOTHER replica — the replica (not
 #: the request) is the suspect. Everything else is terminal for the
@@ -412,6 +417,22 @@ class ServingFleet:
         self._incident_hook = incident_hook
         self._factory = engine_factory or self._default_factory
 
+        # ---- serving cost & profiling plane (telemetry/costs.py) ----
+        # always on (dict bookkeeping, no model cost): the shared
+        # per-executable cost ledger (every replica of a pool merges into
+        # one cell), the per-replica goodput ledger (the fleet layers
+        # probe/drain on what the engines account), and the exemplar
+        # flight book behind /explainz
+        self.costs = ExecutableCostLedger(self.registry)
+        self.goodput = ServeGoodputLedger(self.registry)
+        self.flights = FlightBook()
+        # per-pool arrival tracking for the headroom model: counts at
+        # _admit (preferred-pool key), rates derived in sample_gauges
+        self._arrivals_lock = threading.Lock()
+        self._arrivals = {name: 0 for name in self._pools}
+        self._arrival_rate = {}   # pool -> {"count", "ts", "ema"}
+        self._last_headroom = {}  # pool -> headroom model (sample_gauges)
+
         self._lock = threading.Lock()
         self._closed = False
         self._drain_on_stop = True
@@ -606,6 +627,14 @@ class ServingFleet:
             model_apply_fn=self._model_apply_fn,
             fault_hook=fault_hook, tracer=self._tracer,
             replica_name=name, incident_hook=self._incident_hook,
+            # the shared cost plane: this replica's cells merge into its
+            # pool's rows and its execute/compile/requeue seconds land in
+            # the fleet-wide per-replica economy (the fleet itself adds
+            # probe/drain). The flight book stays FLEET-owned — the
+            # fleet sees the whole cross-replica flight.
+            pool_name=(DEGRADED if name == DEGRADED
+                       else self._replica_pool[name]),
+            cost_ledger=self.costs, goodput=self.goodput,
         )
 
     def _make_factory(self, rep: _Replica):
@@ -649,6 +678,10 @@ class ServingFleet:
             # are never reused, so entries never need removal)
             self._replica_pool[name] = pool_name
             rep.factory = self._make_factory(rep)
+        # the goodput clock starts when the SLOT exists (engine build —
+        # which may compile — is already on it); fleet-side so custom
+        # engine_factory fleets keep per-replica accounts too
+        self.goodput.register(name, pool_name)
         rep.engine = rep.factory()
         with self._lock:
             self._replicas[name] = rep
@@ -702,6 +735,11 @@ class ServingFleet:
                 raise EngineClosedError("fleet is shut down")
             ttl = (self.cfg.default_timeout_s if timeout is None else timeout)
             deadline = (time.monotonic() + ttl) if ttl is not None else None
+            # exemplar flight record (telemetry/costs.py FlightBook —
+            # the /explainz backing): born HERE, the fleet front door;
+            # every hop below appends to it
+            self.flights.begin(trace_id, length=len(seq),
+                               priority=str(priority))
 
             if features is None and self._featurize is None:
                 # no tier: featurize inline on the submit thread (the
@@ -718,9 +756,11 @@ class ServingFleet:
                     )
                 except SequenceTooLongError as e:
                     self._shed_too_long(e)
+                    self.flights.finish(trace_id, "shed", code=e.code)
                     raise
                 except ServingError as e:
                     self._count_error(e)
+                    self.flights.finish(trace_id, "failed", code=e.code)
                     raise
             if features is not None:
                 if features.length > self._ladder.max_len:
@@ -733,6 +773,7 @@ class ServingFleet:
                         f"capability pool's bucket ceiling "
                         f"({self._ladder.max_len})")
                     self._shed_too_long(e)
+                    self.flights.finish(trace_id, "shed", code=e.code)
                     raise e
                 entry = FleetRequest(features.seq, msa, msa_mask,
                                      resolve_priority(priority), deadline,
@@ -748,6 +789,7 @@ class ServingFleet:
                                  resolve_priority(priority), deadline,
                                  trace_id=trace_id)
             self._counts["submitted"].inc()
+            self.flights.note(trace_id, "featurize_enqueue")
             try:
                 self._featurize.submit(
                     seq, msa, msa_mask, trace_id=trace_id,
@@ -759,6 +801,7 @@ class ServingFleet:
                 self._shed_counter("featurize_queue_full").inc()
                 self._counts["shed"].inc()
                 self._count_error(e)
+                self.flights.finish(trace_id, "shed", code=e.code)
                 raise
             except EngineClosedError as e:
                 self._resolve_failed(entry, e)
@@ -789,6 +832,8 @@ class ServingFleet:
             return
         entry.features = bundle
         entry.seq = bundle.seq
+        self.flights.note(entry.trace_id, "featurized",
+                          bucket=bundle.bucket)
         self._admit(entry, raise_on_full=False)
 
     def _preferred_pool_name(self, length: int) -> Optional[str]:
@@ -831,6 +876,15 @@ class ServingFleet:
         length = (entry.features.length if entry.features is not None
                   else len(entry.seq))
         entry.pool = self._preferred_pool_name(length)
+        if entry.pool is not None:
+            # the ARRIVAL half of the headroom model (sample_gauges
+            # derives rates): demand is counted where it is admitted,
+            # shed included — a shed request is still demand the pool
+            # failed to absorb
+            with self._arrivals_lock:
+                self._arrivals[entry.pool] = (
+                    self._arrivals.get(entry.pool, 0) + 1)
+        self.flights.note(entry.trace_id, "admitted", pool=entry.pool)
         try:
             evicted = self._admission.offer(entry)
         except QueueFullError as e:
@@ -845,6 +899,12 @@ class ServingFleet:
                 self._shed_counter("queue_full").inc()
                 self._counts["shed"].inc()
                 self._count_error(e)
+                # the entry never resolves through _resolve_shed on this
+                # synchronous path — seal its flight here or /explainz
+                # would show an overload shed (the flight most worth
+                # explaining) as forever in flight
+                self.flights.finish(entry.trace_id, "shed",
+                                    reason="queue_full", code=e.code)
                 raise e from None
             self._resolve_shed(entry, "queue_full", e)
             return
@@ -1033,6 +1093,7 @@ class ServingFleet:
                 p_live = [r for r in live if r.pool == name]
                 per_pool[name] = (
                     len(p_live),
+                    sum(1 for r in p_live if r.name in healthy),
                     sum(r.in_flight for r in p_live if r.name in healthy),
                     sum(r.cfg.max_batch for r in p_live
                         if r.name in healthy),
@@ -1042,12 +1103,96 @@ class ServingFleet:
         # the per-capability-pool view: each pool autoscaler reads ITS
         # queue depth / occupancy / size, so a saturated SP pool scales
         # without the idle dense pool's signals diluting the decision
-        for name, (n_p, inf_p, slots_p) in per_pool.items():
+        for name, (n_p, _healthy_p, inf_p, slots_p) in per_pool.items():
             self._pool_reps_g[name].set(n_p)
             self._pool_occ_g[name].set(inf_p / slots_p if slots_p else 0.0)
             self._pool_depth_g[name].set(depth_by_pool.get(name, 0))
+        self._sample_headroom(
+            now, {name: h for name, (_n, h, _i, _s) in per_pool.items()})
+        # the shared cost plane's gauges ride the same tick
+        self.costs.publish()
+        self.goodput.publish()
         if self._featurize is not None:
             self._featurize.sample_gauges()
+
+    def _sample_headroom(self, now: float, healthy_by_pool: dict):
+        """The capacity model closing ROADMAP item 2's loop: per pool,
+        arrival rate (EMA over `_admit` counts) vs modeled capacity
+        (cost-ledger service rate x healthy replicas) published as
+        `fleet_pool_headroom_ratio` — the autoscaler's new up-trigger
+        reads it, so scale-up fires when the MODEL says the pool is
+        running out, before queue-wait p95 (a lagging symptom) climbs.
+        `fleet_pool_slo_burn_predicted` (arrival/capacity) is the burn
+        predictor: >1 means the queue grows without bound and an SLO
+        page is a matter of time. Gauges stay ABSENT until the pool has
+        measured batches — a guessed capacity is worse than none."""
+        snap = {}
+        with self._arrivals_lock:
+            counts = dict(self._arrivals)
+            for name, count in counts.items():
+                state = self._arrival_rate.get(name)
+                if state is None:
+                    self._arrival_rate[name] = {
+                        "count": count, "ts": now, "ema": None}
+                    continue
+                dt = now - state["ts"]
+                if dt <= 0:
+                    continue
+                inst = (count - state["count"]) / dt
+                state["ema"] = (inst if state["ema"] is None
+                                else 0.3 * inst + 0.7 * state["ema"])
+                state["count"], state["ts"] = count, now
+            rates = {name: (s["ema"] or 0.0)
+                     for name, s in self._arrival_rate.items()}
+        for name in self._pools:
+            arrival = rates.get(name, 0.0)
+            self.registry.gauge(
+                "fleet_pool_arrival_per_sec",
+                help="EMA request arrival rate whose preferred "
+                     "capability pool is this one (sheds included — "
+                     "demand, not throughput)", pool=name).set(arrival)
+            per_replica = self.costs.pool_rate_rps(name)
+            if per_replica is None:
+                continue  # nothing measured yet: headroom stays absent
+            capacity = per_replica * healthy_by_pool.get(name, 0)
+            self.registry.gauge(
+                "fleet_pool_capacity_per_sec",
+                help="modeled service capacity: cost-ledger per-replica "
+                     "rate x healthy replicas", pool=name).set(capacity)
+            # capacity 0 = every replica of a measured pool is down:
+            # publish WORST-case headroom rather than `continue` —
+            # freezing the last pre-outage value would blind the
+            # headroom up-trigger during exactly the outage it exists
+            # for. Burn caps at a large finite ceiling (a gauge must
+            # stay finite) and reads 0 only when demand is also 0.
+            if capacity > 0:
+                headroom = max(-1.0,
+                               min(1.0, (capacity - arrival) / capacity))
+                burn = min(1e6, arrival / capacity)
+            else:
+                headroom = -1.0
+                burn = 1e6 if arrival > 0 else 0.0
+            self.registry.gauge(
+                "fleet_pool_headroom_ratio",
+                help="(capacity - arrival) / capacity; the autoscaler "
+                     "headroom up-trigger and the capacity runbook's "
+                     "first signal (-1 when a measured pool has zero "
+                     "healthy capacity)", pool=name).set(headroom)
+            self.registry.gauge(
+                "fleet_pool_slo_burn_predicted",
+                help="arrival / capacity: >1 predicts unbounded queue "
+                     "growth (an SLO page is a matter of time; capped "
+                     "at 1e6 when capacity is zero)",
+                pool=name).set(burn)
+            snap[name] = {
+                "arrival_per_sec": arrival,
+                "capacity_per_sec": capacity,
+                "per_replica_rps": per_replica,
+                "healthy_replicas": healthy_by_pool.get(name, 0),
+                "headroom_ratio": headroom,
+                "burn_predicted": burn,
+            }
+        self._last_headroom = snap
 
     def rolling_update(self, *, params=None, model_cfg=None,
                        params_tag: Optional[str] = None,
@@ -1233,6 +1378,13 @@ class ServingFleet:
                 "retry_after_s": self._pool_retry_after(
                     name, depth=depth_by_pool.get(name, 0)),
             }
+        # publish the cost-plane ledgers so the registry snapshot below
+        # agrees with the sections; deliberately NOT the full
+        # sample_gauges sweep — its dedupe guard exists for the ticker
+        # cadence, and a stats() poll must not consume an explicit
+        # sample_gauges() caller's refresh window
+        self.costs.publish()
+        self.goodput.publish()
         out = {
             "closed": self._closed,
             "requests": counts,
@@ -1244,6 +1396,10 @@ class ServingFleet:
             "replicas": replicas,
             "pools": pools,
             "health": self._health.snapshot(),
+            "costs": self.costs.snapshot(),
+            "serve_goodput": self.goodput.snapshot(),
+            "headroom": dict(self._last_headroom),
+            "flights": self.flights.snapshot(),
             "telemetry": {
                 "metrics": self.registry.snapshot(),
                 "spans": self._tracer.summary(),
@@ -1458,6 +1614,22 @@ class ServingFleet:
         # signal — a saturated pool's wait climbs even while another
         # pool's sits at zero)
         self._routed_counter(rep.pool).inc()
+        cell = {}
+        if entry.features is not None:
+            cell_fn = getattr(rep.engine, "cell_for", None)
+            if cell_fn is not None:
+                try:
+                    cell = dict(cell_fn(entry.features.bucket))
+                except Exception:  # noqa: BLE001 — a stub engine without
+                    # real cells must not break routing
+                    cell = {}
+            # the engine cell's pool IS rep.pool (passed at build) —
+            # drop it so the explicit kwarg below stays the one source
+            cell.pop("pool", None)
+        self.flights.note(
+            entry.trace_id, "dispatch", replica=rep.name, pool=rep.pool,
+            queue_wait_s=round(now - entry.enqueued_at, 6),
+            requeues=entry.requeues, **cell)
         hist = self._pool_wait.get(rep.pool)
         if hist is not None:
             hist.observe(now - entry.enqueued_at)
@@ -1497,6 +1669,13 @@ class ServingFleet:
                 self._latency.observe(time.monotonic() - entry.enqueued_at)
                 if degraded:
                     self._degraded_total.inc()
+                self.flights.finish(
+                    entry.trace_id, "completed", replica=rep.name,
+                    pool=rep.pool, degraded=degraded,
+                    requeues=entry.requeues,
+                    from_cache=result.from_cache, bucket=result.bucket,
+                    latency_s=round(
+                        time.monotonic() - entry.enqueued_at, 6))
             return
         if isinstance(exc, RequestTimeoutError):
             # the request's OWN deadline expired inside the replica —
@@ -1511,6 +1690,8 @@ class ServingFleet:
             if not self._closed and entry.requeues < self.cfg.requeue_limit:
                 entry.requeues += 1
                 self._requeue_total.inc()
+                self.flights.note(entry.trace_id, "requeue",
+                                  failed_on=rep.name, code=exc.code)
                 self._admission.requeue(entry)
                 return
             if entry.requeues >= self.cfg.requeue_limit > 0:
@@ -1567,6 +1748,9 @@ class ServingFleet:
             self._counts["shed"].inc()
             self._shed_counter(reason).inc()
             self._count_error(exc)
+            self.flights.finish(entry.trace_id, "shed", reason=reason,
+                                code=getattr(exc, "code", "serving_error"),
+                                requeues=entry.requeues)
             return True
         return False
 
@@ -1575,6 +1759,10 @@ class ServingFleet:
         if entry._finish(exc=exc):
             self._counts["failed"].inc()
             self._count_error(exc)
+            self.flights.finish(entry.trace_id, "failed",
+                                code=getattr(exc, "code",
+                                             type(exc).__name__),
+                                requeues=entry.requeues)
             return True
         return False
 
@@ -1604,9 +1792,14 @@ class ServingFleet:
             seq.append(AA_ORDER[n % len(AA_ORDER)])
             n //= len(AA_ORDER)
         try:
-            req = engine.submit("".join(seq),
-                                timeout=self.cfg.probe_timeout_s)
-            req.result(timeout=self.cfg.probe_timeout_s)
+            # probe_span accounts the round trip as "probe" badput MINUS
+            # whatever the engine accounts during it (the probe's own
+            # execute/compile) — sums-to-wall survives reinstatement
+            # probes whose first dispatch compiles
+            with self.goodput.probe_span(name):
+                req = engine.submit("".join(seq),
+                                    timeout=self.cfg.probe_timeout_s)
+                req.result(timeout=self.cfg.probe_timeout_s)
             return True
         except (ServingError, TimeoutError):
             return False
@@ -1639,7 +1832,9 @@ class ServingFleet:
                 # take the supervisor down
                 traceback.print_exc()
         if engine is not None:
+            t0 = time.monotonic()
             engine.shutdown(drain=False, timeout=self.cfg.drain_timeout_s)
+            self.goodput.add(name, "drain", time.monotonic() - t0)
 
     def _reinstate_replica(self, name: str):
         gauge = self._up_gauges.get(name)

@@ -178,6 +178,14 @@ class ServingMetrics:
         with self._compiles_lock:
             return len(self._compile_seconds)
 
+    def compile_seconds_total(self) -> float:
+        """Cumulative compile wall seconds across every bucket — the
+        engine's dispatch timing reads this before/after a device call
+        so a first-call compile is EXCLUDED from the cost ledger's
+        execute EMA (telemetry/costs.py)."""
+        with self._compiles_lock:
+            return float(sum(g.value for g in self._compile_seconds.values()))
+
     def snapshot(self, max_batch: int) -> dict:
         with self._counts_lock:
             counts = {name: int(c.value) for name, c in self._counts.items()}

@@ -26,6 +26,13 @@ scaling results):
                   badput ledger, pod-wide metric federation with a
                   `process` label, straggler/data-stall detection, and
                   the trainer ops-plane wiring (`train_*.py --ops-port`).
+  * `costs`     — the SERVING cost plane: per-executable chip-cost
+                  ledger (analytic FLOPs x priced residency x measured
+                  EMA per (pool, bucket, schedule, arm, dtype) cell),
+                  per-replica serve-goodput ledger, and the exemplar
+                  flight book behind `/explainz` — the capacity model
+                  the fleet's headroom gauges and the autoscaler's
+                  `up_headroom` trigger consume.
 
 Everything is disabled-by-default at the call sites: an engine or
 trainer built without a tracer/registry runs the shared no-op singletons
@@ -52,9 +59,18 @@ from alphafold2_tpu.telemetry.logger import (
     MetricsLogger,
     per_process_metrics_path,
 )
+from alphafold2_tpu.telemetry.costs import (
+    SERVE_CAUSES,
+    ExecutableCostLedger,
+    FlightBook,
+    ServeGoodputLedger,
+)
 from alphafold2_tpu.telemetry.ops_plane import (
     FlightRecorder,
     OpsServer,
+    ProfileBusyError,
+    ProfileCapturer,
+    ProfileRateLimitedError,
     ops_server_for_engine,
     ops_server_for_fleet,
 )
@@ -120,7 +136,9 @@ __all__ = [
     "BUCKETS",
     "CompileTracker",
     "Counter",
+    "ExecutableCostLedger",
     "FederatedRegistryView",
+    "FlightBook",
     "FlightRecorder",
     "Gauge",
     "GoodputLedger",
@@ -133,6 +151,11 @@ __all__ = [
     "NULL_TRACER",
     "NULL_TRAIN_TELEMETRY",
     "OpsServer",
+    "ProfileBusyError",
+    "ProfileCapturer",
+    "ProfileRateLimitedError",
+    "SERVE_CAUSES",
+    "ServeGoodputLedger",
     "StragglerDetector",
     "TrainTelemetry",
     "SloConfig",

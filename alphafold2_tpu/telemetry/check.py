@@ -91,6 +91,15 @@ _RULES: Tuple[Tuple[str, str, float], ...] = (
     # (a saturated SP pool hides inside a healthy global p95)
     ("*pool_queue_wait*", "lower", 0.25),
     ("*badput*", "lower", 0.25),
+    # the serving cost plane (ISSUE 15): per-request chip cost gates
+    # lower-better (it would also hit the generic *_seconds* rule, but
+    # the explicit entry pins intent and a tighter doc trail); capacity
+    # headroom and the serving goodput ratio gate higher-better at the
+    # same noise-tolerant 25% as the training ratios — chip-free rows
+    # are machine-speed-dominated, structural regressions move far more
+    ("*chip_seconds*", "lower", 0.25),
+    ("*headroom*", "higher", 0.25),
+    ("*serve_goodput*", "higher", 0.25),
     # 25%, not the 5-10% of the steady-state throughput rules: the
     # chip-free train_goodput leg's ratio is compile-dominated on a CPU
     # host (machine-speed noise), while a structural regression — a

@@ -20,6 +20,18 @@ endpoints):
   * ``/statusz``  the deep-dive JSON: health + full stats snapshot +
                   registry snapshot + span summary + SLO state + flight
                   recorder state.
+  * ``/explainz`` exemplar flight lookup (`?trace_id=<id>`): the full
+                  per-request flight record from a `telemetry.costs.
+                  FlightBook` — every lifecycle event across featurize
+                  tier, admission, and replicas. Without a trace_id it
+                  answers 400 with the most recent ids; an unknown id is
+                  404. Absent entirely (no flight book wired) it is 404.
+  * ``/profilez`` on-demand `jax.profiler` capture (`?duration_s=N`,
+                  bounded and rate-limited — see `ProfileCapturer`):
+                  200 with the capture directory when started, 409 while
+                  one is already running, 429 inside the rate-limit
+                  window — so the next healthy TPU probe can be profiled
+                  WITHOUT redeploying the fleet.
 
   plus a background TICKER thread that drives the periodic work live
   observability needs: `SloEngine.evaluate()`, `FlightRecorder.poll()`
@@ -61,6 +73,7 @@ import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, List, Optional
+from urllib.parse import parse_qs, urlsplit
 
 from alphafold2_tpu.telemetry.registry import MetricRegistry
 from alphafold2_tpu.telemetry.trace import NULL_TRACER, Tracer
@@ -255,6 +268,160 @@ class FlightRecorder:
             }
 
 
+class ProfileCapturer:
+    """On-demand, duration-bounded, rate-limited `jax.profiler` capture
+    (the `/profilez` backing; module docstring).
+
+    One capture at a time: `start()` raises `ProfileBusyError` while a
+    capture runs (HTTP 409) and `ProfileRateLimitedError` inside
+    `min_interval_s` of the previous start (HTTP 429) — an operator
+    hammering the endpoint must not turn the profiler into the overload.
+    The capture itself runs on a daemon thread: `jax.profiler.
+    start_trace` into a fresh `profile-<seq>` directory under `out_dir`,
+    stopped after `duration_s` (clamped to `max_duration_s`). Outcomes
+    are counted (`profilez_captures_total{outcome}`) so abuse is itself
+    scrapeable.
+    """
+
+    def __init__(self, out_dir: str, *,
+                 registry: Optional[MetricRegistry] = None,
+                 max_duration_s: float = 30.0, min_interval_s: float = 30.0,
+                 clock=time.monotonic):
+        if max_duration_s <= 0 or min_interval_s < 0:
+            raise ValueError(
+                f"max_duration_s must be > 0 and min_interval_s >= 0, got "
+                f"{max_duration_s}/{min_interval_s}")
+        self.out_dir = out_dir
+        self._registry = registry
+        self.max_duration_s = max_duration_s
+        self.min_interval_s = min_interval_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._running: Optional[dict] = None
+        self._last_start: Optional[float] = None
+        self._seq = 0
+        self._captures: List[dict] = []
+        self._abort = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _count(self, outcome: str):
+        if self._registry is not None:
+            self._registry.counter(
+                "profilez_captures_total",
+                help="/profilez capture requests by outcome",
+                outcome=outcome).inc()
+
+    def start(self, duration_s: float = 2.0) -> dict:
+        """Begin one capture; returns {"dir", "duration_s", "seq"}.
+        Raises ProfileBusyError / ProfileRateLimitedError /
+        ValueError(duration) — the HTTP layer maps them to 409/429/400.
+
+        The capture itself (start_trace -> bounded wait -> stop_trace)
+        runs ENTIRELY on one NON-daemon worker thread, asynchronously:
+
+          * asynchronously, because `jax.profiler.start_trace` can block
+            for seconds behind an in-flight XLA compile — an HTTP
+            handler must answer now, not when the compiler yields;
+          * one thread for both ends, NON-daemon, because any daemon
+            thread still inside the profiler (blocked start OR pending
+            stop) at interpreter teardown SEGFAULTS in native code
+            (reproduced on jax 0.4.x CPU): threading._shutdown joins
+            non-daemon threads BEFORE teardown, and close() — wired
+            into OpsServer.stop — aborts the wait early so exit never
+            stalls a full capture window.
+
+        A start_trace failure is counted (`outcome="failed"`) and
+        surfaced in `snapshot()` rather than the HTTP response (the
+        request already returned)."""
+        if duration_s <= 0:
+            raise ValueError(
+                f"duration_s must be positive, got {duration_s}")
+        duration_s = min(float(duration_s), self.max_duration_s)
+        now = self._clock()
+        with self._lock:
+            if self._running is not None:
+                self._count("rejected_busy")
+                raise ProfileBusyError(
+                    f"a profile capture is already running "
+                    f"(dir {self._running['dir']})")
+            if (self._last_start is not None
+                    and now - self._last_start < self.min_interval_s):
+                self._count("rejected_rate_limited")
+                raise ProfileRateLimitedError(
+                    f"last capture started "
+                    f"{now - self._last_start:.1f}s ago; minimum interval "
+                    f"is {self.min_interval_s}s")
+            self._seq += 1
+            seq = self._seq
+            path = os.path.join(self.out_dir, f"profile-{seq:03d}")
+            info = {"seq": seq, "dir": path, "duration_s": duration_s}
+            self._running = info
+            self._last_start = now
+        self._abort.clear()
+
+        def capture():
+            try:
+                import jax
+
+                os.makedirs(path, exist_ok=True)
+                jax.profiler.start_trace(path)
+            except Exception:  # noqa: BLE001 — surfaced via snapshot
+                traceback.print_exc()
+                self._count("failed")
+                info["error"] = "start_trace failed (see server log)"
+                with self._lock:
+                    self._running = None
+                    self._captures.append(dict(info))
+                return
+            self._count("started")
+            self._abort.wait(duration_s)
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001 — a failing stop must not
+                # kill the capture thread silently mid-serving
+                traceback.print_exc()
+                info["error"] = "stop_trace failed (see server log)"
+            finally:
+                with self._lock:
+                    self._running = None
+                    self._captures.append(dict(info))
+
+        self._thread = threading.Thread(
+            target=capture, name="profilez-capture", daemon=False)
+        self._thread.start()
+        return dict(info)
+
+    def close(self, timeout: Optional[float] = 30.0):
+        """Abort any in-flight capture and join the capture thread —
+        called from `OpsServer.stop()` so a capture can never be left
+        racing process teardown (a blocked start_trace can hold the
+        join up to roughly one compile; the non-daemon thread covers
+        the exit path even if this times out). Idempotent."""
+        self._abort.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        self._thread = None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "dir": self.out_dir,
+                "running": dict(self._running) if self._running else None,
+                "captures": [dict(c) for c in self._captures],
+                "max_duration_s": self.max_duration_s,
+                "min_interval_s": self.min_interval_s,
+            }
+
+
+class ProfileBusyError(RuntimeError):
+    """A capture is already in flight (HTTP 409)."""
+
+
+class ProfileRateLimitedError(RuntimeError):
+    """Too soon after the previous capture (HTTP 429)."""
+
+
 class _Handler(BaseHTTPRequestHandler):
     """One request; the server instance carries the providers."""
 
@@ -278,7 +445,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 — http.server API
         ops: "OpsServer" = self.server.ops  # type: ignore[attr-defined]
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        parts = urlsplit(self.path)
+        path = parts.path.rstrip("/") or "/"
+        query = parse_qs(parts.query)
         try:
             if path == "/metrics":
                 body = ops.registry.to_prometheus().encode("utf-8")
@@ -293,9 +462,18 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(code, payload)
             elif path == "/statusz":
                 self._send_json(200, ops.statusz())
+            elif path == "/explainz":
+                code, payload = ops.explainz(
+                    query.get("trace_id", [None])[0])
+                self._send_json(code, payload)
+            elif path == "/profilez":
+                code, payload = ops.profilez(
+                    query.get("duration_s", [None])[0])
+                self._send_json(code, payload)
             elif path == "/":
                 self._send_json(200, {"endpoints": [
-                    "/metrics", "/healthz", "/statusz"]})
+                    "/metrics", "/healthz", "/statusz", "/explainz",
+                    "/profilez"]})
             else:
                 self._send_json(404, {"error": f"no such endpoint {path!r}"})
         except Exception:  # noqa: BLE001 — a handler bug must answer 500,
@@ -317,6 +495,7 @@ class OpsServer:
                  stats_fn: Optional[Callable[[], dict]] = None,
                  tracer: Tracer = NULL_TRACER,
                  slo=None, recorder: Optional[FlightRecorder] = None,
+                 flights=None, profiler: Optional[ProfileCapturer] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  tick_interval_s: float = 1.0):
         if tick_interval_s <= 0:
@@ -329,6 +508,18 @@ class OpsServer:
         self._tracer = tracer
         self.slo = slo
         self.recorder = recorder
+        self.flights = flights      # telemetry.costs.FlightBook (/explainz)
+        self.profiler = profiler    # ProfileCapturer (/profilez)
+        self._dropped_seen = 0
+        if tracer.enabled:
+            # registered eagerly at 0 so span loss is alertable from the
+            # first scrape (the ticker publishes increments; before this
+            # counter, retention overflow was visible only in summary()
+            # and the Chrome export's otherData)
+            registry.counter(
+                "trace_spans_dropped_total",
+                help="spans lost to the tracer retention bound "
+                     "(max_spans) — raise --trace-max-spans if nonzero")
         self._tick_interval_s = tick_interval_s
         self._extra_ticks: List[Callable[[], None]] = []
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
@@ -368,7 +559,51 @@ class OpsServer:
             out["slo"] = self.slo.snapshot()
         if self.recorder is not None:
             out["flight_recorder"] = self.recorder.snapshot()
+        if self.flights is not None:
+            out["flights"] = self.flights.snapshot()
+        if self.profiler is not None:
+            out["profiler"] = self.profiler.snapshot()
         return out
+
+    def explainz(self, trace_id: Optional[str]):
+        """(code, payload) for `/explainz?trace_id=` — the exemplar
+        flight lookup (telemetry/costs.py FlightBook)."""
+        if self.flights is None:
+            return 404, {"error": "no flight book wired on this server"}
+        if not trace_id:
+            return 400, {
+                "error": "pass ?trace_id=<id>",
+                "recent_trace_ids": self.flights.recent(),
+            }
+        rec = self.flights.get(trace_id)
+        if rec is None:
+            return 404, {
+                "error": f"no flight recorded for trace_id {trace_id!r} "
+                         f"(evicted, or never seen)",
+                "recent_trace_ids": self.flights.recent(),
+            }
+        return 200, rec
+
+    def profilez(self, duration_s):
+        """(code, payload) for `/profilez?duration_s=` — start one
+        bounded jax.profiler capture (409 busy / 429 rate-limited)."""
+        if self.profiler is None:
+            return 404, {"error": "no profiler wired on this server "
+                                  "(serve.py arms it with --flight-dir)"}
+        try:
+            duration = float(duration_s) if duration_s is not None else 2.0
+        except ValueError:
+            return 400, {"error": f"duration_s must be a number, got "
+                                  f"{duration_s!r}"}
+        try:
+            info = self.profiler.start(duration)
+        except ProfileBusyError as e:
+            return 409, {"error": str(e)}
+        except ProfileRateLimitedError as e:
+            return 429, {"error": str(e)}
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        return 200, {"status": "capturing", **info}
 
     # ------------------------------------------------------------ lifecycle
 
@@ -385,12 +620,28 @@ class OpsServer:
             hooks.append(self.slo.evaluate)
         if self.recorder is not None:
             hooks.append(self.recorder.poll)
+        if self._tracer.enabled:
+            hooks.append(self._sync_dropped_spans)
         hooks.extend(self._extra_ticks)
         for fn in hooks:
             try:
                 fn()
             except Exception:  # noqa: BLE001 — see docstring
                 traceback.print_exc()
+
+    def _sync_dropped_spans(self):
+        """Ticker hook: publish tracer retention overflow as the
+        monotone `trace_spans_dropped_total` counter (increment-based so
+        the counter only grows across tracer instances)."""
+        dropped = self._tracer.dropped
+        delta = dropped - self._dropped_seen
+        if delta > 0:
+            self._dropped_seen = dropped
+            self.registry.counter(
+                "trace_spans_dropped_total",
+                help="spans lost to the tracer retention bound "
+                     "(max_spans) — raise --trace-max-spans if nonzero"
+            ).inc(delta)
 
     def start(self):
         if self._serve_thread is not None:
@@ -411,6 +662,10 @@ class OpsServer:
 
     def stop(self, timeout: Optional[float] = 5.0):
         self._stop.set()
+        if self.profiler is not None:
+            # an in-flight /profilez capture must resolve before the
+            # process can tear down (see ProfileCapturer.close)
+            self.profiler.close()
         if self._tick_thread is not None:
             self._tick_thread.join(timeout)
             self._tick_thread = None
@@ -433,26 +688,31 @@ class OpsServer:
 
 def ops_server_for_engine(engine, *, tracer: Tracer = NULL_TRACER,
                           slo=None, recorder: Optional[FlightRecorder] = None,
+                          profiler: Optional[ProfileCapturer] = None,
                           host: str = "127.0.0.1", port: int = 0,
                           tick_interval_s: float = 1.0) -> OpsServer:
     """Wire an `OpsServer` over one `ServingEngine`: its metrics
-    registry, `health()`, and `stats()`."""
+    registry, `health()`, `stats()`, and its flight book (/explainz)."""
     return OpsServer(
         registry=engine.metrics.registry, health_fn=engine.health,
         stats_fn=engine.stats, tracer=tracer, slo=slo, recorder=recorder,
+        flights=getattr(engine, "flights", None), profiler=profiler,
         host=host, port=port, tick_interval_s=tick_interval_s,
     )
 
 
 def ops_server_for_fleet(fleet, *, tracer: Tracer = NULL_TRACER,
                          slo=None, recorder: Optional[FlightRecorder] = None,
+                         profiler: Optional[ProfileCapturer] = None,
                          host: str = "127.0.0.1", port: int = 0,
                          tick_interval_s: float = 1.0) -> OpsServer:
     """Wire an `OpsServer` over a `ServingFleet`: the fleet registry
     (fleet_* families + SLO/flight metrics), `health()` (HealthMonitor +
-    replica-up view), and the full fleet `stats()`."""
+    replica-up view), the full fleet `stats()`, and the fleet's flight
+    book (/explainz)."""
     return OpsServer(
         registry=fleet.registry, health_fn=fleet.health,
         stats_fn=fleet.stats, tracer=tracer, slo=slo, recorder=recorder,
+        flights=getattr(fleet, "flights", None), profiler=profiler,
         host=host, port=port, tick_interval_s=tick_interval_s,
     )

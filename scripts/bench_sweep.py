@@ -651,6 +651,76 @@ print(json.dumps(out))
 """
 
 
+# Serving cost plane (ISSUE 15): chip-free leg — a REAL tiny fleet on
+# CPU serves a short trace, then the row records what the cost ledger
+# measured: per-request chip-seconds for the served cells, the serving
+# goodput ratio, and the headroom model's capacity column. Gated by
+# telemetry.check's *chip_seconds* (lower) / *serve_goodput* /
+# *headroom* (higher) rules, platform-qualified like every row.
+SERVE_COSTS_WORKER = r"""
+import json, sys, time, os
+spec = json.loads(sys.argv[1])
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+import numpy as np
+
+from alphafold2_tpu.models import Alphafold2Config, alphafold2_init
+from alphafold2_tpu.serving import FleetConfig, ServingConfig, ServingFleet
+from alphafold2_tpu.constants import AA_ORDER
+
+cfg = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8,
+                       max_seq_len=16)
+params = alphafold2_init(jax.random.PRNGKey(0), cfg)
+fleet = ServingFleet(
+    params, cfg,
+    ServingConfig(buckets=(8, 16), max_batch=2, max_wait_s=0.01,
+                  mds_iters=4, request_timeout_s=None),
+    FleetConfig(replicas=2, probe_interval_s=0, reprobe_interval_s=30.0,
+                default_timeout_s=None))
+rs = np.random.RandomState(0)
+n = spec.get("n", 16)
+t0 = time.perf_counter()
+reqs = []
+for i in range(n):
+    L = int(rs.randint(4, 17))
+    seq = "".join(AA_ORDER[j] for j in rs.randint(0, 20, L))
+    reqs.append(fleet.submit(seq))
+for r in reqs:
+    r.result(timeout=600)
+wall = time.perf_counter() - t0
+fleet.sample_gauges()
+time.sleep(0.06)
+fleet.sample_gauges()  # second pass: arrival-rate EMA + headroom arm
+st = fleet.stats()
+cells = [c for c in st["costs"]["cells"] if c["requests"]]
+assert cells, "no cost-ledger cell measured"
+# traffic-weighted per-request chip cost over the served cells
+total_req = sum(c["requests"] for c in cells)
+csr = sum(c["chip_seconds_per_request"] * c["requests"]
+          for c in cells) / total_req
+goodput = st["serve_goodput"]["pools"]["default"]["goodput_ratio"]
+# sums-to-wall within 1% against the ledger's LIVE clock wall (the
+# snapshot's wall_s is the bucket sum — comparing against it would be
+# a tautology); accounted can only exceed wall via cross-thread
+# accounting overlap, which this bounds
+for name in st["serve_goodput"]["replicas"]:
+    tot = sum(fleet.goodput.totals(name).values())
+    wall_now = fleet.goodput.wall(name)
+    assert tot <= wall_now * 1.01 + 1e-6, (name, tot, wall_now)
+head = st["headroom"].get("default", {})
+out = {"sec_per_iter": round(wall / n, 4),
+       "serve_chip_seconds_per_request": round(csr, 5),
+       "serve_goodput_ratio": round(goodput, 4),
+       "cells_measured": len(cells),
+       "platform": "cpu", "backend_arm": "xla_ref"}
+if head.get("capacity_per_sec"):
+    out["capacity_per_sec"] = round(head["capacity_per_sec"], 3)
+    out["headroom_ratio"] = round(head["headroom_ratio"], 4)
+fleet.shutdown()
+print(json.dumps(out))
+"""
+
+
 # Cross-backend dispatch matrix (ISSUE 13 tentpole): one leg per
 # (hot op, backend arm) over the ops/dispatch.py registry. The arm is
 # pinned via AF2_KERNEL_BACKEND_<OP> and VERIFIED against the resolver
@@ -1054,6 +1124,8 @@ def main():
     def serving_legs():
         return (
             ("serve_routed", {"n": 16}, SERVE_ROUTED_WORKER, 900),
+            # ISSUE 15: the cost-ledger row — chip-free, real on any host
+            ("serve_costs", {"n": 16}, SERVE_COSTS_WORKER, 900),
             ("serve_sp_on",
              {"depth": args.depth, "bucket": 1024, "sp_shards": 4,
               "sp_on": True, "require_tpu": True}, SERVE_SP_WORKER, 2100),

@@ -273,7 +273,13 @@ def main():
                          "opens, replica drains, watchdog fires, and SLO "
                          "pages snapshot a forensic JSON bundle (recent "
                          "spans incl. trace_ids, event ring, registry "
-                         "snapshot, stats) into DIR")
+                         "snapshot, stats) into DIR; with --ops-port it "
+                         "also arms /profilez (on-demand jax.profiler "
+                         "captures land under DIR/profiles)")
+    ap.add_argument("--peak-tflops", type=float, default=None,
+                    help="declared per-chip peak TFLOP/s for the "
+                         "serve_mfu cost-ledger gauge (unset = publish "
+                         "achieved FLOP/s only)")
     from alphafold2_tpu.telemetry import (
         add_telemetry_args,
         finish_trace,
@@ -525,18 +531,76 @@ def main():
                  if args.featurize_workers else "OFF")
               + ", degraded tier " + (degraded_desc or "OFF"))
     else:
+        from alphafold2_tpu.telemetry import FlightBook
+
         engine = ServingEngine(
             params, cfg, serving_cfg,
             metrics_logger=logger,
             fault_hook=injector.serving_hook() if injector else None,
             tracer=tracer,
             incident_hook=recorder.incident if recorder else None,
+            # single-engine /explainz: the engine records its own
+            # submit->terminal exemplars (the fleet keeps its own book)
+            flights=FlightBook(),
         )
 
     # --- live operations plane -----------------------------------------
     registry = engine.registry if fleet_mode else engine.metrics.registry
     if recorder is not None:
         recorder.bind(registry=registry, stats_fn=engine.stats)
+    # serving cost plane (telemetry/costs.py): both modes carry a cost
+    # ledger (`.costs`); the declared peak arms the serve_mfu gauge
+    if args.peak_tflops:
+        engine.costs.set_peak(args.peak_tflops * 1e12)
+
+    # --- guaranteed final stats flush (clean shutdown AND SIGTERM) ------
+    # the periodic flusher below is timer-driven; without this, a run
+    # terminated between ticks (or SIGTERM'd by its supervisor) loses
+    # everything since the last tick
+    _stats_flushed = {"final": False}
+
+    def _flush_stats_snapshot():
+        if not args.stats_json or _stats_flushed["final"]:
+            return
+        try:
+            snap = engine.stats()
+            tmp = args.stats_json + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(snap, fh, indent=2)
+            os.replace(tmp, args.stats_json)  # atomic: never torn
+        except Exception:  # noqa: BLE001 — a flush failure must not mask
+            # the run's real exit path
+            import traceback
+
+            traceback.print_exc()
+
+    if args.stats_json:
+        import atexit
+        import signal
+
+        # clean-shutdown guarantee: whatever path the process leaves by
+        # (normal return, uncaught exception, sys.exit), the LAST
+        # complete snapshot lands — the end-of-run dump below sets the
+        # flag, so the common path writes once
+        atexit.register(_flush_stats_snapshot)
+
+        def _on_sigterm(signum, frame):  # noqa: ARG001 — signal API
+            # one last complete snapshot, then die with the default
+            # disposition so the exit status still says "terminated".
+            # The flush runs on a WORKER thread with a bounded join:
+            # signal handlers run on the main thread, which may have
+            # been interrupted while holding a fleet/registry lock that
+            # stats() needs — flushing inline could self-deadlock and
+            # turn termination into a hang (worst case here: the join
+            # times out, the snapshot is lost, the process still dies)
+            t = threading.Thread(target=_flush_stats_snapshot,
+                                 daemon=True)
+            t.start()
+            t.join(10.0)
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
 
     # --- elastic replica autoscaler (serving/autoscale.py) --------------
     scaler = scale_policy = None
@@ -598,15 +662,26 @@ def main():
             registry, slo_cfg,
             on_page=recorder.slo_page_hook if recorder else None,
         )
+        profiler = None
+        if args.flight_dir:
+            from alphafold2_tpu.telemetry import ProfileCapturer
+
+            # /profilez: on-demand jax.profiler captures into the
+            # flight dir — the next healthy TPU probe can be profiled
+            # without redeploying
+            profiler = ProfileCapturer(
+                os.path.join(args.flight_dir, "profiles"),
+                registry=registry)
         make_ops = ops_server_for_fleet if fleet_mode else ops_server_for_engine
         ops = make_ops(engine, tracer=tracer, slo=slo, recorder=recorder,
+                       profiler=profiler,
                        port=args.ops_port, tick_interval_s=args.ops_tick)
         ops.add_tick(lambda: host_memory_gauges(registry))
-        if fleet_mode:
-            # live queue/occupancy gauges (+ featurize depth): scrapes
-            # see pressure between requests, and the autoscaler's
-            # signals stay fresh
-            ops.add_tick(engine.sample_gauges)
+        # live queue/occupancy/cost-plane gauges: scrapes see pressure
+        # (and per-request chip cost + headroom) between requests, and
+        # the autoscaler's signals stay fresh. Both modes have the hook
+        # (the single engine's publishes its private cost ledgers).
+        ops.add_tick(engine.sample_gauges)
         ops.start()
         print(f"ops plane listening on {ops.url} "
               f"(/metrics /healthz /statusz)")
@@ -841,6 +916,7 @@ def main():
         with open(tmp, "w") as fh:
             json.dump(stats, fh, indent=2)
         os.replace(tmp, args.stats_json)
+        _stats_flushed["final"] = True  # the atexit flush can stand down
         print(f"wrote {args.stats_json}")
     return 1 if failures else 0
 
