@@ -1,7 +1,7 @@
 """af2lint: in-repo static analysis for a JAX codebase that cannot afford
 runtime discovery of statically detectable breakage.
 
-Eight passes, each a module in this package:
+Nine passes, each a module in this package:
 
   * ``compat``   — AST linter: no `jax.experimental.*` access and no
                    drift-table symbol outside `alphafold2_tpu/compat.py`
@@ -36,7 +36,16 @@ Eight passes, each a module in this package:
   * ``dispatch`` — kernel-dispatch monopoly: every registered hot op has
                    an `xla_ref` arm and a chip-free parity test, no
                    direct kernel imports outside ops/, no AF2_* env
-                   reads outside ops/knobs.py (dispatch_lint.py).
+                   reads outside ops/knobs.py (dispatch_lint.py);
+  * ``concurrency`` — lock discipline over serving/telemetry/
+                   reliability: shared attributes written from multiple
+                   discovered thread entry points without a common lock
+                   (CONC001), lock-order cycles in the cross-module
+                   acquisition graph (CONC002), known-blocking calls
+                   under a lock (CONC003), daemon threads whose call
+                   graph reaches jax (CONC004); validated at runtime by
+                   lock_runtime.py under the chaos acceptance tests
+                   (concurrency_lint.py).
 
 CLI: ``python -m alphafold2_tpu.analysis --strict`` (docs/STATIC_ANALYSIS.md).
 """
@@ -48,6 +57,7 @@ from alphafold2_tpu.analysis.common import Finding, iter_py_files, suppressed
 __all__ = [
     "Finding",
     "PASSES",
+    "PASS_SUMMARIES",
     "iter_py_files",
     "run_passes",
     "suppressed",
@@ -102,6 +112,12 @@ def _run_dispatch(root, files=None, **_):
     return run(root, files=files)
 
 
+def _run_concurrency(root, files=None, **_):
+    from alphafold2_tpu.analysis.concurrency_lint import run
+
+    return run(root, files=files)
+
+
 # name -> runner(root, files=..., axes=...) -> list[Finding]
 PASSES = {
     "compat": _run_compat,
@@ -112,6 +128,31 @@ PASSES = {
     "schedule": _run_schedule,
     "metrics": _run_metrics,
     "dispatch": _run_dispatch,
+    "concurrency": _run_concurrency,
+}
+
+# one-line summaries for `--list-passes` (kept here, beside PASSES, so
+# adding a pass without a summary fails the pinned listing test)
+PASS_SUMMARIES = {
+    "compat": "no jax.experimental access / drift-table symbols outside "
+              "compat.py",
+    "trace": "Python side effects and host-numpy calls inside "
+             "jit/pjit/shard_map-reachable code",
+    "sharding": "PartitionSpec rank vs annotated rank; unknown or "
+                "duplicate mesh axes",
+    "smoke": "jax.eval_shape every public op and training preset under "
+             "abstract inputs",
+    "overlap": "lowered multi-chip programs must interleave collectives "
+               "with compute",
+    "schedule": "branch-parallel trunks: pair/MSA branches data-"
+                "independent before their join",
+    "metrics": "every registered metric name documented in "
+               "docs/OBSERVABILITY.md and vice versa",
+    "dispatch": "registered hot ops have xla_ref arms + parity tests; "
+                "no kernel imports outside ops/",
+    "concurrency": "lock discipline: multi-entry-point writes without a "
+                   "lock, lock-order cycles, blocking calls under a "
+                   "lock, daemon threads reaching jax",
 }
 
 # passes that verify whole programs rather than the given files: dropped

@@ -3,6 +3,11 @@
 Exit status: 0 when clean (always, without --strict); with --strict, 1
 when any finding survives. CI runs ``--strict`` as a build gate and
 ``--select smoke`` as the fast pre-test gate (.github/workflows/test.yml).
+
+``--json`` emits one machine-readable object — findings grouped per
+pass with rule ids and locations — for tooling that wants structure
+rather than the flat ``--format json`` list. ``--list-passes`` prints
+the registered passes with their one-line summaries and exits.
 """
 
 from __future__ import annotations
@@ -11,7 +16,7 @@ import argparse
 import json
 import sys
 
-from alphafold2_tpu.analysis import PASSES, run_passes
+from alphafold2_tpu.analysis import PASSES, PASS_SUMMARIES, run_passes
 
 
 def main(argv=None) -> int:
@@ -19,7 +24,7 @@ def main(argv=None) -> int:
         prog="python -m alphafold2_tpu.analysis",
         description="af2lint: JAX-aware static analysis "
         "(compat / trace / sharding / smoke / overlap / schedule / "
-        "metrics / dispatch)",
+        "metrics / dispatch / concurrency)",
     )
     ap.add_argument(
         "paths",
@@ -55,7 +60,24 @@ def main(argv=None) -> int:
         help="comma-separated mesh-axis allowlist for the sharding pass "
         "(default: parallel/mesh.py KNOWN_AXES)",
     )
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="emit one machine-readable JSON object (findings grouped "
+        "per pass, with rule ids and locations); implies no text output",
+    )
+    ap.add_argument(
+        "--list-passes",
+        action="store_true",
+        help="list the registered passes with their summaries and exit",
+    )
     args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for name in PASSES:
+            print(f"{name:<12} {PASS_SUMMARIES.get(name, '')}")
+        print(f"{len(PASSES)} passes")
+        return 0
 
     select = None
     if args.select:
@@ -72,7 +94,23 @@ def main(argv=None) -> int:
 
     findings = run_passes(args.root, select=select, files=files, axes=axes)
 
-    if args.format == "json":
+    if args.json:
+        names = select or list(PASSES)
+        by_pass = {n: [] for n in names}
+        for f in findings:
+            by_pass.setdefault(f.pass_name, []).append({
+                "rule": f.code,
+                "path": f.path,
+                "line": f.line,
+                "message": f.message,
+            })
+        print(json.dumps({
+            "passes": names,
+            "findings": by_pass,
+            "total": len(findings),
+            "strict": bool(args.strict),
+        }, indent=2, sort_keys=True))
+    elif args.format == "json":
         print(
             json.dumps(
                 [f.__dict__ for f in findings], indent=2, sort_keys=True

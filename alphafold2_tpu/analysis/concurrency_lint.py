@@ -1,0 +1,913 @@
+"""af2lint pass 9 — "concurrency": lock discipline and thread-interaction
+lint over the serving/telemetry/reliability packages.
+
+The fleet is a genuinely concurrent system (dispatcher, health monitor,
+autoscaler, ops ticker, featurize pool, watchdog, HTTP handlers share
+~30 locks across 15 modules) and every concurrency bug so far was found
+LIVE: the probe/record_failure drain race, the kill-vs-scale-down
+double-drain, the SIGTERM self-deadlock, the daemon-thread-in-jax
+teardown segfault. This pass encodes those bug classes statically:
+
+  * **CONC001** — a ``self._*`` attribute mutated from >= 2 distinct
+    thread entry points without a common lock scope. Entry points are
+    DISCOVERED, not hand-listed: ``threading.Thread(target=...)``
+    targets, ``add_tick(...)`` ticker hooks, ``do_*`` methods on
+    ``BaseHTTPRequestHandler`` subclasses, ``add_done_callback(...)``
+    callbacks, ``signal.signal(...)`` handlers — plus one implicit
+    "api" root modeling the caller thread of any class that owns an
+    entry point. Lock scope = enclosing ``with self._lock:`` regions.
+  * **CONC002** — lock-order inversion: the cross-module
+    lock-acquisition graph (which locks are acquired while which are
+    held, including through resolvable call edges) must be acyclic.
+    A self-edge on a plain ``Lock`` (re-acquisition while held) is a
+    length-1 cycle; RLocks are exempt from self-edges.
+  * **CONC003** — a known-blocking call (engine build via a
+    ``*factory`` call, ``_executable_for`` / ``.lower().compile()``
+    XLA compiles, ``Thread.join``, unbounded ``Queue.get``,
+    ``.stats()`` snapshots) made while holding any analyzed lock —
+    the PR 15 SIGTERM self-deadlock class.
+  * **CONC004** — a ``daemon=True`` thread whose target's call graph
+    can reach jax — the teardown-segfault class (the interpreter kills
+    daemon threads mid-device-call at exit).
+  * **CONC000** — allowlist hygiene: an entry without a written
+    justification, or one that matches nothing (stale).
+
+Intentional patterns are allowlisted in ``concurrency_allowlist.json``
+(same directory); every entry carries a mandatory ``why`` string.
+Findings can also be suppressed per-line with
+``# af2lint: disable=CONC00x``.
+
+Honest limits (documented, by design): lock regions are ``with``-based
+only (bare ``.acquire()``/``.release()`` pairs are not modeled); call
+edges resolve ``self._m()``, ``self._attr.m()`` where ``self._attr``
+was built from a class in the analyzed set, module functions, and
+nested ``def``s — callables stored in containers (health-monitor probe
+registries, tick hook lists) are dynamic and out of reach, which is
+exactly why `analysis/lock_runtime.py` validates the same graph against
+live chaos executions.
+
+Fixture-injectable like the other passes: ``run(root, files=[...])``
+analyzes exactly that file set as its universe.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from alphafold2_tpu.analysis.common import (
+    Finding,
+    dotted_name,
+    filter_suppressed,
+    parse_file,
+    rel,
+    suppressed_lines,
+)
+
+PASS = "concurrency"
+_SCOPE_PKGS = ("serving", "telemetry", "reliability")
+ALLOWLIST_PATH = Path(__file__).with_name("concurrency_allowlist.json")
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+
+
+# --------------------------------------------------------------- model
+
+
+class _Meth:
+    """One function body: writes, lock acquisitions, calls, blocking ops."""
+
+    def __init__(self, owner, name: str, line: int):
+        self.owner = owner              # _Cls or _Mod
+        self.name = name                # may be dotted for nested defs
+        self.line = line
+        self.writes: List[Tuple[str, int, frozenset]] = []
+        self.acquires: List[Tuple[str, int, frozenset]] = []
+        self.calls: List[Tuple[tuple, int, frozenset]] = []
+        self.blocking: List[Tuple[str, int, frozenset]] = []
+        self.jax_local = False          # body references a jax alias
+
+    @property
+    def qualname(self) -> str:
+        if isinstance(self.owner, _Cls):
+            return f"{self.owner.name}.{self.name}"
+        return f"{self.owner.stem}.{self.name}"
+
+    @property
+    def mod(self) -> "_Mod":
+        return self.owner.mod if isinstance(self.owner, _Cls) else self.owner
+
+
+class _Cls:
+    def __init__(self, mod: "_Mod", name: str, line: int):
+        self.mod = mod
+        self.name = name
+        self.line = line
+        self.locks: Dict[str, str] = {}   # attr -> ctor kind (Lock/RLock/..)
+        self.threads: set = set()         # attrs assigned threading.Thread
+        self.queues: set = set()          # attrs assigned queue.Queue
+        self.collab: Dict[str, str] = {}  # attr -> class name
+        self.meths: Dict[str, _Meth] = {}
+        self.http_handler = False
+
+
+class _Mod:
+    def __init__(self, path: str):
+        self.path = path
+        self.stem = Path(path).stem
+        self.classes: Dict[str, _Cls] = {}
+        self.funcs: Dict[str, _Meth] = {}
+        self.entries: List[tuple] = []       # (kind, owner, caller, desc, line)
+        self.spawns: List[tuple] = []        # (meth, line, daemon, name, descs)
+        self.jax_aliases: set = set()
+        self.mod_locks: Dict[str, str] = {}  # name -> ctor kind
+        self.supp: dict = {}
+
+
+def _is_ctor(node, kinds) -> Optional[str]:
+    """The ctor kind if `node` is a call to threading.Lock()-like."""
+    if not isinstance(node, ast.Call):
+        return None
+    d = dotted_name(node.func)
+    if d is None:
+        return None
+    last = d.rsplit(".", 1)[-1]
+    return last if last in kinds else None
+
+
+def _is_thread_ctor(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted_name(node.func)
+    return d is not None and d.rsplit(".", 1)[-1] == "Thread"
+
+
+def _callable_descs(node) -> List[tuple]:
+    """Call-target descriptors for a callback argument: a bound method,
+    a bare name, or the calls inside a lambda body."""
+    if isinstance(node, ast.Lambda):
+        out = []
+        for sub in ast.walk(node.body):
+            if isinstance(sub, ast.Call):
+                d = _call_desc(sub.func)
+                if d is not None:
+                    out.append(d)
+        return out
+    d = _call_desc(node)
+    return [d] if d is not None else []
+
+
+def _call_desc(func) -> Optional[tuple]:
+    """("self", m) | ("attr", a, m) | ("name", f) | ("ext", dotted)."""
+    if isinstance(func, ast.Attribute):
+        base = func.value
+        if isinstance(base, ast.Name) and base.id == "self":
+            return ("self", func.attr)
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"):
+            return ("attr", base.attr, func.attr)
+    if isinstance(func, ast.Name):
+        return ("name", func.id)
+    d = dotted_name(func)
+    return ("ext", d) if d else None
+
+
+# ------------------------------------------------------------ collection
+
+
+class _FnWalker(ast.NodeVisitor):
+    """Walk one function body tracking the `with self._lock:` stack."""
+
+    def __init__(self, meth: _Meth, cls: Optional[_Cls], mod: _Mod,
+                 in_init: bool):
+        self.meth, self.cls, self.mod = meth, cls, mod
+        self.in_init = in_init
+        self.held: List[str] = []
+        self.local_threads: set = set()
+        self.local_queues: set = set()
+
+    # ---- lock identity
+
+    def _lock_id(self, expr) -> Optional[str]:
+        d = dotted_name(expr)
+        if d is None:
+            return None
+        if d.startswith("self.") and d.count(".") == 1:
+            attr = d.split(".", 1)[1]
+            if self.cls is not None and attr in self.cls.locks:
+                return f"{self.cls.name}.{attr}"
+        elif "." not in d and d in self.mod.mod_locks:
+            return f"{self.mod.stem}.{d}"
+        return None
+
+    def _lock_kind(self, lock_id: str) -> str:
+        owner, attr = lock_id.split(".", 1)
+        if self.cls is not None and owner == self.cls.name:
+            return self.cls.locks.get(attr, "Lock")
+        return self.mod.mod_locks.get(attr, "Lock")
+
+    # ---- with-regions
+
+    def visit_With(self, node):
+        acquired = []
+        for item in node.items:
+            lid = self._lock_id(item.context_expr)
+            if lid is not None:
+                self.meth.acquires.append(
+                    (lid, item.context_expr.lineno, frozenset(self.held)))
+                self.held.append(lid)
+                acquired.append(lid)
+            else:
+                # still visit the context expr (calls inside it)
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    # ---- writes
+
+    def _record_write(self, attr: str, line: int):
+        if self.in_init or not attr.startswith("_"):
+            return
+        if self.cls is not None and attr in self.cls.locks:
+            return
+        self.meth.writes.append((attr, line, frozenset(self.held)))
+
+    def _classify_self_assign(self, attr: str, value):
+        kind = _is_ctor(value, _LOCK_CTORS)
+        if kind is not None and self.cls is not None:
+            self.cls.locks[attr] = kind
+            return
+        if _is_thread_ctor(value) and self.cls is not None:
+            self.cls.threads.add(attr)
+            return
+        if _is_ctor(value, _QUEUE_CTORS) is not None and self.cls is not None:
+            self.cls.queues.add(attr)
+            return
+        if self.in_init and self.cls is not None and isinstance(value, ast.Call):
+            d = dotted_name(value.func)
+            if d is not None:
+                last = d.rsplit(".", 1)[-1]
+                if last[:1].isupper():
+                    self.cls.collab[attr] = last
+
+    def visit_Assign(self, node):
+        for tgt in node.targets:
+            self._handle_target(tgt, node.value, node.lineno)
+        self.visit(node.value)
+
+    def _handle_target(self, tgt, value, line):
+        if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) \
+                and tgt.value.id == "self":
+            self._classify_self_assign(tgt.attr, value)
+            self._record_write(tgt.attr, line)
+        elif isinstance(tgt, ast.Subscript):
+            d = dotted_name(tgt.value)
+            if d and d.startswith("self.") and d.count(".") == 1:
+                self._record_write(d.split(".", 1)[1], line)
+        elif isinstance(tgt, ast.Name) and value is not None:
+            if _is_thread_ctor(value):
+                self.local_threads.add(tgt.id)
+            elif _is_ctor(value, _QUEUE_CTORS) is not None:
+                self.local_queues.add(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._handle_target(el, None, line)
+
+    def visit_AugAssign(self, node):
+        self._handle_target(node.target, None, node.lineno)
+        self.visit(node.value)
+
+    def visit_Delete(self, node):
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+                self._record_write(tgt.attr, node.lineno)
+            elif isinstance(tgt, ast.Subscript):
+                d = dotted_name(tgt.value)
+                if d and d.startswith("self.") and d.count(".") == 1:
+                    self._record_write(d.split(".", 1)[1], node.lineno)
+
+    # ---- calls
+
+    def visit_Call(self, node):
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        if _is_thread_ctor(node):
+            daemon = isinstance(kwargs.get("daemon"), ast.Constant) \
+                and kwargs["daemon"].value is True
+            name = None
+            nk = kwargs.get("name")
+            if isinstance(nk, ast.Constant):
+                name = nk.value
+            elif isinstance(nk, ast.JoinedStr):
+                name = "".join(
+                    v.value for v in nk.values
+                    if isinstance(v, ast.Constant)) + "*"
+            descs = _callable_descs(kwargs["target"]) \
+                if "target" in kwargs else []
+            self.mod.spawns.append(
+                (self.meth, node.lineno, daemon, name, tuple(descs)))
+            for d in descs:
+                self.mod.entries.append(
+                    ("thread", self.cls, self.meth, d, node.lineno))
+        func = node.func
+        if isinstance(func, ast.Attribute) and \
+                func.attr in ("add_tick", "add_done_callback") and node.args:
+            kind = "tick" if func.attr == "add_tick" else "done_callback"
+            for d in _callable_descs(node.args[0]):
+                self.mod.entries.append(
+                    (kind, self.cls, self.meth, d, node.lineno))
+        d_full = dotted_name(func)
+        if d_full == "signal.signal" and len(node.args) >= 2:
+            for d in _callable_descs(node.args[1]):
+                self.mod.entries.append(
+                    ("signal", self.cls, self.meth, d, node.lineno))
+        desc = _call_desc(func)
+        if desc is not None:
+            self.meth.calls.append((desc, node.lineno, frozenset(self.held)))
+        self._check_blocking(node, func, kwargs)
+        self.generic_visit(node)
+
+    def _check_blocking(self, node, func, kwargs):
+        what = None
+        if isinstance(func, ast.Attribute):
+            recv = dotted_name(func.value)
+            if func.attr == "join":
+                is_thread = (
+                    (recv and recv.startswith("self.")
+                     and self.cls is not None
+                     and recv.split(".", 1)[1] in self.cls.threads)
+                    or (recv in self.local_threads)
+                )
+                if is_thread:
+                    what = "Thread.join"
+            elif func.attr == "get":
+                is_queue = (
+                    (recv and recv.startswith("self.")
+                     and self.cls is not None
+                     and recv.split(".", 1)[1] in self.cls.queues)
+                    or (recv in self.local_queues)
+                )
+                if is_queue and "timeout" not in kwargs:
+                    what = "unbounded Queue.get"
+            elif func.attr == "stats":
+                what = "stats() snapshot"
+            elif func.attr == "compile" and isinstance(func.value, ast.Call):
+                inner = func.value.func
+                inner_name = inner.attr if isinstance(inner, ast.Attribute) \
+                    else (dotted_name(inner) or "").rsplit(".", 1)[-1]
+                if inner_name in ("lower", "jit"):
+                    what = "XLA compile (.lower().compile())"
+        callee = None
+        if isinstance(func, ast.Attribute):
+            callee = func.attr
+        elif isinstance(func, ast.Name):
+            callee = func.id
+        if callee is not None and what is None:
+            if callee == "_executable_for":
+                what = "compile (_executable_for)"
+            elif (callee == "factory"
+                  or (callee.endswith("_factory")
+                      and not callee.lstrip("_").startswith("make"))):
+                what = "engine build (factory call)"
+        if what is not None:
+            self.meth.blocking.append(
+                (what, node.lineno, frozenset(self.held)))
+
+    # ---- jax references
+
+    def visit_Name(self, node):
+        if node.id in self.mod.jax_aliases:
+            self.meth.jax_local = True
+
+    # ---- nested defs: separate bodies, fresh lock stack
+
+    def visit_FunctionDef(self, node):
+        _collect_function(node, self.cls, self.mod,
+                          prefix=self.meth.name, in_init=False)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        # lambda bodies execute later, not under the current lock stack
+        pass
+
+
+def _collect_function(node, cls: Optional[_Cls], mod: _Mod,
+                      prefix: Optional[str] = None, in_init: bool = False):
+    name = f"{prefix}.{node.name}" if prefix else node.name
+    meth = _Meth(cls if cls is not None else mod, name, node.lineno)
+    if cls is not None:
+        cls.meths[name] = meth
+    else:
+        mod.funcs[name] = meth
+    w = _FnWalker(meth, cls, mod, in_init=in_init)
+    for stmt in node.body:
+        w.visit(stmt)
+    return meth
+
+
+def _prescan_class(stmt: ast.ClassDef, cls: _Cls):
+    """Classify `self.X = <ctor>` attributes BEFORE walking bodies, so a
+    method defined above __init__ still resolves `with self._lock:`."""
+    for sub in stmt.body:
+        if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        in_init = sub.name == "__init__"
+        for node in ast.walk(sub):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                kind = _is_ctor(node.value, _LOCK_CTORS)
+                if kind is not None:
+                    cls.locks[tgt.attr] = kind
+                elif _is_thread_ctor(node.value):
+                    cls.threads.add(tgt.attr)
+                elif _is_ctor(node.value, _QUEUE_CTORS) is not None:
+                    cls.queues.add(tgt.attr)
+                elif in_init and isinstance(node.value, ast.Call):
+                    d = dotted_name(node.value.func)
+                    if d is not None:
+                        last = d.rsplit(".", 1)[-1]
+                        if last[:1].isupper():
+                            cls.collab[tgt.attr] = last
+
+
+def _collect_module(path, root) -> Optional[_Mod]:
+    src, tree = parse_file(path)
+    if tree is None:
+        return None
+    mod = _Mod(rel(path, root))
+    mod.supp = suppressed_lines(src)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax" or alias.name.startswith("jax."):
+                    mod.jax_aliases.add(
+                        alias.asname or alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and (node.module == "jax"
+                                or node.module.startswith("jax.")):
+                for alias in node.names:
+                    mod.jax_aliases.add(alias.asname or alias.name)
+    # phase 1: module-level locks and per-class attribute classification
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            kind = _is_ctor(stmt.value, _LOCK_CTORS)
+            if kind is not None:
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        mod.mod_locks[tgt.id] = kind
+        elif isinstance(stmt, ast.ClassDef):
+            cls = _Cls(mod, stmt.name, stmt.lineno)
+            mod.classes[stmt.name] = cls
+            for base in stmt.bases:
+                b = dotted_name(base) or ""
+                if "HTTPRequestHandler" in b:
+                    cls.http_handler = True
+            _prescan_class(stmt, cls)
+    # phase 2: full body walk with complete lock/thread/queue sets
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ClassDef):
+            cls = mod.classes[stmt.name]
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    _collect_function(
+                        sub, cls, mod, in_init=(sub.name == "__init__"))
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _collect_function(stmt, None, mod)
+    return mod
+
+
+# ------------------------------------------------------------ resolution
+
+
+class _Graph:
+    """The resolved cross-module call/lock/blocking view."""
+
+    def __init__(self, mods: List[_Mod]):
+        self.mods = mods
+        self.classes: Dict[str, _Cls] = {}
+        for m in mods:
+            for c in m.classes.values():
+                self.classes.setdefault(c.name, c)
+        self._lock_clo: Dict[int, set] = {}
+        self._blk_clo: Dict[int, set] = {}
+        self._jax_clo: Dict[int, bool] = {}
+
+    def resolve(self, caller: _Meth, desc: tuple) -> Optional[_Meth]:
+        kind = desc[0]
+        cls = caller.owner if isinstance(caller.owner, _Cls) else None
+        if kind == "self" and cls is not None:
+            return cls.meths.get(desc[1])
+        if kind == "attr" and cls is not None:
+            cname = cls.collab.get(desc[1])
+            if cname is None:
+                return None
+            target = caller.mod.classes.get(cname) or self.classes.get(cname)
+            if target is None:
+                return None
+            return target.meths.get(desc[2])
+        if kind == "name":
+            # nested defs shadow module functions: try the caller's own
+            # prefix chain first ("start.loop" from inside "start")
+            table = cls.meths if cls is not None else caller.mod.funcs
+            parts = caller.name.split(".")
+            for i in range(len(parts), 0, -1):
+                hit = table.get(".".join(parts[:i]) + "." + desc[1])
+                if hit is not None:
+                    return hit
+            return caller.mod.funcs.get(desc[1])
+        return None
+
+    def _closure(self, meth: _Meth, cache: dict, collect, stack=None) -> set:
+        key = id(meth)
+        if key in cache:
+            return cache[key]
+        stack = stack or set()
+        if key in stack:
+            return set()
+        stack = stack | {key}
+        out = set(collect(meth))
+        for desc, _line, _held in meth.calls:
+            callee = self.resolve(meth, desc)
+            if callee is not None:
+                out |= self._closure(callee, cache, collect, stack)
+        cache[key] = out
+        return out
+
+    def lock_closure(self, meth: _Meth) -> set:
+        return self._closure(
+            meth, self._lock_clo,
+            lambda m: {lid for lid, _l, _h in m.acquires})
+
+    def blocking_closure(self, meth: _Meth) -> set:
+        return self._closure(
+            meth, self._blk_clo,
+            lambda m: {(what, m.qualname, line)
+                       for what, line, _h in m.blocking})
+
+    def reaches_jax(self, meth: _Meth) -> bool:
+        key = id(meth)
+        if key not in self._jax_clo:
+            self._jax_clo[key] = bool(self._closure(
+                meth, {}, lambda m: {1} if m.jax_local else set()))
+        return self._jax_clo[key]
+
+    def reach_set(self, roots: Sequence[_Meth]) -> set:
+        seen = set()
+        todo = list(roots)
+        while todo:
+            m = todo.pop()
+            if id(m) in seen:
+                continue
+            seen.add(id(m))
+            for desc, _line, _held in m.calls:
+                callee = self.resolve(m, desc)
+                if callee is not None and id(callee) not in seen:
+                    todo.append(callee)
+        return seen
+
+    def lock_kind(self, lock_id: str) -> str:
+        owner, attr = lock_id.split(".", 1)
+        cls = self.classes.get(owner)
+        if cls is not None:
+            return cls.locks.get(attr, "Lock")
+        for m in self.mods:
+            if m.stem == owner:
+                return m.mod_locks.get(attr, "Lock")
+        return "Lock"
+
+
+# -------------------------------------------------------------- the rules
+
+
+def _discover_roots(g: _Graph) -> Dict[str, set]:
+    """{root label: set(id(meth) reachable)} for every discovered entry
+    point plus one shared "api" root (the external caller thread of any
+    class that owns an entry point)."""
+    roots: Dict[str, List[_Meth]] = {}
+    api_classes = set()
+    for mod in g.mods:
+        for kind, cls, caller, desc, _line in mod.entries:
+            target = g.resolve(caller, desc)
+            if target is None:
+                continue
+            label = f"{kind}:{target.qualname}"
+            roots.setdefault(label, []).append(target)
+            if isinstance(target.owner, _Cls):
+                api_classes.add(id(target.owner))
+            if isinstance(caller.owner, _Cls):
+                api_classes.add(id(caller.owner))
+        for cls in mod.classes.values():
+            if cls.http_handler:
+                for name, meth in cls.meths.items():
+                    if name.startswith("do_"):
+                        roots.setdefault(f"http:{meth.qualname}", []) \
+                            .append(meth)
+                        api_classes.add(id(cls))
+    api_roots: List[_Meth] = []
+    for mod in g.mods:
+        for cls in mod.classes.values():
+            if id(cls) not in api_classes:
+                continue
+            for name, meth in cls.meths.items():
+                top = name.split(".", 1)[0]
+                if not top.startswith("_") or top in ("__enter__",
+                                                      "__exit__"):
+                    api_roots.append(meth)
+    out = {label: g.reach_set(ms) for label, ms in roots.items()}
+    if api_roots:
+        out["api"] = g.reach_set(api_roots)
+    return out
+
+
+def _conc001(g: _Graph, out: List[Finding]):
+    reach = _discover_roots(g)
+    for mod in g.mods:
+        for cls in mod.classes.values():
+            by_attr: Dict[str, list] = {}
+            for meth in cls.meths.values():
+                for attr, line, held in meth.writes:
+                    by_attr.setdefault(attr, []).append((meth, line, held))
+            for attr, writes in sorted(by_attr.items()):
+                write_roots = set()
+                for meth, _line, _held in writes:
+                    for label, members in reach.items():
+                        if id(meth) in members:
+                            write_roots.add(label)
+                if len(write_roots) < 2:
+                    continue
+                common = frozenset.intersection(
+                    *[held for _m, _l, held in writes])
+                if common:
+                    continue
+                bare = min(writes, key=lambda w: len(w[2]))
+                sites = ", ".join(sorted(
+                    {f"{m.name}:{ln}" for m, ln, _h in writes}))
+                out.append(Finding(
+                    PASS, "CONC001", mod.path, bare[1],
+                    f"{cls.name}.{attr} is written from "
+                    f"{len(write_roots)} thread entry points "
+                    f"({', '.join(sorted(write_roots))}) without a common "
+                    f"lock scope (writes at {sites}) — wrap every write "
+                    f"in one `with self._lock:` region or allowlist with "
+                    f"a justification"))
+
+
+def _conc002(g: _Graph, out: List[Finding]):
+    edges: Dict[str, Dict[str, tuple]] = {}
+
+    def add_edge(a: str, b: str, witness: tuple):
+        if a == b and g.lock_kind(a) == "RLock":
+            return
+        edges.setdefault(a, {}).setdefault(b, witness)
+
+    for mod in g.mods:
+        meths = list(mod.funcs.values())
+        for cls in mod.classes.values():
+            meths.extend(cls.meths.values())
+        for meth in meths:
+            for lid, line, held in meth.acquires:
+                for h in held:
+                    add_edge(h, lid, (mod.path, line, meth.qualname, None))
+            for desc, line, held in meth.calls:
+                if not held:
+                    continue
+                callee = g.resolve(meth, desc)
+                if callee is None:
+                    continue
+                for lid in g.lock_closure(callee):
+                    for h in held:
+                        add_edge(h, lid,
+                                 (mod.path, line, meth.qualname,
+                                  callee.qualname))
+
+    # cycle detection: DFS, each cycle reported once (keyed on node set)
+    seen_cycles = set()
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: Dict[str, int] = {}
+
+    def report(nodes, wits):
+        key = frozenset(nodes)
+        if key in seen_cycles:
+            return
+        seen_cycles.add(key)
+        hops = []
+        for i, w in enumerate(wits):
+            via = f" via {w[3]}" if w[3] else ""
+            hops.append(f"{nodes[i]} -> {nodes[i + 1]} "
+                        f"({w[0]}:{w[1]} in {w[2]}{via})")
+        out.append(Finding(
+            PASS, "CONC002", wits[-1][0], wits[-1][1],
+            "lock-order cycle: " + "; ".join(hops)
+            + " — pick one global acquisition order or move the inner "
+              "acquisition outside the outer region"))
+
+    def dfs(node, path, wits):
+        color[node] = GREY
+        for nxt in sorted(edges.get(node, {})):
+            w = edges[node][nxt]
+            if color.get(nxt, WHITE) == GREY:
+                start = path.index(nxt)
+                report(path[start:] + [nxt], wits[start:] + [w])
+            elif color.get(nxt, WHITE) == WHITE:
+                dfs(nxt, path + [nxt], wits + [w])
+        color[node] = BLACK
+
+    for n in sorted(edges):
+        if color.get(n, WHITE) == WHITE:
+            dfs(n, [n], [])
+
+
+def _conc003(g: _Graph, out: List[Finding]):
+    for mod in g.mods:
+        meths = list(mod.funcs.values())
+        for cls in mod.classes.values():
+            meths.extend(cls.meths.values())
+        for meth in meths:
+            for what, line, held in meth.blocking:
+                if held:
+                    out.append(Finding(
+                        PASS, "CONC003", mod.path, line,
+                        f"known-blocking call [{what}] in {meth.qualname} "
+                        f"while holding {', '.join(sorted(held))} — move "
+                        f"it outside the lock region (collect under the "
+                        f"lock, act outside)"))
+            for desc, line, held in meth.calls:
+                if not held:
+                    continue
+                callee = g.resolve(meth, desc)
+                if callee is None:
+                    continue
+                for what, where, bline in sorted(g.blocking_closure(callee)):
+                    out.append(Finding(
+                        PASS, "CONC003", mod.path, line,
+                        f"call to {callee.qualname} in {meth.qualname} "
+                        f"while holding {', '.join(sorted(held))} reaches "
+                        f"known-blocking [{what}] at {where}:{bline} — "
+                        f"move the call outside the lock region"))
+
+
+def _conc004(g: _Graph, out: List[Finding]):
+    for mod in g.mods:
+        for meth, line, daemon, name, descs in mod.spawns:
+            if not daemon:
+                continue
+            for desc in descs:
+                target = g.resolve(meth, desc)
+                if target is not None and g.reaches_jax(target):
+                    label = name or "<unnamed>"
+                    out.append(Finding(
+                        PASS, "CONC004", mod.path, line,
+                        f"daemon thread {label!r} (target "
+                        f"{target.qualname}) can reach jax — the "
+                        f"interpreter kills daemon threads mid-device-"
+                        f"call at exit (teardown segfault class); make "
+                        f"it non-daemon with a bounded join on the "
+                        f"shutdown path, or allowlist with the "
+                        f"abandonment contract spelled out"))
+
+
+# -------------------------------------------------------------- allowlist
+
+
+def load_allowlist(path=None) -> List[dict]:
+    p = Path(path) if path is not None else ALLOWLIST_PATH
+    if not p.exists():
+        return []
+    return json.loads(p.read_text())
+
+
+def _apply_allowlist(findings: List[Finding], allowlist: List[dict],
+                     check_stale: bool) -> List[Finding]:
+    out: List[Finding] = []
+    used = [False] * len(allowlist)
+    for i, entry in enumerate(allowlist):
+        if not str(entry.get("why", "")).strip():
+            out.append(Finding(
+                PASS, "CONC000", str(ALLOWLIST_PATH.name), i + 1,
+                f"allowlist entry {i} ({entry.get('rule')}, "
+                f"{entry.get('match')!r}) has no written justification — "
+                f"every entry needs a non-empty 'why'"))
+            used[i] = True  # don't double-report as stale
+    for f in findings:
+        allowed = False
+        for i, entry in enumerate(allowlist):
+            if entry.get("rule") != f.code:
+                continue
+            if entry.get("path") and not f.path.endswith(entry["path"]):
+                continue
+            if entry.get("match") and entry["match"] not in f.message:
+                continue
+            if not str(entry.get("why", "")).strip():
+                continue
+            allowed, used[i] = True, True
+            break
+        if not allowed:
+            out.append(f)
+    if check_stale:
+        for i, entry in enumerate(allowlist):
+            if not used[i]:
+                out.append(Finding(
+                    PASS, "CONC000", str(ALLOWLIST_PATH.name), i + 1,
+                    f"stale allowlist entry {i}: rule={entry.get('rule')} "
+                    f"path={entry.get('path')!r} "
+                    f"match={entry.get('match')!r} matched no finding — "
+                    f"the pattern it justified is gone; delete the entry"))
+    return out
+
+
+# -------------------------------------------------------------- entry
+
+
+def _default_files(root) -> List[Path]:
+    root = Path(root)
+    out = []
+    for pkg in _SCOPE_PKGS:
+        base = root / "alphafold2_tpu" / pkg
+        if base.is_dir():
+            out.extend(sorted(base.rglob("*.py")))
+    return out
+
+
+def lock_graph(root, files=None) -> Dict[str, Dict[str, tuple]]:
+    """The static lock-acquisition graph {held: {acquired: witness}} —
+    exported for docs tooling and for comparing against the runtime
+    graph from analysis/lock_runtime.py."""
+    mods = [m for m in (_collect_module(p, root)
+                        for p in (files or _default_files(root)))
+            if m is not None]
+    g = _Graph(mods)
+    edges: Dict[str, Dict[str, tuple]] = {}
+    for mod in g.mods:
+        meths = list(mod.funcs.values())
+        for cls in mod.classes.values():
+            meths.extend(cls.meths.values())
+        for meth in meths:
+            for lid, line, held in meth.acquires:
+                for h in held:
+                    edges.setdefault(h, {}).setdefault(
+                        lid, (mod.path, line))
+            for desc, line, held in meth.calls:
+                if not held:
+                    continue
+                callee = g.resolve(meth, desc)
+                if callee is None:
+                    continue
+                for lid in g.lock_closure(callee):
+                    for h in held:
+                        edges.setdefault(h, {}).setdefault(
+                            lid, (mod.path, line))
+    return edges
+
+
+def run(root, files: Optional[Sequence] = None,
+        allowlist: Optional[Sequence] = None) -> List[Finding]:
+    """Run the concurrency pass. `files` restricts the analyzed universe
+    (fixture injection); `allowlist` overrides the default JSON (a list
+    of {"rule", "path", "match", "why"} dicts)."""
+    paths = [Path(f) for f in files] if files is not None \
+        else _default_files(root)
+    mods = []
+    findings: List[Finding] = []
+    for p in paths:
+        if not str(p).endswith(".py"):
+            continue
+        try:
+            m = _collect_module(p, root)
+        except (OSError, ValueError):
+            continue
+        if m is None:
+            findings.append(Finding(
+                PASS, "CONC000", rel(p, root), 1,
+                "file does not parse; concurrency analysis skipped"))
+            continue
+        mods.append(m)
+    g = _Graph(mods)
+    _conc001(g, findings)
+    _conc002(g, findings)
+    _conc003(g, findings)
+    _conc004(g, findings)
+    per_file_supp = {m.path: m.supp for m in mods}
+    findings = [
+        f for f in findings
+        if f.path not in per_file_supp
+        or f in filter_suppressed([f], per_file_supp[f.path])
+    ]
+    check_stale = allowlist is not None or files is None
+    wl = list(allowlist) if allowlist is not None else load_allowlist()
+    findings = _apply_allowlist(findings, wl, check_stale)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings

@@ -1,0 +1,200 @@
+"""Runtime validation arm for the concurrency pass: instrumented locks.
+
+`concurrency_lint` builds the lock-acquisition graph STATICALLY and
+honestly documents its blind spot: callables stored in containers
+(health-probe registries, tick-hook lists, done-callbacks) are dynamic
+call edges it cannot resolve. This module closes that gap the way
+overlap-lint's runtime assertions close its: wrap the real locks of a
+live system, record the ACTUAL acquisition-order graph plus
+held-while-blocking events while the existing chaos acceptance tests
+drive real traffic, and assert the observed graph is acyclic.
+
+Usage (see tests/test_chaos.py)::
+
+    mon = LockMonitor()
+    mon.instrument(fleet)            # wraps every Lock/RLock attr
+    mon.instrument(fleet._health)
+    ... drive the chaos scenario ...
+    mon.assert_acyclic()             # observed lock-order graph
+    snap = mon.snapshot()            # edges, counts, long holds
+
+Instrumentation swaps a ``self._lock`` attribute for a proxy that
+delegates ``acquire``/``release`` to the SAME underlying lock, so
+mutual exclusion is untouched even for threads already running (Python
+re-reads the attribute at each ``with self._lock:``) and for
+``threading.Condition`` objects built over the raw lock. Bookkeeping
+is per-thread (a thread-local held-stack) plus one leaf-only registry
+lock, so the monitor itself cannot introduce an ordering edge.
+
+A hold longer than ``long_hold_s`` is recorded as a held-while-blocking
+event (name, duration, holder thread). Condition waits release the raw
+lock without telling the proxy, so long-hold events are diagnostic
+only — the acyclicity assertion is the contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))
+
+
+class _InstrumentedLock:
+    """Delegating proxy over a real Lock/RLock with order bookkeeping."""
+
+    def __init__(self, raw, name: str, monitor: "LockMonitor"):
+        self._raw = raw
+        self._name = name
+        self._mon = monitor
+
+    # the two methods Condition and `with` need
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._raw.acquire(blocking, timeout)
+        if got:
+            self._mon._on_acquire(self._name)
+        return got
+
+    def release(self):
+        self._mon._on_release(self._name)
+        self._raw.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._raw.locked()
+
+    def __repr__(self):
+        return f"<instrumented {self._name} over {self._raw!r}>"
+
+
+class LockMonitor:
+    """Records the live lock-acquisition-order graph across threads."""
+
+    def __init__(self, long_hold_s: float = 0.05):
+        self.long_hold_s = long_hold_s
+        self._tls = threading.local()
+        self._reg = threading.Lock()   # leaf-only: never held while
+        #                                acquiring an instrumented lock
+        self._edges: Dict[Tuple[str, str], int] = {}
+        self._acquires: Dict[str, int] = {}
+        self._long_holds: List[dict] = []
+
+    # ------------------------------------------------------ bookkeeping
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _on_acquire(self, name: str):
+        st = self._stack()
+        with self._reg:
+            self._acquires[name] = self._acquires.get(name, 0) + 1
+            for held, _t0 in st:
+                if held != name:
+                    key = (held, name)
+                    self._edges[key] = self._edges.get(key, 0) + 1
+        st.append((name, time.monotonic()))
+
+    def _on_release(self, name: str):
+        st = self._stack()
+        # release order may not mirror acquire order — pop the newest
+        # matching entry
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][0] == name:
+                _n, t0 = st.pop(i)
+                held_for = time.monotonic() - t0
+                if held_for >= self.long_hold_s:
+                    with self._reg:
+                        self._long_holds.append({
+                            "lock": name,
+                            "held_s": round(held_for, 4),
+                            "thread": threading.current_thread().name,
+                        })
+                return
+
+    # ------------------------------------------------------ wrapping
+
+    def wrap(self, raw, name: str) -> _InstrumentedLock:
+        if isinstance(raw, _InstrumentedLock):
+            return raw
+        return _InstrumentedLock(raw, name, self)
+
+    def instrument(self, obj, label: Optional[str] = None) -> List[str]:
+        """Swap every plain Lock/RLock attribute of `obj` for an
+        instrumented proxy named `<TypeName>.<attr>`. Returns the names
+        wrapped. Safe on live objects: the proxy delegates to the same
+        raw lock, so mutual exclusion is unchanged."""
+        label = label or type(obj).__name__
+        wrapped = []
+        for attr, val in sorted(vars(obj).items()):
+            if isinstance(val, _LOCK_TYPES):
+                name = f"{label}.{attr}"
+                setattr(obj, attr, self.wrap(val, name))
+                wrapped.append(name)
+        return wrapped
+
+    # ------------------------------------------------------ the verdicts
+
+    def edges(self) -> Dict[Tuple[str, str], int]:
+        with self._reg:
+            return dict(self._edges)
+
+    def cycles(self) -> List[List[str]]:
+        """Every distinct cycle in the observed acquisition-order graph
+        (a self-edge never arises: re-entry on a plain Lock deadlocks
+        before it could be recorded, and RLock re-entry is filtered at
+        edge time by the held != name guard)."""
+        adj: Dict[str, set] = {}
+        for (a, b) in self.edges():
+            adj.setdefault(a, set()).add(b)
+        out, seen = [], set()
+        WHITE, GREY, BLACK = 0, 1, 2
+        color: Dict[str, int] = {}
+
+        def dfs(node, path):
+            color[node] = GREY
+            for nxt in sorted(adj.get(node, ())):
+                if color.get(nxt, WHITE) == GREY:
+                    cyc = path[path.index(nxt):] + [nxt]
+                    key = frozenset(cyc)
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(cyc)
+                elif color.get(nxt, WHITE) == WHITE:
+                    dfs(nxt, path + [nxt])
+            color[node] = BLACK
+
+        for n in sorted(adj):
+            if color.get(n, WHITE) == WHITE:
+                dfs(n, [n])
+        return out
+
+    def assert_acyclic(self):
+        cycles = self.cycles()
+        if cycles:
+            rendered = "; ".join(" -> ".join(c) for c in cycles)
+            raise AssertionError(
+                f"observed lock-order graph has cycle(s): {rendered} — "
+                f"two threads taking these locks in opposite orders can "
+                f"deadlock (see docs/STATIC_ANALYSIS.md pass 9)")
+
+    def snapshot(self) -> dict:
+        with self._reg:
+            return {
+                "acquires": dict(self._acquires),
+                "edges": [
+                    {"held": a, "acquired": b, "count": n}
+                    for (a, b), n in sorted(self._edges.items())
+                ],
+                "long_holds": list(self._long_holds),
+            }

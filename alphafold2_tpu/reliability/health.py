@@ -303,7 +303,7 @@ class HealthMonitor:
                     traceback.print_exc()
 
         self._thread = threading.Thread(
-            target=loop, name="health-monitor", daemon=True
+            target=loop, name="af2-health-monitor", daemon=True
         )
         self._thread.start()
 

@@ -430,8 +430,9 @@ class ReplicaAutoscaler:
                     # must survive its own bugs
                     traceback.print_exc()
 
-        self._thread = threading.Thread(target=loop, name="autoscaler",
-                                        daemon=True)
+        self._thread = threading.Thread(
+            target=loop, name=f"af2-autoscale-{self.pool or 'fleet'}",
+            daemon=True)
         self._thread.start()
 
     def stop(self, timeout: Optional[float] = 5.0):

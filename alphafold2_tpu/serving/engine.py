@@ -439,6 +439,10 @@ class ServingEngine:
 
         self._executables = {}
         self._compile_lock = threading.Lock()
+        # guards _batch_counter: the worker and an abandoned watchdog
+        # runner can reach _call_executable concurrently, and the RNG
+        # fold must never hand two batches the same key
+        self._counter_lock = threading.Lock()
         self._batch_counter = 0
         self._fault_hook = fault_hook
         self._dispatch_counter = 0  # worker-thread only (the chaos clock)
@@ -522,7 +526,8 @@ class ServingEngine:
             for bucket in self._ladder.buckets:
                 self._executable_for(bucket)
         self._worker = threading.Thread(
-            target=self._worker_loop, name="serving-engine-worker", daemon=True
+            target=self._worker_loop,
+            name=f"af2-serve-{replica_name or 'engine'}", daemon=True
         )
         self._worker.start()
 
@@ -869,7 +874,10 @@ class ServingEngine:
         drain=False: pending requests fail with EngineClosedError.
         Idempotent; safe to call from any thread except the worker.
         """
-        self._closed = True
+        # under the inflight lock: _abort_worker flips the same flag
+        # from the worker thread (CONC001)
+        with self._inflight_lock:
+            self._closed = True
         self._drain_on_stop = drain
         self._stop.set()
         self._worker.join(timeout)
@@ -993,8 +1001,10 @@ class ServingEngine:
         """One device call. Overridable seam: tests substitute failure
         injection or fake outputs here without touching the scheduler."""
         exe = self._executable_for(bucket)
-        self._batch_counter += 1
-        key = jax.random.fold_in(self._base_key, self._batch_counter)
+        with self._counter_lock:
+            self._batch_counter += 1
+            batch_idx = self._batch_counter
+        key = jax.random.fold_in(self._base_key, batch_idx)
         if self.cfg.msa_rows:
             return exe(self._params, tokens, mask, key, msa, msa_mask)
         return exe(self._params, tokens, mask, key)
@@ -1050,7 +1060,8 @@ class ServingEngine:
                 done.set()
 
         threading.Thread(
-            target=runner, daemon=True, name=f"serving-dispatch-{idx}"
+            target=runner, daemon=True,
+            name=f"af2-dispatch-{self.replica_name or 'engine'}-{idx}"
         ).start()
         if not done.wait(timeout):
             self._incident("watchdog_fire", bucket=bucket, dispatch=idx,
@@ -1097,7 +1108,8 @@ class ServingEngine:
     def _abort_worker(self, staged, cause: BaseException):
         import traceback
 
-        self._closed = True
+        with self._inflight_lock:
+            self._closed = True
         traceback.print_exc()
         err = PredictionError(
             f"serving worker crashed: {type(cause).__name__}: {cause}; "

@@ -327,7 +327,7 @@ class FeaturizePool:
             if self._closed:
                 return
             self._worker_seq += 1
-            name = f"featurize-{self._worker_seq}"
+            name = f"af2-featurize-{self._worker_seq}"
             t = threading.Thread(target=self._worker_loop, args=(name,),
                                  name=name, daemon=True)
             self._workers[name] = t

@@ -569,7 +569,8 @@ class ServingFleet:
 
         self._health.start(fleet_cfg.tick_interval_s)
         self._dispatcher = threading.Thread(
-            target=self._dispatch_loop, name="fleet-dispatcher", daemon=True)
+            target=self._dispatch_loop, name="af2-fleet-dispatcher",
+            daemon=True)
         self._dispatcher.start()
 
     # ------------------------------------------------------------ factories
@@ -1421,7 +1422,10 @@ class ServingFleet:
         engine. drain=True serves what it still can (replica engines
         drain their queues); whatever cannot be served resolves with
         EngineClosedError — nothing is left unresolved. Idempotent."""
-        self._closed = True
+        # under the fleet lock: the dispatcher's crash guard flips the
+        # same flag from its own thread (CONC001)
+        with self._lock:
+            self._closed = True
         self._drain_on_stop = drain
         if self._autoscaler is not None:
             # the control loop must not scale a closing fleet (tick()
@@ -1482,7 +1486,10 @@ class ServingFleet:
                     self._route(entry)
         except BaseException:  # noqa: BLE001 — last-resort guard (engine
             # worker stance): fail queued work loudly, refuse new traffic
-            self._closed = True
+            # (the `with` regions above released _lock during unwind, so
+            # re-acquiring here cannot self-deadlock)
+            with self._lock:
+                self._closed = True
             traceback.print_exc()
             for entry in self._admission.drain():
                 self._resolve_failed(entry, PredictionError(

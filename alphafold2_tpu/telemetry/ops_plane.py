@@ -32,6 +32,11 @@ endpoints):
                   one is already running, 429 inside the rate-limit
                   window — so the next healthy TPU probe can be profiled
                   WITHOUT redeploying the fleet.
+  * ``/threadz``  every live thread (name, daemon flag, current stack
+                  via ``sys._current_frames()``) — the first diagnostic
+                  for a suspected deadlock; thread names follow the
+                  stable ``af2-*`` scheme so the owner of each stack is
+                  readable at a glance.
 
   plus a background TICKER thread that drives the periodic work live
   observability needs: `SloEngine.evaluate()`, `FlightRecorder.poll()`
@@ -68,6 +73,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import time
 import traceback
@@ -387,7 +393,7 @@ class ProfileCapturer:
                     self._captures.append(dict(info))
 
         self._thread = threading.Thread(
-            target=capture, name="profilez-capture", daemon=False)
+            target=capture, name="af2-profilez-capture", daemon=False)
         self._thread.start()
         return dict(info)
 
@@ -470,10 +476,12 @@ class _Handler(BaseHTTPRequestHandler):
                 code, payload = ops.profilez(
                     query.get("duration_s", [None])[0])
                 self._send_json(code, payload)
+            elif path == "/threadz":
+                self._send_json(200, ops.threadz())
             elif path == "/":
                 self._send_json(200, {"endpoints": [
                     "/metrics", "/healthz", "/statusz", "/explainz",
-                    "/profilez"]})
+                    "/profilez", "/threadz"]})
             else:
                 self._send_json(404, {"error": f"no such endpoint {path!r}"})
         except Exception:  # noqa: BLE001 — a handler bug must answer 500,
@@ -584,6 +592,30 @@ class OpsServer:
             }
         return 200, rec
 
+    def threadz(self) -> dict:
+        """`/threadz` payload: every live thread with its current stack
+        (`sys._current_frames()`) — the FIRST diagnostic for a suspected
+        deadlock or hang: two threads parked in `acquire` with crossed
+        lock owners is a lock-order inversion caught red-handed (the
+        static side of the same contract is af2lint's concurrency pass).
+        Served by one of the HTTP pool's own threads, so even a fully
+        wedged serving tier still answers."""
+        frames = sys._current_frames()
+        threads = []
+        for t in threading.enumerate():
+            frame = frames.get(t.ident)
+            stack = [ln.rstrip() for ln in
+                     traceback.format_stack(frame)] if frame else []
+            threads.append({
+                "name": t.name,
+                "ident": t.ident,
+                "daemon": t.daemon,
+                "alive": t.is_alive(),
+                "stack": stack,
+            })
+        threads.sort(key=lambda e: str(e["name"]))
+        return {"count": len(threads), "threads": threads}
+
     def profilez(self, duration_s):
         """(code, payload) for `/profilez?duration_s=` — start one
         bounded jax.profiler capture (409 busy / 429 rate-limited)."""
@@ -648,7 +680,7 @@ class OpsServer:
             return
         self._stop.clear()
         self._serve_thread = threading.Thread(
-            target=self._httpd.serve_forever, name="ops-plane-http",
+            target=self._httpd.serve_forever, name="af2-ops-http",
             daemon=True)
         self._serve_thread.start()
 
@@ -657,7 +689,7 @@ class OpsServer:
                 self.tick()
 
         self._tick_thread = threading.Thread(
-            target=tick_loop, name="ops-plane-ticker", daemon=True)
+            target=tick_loop, name="af2-ops-ticker", daemon=True)
         self._tick_thread.start()
 
     def stop(self, timeout: Optional[float] = 5.0):

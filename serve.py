@@ -594,7 +594,7 @@ def main():
             # turn termination into a hang (worst case here: the join
             # times out, the snapshot is lost, the process still dies)
             t = threading.Thread(target=_flush_stats_snapshot,
-                                 daemon=True)
+                                 name="af2-sigterm-flush", daemon=True)
             t.start()
             t.join(10.0)
             signal.signal(signal.SIGTERM, signal.SIG_DFL)
@@ -717,7 +717,7 @@ def main():
                     traceback.print_exc()
 
         stats_thread = threading.Thread(
-            target=_flush_stats, name="stats-flusher", daemon=True)
+            target=_flush_stats, name="af2-stats-flusher", daemon=True)
         stats_thread.start()
 
     # --- replay: submit everything, honoring backpressure explicitly ----

@@ -8,14 +8,19 @@ deliberately re-introduced `pltpu.CompilerParams` direct access (the
 exact API-drift defect that had the seed suite red) must be caught.
 """
 
+import json
 import os
 import textwrap
+import threading
 
 import pytest
 
-from alphafold2_tpu.analysis import run_passes
+from alphafold2_tpu.analysis import PASSES, PASS_SUMMARIES, run_passes
 from alphafold2_tpu.analysis.__main__ import main as af2lint_main
 from alphafold2_tpu.analysis.compat_lint import run as compat_run
+from alphafold2_tpu.analysis.concurrency_lint import lock_graph
+from alphafold2_tpu.analysis.concurrency_lint import run as conc_run
+from alphafold2_tpu.analysis.lock_runtime import LockMonitor
 from alphafold2_tpu.analysis.sharding_lint import run as sharding_run
 from alphafold2_tpu.analysis.trace_safety import run as trace_run
 
@@ -505,3 +510,462 @@ class TestRepoIsClean:
         targets = _targets()
         assert "ops.feed_forward" in targets
         targets["ops.feed_forward"]()  # raises on breakage
+
+
+# ---------------------------------------------------------------------------
+# concurrency pass
+# ---------------------------------------------------------------------------
+
+
+class TestConcurrencyPass:
+    """Every CONC rule fires on its broken twin and stays silent on the
+    clean one; fixtures are injected via `files=` + `allowlist=[]` so
+    the repo's own allowlist can never mask a fixture regression."""
+
+    def _run(self, tmp_path, *paths, allowlist=()):
+        return conc_run(tmp_path, files=list(paths),
+                        allowlist=list(allowlist))
+
+    # ---- CONC001: multi-entry-point writes without a common lock
+
+    CONC1_BROKEN = """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def start(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def _loop(self):
+                self._n += 1
+
+            def bump(self):
+                self._n += 1
+        """
+
+    def test_conc001_fires_on_unlocked_shared_write(self, tmp_path):
+        bad = _write(tmp_path, "bad1.py", self.CONC1_BROKEN)
+        findings = self._run(tmp_path, bad)
+        assert _codes(findings) == ["CONC001"]
+        assert "Counter._n" in findings[0].message
+        # both the thread root and the external-caller root are named
+        assert "thread:" in findings[0].message
+
+    def test_conc001_silent_when_writes_share_a_lock(self, tmp_path):
+        ok = _write(tmp_path, "ok1.py", """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def start(self):
+                    self._t = threading.Thread(target=self._loop)
+                    self._t.start()
+
+                def _loop(self):
+                    with self._lock:
+                        self._n += 1
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+            """)
+        assert self._run(tmp_path, ok) == []
+
+    def test_conc001_silent_for_single_root(self, tmp_path):
+        """A private attr only the external caller ever writes (classic
+        start/stop pair) is single-root — no lock demanded."""
+        ok = _write(tmp_path, "ok1b.py", """
+            import threading
+
+            class Runner:
+                def start(self):
+                    self._t = threading.Thread(target=self._loop)
+                    self._t.start()
+
+                def stop(self):
+                    self._t = None
+
+                def _loop(self):
+                    pass
+            """)
+        assert self._run(tmp_path, ok) == []
+
+    # ---- CONC002: lock-order inversion
+
+    CONC2_BROKEN = """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def backward(self):
+                with self._b:
+                    self._inner()
+
+            def _inner(self):
+                with self._a:
+                    pass
+        """
+
+    def test_conc002_fires_on_inversion_through_a_call(self, tmp_path):
+        bad = _write(tmp_path, "bad2.py", self.CONC2_BROKEN)
+        findings = self._run(tmp_path, bad)
+        assert "CONC002" in _codes(findings)
+        msg = next(f for f in findings if f.code == "CONC002").message
+        assert "Pair._a" in msg and "Pair._b" in msg
+        assert "via Pair._inner" in msg
+
+    def test_conc002_silent_on_consistent_order(self, tmp_path):
+        ok = _write(tmp_path, "ok2.py", """
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def backward(self):
+                    with self._a:
+                        self._inner()
+
+                def _inner(self):
+                    with self._b:
+                        pass
+            """)
+        assert self._run(tmp_path, ok) == []
+
+    def test_conc002_lock_graph_export(self, tmp_path):
+        bad = _write(tmp_path, "bad2.py", self.CONC2_BROKEN)
+        edges = lock_graph(tmp_path, files=[bad])
+        assert "Pair._b" in edges["Pair._a"]
+        assert "Pair._a" in edges["Pair._b"]
+
+    # ---- CONC003: blocking while holding a lock
+
+    CONC3_BROKEN = """
+        import queue
+        import threading
+
+        class Drainer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                pass
+
+            def stop(self):
+                with self._lock:
+                    self._t.join()
+
+            def drain(self):
+                with self._lock:
+                    return self._q.get()
+        """
+
+    def test_conc003_fires_on_join_and_unbounded_get_under_lock(
+            self, tmp_path):
+        bad = _write(tmp_path, "bad3.py", self.CONC3_BROKEN)
+        findings = self._run(tmp_path, bad)
+        assert _codes(findings) == ["CONC003"]
+        msgs = " | ".join(f.message for f in findings)
+        assert "join" in msgs and "get" in msgs
+
+    def test_conc003_silent_outside_lock_or_with_timeout(self, tmp_path):
+        ok = _write(tmp_path, "ok3.py", """
+            import queue
+            import threading
+
+            class Drainer:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+                    self._t = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    pass
+
+                def stop(self):
+                    with self._lock:
+                        t = self._t
+                    t.join()
+
+                def drain(self):
+                    with self._lock:
+                        return self._q.get(timeout=1.0)
+            """)
+        assert self._run(tmp_path, ok) == []
+
+    # ---- CONC004: daemon thread reaching jax
+
+    CONC4_BROKEN = """
+        import threading
+
+        import jax
+
+        class Background:
+            def start(self):
+                self._t = threading.Thread(
+                    target=self._loop, daemon=True, name="bg")
+                self._t.start()
+
+            def _loop(self):
+                jax.device_count()
+        """
+
+    def test_conc004_fires_on_daemon_thread_reaching_jax(self, tmp_path):
+        bad = _write(tmp_path, "bad4.py", self.CONC4_BROKEN)
+        findings = self._run(tmp_path, bad)
+        assert _codes(findings) == ["CONC004"]
+        assert "Background._loop" in findings[0].message
+
+    def test_conc004_silent_when_nondaemon_or_no_jax(self, tmp_path):
+        ok = _write(tmp_path, "ok4.py", """
+            import threading
+
+            import jax
+
+            class Background:
+                def start(self):
+                    # non-daemon may reach jax; daemon may not reach jax
+                    self._t = threading.Thread(target=self._loop)
+                    self._u = threading.Thread(target=self._idle,
+                                               daemon=True)
+                    self._t.start()
+                    self._u.start()
+
+                def _loop(self):
+                    jax.device_count()
+
+                def _idle(self):
+                    pass
+            """)
+        assert self._run(tmp_path, ok) == []
+
+    # ---- suppression comment
+
+    def test_inline_disable_comment(self, tmp_path):
+        ok = _write(tmp_path, "sup4.py", """
+            import threading
+
+            import jax
+
+            class Background:
+                def start(self):
+                    self._t = threading.Thread(target=self._loop, daemon=True)  # af2lint: disable=CONC004
+                    self._t.start()
+
+                def _loop(self):
+                    jax.device_count()
+            """)
+        assert self._run(tmp_path, ok) == []
+
+    # ---- allowlist round-trip
+
+    def test_allowlist_suppresses_with_justification(self, tmp_path):
+        bad = _write(tmp_path, "bad4.py", self.CONC4_BROKEN)
+        entry = {"rule": "CONC004", "path": "bad4.py",
+                 "match": "Background._loop",
+                 "why": "fixture: abandonment contract documented"}
+        assert self._run(tmp_path, bad, allowlist=[entry]) == []
+
+    def test_allowlist_empty_why_is_a_finding_not_a_suppression(
+            self, tmp_path):
+        bad = _write(tmp_path, "bad4.py", self.CONC4_BROKEN)
+        entry = {"rule": "CONC004", "path": "bad4.py",
+                 "match": "Background._loop", "why": "   "}
+        findings = self._run(tmp_path, bad, allowlist=[entry])
+        assert _codes(findings) == ["CONC000", "CONC004"]
+
+    def test_allowlist_stale_entry_flagged(self, tmp_path):
+        ok = _write(tmp_path, "ok.py", "import threading\n")
+        entry = {"rule": "CONC004", "path": "gone.py",
+                 "match": "nothing", "why": "was justified once"}
+        findings = self._run(tmp_path, ok, allowlist=[entry])
+        assert _codes(findings) == ["CONC000"]
+        assert "stale" in findings[0].message
+
+    # ---- the repo itself
+
+    def test_concurrency_pass_clean_on_repo(self):
+        """The tree (plus its checked-in allowlist: every entry both
+        justified and still matching) carries zero concurrency findings."""
+        findings = run_passes(REPO_ROOT, select=("concurrency",))
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_repo_static_lock_graph_is_acyclic(self):
+        """Pin the static acquisition graph's shape: acyclic, and the
+        known engine->metrics / fleet->health edges present."""
+        edges = lock_graph(REPO_ROOT)
+        # acyclicity via Kahn's algorithm
+        nodes = set(edges) | {b for d in edges.values() for b in d}
+        indeg = {n: 0 for n in nodes}
+        for a, outs in edges.items():
+            for b in outs:
+                indeg[b] += 1
+        frontier = [n for n in nodes if indeg[n] == 0]
+        seen = 0
+        while frontier:
+            n = frontier.pop()
+            seen += 1
+            for b in edges.get(n, ()):
+                indeg[b] -= 1
+                if indeg[b] == 0:
+                    frontier.append(b)
+        assert seen == len(nodes), f"static lock graph has a cycle: {edges}"
+        assert "ServingMetrics._counts_lock" in edges.get(
+            "ServingEngine._inflight_lock", {})
+        assert "HealthMonitor._lock" in edges.get("ServingFleet._lock", {})
+
+
+# ---------------------------------------------------------------------------
+# pass registry & CLI surface
+# ---------------------------------------------------------------------------
+
+
+class TestPassListing:
+    def test_nine_passes_registered_in_order(self):
+        assert list(PASSES) == [
+            "compat", "trace", "sharding", "smoke", "overlap",
+            "schedule", "metrics", "dispatch", "concurrency",
+        ]
+
+    def test_every_pass_has_a_summary(self):
+        assert set(PASS_SUMMARIES) == set(PASSES)
+        for name, summary in PASS_SUMMARIES.items():
+            assert summary.strip(), f"pass {name!r} has an empty summary"
+
+    def test_cli_list_passes(self, capsys):
+        assert af2lint_main(["--list-passes"]) == 0
+        out = capsys.readouterr().out
+        for name in PASSES:
+            assert name in out
+        assert "9 passes" in out
+
+    def test_cli_json_groups_findings_per_pass(self, tmp_path, capsys):
+        bad = _write(tmp_path, "bad.py", """
+            from jax.experimental import pallas as pl
+            """)
+        rc = af2lint_main(["--select", "compat,concurrency", "--json",
+                           "--strict", bad])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["passes"] == ["compat", "concurrency"]
+        assert doc["strict"] is True
+        assert doc["total"] == len(doc["findings"]["compat"])
+        assert doc["findings"]["concurrency"] == []
+        rec = doc["findings"]["compat"][0]
+        assert set(rec) == {"rule", "path", "line", "message"}
+
+    def test_cli_json_clean_exit_zero(self, tmp_path, capsys):
+        ok = _write(tmp_path, "ok.py", "import jax\n")
+        rc = af2lint_main(["--select", "concurrency", "--json",
+                           "--strict", str(ok)])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# lock_runtime: the instrumented-lock harness
+# ---------------------------------------------------------------------------
+
+
+class _TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+
+class TestLockMonitor:
+    def test_consistent_order_is_acyclic(self):
+        mon = LockMonitor()
+        obj = _TwoLocks()
+        wrapped = mon.instrument(obj)
+        assert wrapped == ["_TwoLocks._a", "_TwoLocks._b"]
+        for _ in range(3):
+            with obj._a:
+                with obj._b:
+                    pass
+        mon.assert_acyclic()
+        assert mon.edges() == {("_TwoLocks._a", "_TwoLocks._b"): 3}
+
+    def test_inverted_order_is_a_cycle(self):
+        mon = LockMonitor()
+        obj = _TwoLocks()
+        mon.instrument(obj)
+        with obj._a:
+            with obj._b:
+                pass
+        with obj._b:
+            with obj._a:
+                pass
+        assert mon.cycles() != []
+        with pytest.raises(AssertionError, match="lock-order graph"):
+            mon.assert_acyclic()
+
+    def test_mutual_exclusion_preserved_through_proxy(self):
+        """The proxy delegates to the SAME raw lock, so a thread that
+        captured the lock before instrumentation still excludes one
+        that acquires through the proxy."""
+        raw = threading.Lock()
+        mon = LockMonitor()
+        proxy = mon.wrap(raw, "x")
+        raw.acquire()
+        assert not proxy.acquire(blocking=False)
+        raw.release()
+        assert proxy.acquire(blocking=False)
+        proxy.release()
+
+    def test_long_hold_recorded(self):
+        mon = LockMonitor(long_hold_s=0.0)
+        obj = _TwoLocks()
+        mon.instrument(obj)
+        with obj._a:
+            pass
+        snap = mon.snapshot()
+        assert snap["acquires"] == {"_TwoLocks._a": 1}
+        assert snap["long_holds"] and \
+            snap["long_holds"][0]["lock"] == "_TwoLocks._a"
+
+    def test_cross_thread_edges_merge(self):
+        """Edges observed on different threads land in one graph —
+        that is the whole point (thread A: a->b, thread B: b->a)."""
+        mon = LockMonitor()
+        obj = _TwoLocks()
+        mon.instrument(obj)
+
+        def locked_pair(first, second):
+            with first:
+                with second:
+                    pass
+
+        t = threading.Thread(target=locked_pair, args=(obj._a, obj._b))
+        t.start()
+        t.join()
+        locked_pair(obj._b, obj._a)
+        assert set(mon.edges()) == {
+            ("_TwoLocks._a", "_TwoLocks._b"),
+            ("_TwoLocks._b", "_TwoLocks._a"),
+        }
+        assert mon.cycles() != []

@@ -523,6 +523,16 @@ def test_fleet_kill_replica_requeues_to_healthy_replica():
     and the dead replica is drained out of rotation."""
     inj = plan(Fault("kill_replica", replica="r0", at=0)).injector()
     fleet = fake_fleet(inj, reprobe_interval_s=30.0)  # stays dead in-window
+    # instrumented-lock harness (analysis/lock_runtime): swap the fleet's
+    # and health monitor's locks for recording proxies and assert the
+    # acquisition-order graph observed under real failover traffic is
+    # acyclic — the runtime twin of af2lint's CONC002.
+    from alphafold2_tpu.analysis.lock_runtime import LockMonitor
+
+    mon = LockMonitor()
+    wrapped = mon.instrument(fleet) + mon.instrument(fleet._health)
+    assert "ServingFleet._lock" in wrapped
+    assert "HealthMonitor._lock" in wrapped
     try:
         reqs = [fleet.submit(seq_of(4 + i % 3, offset=i)) for i in range(6)]
         for r in reqs:
@@ -537,6 +547,10 @@ def test_fleet_kill_replica_requeues_to_healthy_replica():
         counters = st["telemetry"]["metrics"]["counters"]
         assert counters["fleet_requeue_total"] >= 1
         assert inj.exhausted()
+        snap = mon.snapshot()
+        assert sum(snap["acquires"].values()) > 0, \
+            "instrumentation saw no lock traffic"
+        mon.assert_acyclic()
     finally:
         fleet.shutdown(timeout=30)
 

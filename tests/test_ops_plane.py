@@ -307,6 +307,28 @@ class TestOpsServer:
             assert exc_info.value.code == 503
             assert json.loads(exc_info.value.read())["why"] == "drained"
 
+    def test_threadz_lists_named_threads_with_stacks(self):
+        srv = OpsServer(registry=MetricRegistry())
+        with srv:
+            status, body, _ = http_get(f"{srv.url}/threadz")
+            payload = json.loads(body)
+        assert status == 200
+        assert payload["count"] == len(payload["threads"]) >= 2
+        names = [t["name"] for t in payload["threads"]]
+        # the ops plane's own threads carry stable af2-* names
+        assert "af2-ops-http" in names
+        by_name = {t["name"]: t for t in payload["threads"]}
+        handler = by_name["af2-ops-http"]
+        assert handler["daemon"] is True and handler["alive"] is True
+        assert isinstance(handler["ident"], int)
+        # the stacks are real frames: the accept loop is parked in
+        # serve_forever, and the per-request thread that built this very
+        # response is captured inside threadz itself
+        assert any("serve_forever" in fr for fr in handler["stack"])
+        assert any("threadz" in "".join(t["stack"])
+                   for t in payload["threads"])
+        assert names == sorted(names)
+
     def test_statusz_sections_and_404(self):
         r = MetricRegistry()
         tracer = Tracer()

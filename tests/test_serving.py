@@ -1123,15 +1123,34 @@ def test_saturated_pool_shed_quotes_capable_pool_not_global():
                                           request_timeout_s=None),
                          queue_capacity=4, dispatch_backoff_s=1.0,
                          default_timeout_s=None)
-    try:
-        # wedge both pools: 2 requests each (1 dispatched, 1 in the
-        # replica queue)
-        pending = [fleet.submit(seq_of(6, offset=i)) for i in range(2)]
-        pending += [fleet.submit(seq_of(20, offset=i)) for i in range(2)]
-        deadline = time.monotonic() + 10
-        while fleet.stats()["admission"]["depth"] > 0:
+    def _await(cond, timeout=10):
+        deadline = time.monotonic() + timeout
+        while not cond():
             assert time.monotonic() < deadline
             time.sleep(0.02)
+
+    def rep_state(name):
+        r = fleet.stats()["replicas"][name]
+        return r["in_flight"], r["engine"]["queue"]["depth"]
+
+    try:
+        # wedge both pools: 2 requests each (1 dispatched, 1 in the
+        # replica queue). Sequenced: each pool's second request is
+        # submitted only after its worker holds the first (engine queue
+        # back to 0) — submitting both at once races the dispatcher
+        # against the worker's dequeue, and losing that race spills the
+        # second SHORT onto the short-capable LONG pool, wedging it with
+        # three entries while a long request orbits the admission queue
+        # forever (the 2+2 wedge this test needs never forms).
+        pending = [fleet.submit(seq_of(6))]
+        _await(lambda: rep_state("r0") == (1, 0))
+        pending += [fleet.submit(seq_of(6, offset=1))]
+        _await(lambda: rep_state("r0") == (2, 1))
+        pending += [fleet.submit(seq_of(20))]
+        _await(lambda: rep_state("r1") == (1, 0))
+        pending += [fleet.submit(seq_of(20, offset=1))]
+        _await(lambda: rep_state("r1") == (2, 1))
+        _await(lambda: fleet.stats()["admission"]["depth"] == 0)
         # now fill the SHARED queue: 3 long + 1 short queued
         pending += [fleet.submit(seq_of(21 + i, offset=i)) for i in range(3)]
         pending += [fleet.submit(seq_of(7))]
