@@ -5,15 +5,21 @@ trace per sequence length. This package is the production front end
 (docs/SERVING.md): a pure pipeline function (`pipeline.predict_structure`),
 a length-bucket ladder with an AOT-compiled-executable cache
 (`bucketing`), a dynamic micro-batching scheduler with bounded-queue
-backpressure (`engine.ServingEngine`), a result LRU (`cache`), and
-serving metrics with latency quantiles (`metrics`). `serve.py` at the
-repo root drives it over a many-record FASTA as a traffic-replay harness.
+backpressure (`engine.ServingEngine`), a result LRU (`cache`), a
+fleet-wide content-addressed artifact store with front-door coalescing
+(`artifact_store` + `frontdoor`), and serving metrics with latency
+quantiles (`metrics`). `serve.py` at the repo root drives it over a
+many-record FASTA as a traffic-replay harness.
 """
 
 from alphafold2_tpu.serving.admission import (
     PRIORITIES,
     AdmissionConfig,
     AdmissionController,
+)
+from alphafold2_tpu.serving.artifact_store import (
+    ArtifactStore,
+    ArtifactStoreConfig,
 )
 from alphafold2_tpu.serving.bucketing import (
     DEFAULT_BUCKETS,
@@ -56,6 +62,7 @@ from alphafold2_tpu.serving.fleet import (
     PoolSpec,
     ServingFleet,
 )
+from alphafold2_tpu.serving.frontdoor import FrontDoor
 from alphafold2_tpu.serving.sp_arm import (
     SP_SCHEDULES,
     choose_schedule,
@@ -76,6 +83,9 @@ __all__ = [
     "PRIORITIES",
     "AdmissionConfig",
     "AdmissionController",
+    "ArtifactStore",
+    "ArtifactStoreConfig",
+    "FrontDoor",
     "BucketLadder",
     "pad_batch",
     "ResultCache",

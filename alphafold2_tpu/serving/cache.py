@@ -9,6 +9,22 @@ AND the bucket ladder: a structure is a deterministic function of
 (sequence, bucket) — Torgerson centering and the Guttman step see the
 padded matrix size (serving/bucketing.py) — so a different ladder is a
 different computation.
+
+The key's config tag also versions on the kernel-dispatch
+`resolution_tag` (ops/dispatch.py) and the deploy's `params_tag`
+(rolling updates re-key the cache rather than serving the old weights'
+structures; see ServingConfig.params_tag), both folded into the
+engine's `config_tag` — and, one tier up, into the fleet store tags.
+
+This per-engine LRU is TIER ONE of a two-tier memoization scheme. The
+fleet-wide artifact store (serving/artifact_store.py) COMPOSES with it
+— it does not replace it: the fleet tier intercepts at the front door
+(before routing, shared across replicas and pools, persisted to disk),
+while this LRU still absorbs repeats that reach one engine directly
+(single-engine deployments, fleet probe traffic, replica-local retry
+storms). Both tiers key on `request_key` with config-tag inputs drawn
+from the same knobs, so an invalidation event (redeploy, precision
+change, kernel arm flip) re-keys them in lockstep.
 """
 
 from __future__ import annotations
@@ -26,10 +42,17 @@ def request_key(seq: str, msa: Optional[np.ndarray], config_tag: str,
     """Stable content hash for one request against one engine config.
 
     `config_tag` is the engine's repr of everything numerically relevant
-    (model config, mds knobs, params fingerprint); `msa` and `msa_mask`
+    (model config, mds knobs, params fingerprint, kernel resolution tag,
+    params_tag — see `ServingEngine.config_tag`); `msa` and `msa_mask`
     are hashed by bytes so equal alignments hit regardless of object
     identity. The mask is part of the key: the same alignment under a
     different mask is a different computation.
+
+    The same function keys the fleet artifact store: the fleet passes
+    its per-pool store tag (engine config-tag inputs + the pool ladder
+    and SP plan, prefixed "af2store:") or the feature tag ("af2feat:")
+    as `config_tag`, so one hashing scheme addresses every memoization
+    tier and a key can never collide across tiers or deploys.
     """
     h = hashlib.sha256()
     h.update(config_tag.encode())
@@ -49,10 +72,15 @@ def request_key(seq: str, msa: Optional[np.ndarray], config_tag: str,
 
 
 class ResultCache:
-    """Thread-safe LRU over prediction results.
+    """Thread-safe LRU over prediction results — the PER-ENGINE tier.
 
     capacity=0 disables caching (every get misses, puts are dropped) —
     the engine code path stays identical either way.
+
+    In a fleet this sits UNDER the fleet-wide artifact store
+    (serving/artifact_store.py): the store absorbs cross-replica and
+    cross-restart repeats at the front door, this LRU absorbs whatever
+    still reaches its engine. They compose; neither replaces the other.
     """
 
     def __init__(self, capacity: int = 256):

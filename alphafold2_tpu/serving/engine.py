@@ -757,6 +757,18 @@ class ServingEngine:
     def compile_count(self) -> int:
         return self.metrics.compile_count
 
+    @property
+    def config_tag(self) -> str:
+        """The numerics-identity tag this engine keys its result cache
+        and executable table on. Public because the fleet artifact tier
+        (serving/artifact_store.py) builds its per-pool store tags from
+        the same inputs: the per-engine LRU and the fleet store are two
+        TIERS of one memoization scheme, and both must re-key on exactly
+        the knobs that move this engine's numerics (model config, MDS
+        knobs, seed, params_tag, bucket ladder, kernel resolution tag,
+        SP plan)."""
+        return self._config_tag
+
     def capability(self) -> dict:
         """The replica capability tag (ROADMAP item 4b): what traffic this
         engine can physically serve — the fleet's length-adaptive router

@@ -71,7 +71,11 @@ class FeatureBundle:
     a bundle computed on a pool worker, inline on a submit thread, or
     by the client itself (the pre-featurized bypass) is interchangeable
     — the engine's cache keys and the fleet's bit-exactness pins see
-    identical arrays either way."""
+    identical arrays either way. That determinism is also what lets the
+    fleet artifact store (serving/artifact_store.py) persist bundles
+    under a content hash and replay them across requeues, retries, and
+    re-submissions: a stored bundle IS the recomputation, byte for
+    byte, so the featurize tier is skipped entirely on a hit."""
 
     seq: str                      # normalized (stripped, uppercased)
     tokens: np.ndarray            # (L,) int32 strict tokenization

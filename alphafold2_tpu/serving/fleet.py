@@ -58,18 +58,24 @@ import traceback
 from typing import Optional
 
 from alphafold2_tpu.constants import AA_ORDER
+from alphafold2_tpu.ops.dispatch import (
+    resolution_tag as dispatch_resolution_tag,
+)
 from alphafold2_tpu.reliability.health import HealthMonitor, ReplicaState
 from alphafold2_tpu.serving.admission import (
     AdmissionConfig,
     AdmissionController,
     resolve_priority,
 )
+from alphafold2_tpu.serving.artifact_store import ArtifactStore
 from alphafold2_tpu.serving.bucketing import BucketLadder
+from alphafold2_tpu.serving.cache import request_key
 from alphafold2_tpu.serving.engine import (
     PredictionResult,
     ServingConfig,
     ServingEngine,
 )
+from alphafold2_tpu.serving.frontdoor import FrontDoor
 from alphafold2_tpu.serving.errors import (
     CircuitOpenError,
     EngineClosedError,
@@ -264,6 +270,12 @@ class FleetRequest:
         self.trace_id = trace_id or new_trace_id()
         self.requeues = 0
         self.pool = None         # preferred capability pool (set at admit)
+        # artifact-store identity, stamped at the front door: (store tag,
+        # content hash) — the waiter-registry key this request leads or
+        # follows, and the address its result persists under
+        self.store_key = None
+        self.coalesced = False   # True: follower of an in-flight leader
+        self.feat_store_key = None  # (tag, hash) to persist features under
         self.failed_on = set()   # replica names this request failed on
         self.last_error: Optional[BaseException] = None
         self._event = threading.Event()
@@ -371,7 +383,8 @@ class ServingFleet:
                  fleet_cfg: FleetConfig = FleetConfig(), *,
                  engine_factory=None, model_apply_fn=None, injector=None,
                  tracer=None, registry: Optional[MetricRegistry] = None,
-                 incident_hook=None):
+                 incident_hook=None,
+                 artifact_store: Optional[ArtifactStore] = None):
         self.cfg = fleet_cfg
         self._params = params
         self._model_cfg = model_cfg
@@ -416,6 +429,19 @@ class ServingFleet:
         self.registry = registry if registry is not None else MetricRegistry()
         self._incident_hook = incident_hook
         self._factory = engine_factory or self._default_factory
+
+        # ---- fleet-wide artifact store + front-door coalescing (ISSUE
+        # 17) ---- None keeps the pre-store fleet behavior-identical;
+        # with a store, submissions consult it (and register in the
+        # coalescing waiter registry) at `_admit`, BEFORE pool routing.
+        # The store's metric families land in the FLEET registry so one
+        # /metrics scrape carries both.
+        self._store = artifact_store
+        self._frontdoor = (FrontDoor(self.registry)
+                           if artifact_store is not None else None)
+        if self._store is not None:
+            self._store.bind_registry(self.registry)
+            self._store.set_current_tags(self._current_store_tags())
 
         # ---- serving cost & profiling plane (telemetry/costs.py) ----
         # always on (dict bookkeeping, no model cost): the shared
@@ -617,6 +643,41 @@ class ServingFleet:
             "max_len": pool.max_len,
         }
 
+    # ------------------------------------------------- artifact-store tags
+
+    def _store_tag(self, pool_name: str) -> str:
+        """The fleet-level store tag for one capability pool: the
+        `request_key` config tag extended (ISSUE 17) with the PR 13
+        dispatch `resolution_tag` and the deploy's `params_tag`, plus
+        every other knob that moves the numerics a pool's engines
+        produce (model config incl. the pool's weight precision, MDS
+        knobs, seed, the pool's bucket ladder, and the SP plan inputs).
+        Derived LIVE from the fleet template, so `rolling_update`'s
+        retag re-keys the whole fleet tier exactly like it re-keys the
+        per-engine LRUs — old-tag entries become unreachable, never
+        stale answers."""
+        pool = self._pools[pool_name]
+        cfg = self._pool_serving_cfg(pool)
+        mcfg = self._pool_model_cfg(pool)
+        return "af2store:" + repr((
+            mcfg, cfg.mds_iters, cfg.mds_init, cfg.seed, cfg.msa_rows,
+            cfg.params_tag, tuple(pool.ladder.buckets),
+            dispatch_resolution_tag(), cfg.sp_shards, cfg.sp_hbm_gb,
+            tuple(sorted(cfg.sp_schedules)),
+        ))
+
+    def _feature_tag(self) -> str:
+        """Feature bundles depend only on (union ladder, msa_rows) —
+        deterministic host preprocessing, no params, no kernels — so
+        their tag survives rolling updates: a redeploy invalidates
+        results, not featurization."""
+        return "af2feat:" + repr(
+            (tuple(self._ladder.buckets), self._serving_cfg.msa_rows))
+
+    def _current_store_tags(self) -> list:
+        return ([self._store_tag(name) for name in self._pools]
+                + [self._feature_tag()])
+
     def _default_factory(self, name, cfg, fault_hook):
         if name == DEGRADED:
             model_cfg = self._degraded_model_cfg
@@ -742,6 +803,24 @@ class ServingFleet:
             self.flights.begin(trace_id, length=len(seq),
                                priority=str(priority))
 
+            # feature reuse from the artifact store (ISSUE 17): the
+            # generalization of the `features` ride-along — a bundle any
+            # replica (or a previous submission, retry, or process
+            # sharing the disk tier) already computed is fetched instead
+            # of re-featurized, bypassing the tier and the inline path
+            # alike. Seq-only requests only: an MSA submission's raw
+            # arrays are unvalidated before featurize_request, so their
+            # content key is not yet well-defined.
+            feat_key = None
+            if features is None and self._store is not None and msa is None:
+                ftag = self._feature_tag()
+                feat_key = request_key(seq.strip().upper(), None, ftag)
+                hit = self._store.lookup_features(ftag, feat_key)
+                if hit is not None:
+                    features, level = hit
+                    self.flights.note(trace_id, "features_from_store",
+                                      level=level)
+
             if features is None and self._featurize is None:
                 # no tier: featurize inline on the submit thread (the
                 # pre-tier contract — same function, same errors). The
@@ -763,6 +842,8 @@ class ServingFleet:
                     self._count_error(e)
                     self.flights.finish(trace_id, "failed", code=e.code)
                     raise
+                if feat_key is not None:
+                    self._store.put_features(ftag, feat_key, features)
             if features is not None:
                 if features.length > self._ladder.max_len:
                     # a client-built bundle is untrusted: a length past
@@ -789,6 +870,8 @@ class ServingFleet:
             entry = FleetRequest(seq, msa, msa_mask,
                                  resolve_priority(priority), deadline,
                                  trace_id=trace_id)
+            if feat_key is not None:
+                entry.feat_store_key = (ftag, feat_key)
             self._counts["submitted"].inc()
             self.flights.note(trace_id, "featurize_enqueue")
             try:
@@ -833,6 +916,8 @@ class ServingFleet:
             return
         entry.features = bundle
         entry.seq = bundle.seq
+        if entry.feat_store_key is not None and self._store is not None:
+            self._store.put_features(*entry.feat_store_key, bundle)
         self.flights.note(entry.trace_id, "featurized",
                           bucket=bundle.bucket)
         self._admit(entry, raise_on_full=False)
@@ -867,6 +952,41 @@ class ServingFleet:
         return float(min(acfg.max_retry_after_s,
                          max(acfg.min_retry_after_s, est)))
 
+    def _front_door(self, entry: FleetRequest) -> bool:
+        """The fleet front door (ISSUE 17): artifact-store result lookup
+        then cross-pool coalescing, after featurization but BEFORE pool
+        routing. Returns True if the entry was fully handled here — hit
+        served, or attached as a follower of an identical in-flight
+        leader — and must not be admitted. Runs on the caller's thread
+        (sync submit or featurize-tier callback); all store I/O is
+        lock-free with respect to the fleet lock."""
+        if (self._store is None or self._frontdoor is None
+                or entry.pool is None or entry.features is None):
+            return False
+        f = entry.features
+        tag = self._store_tag(entry.pool)
+        key = request_key(f.seq, f.msa, tag, msa_mask=f.msa_mask)
+        entry.store_key = (tag, key)
+        hit = self._store.lookup_result(tag, key)
+        if hit is not None:
+            cached, level = hit
+            latency = time.monotonic() - entry.enqueued_at
+            if entry._finish(result=cached, replica="", degraded=False,
+                             latency_s=latency):
+                self._counts["completed"].inc()
+                self._latency.observe(latency)
+                self.flights.finish(
+                    entry.trace_id, "completed", pool=entry.pool,
+                    from_cache=True, cache_tier="artifact_store",
+                    cache_level=level, bucket=cached.bucket,
+                    latency_s=round(latency, 6))
+            return True
+        if not self._frontdoor.register((tag, key), entry):
+            entry.coalesced = True
+            self.flights.note(entry.trace_id, "coalesced", pool=entry.pool)
+            return True
+        return False
+
     def _admit(self, entry: FleetRequest, *, raise_on_full: bool):
         """Offer an accepted entry to the admission queue; shed/eviction
         accounting in one place for the sync and async entry paths."""
@@ -877,6 +997,13 @@ class ServingFleet:
         length = (entry.features.length if entry.features is not None
                   else len(entry.seq))
         entry.pool = self._preferred_pool_name(length)
+        if self._front_door(entry):
+            # served from the artifact store or attached to an identical
+            # in-flight leader — the entry never reaches the admission
+            # queue, and deliberately never counts as pool ARRIVAL: the
+            # headroom model measures demand on CHIP capacity, and
+            # cache-absorbed demand is exactly the demand that costs none
+            return
         if entry.pool is not None:
             # the ARRIVAL half of the headroom model (sample_gauges
             # derives rates): demand is counted where it is admitted,
@@ -906,6 +1033,9 @@ class ServingFleet:
                 # explaining) as forever in flight
                 self.flights.finish(entry.trace_id, "shed",
                                     reason="queue_full", code=e.code)
+                # a shed LEADER's followers must shed with it (the
+                # raise skips _resolve_shed, so settle here)
+                self._settle_waiters(entry, exc=e)
                 raise e from None
             self._resolve_shed(entry, "queue_full", e)
             return
@@ -1113,6 +1243,24 @@ class ServingFleet:
         # the shared cost plane's gauges ride the same tick
         self.costs.publish()
         self.goodput.publish()
+        if self._store is not None:
+            self._store.publish_gauges()
+        # the AMORTIZED fleet economy: cumulative chip-seconds over ALL
+        # completed requests, cache/coalesce hits included. The per-cell
+        # serve_chip_seconds_per_request gauge is an EMA over DISPATCHED
+        # batches and cannot drop when a request never touches a chip —
+        # this one is what the artifact store actually moves, and what
+        # the ISSUE 17 telemetry.check gate reads from bench artifacts.
+        completed = int(self._counts["completed"].value)
+        if completed > 0:
+            self.registry.gauge(
+                "fleet_chip_seconds_per_request",
+                help="cumulative device-seconds x chips across every "
+                     "executable, amortized over completed requests "
+                     "(artifact-store hits and coalesced followers "
+                     "complete without spending chip time, so this "
+                     "drops as the fleet memoizes)",
+            ).set(self.costs.fleet_chip_seconds_total() / completed)
         if self._featurize is not None:
             self._featurize.sample_gauges()
 
@@ -1248,6 +1396,15 @@ class ServingFleet:
                     self._degraded_rep.cfg = dataclasses.replace(
                         self._degraded_rep.cfg, params_tag=params_tag)
             degraded = self._degraded_rep
+        if self._store is not None:
+            # re-key the fleet artifact tier the moment the tags change —
+            # BEFORE cycling replicas, so no window exists where a
+            # new-weights replica could read an old-tag entry. In-flight
+            # old-tag leaders still settle their coalitions (settle keys
+            # on the entry's stamped store_key, not the current tags);
+            # their put_result lands under a retired tag and the sweep
+            # below (plus the periodic budget sweep) reclaims it.
+            self._store.set_current_tags(self._current_store_tags())
         summary = {}
         for rep in reps:
             try:
@@ -1277,6 +1434,10 @@ class ServingFleet:
                 old.shutdown(drain=False,
                              timeout=self.cfg.drain_timeout_s)
             degraded.engine = degraded.factory()
+        if self._store is not None:
+            # GC the retired deploy's keyspace from disk right away
+            # rather than waiting for the next budget sweep
+            self._store.sweep()
         return summary
 
     def health(self) -> dict:
@@ -1406,6 +1567,10 @@ class ServingFleet:
                 "spans": self._tracer.summary(),
             },
         }
+        if self._store is not None:
+            out["artifact_store"] = self._store.snapshot()
+        if self._frontdoor is not None:
+            out["frontdoor"] = self._frontdoor.snapshot()
         if self._featurize is not None:
             out["featurize"] = self._featurize.stats()
         if self._autoscaler is not None:
@@ -1455,6 +1620,15 @@ class ServingFleet:
         for entry in self._admission.drain():
             self._resolve_failed(entry, EngineClosedError(
                 "fleet shut down before the request was served"))
+        if self._frontdoor is not None:
+            # every leader above settled its own coalition through a
+            # terminal path; this catches followers whose leader never
+            # reached one (e.g. stranded mid-submit) — nothing is left
+            # unresolved, the front-door promise included
+            for entry in self._frontdoor.drain():
+                self._resolve_failed(entry, EngineClosedError(
+                    "fleet shut down before the coalesced request was "
+                    "served"))
 
     def __enter__(self):
         return self
@@ -1683,6 +1857,9 @@ class ServingFleet:
                     from_cache=result.from_cache, bucket=result.bucket,
                     latency_s=round(
                         time.monotonic() - entry.enqueued_at, 6))
+            # settle even when _finish lost a race (the result is still
+            # the coalition's answer) — store put + follower resolution
+            self._settle_waiters(entry, result=result, rep=rep)
             return
         if isinstance(exc, RequestTimeoutError):
             # the request's OWN deadline expired inside the replica —
@@ -1758,6 +1935,7 @@ class ServingFleet:
             self.flights.finish(entry.trace_id, "shed", reason=reason,
                                 code=getattr(exc, "code", "serving_error"),
                                 requeues=entry.requeues)
+            self._settle_waiters(entry, exc=exc)
             return True
         return False
 
@@ -1770,8 +1948,71 @@ class ServingFleet:
                                 code=getattr(exc, "code",
                                              type(exc).__name__),
                                 requeues=entry.requeues)
+            self._settle_waiters(entry, exc=exc)
             return True
         return False
+
+    def _settle_waiters(self, entry: FleetRequest, *, result=None,
+                        rep: Optional[_Replica] = None,
+                        exc: Optional[BaseException] = None):
+        """Settle the coalition `entry` leads, at its terminal path:
+        persist a successful full-fidelity result into the artifact
+        store and resolve every follower with the same outcome. Runs on
+        whatever thread resolved the leader; never under the fleet lock.
+        Followers never settle (their `coalesced` flag short-circuits),
+        so a follower failing through _resolve_failed cannot pop a NEW
+        leader's coalition registered under the same key after ours."""
+        if (self._frontdoor is None or entry.store_key is None
+                or entry.coalesced):
+            return
+        tag, key = entry.store_key
+        degraded = rep is not None and rep.name == DEGRADED
+        if result is not None and rep is not None and not degraded:
+            # persist under the tag of the pool that actually SERVED the
+            # request: a failover to another pool means another weight
+            # precision / SP plan, i.e. another keyspace — storing it
+            # under the preferred pool's tag would alias wrong numerics
+            if rep.pool != entry.pool and rep.pool in self._pools:
+                tag = self._store_tag(rep.pool)
+                f = entry.features
+                key = request_key(f.seq, f.msa, tag, msa_mask=f.msa_mask)
+            # normalize provenance before persisting: a cached artifact
+            # carries no replica/latency history (each reader's result()
+            # copy re-stamps its own), and from_cache=True by decode
+            self._store.put_result(tag, key, dataclasses.replace(
+                result, from_cache=True, latency_s=0.0, replica="",
+                degraded=False, requeues=0, trace_id=""))
+        followers = self._frontdoor.settle(entry.store_key)
+        # followers are served BY the coalition, not by a dispatch of
+        # their own — their copy reads from_cache=True like a store hit
+        shared = (None if result is None
+                  else dataclasses.replace(result, from_cache=True))
+        for follower in followers:
+            if shared is not None and rep is not None:
+                latency = time.monotonic() - follower.enqueued_at
+                if follower._finish(result=shared, replica=rep.name,
+                                    degraded=degraded, latency_s=latency):
+                    self._counts["completed"].inc()
+                    self._latency.observe(latency)
+                    if degraded:
+                        self._degraded_total.inc()
+                    self.flights.finish(
+                        follower.trace_id, "completed", replica=rep.name,
+                        pool=rep.pool, degraded=degraded, coalesced=True,
+                        leader=entry.trace_id, from_cache=True,
+                        bucket=result.bucket, latency_s=round(latency, 6))
+            elif isinstance(exc, QueueFullError):
+                self._resolve_shed(follower, "coalesced_leader_shed", exc)
+            elif isinstance(exc, RequestTimeoutError):
+                # the LEADER's deadline expired; followers carry their
+                # own deadlines, but without a leader there is nothing
+                # left in flight to serve them — shed with retry advice
+                self._resolve_shed(follower, "coalesced_leader_deadline",
+                                   exc)
+            else:
+                self._resolve_failed(
+                    follower, exc if exc is not None else ServingError(
+                        "coalesced leader resolved without an outcome"))
 
     # -------------------------------------------------- health callbacks
 

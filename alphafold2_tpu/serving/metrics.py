@@ -47,6 +47,11 @@ _COUNTERS = (
     "rejected",       # refused at submit(): queue full / too long / invalid
     "cache_hits",     # completed without touching the queue or the model
     "coalesced",      # submission attached to an identical in-flight request
+    #                   — PER ENGINE. The fleet-level twin is
+    #                   `fleet_coalesced_total` (serving/frontdoor.py):
+    #                   identical requests collapsed ACROSS replicas and
+    #                   pools before routing; artifact-store hit/corrupt
+    #                   volume rides `artifact_store_*` / `cache_corrupt_total`
 )
 
 

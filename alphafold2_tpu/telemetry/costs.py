@@ -255,6 +255,17 @@ class ExecutableCostLedger:
             return None
         return reqs / secs
 
+    def fleet_chip_seconds_total(self) -> float:
+        """Cumulative CHIP-seconds spent executing, summed over every
+        cell: `device_seconds` is x1 wall per batch, so each cell scales
+        by its chip count. An extensive quantity — the numerator of the
+        fleet-amortized `fleet_chip_seconds_per_request` gauge, which is
+        what the artifact-store/coalescing tier (ISSUE 17) actually
+        lowers: cache hits complete requests without adding here."""
+        with self._lock:
+            return sum(c.device_seconds * c.chips
+                       for c in self._cells.values())
+
     def snapshot(self) -> dict:
         with self._lock:
             peak = self._peak

@@ -19,13 +19,23 @@ endpoints):
                   load balancers need the status CODE, not JSON parsing.
   * ``/statusz``  the deep-dive JSON: health + full stats snapshot +
                   registry snapshot + span summary + SLO state + flight
-                  recorder state.
+                  recorder state. A store-armed fleet's stats carry the
+                  ``artifact_store`` (hit/miss/corrupt/byte view) and
+                  ``frontdoor`` (in-flight keys, waiting followers)
+                  sections — the first place to look when the cache hit
+                  rate moves (docs/OPERATIONS.md runbook).
   * ``/explainz`` exemplar flight lookup (`?trace_id=<id>`): the full
                   per-request flight record from a `telemetry.costs.
                   FlightBook` — every lifecycle event across featurize
-                  tier, admission, and replicas. Without a trace_id it
-                  answers 400 with the most recent ids; an unknown id is
-                  404. Absent entirely (no flight book wired) it is 404.
+                  tier, admission, and replicas. Cache provenance rides
+                  the terminal event: an artifact-store hit finishes
+                  with ``cache_tier="artifact_store"`` + its level
+                  (memory/disk), a coalesced follower with
+                  ``coalesced=true`` + its leader's trace_id, and
+                  store-served features note ``features_from_store``.
+                  Without a trace_id it answers 400 with the most
+                  recent ids; an unknown id is 404. Absent entirely (no
+                  flight book wired) it is 404.
   * ``/profilez`` on-demand `jax.profiler` capture (`?duration_s=N`,
                   bounded and rate-limited — see `ProfileCapturer`):
                   200 with the capture directory when started, 409 while

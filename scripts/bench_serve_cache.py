@@ -1,0 +1,157 @@
+"""A/B bench: the fleet artifact store under a duplicate-heavy trace.
+
+Measures what ISSUE 17 gates on — `serve_chip_seconds_per_request`
+amortized over a recorded trace where every unique sequence is submitted
+REPEATS (>= 3) times, the redundancy profile of real traffic (popular
+proteins, proteome sweeps, retried submissions). Two arms over the SAME
+trace and the SAME tiny-but-real fleet (real engines, real executables,
+CPU backend):
+
+  off  — store disabled: every repeat dispatches to a chip.
+  on   — ArtifactStore (hot ring + disk tier in a tempdir): repeats are
+         served from the store; only the first submission of each unique
+         sequence touches an executable.
+
+Each arm writes a raw-bench-line artifact (`load_metrics`-compatible) to
+BENCH_serve_cache_off.json / BENCH_serve_cache_on.json at the repo root,
+then the telemetry.check improvement-floor gate runs in-process:
+
+    *chip_seconds_per_request* = lower : -0.30
+
+i.e. the store arm must CUT amortized chip-seconds per request by >= 30%
+or this script exits nonzero. The equivalent CI command over the
+committed artifacts:
+
+    python -m alphafold2_tpu.telemetry.check \
+        --current BENCH_serve_cache_on.json \
+        --baseline BENCH_serve_cache_off.json \
+        --rule '*chip_seconds_per_request*=lower:-0.30'
+
+Chip-free by design: device-seconds come from the PR 15 executable cost
+ledger, which prices whatever backend ran the dispatch — the RATIO the
+gate checks is backend-independent (it counts dispatches avoided).
+
+Usage: python scripts/bench_serve_cache.py [--unique N] [--repeats N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from alphafold2_tpu.constants import AA_ORDER  # noqa: E402
+from alphafold2_tpu.models import Alphafold2Config, alphafold2_init  # noqa: E402
+from alphafold2_tpu.serving import (  # noqa: E402
+    ArtifactStore,
+    ArtifactStoreConfig,
+    FleetConfig,
+    ServingConfig,
+    ServingFleet,
+)
+from alphafold2_tpu.telemetry.check import check  # noqa: E402
+
+TINY = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8, max_seq_len=16)
+AA = AA_ORDER.replace("W", "")
+GATE = [("*chip_seconds_per_request*", "lower", -0.30)]
+
+
+def seq_of(length: int, offset: int = 0) -> str:
+    return "".join(AA[(offset + i) % len(AA)] for i in range(length))
+
+
+def run_arm(params, store, n_unique: int, repeats: int) -> dict:
+    """One arm: fresh fleet (default engine factory, so the shared fleet
+    cost ledger prices every dispatch), the duplicate-heavy trace run
+    sequentially so the store arm exercises HITS, not just coalescing."""
+    fleet = ServingFleet(
+        params, TINY,
+        ServingConfig(buckets=(8, 16), max_batch=2, max_queue=16,
+                      max_wait_s=0.0, request_timeout_s=60.0,
+                      cache_capacity=0),
+        FleetConfig(replicas=1, probe_interval_s=0, reprobe_interval_s=30.0),
+        artifact_store=store)
+    try:
+        seqs = [seq_of(6 + i % 8, offset=i) for i in range(n_unique)]
+        n = 0
+        for _ in range(repeats):
+            for seq in seqs:
+                fleet.predict(seq)
+                n += 1
+        stats = fleet.stats()
+        completed = stats["requests"]["completed"]
+        assert completed == n, (completed, n)
+        chip_s = fleet.costs.fleet_chip_seconds_total()
+        row = {
+            "metric": "serve_chip_seconds_per_request",
+            "value": chip_s / completed,
+            "unit": "chip-seconds/request",
+            "backend": jax.default_backend(),
+            "requests": float(completed),
+            "unique": float(n_unique),
+            "repeats": float(repeats),
+            "chip_seconds_total": chip_s,
+        }
+        if store is not None:
+            snap = stats["artifact_store"]
+            row["store_hits"] = float(snap["hits_memory"]
+                                      + snap["hits_disk"])
+            row["store_hit_rate"] = snap["hit_rate"]
+        return row
+    finally:
+        fleet.shutdown()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--unique", type=int, default=4,
+                    help="unique sequences in the trace (default 4)")
+    ap.add_argument("--repeats", type=int, default=4,
+                    help="times each unique sequence is submitted "
+                         "(default 4; the gate's premise needs >= 3)")
+    args = ap.parse_args()
+    if args.repeats < 3:
+        ap.error("--repeats must be >= 3 (the duplicate-heavy premise)")
+
+    params = alphafold2_init(jax.random.PRNGKey(0), TINY)
+
+    print(f"trace: {args.unique} unique x {args.repeats} repeats "
+          f"({args.unique * args.repeats} requests) on "
+          f"{jax.default_backend()}")
+    baseline = run_arm(params, None, args.unique, args.repeats)
+    print(f"  off: {baseline['value']:.6f} chip-s/request")
+    with tempfile.TemporaryDirectory(prefix="af2store-bench-") as root:
+        store = ArtifactStore(ArtifactStoreConfig(root=root))
+        current = run_arm(params, store, args.unique, args.repeats)
+    print(f"  on:  {current['value']:.6f} chip-s/request "
+          f"(hit rate {current.get('store_hit_rate', 0.0):.2f})")
+
+    for name, row in (("BENCH_serve_cache_off.json", baseline),
+                      ("BENCH_serve_cache_on.json", current)):
+        path = os.path.join(REPO, name)
+        with open(path, "w") as fh:
+            json.dump(row, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {path}")
+
+    passed, rows = check(current, baseline, rules=GATE)
+    gated = next(r for r in rows
+                 if r["metric"] == "serve_chip_seconds_per_request")
+    print(f"gate *chip_seconds_per_request*=lower:-0.30 -> "
+          f"change {gated['change']:+.1%} "
+          f"[{'PASS' if passed else 'FAIL'}]")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -280,6 +280,26 @@ def main():
                     help="declared per-chip peak TFLOP/s for the "
                          "serve_mfu cost-ledger gauge (unset = publish "
                          "achieved FLOP/s only)")
+    ap.add_argument("--artifact-store", default="off", metavar="DIR",
+                    help="fleet-wide content-addressed result/feature "
+                         "cache with front-door coalescing "
+                         "(docs/OPERATIONS.md): a directory for the "
+                         "disk tier, 'auto' (sibling 'artifacts/' dir "
+                         "next to --flight-dir, memory-only without "
+                         "one), or 'off' (default). Fleet mode only")
+    ap.add_argument("--artifact-mem-entries", type=int, default=256,
+                    metavar="N",
+                    help="artifact-store hot-ring entry cap "
+                         "(default 256)")
+    ap.add_argument("--artifact-mem-mb", type=int, default=256,
+                    metavar="MB",
+                    help="artifact-store hot-ring byte budget "
+                         "(default 256 MB)")
+    ap.add_argument("--artifact-disk-mb", type=int, default=2048,
+                    metavar="MB",
+                    help="artifact-store disk-tier byte budget, "
+                         "enforced oldest-first by the sweep "
+                         "(default 2048 MB)")
     from alphafold2_tpu.telemetry import (
         add_telemetry_args,
         finish_trace,
@@ -312,6 +332,10 @@ def main():
         ap.error("--scale-grace requires --max-replicas")
     if args.featurize_workers < 0:
         ap.error("--featurize-workers must be >= 0")
+    if args.artifact_mem_entries < 1:
+        ap.error("--artifact-mem-entries must be >= 1")
+    if args.artifact_mem_mb < 1 or args.artifact_disk_mb < 1:
+        ap.error("--artifact-mem-mb / --artifact-disk-mb must be >= 1")
 
     # single-client tunnel discipline AFTER argparse (--help must not
     # block on the lock) — same stance as predict.py
@@ -484,6 +508,13 @@ def main():
             else (60.0 if fleet_mode else None)
         ),
     )
+    if args.artifact_store != "off" and not fleet_mode:
+        # the store intercepts at the FLEET front door (before routing);
+        # a single engine already has its own LRU + per-replica
+        # coalescing, so there is nothing for the fleet tier to collapse
+        print("WARNING: --artifact-store applies to fleet mode only "
+              "(--replicas > 1, pools, featurize tier, or autoscale); "
+              "single-engine mode keeps its per-engine result LRU")
     if fleet_mode:
         if logger is not None:
             # the per-batch JSONL stream is an engine-level concept (one
@@ -499,6 +530,34 @@ def main():
             max(1, args.mds_iters // 4) if args.degraded_iters < 0
             else args.degraded_iters
         )
+        artifact_store = None
+        if args.artifact_store != "off":
+            from alphafold2_tpu.serving import (
+                ArtifactStore,
+                ArtifactStoreConfig,
+            )
+
+            if args.artifact_store == "auto":
+                # sibling of --flight-dir (the ISSUE 17 layout: forensic
+                # bundles and the artifact tier share a volume), memory-
+                # only when no flight dir anchors one
+                store_root = (os.path.join(
+                    os.path.dirname(os.path.abspath(args.flight_dir)),
+                    "artifacts") if args.flight_dir else None)
+            else:
+                store_root = args.artifact_store
+            artifact_store = ArtifactStore(ArtifactStoreConfig(
+                root=store_root,
+                memory_entries=args.artifact_mem_entries,
+                memory_bytes=args.artifact_mem_mb << 20,
+                disk_bytes=args.artifact_disk_mb << 20,
+            ))
+            print("artifact store: "
+                  + (f"disk tier at {store_root}" if store_root
+                     else "memory-only (no --flight-dir to anchor "
+                          "'auto' disk tier)")
+                  + f", hot ring {args.artifact_mem_entries} entries / "
+                    f"{args.artifact_mem_mb} MB")
         engine = ServingFleet(
             params, cfg, serving_cfg,
             FleetConfig(
@@ -519,6 +578,7 @@ def main():
             injector=injector,
             tracer=tracer,
             incident_hook=recorder.incident if recorder else None,
+            artifact_store=artifact_store,
         )
         degraded_desc = ", ".join(
             ([f"mds_iters={degraded_iters}"] if degraded_iters else [])

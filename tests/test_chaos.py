@@ -504,15 +504,18 @@ def fleet_scfg(**overrides):
     return ServingConfig(**base)
 
 
-def fake_fleet(injector=None, scfg=None, **overrides):
+def fake_fleet(injector=None, scfg=None, artifact_store=None,
+               engine_factory=None, **overrides):
     """Fleet over stubbed engines; heartbeats off, fast reinstatement."""
     base = dict(replicas=2, probe_interval_s=0, reprobe_interval_s=0.05,
                 fail_threshold=1, requeue_limit=2)
     base.update(overrides)
+    factory = engine_factory or (
+        lambda n, c, h: FakeEngine({}, TINY, c, fault_hook=h))
     return ServingFleet(
         {}, TINY, scfg or fleet_scfg(), FleetConfig(**base),
-        engine_factory=lambda n, c, h: FakeEngine({}, TINY, c, fault_hook=h),
-        injector=injector,
+        engine_factory=factory, injector=injector,
+        artifact_store=artifact_store,
     )
 
 
@@ -882,3 +885,160 @@ def test_serve_cli_fleet_chaos_replay(tmp_path):
     counters = stats["telemetry"]["metrics"]["counters"]
     assert counters["fleet_requeue_total"] >= 1
     assert counters["fleet_degraded_total"] >= 1
+
+
+# ===========================================================================
+# fleet artifact store under disk chaos (ISSUE 17 satellite): a torn,
+# truncated, or poisoned on-disk entry — and a sweep racing a reader —
+# degrade to RECOMPUTE with cache_corrupt_total counting the event;
+# the tier never serves a wrong or partial answer.
+
+import os  # noqa: E402
+
+from alphafold2_tpu.analysis.lock_runtime import LockMonitor  # noqa: E402
+from alphafold2_tpu.serving import (  # noqa: E402
+    ArtifactStore,
+    ArtifactStoreConfig,
+    request_key,
+)
+from alphafold2_tpu.serving import artifact_store as _store_mod  # noqa: E402
+
+
+def _result_path_for(fleet, store, seq):
+    """On-disk artifact path for `seq` under the fleet's current result
+    tag, waiting for the settle-path write (it rides the dispatch
+    callback thread, AFTER the caller's future resolves)."""
+    tag = fleet._store_tag(next(iter(fleet._pools)))
+    path = store._path("result", tag, request_key(seq, None, tag))
+    deadline = time.monotonic() + 10
+    while not os.path.exists(path) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert os.path.exists(path), "settle path never persisted the result"
+    return path
+
+
+@bounded(120)
+def test_store_disk_corruption_every_class_recomputes(tmp_path):
+    """Torn tail, truncated header, poisoned payload: each corruption
+    class is detected by the checksum frame, counted, quarantined, and
+    answered by a FRESH dispatch with correct numerics — then the next
+    request hits the re-persisted clean entry."""
+    dispatches = []
+
+    class CountingEngine(FakeEngine):
+        def _call_executable(self, *args, **kwargs):
+            dispatches.append(1)
+            return super()._call_executable(*args, **kwargs)
+
+    store = ArtifactStore(ArtifactStoreConfig(root=str(tmp_path),
+                                              memory_entries=0))
+    fleet = fake_fleet(
+        artifact_store=store,
+        engine_factory=lambda n, c, h: CountingEngine({}, TINY, c,
+                                                      fault_hook=h))
+    try:
+        corruptions = (
+            ("torn", lambda b: b[:-7]),
+            ("truncated", lambda b: b[:12]),
+            ("poisoned", lambda b: b[:-4] + bytes(x ^ 0xFF
+                                                  for x in b[-4:])),
+        )
+        for i, (_kind, mangle) in enumerate(corruptions):
+            seq = seq_of(6, offset=i)
+            r1 = fleet.predict(seq)
+            path = _result_path_for(fleet, store, seq)
+            with open(path, "rb") as fh:
+                blob = fh.read()
+            with open(path, "wb") as fh:
+                fh.write(mangle(blob))
+            before = len(dispatches)
+            corrupt_before = store.snapshot()["corrupt"]
+            r2 = fleet.predict(seq)
+            assert len(dispatches) == before + 1      # recomputed
+            assert not r2.from_cache
+            np.testing.assert_array_equal(r2.coords, r1.coords)
+            assert store.snapshot()["corrupt"] == corrupt_before + 1
+            # the recompute re-persisted a CLEAN entry: next hit is free
+            _result_path_for(fleet, store, seq)
+            r3 = fleet.predict(seq)
+            assert r3.from_cache and len(dispatches) == before + 1
+    finally:
+        fleet.shutdown()
+
+
+@bounded(60)
+def test_store_mid_read_eviction_recomputes(tmp_path, monkeypatch):
+    """A sweep (this process or a sibling on the same disk tier) unlinks
+    the entry BETWEEN the exists() check and the read: the documented
+    `_read_bytes` seam raises FileNotFoundError, the store counts it on
+    `cache_corrupt_total`, and the request recomputes — never hangs,
+    never errors outward."""
+    store = ArtifactStore(ArtifactStoreConfig(root=str(tmp_path),
+                                              memory_entries=0))
+    real_read = _store_mod._read_bytes
+    raced = []
+
+    def racing_read(path):
+        if not raced and f"{os.sep}result{os.sep}" in path:
+            raced.append(path)
+            os.unlink(path)                  # the "sweeper" wins the race
+            raise FileNotFoundError(path)
+        return real_read(path)
+
+    monkeypatch.setattr(_store_mod, "_read_bytes", racing_read)
+    fleet = fake_fleet(artifact_store=store)
+    try:
+        seq = seq_of(7)
+        r1 = fleet.predict(seq)
+        _result_path_for(fleet, store, seq)
+        r2 = fleet.predict(seq)              # read loses the race
+        assert raced
+        assert not r2.from_cache             # recomputed, not served torn
+        np.testing.assert_array_equal(r2.coords, r1.coords)
+        assert store.snapshot()["corrupt"] == 1
+        _result_path_for(fleet, store, seq)
+        r3 = fleet.predict(seq)              # re-persisted entry serves
+        assert r3.from_cache
+    finally:
+        fleet.shutdown()
+
+
+@bounded(120)
+def test_store_frontdoor_lock_order_acyclic_under_concurrency():
+    """Runtime validation of the af2lint CONC model for the new store +
+    front-door locks: instrument every Lock the two objects own, drive
+    duplicate-heavy concurrent traffic plus sweeps (the `_sweep_lock ->
+    _lock` edge), and assert the OBSERVED acquisition-order graph is
+    acyclic."""
+    mon = LockMonitor()
+    store = ArtifactStore(ArtifactStoreConfig(memory_entries=8,
+                                              sweep_every_writes=4))
+    mon.instrument(store)
+    fleet = fake_fleet(artifact_store=store)
+    mon.instrument(fleet._frontdoor)
+    errs = []
+
+    def client(k):
+        try:
+            for i in range(6):
+                fleet.predict(seq_of(6 + i % 3, offset=k % 4))
+        except Exception as e:  # noqa: BLE001 — surfaced via errs
+            errs.append(e)
+
+    try:
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for _ in range(5):
+            store.sweep()
+            time.sleep(0.01)
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs
+        mon.assert_acyclic()
+        snap = mon.snapshot()
+        assert snap["acquires"].get("ArtifactStore._lock", 0) > 0
+        assert snap["acquires"].get("FrontDoor._lock", 0) > 0
+    finally:
+        fleet.shutdown()
