@@ -39,8 +39,10 @@ from alphafold2_tpu.reliability.faults import (
 )
 from alphafold2_tpu.reliability.health import HealthMonitor, ReplicaState
 from alphafold2_tpu.reliability.preemption import Preempted, PreemptionHandler
+from alphafold2_tpu.reliability.retry_budget import RetryBudget
 
 __all__ = [
+    "RetryBudget",
     "FAULT_KINDS",
     "REPLICA_FAULT_KINDS",
     "Fault",

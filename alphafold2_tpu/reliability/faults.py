@@ -84,10 +84,21 @@ FAULT_KINDS = (
     "scale_flap",       # autoscaler: force alternating up/down demands at
     #                     tick index `at` (`count` forced demands) — the
     #                     hysteresis window must absorb them
+    "crash_process",    # kill -9 the WHOLE serving process (os._exit 137)
+    #                     at process-wide dispatch index `at` — the intake
+    #                     journal must replay every accepted-but-unsettled
+    #                     request after restart
+    "straggle_dispatch",  # named fleet replica: sleep `delay_s` per
+    #                     dispatch, `count` deliveries — a long-tail
+    #                     straggler that eventually SUCCEEDS (unlike
+    #                     slow_replica's transient slowness, this is the
+    #                     hedged-dispatch trigger: delay_s sits far past
+    #                     the pool's p95)
 )
 
 #: kinds that target one named fleet replica and require `replica`
-REPLICA_FAULT_KINDS = ("kill_replica", "slow_replica", "flap_replica")
+REPLICA_FAULT_KINDS = ("kill_replica", "slow_replica", "flap_replica",
+                       "straggle_dispatch")
 
 _CKPT_MODES = ("truncate", "corrupt", "no_manifest")
 
@@ -235,6 +246,9 @@ class FaultInjector:
         self._replica_dispatch = {}  # replica name -> injector-side counter
         self._preemption = None  # bound PreemptionHandler for `preempt`
         self.delivered: List[str] = []  # audit log of delivered faults
+        # precomputed so fault-free dispatch hooks skip the extra lock
+        # roundtrip the process-wide crash counter would cost
+        self._has_crash = any(f.kind == "crash_process" for f in plan.faults)
 
     def bind_preemption(self, handler):
         """Attach the PreemptionHandler that `preempt` faults trip (the
@@ -340,6 +354,24 @@ class FaultInjector:
 
         return hook
 
+    def _maybe_crash(self):
+        """Deliver a scheduled `crash_process`: die the way `kill -9` does
+        — no atexit, no flushing, exit code 137 — at the PROCESS-wide
+        dispatch index. Every serving dispatch advances the counter (the
+        single-engine `serving_hook` and every fleet `replica_hook` feed
+        one shared `__process__` counter), so "crash with N requests in
+        flight" is a deterministic plan, not a sleep race. The intake
+        journal (serving/journal.py) is what must survive this."""
+        if not self._has_crash:
+            return
+        with self._lock:
+            index = self._replica_dispatch.get("__process__", 0)
+            self._replica_dispatch["__process__"] = index + 1
+        if self._take("crash_process", index) is not None:
+            import os
+
+            os._exit(137)
+
     # -- hook: serving dispatch (serving/engine.py) --------------------------
 
     def serving_hook(self):
@@ -348,6 +380,7 @@ class FaultInjector:
         import time
 
         def hook(index: int, bucket: int):
+            self._maybe_crash()
             f = self._take("slow_request", index)
             if f is not None:
                 time.sleep(f.delay_s)
@@ -375,11 +408,18 @@ class FaultInjector:
         import time
 
         def hook(engine_index: int, bucket: int):
+            self._maybe_crash()
             with self._lock:
                 index = self._replica_dispatch.get(name, 0)
                 self._replica_dispatch[name] = index + 1
             f = self._take("slow_replica", index, replica=name)
             if f is not None:
+                time.sleep(f.delay_s)
+            f = self._take("straggle_dispatch", index, replica=name)
+            if f is not None:
+                # long-tail straggler: stall the dispatch but let it
+                # SUCCEED — the hedge timer, not the failure path, is
+                # what should beat it
                 time.sleep(f.delay_s)
             f = self._take("kill_replica", index, replica=name)
             if f is not None:
@@ -468,8 +508,10 @@ def _check_main(argv=None) -> int:
         if f.kind == "ckpt_corrupt":
             extra.append(f"mode={f.mode}")
         if f.kind in ("slow_request", "slow_replica", "slow_featurize",
-                      "slow_data"):
+                      "slow_data", "straggle_dispatch"):
             extra.append(f"delay_s={f.delay_s}")
+        if f.kind == "crash_process":
+            extra.append("exit=137")
         if f.kind == "hung_request":
             extra.append(f"hang_s={f.hang_s}")
         count = "latched" if f.kind == "kill_replica" else f"count={f.count}"

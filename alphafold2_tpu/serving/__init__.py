@@ -46,10 +46,12 @@ from alphafold2_tpu.serving.errors import (
     RequestTimeoutError,
     RequestTooLongError,
     RequeueLimitError,
+    RetryBudgetExhaustedError,
     SequenceTooLongError,
     ScaleRejectedError,
     ServingError,
 )
+from alphafold2_tpu.serving.journal import IntakeJournal, JournalRecord
 from alphafold2_tpu.serving.featurize import (
     FeatureBundle,
     FeaturizeConfig,
@@ -96,6 +98,8 @@ __all__ = [
     "featurize_request",
     "FleetConfig",
     "FleetRequest",
+    "IntakeJournal",
+    "JournalRecord",
     "PoolSpec",
     "SP_SCHEDULES",
     "choose_schedule",
@@ -120,6 +124,7 @@ __all__ = [
     "RequestTimeoutError",
     "RequestTooLongError",
     "RequeueLimitError",
+    "RetryBudgetExhaustedError",
     "SequenceTooLongError",
     "ScaleRejectedError",
     "ServingError",

@@ -34,6 +34,11 @@ class ServingError(Exception):
 
     code = "serving_error"
     retry_after_s: Optional[float] = None
+    #: HTTP status an HTTP front end should map this error to. Backpressure
+    #: sheds that carry retry advice override this with 429 so clients see
+    #: the standard Too Many Requests + Retry-After pairing; everything
+    #: else is a generic 500 unless a subclass says otherwise.
+    http_status = 500
 
     def __init__(self, *args, retry_after_s: Optional[float] = None):
         super().__init__(*args)
@@ -89,6 +94,7 @@ class QueueFullError(ServingError):
     tier can estimate its drain rate."""
 
     code = "queue_full"
+    http_status = 429
 
 
 class RequestTimeoutError(ServingError):
@@ -161,6 +167,22 @@ class FeaturizeError(ServingError):
     request, not that the request was malformed."""
 
     code = "featurize_failed"
+
+
+class RetryBudgetExhaustedError(ServingError):
+    """The fleet-wide retry budget (reliability/retry_budget.py) has no
+    tokens left: featurize requeues, replica-failover retries, and hedged
+    dispatches all draw from one token bucket refilled as a fraction of
+    successful completions, so a fleet-wide brownout degrades to this
+    fast typed shed instead of a retry storm that amplifies the outage.
+    Always carries `retry_after_s` — the bucket's estimate of when refill
+    (i.e. recovered success throughput) will have earned another token.
+    HTTP front ends map it to 429 + Retry-After (same contract as
+    `queue_full`); `fleet_shed_total{reason="retry_budget"}` counts it
+    fleet-side."""
+
+    code = "retry_budget_exhausted"
+    http_status = 429
 
 
 class ScaleRejectedError(ServingError):

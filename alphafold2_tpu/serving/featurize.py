@@ -58,6 +58,8 @@ from alphafold2_tpu.serving.errors import (
     FeaturizeError,
     InvalidSequenceError,
     QueueFullError,
+    RequestTimeoutError,
+    RetryBudgetExhaustedError,
     ServingError,
 )
 from alphafold2_tpu.telemetry import NULL_TRACER, MetricRegistry
@@ -162,9 +164,10 @@ class FeaturizeConfig:
 
 class _Job:
     __slots__ = ("seq", "msa", "msa_mask", "trace_id", "on_done",
-                 "retries", "enqueued_at")
+                 "retries", "enqueued_at", "deadline")
 
-    def __init__(self, seq, msa, msa_mask, trace_id, on_done):
+    def __init__(self, seq, msa, msa_mask, trace_id, on_done,
+                 deadline=None):
         self.seq = seq
         self.msa = msa
         self.msa_mask = msa_mask
@@ -172,6 +175,7 @@ class _Job:
         self.on_done = on_done
         self.retries = 0
         self.enqueued_at = time.monotonic()
+        self.deadline = deadline  # monotonic, or None
 
 
 class FeaturizePool:
@@ -194,7 +198,8 @@ class FeaturizePool:
     def __init__(self, cfg: FeaturizeConfig, ladder: BucketLadder, *,
                  msa_rows: int = 0,
                  registry: Optional[MetricRegistry] = None,
-                 tracer=None, fault_hook=None, incident_hook=None):
+                 tracer=None, fault_hook=None, incident_hook=None,
+                 retry_budget=None):
         self.cfg = cfg
         self._ladder = ladder
         self._msa_rows = msa_rows
@@ -202,6 +207,11 @@ class FeaturizePool:
         self._tracer = tracer if tracer is not None else NULL_TRACER
         self._fault_hook = fault_hook
         self._incident_hook = incident_hook
+        # optional shared reliability.RetryBudget: worker-death requeues
+        # draw from the same fleet-wide bucket as failovers and hedges —
+        # during a brownout the tier sheds instead of ping-ponging jobs
+        # through dying workers
+        self._retry_budget = retry_budget
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -232,6 +242,10 @@ class FeaturizePool:
             "featurize_busy_seconds_total",
             help="cumulative featurize worker busy seconds (the overlap "
                  "bench's CPU-side numerator)")
+        self._expired = self.registry.counter(
+            "featurize_expired_total",
+            help="jobs dropped before featurizing because their fleet "
+                 "deadline had already passed in the queue")
 
         for _ in range(cfg.workers):
             self._spawn_worker()
@@ -240,13 +254,18 @@ class FeaturizePool:
 
     def submit(self, seq: str, msa=None, msa_mask=None, *,
                trace_id: str = "",
+               deadline: Optional[float] = None,
                on_done: Callable[[Optional[FeatureBundle],
                                   Optional[BaseException]], None]):
         """Enqueue one featurization job; `on_done(bundle, exc)` runs
         exactly once, on a pool worker thread (or on the shutdown
         thread for jobs failed at close). Raises QueueFullError
         synchronously — featurize backpressure is explicit, like every
-        other queue in the serving stack."""
+        other queue in the serving stack. `deadline` (monotonic, the
+        fleet request's own) lets a worker drop a job whose deadline
+        passed while it queued — dead-on-arrival work never burns a
+        featurize slot (`featurize_expired_total`; the job finishes with
+        RequestTimeoutError)."""
         with self._lock:
             if self._closed:
                 raise EngineClosedError("featurize pool is shut down")
@@ -257,7 +276,8 @@ class FeaturizePool:
                     retry_after_s=self._retry_after_locked(),
                 )
             self._counts["submitted"].inc()
-            self._jobs.append(_Job(seq, msa, msa_mask, trace_id, on_done))
+            self._jobs.append(_Job(seq, msa, msa_mask, trace_id, on_done,
+                                   deadline))
             self._cond.notify()
 
     def depth(self) -> int:
@@ -369,6 +389,15 @@ class FeaturizePool:
         if self._tracer.enabled:
             self._tracer.add("featurize.queue_wait", wait, cat="featurize",
                              trace_id=job.trace_id)
+        if job.deadline is not None and time.monotonic() >= job.deadline:
+            # the fleet deadline passed while the job queued: CPU spent
+            # featurizing it would be pure waste — drop before the work,
+            # with the same typed timeout the dispatch path would raise
+            self._expired.inc()
+            self._finish(job, None, RequestTimeoutError(
+                f"deadline passed after {wait:.3f}s in the featurize "
+                f"queue", retry_after_s=self.retry_after_s()))
+            return
         t0 = time.monotonic()
         try:
             with self._tracer.span("featurize.run", cat="featurize",
@@ -425,6 +454,15 @@ class FeaturizePool:
                 f"death(s) (retry_limit {self.cfg.retry_limit})")
             err.__cause__ = death.cause
             self._finish(job, None, err)
+            return
+        if (self._retry_budget is not None
+                and not self._retry_budget.try_spend("featurize")):
+            # fleet-wide brownout: the requeue would be amplification —
+            # shed the job with honest backoff advice instead
+            self._finish(job, None, RetryBudgetExhaustedError(
+                "featurize requeue denied: fleet-wide retry budget "
+                "exhausted",
+                retry_after_s=self._retry_budget.retry_after_s()))
             return
         job.retries += 1
         self._counts["requeued"].inc()

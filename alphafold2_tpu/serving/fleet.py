@@ -76,6 +76,8 @@ from alphafold2_tpu.serving.engine import (
     ServingEngine,
 )
 from alphafold2_tpu.serving.frontdoor import FrontDoor
+from alphafold2_tpu.serving.journal import IntakeJournal
+from alphafold2_tpu.reliability.retry_budget import RetryBudget
 from alphafold2_tpu.serving.errors import (
     CircuitOpenError,
     EngineClosedError,
@@ -85,6 +87,7 @@ from alphafold2_tpu.serving.errors import (
     QueueFullError,
     RequestTimeoutError,
     RequeueLimitError,
+    RetryBudgetExhaustedError,
     ScaleRejectedError,
     SequenceTooLongError,
     ServingError,
@@ -221,6 +224,27 @@ class FleetConfig:
     # prefers the cheapest capable pool, and the per-pool autoscalers
     # scale each pool off its own queue-wait signal.
     pools: tuple = ()
+    # Fleet-wide retry budget (ISSUE 18): >0 arms a token bucket (one per
+    # fleet, reliability/retry_budget.py) that featurize requeues,
+    # replica-failover requeues, and hedged dispatches ALL draw from,
+    # refilled `retry_budget_refill` tokens per successful completion. A
+    # drained bucket degrades retries into fast typed
+    # RetryBudgetExhaustedError sheds instead of a retry storm. 0 keeps
+    # retries unmetered (the pre-budget fleet, behavior-identical).
+    retry_budget_capacity: int = 0
+    retry_budget_refill: float = 0.1
+    # Hedged dispatch (ISSUE 18): >0 arms a hedge timer — a dispatch
+    # outstanding longer than `hedge_p95_factor` x its pool's service-time
+    # p95 (floored at `hedge_min_delay_s`, armed only after
+    # `hedge_min_samples` completions have been measured) gets ONE
+    # budgeted duplicate dispatch on another healthy capable replica;
+    # first settle wins, the loser's chip-seconds count into
+    # `hedge_wasted_chip_seconds_total`. Total hedges stay under
+    # `hedge_rate_cap` x dispatches. 0 disables hedging entirely.
+    hedge_p95_factor: float = 0.0
+    hedge_min_delay_s: float = 0.05
+    hedge_rate_cap: float = 0.1
+    hedge_min_samples: int = 8
 
     def __post_init__(self):
         if self.replicas < 1:
@@ -245,6 +269,31 @@ class FleetConfig:
             raise ValueError(
                 "featurize_workers must be >= 0 and featurize_queue >= 1, "
                 f"got {self.featurize_workers}/{self.featurize_queue}"
+            )
+        if self.retry_budget_capacity < 0:
+            raise ValueError(
+                f"retry_budget_capacity must be >= 0, "
+                f"got {self.retry_budget_capacity}"
+            )
+        if not (0.0 < self.retry_budget_refill <= 1.0):
+            raise ValueError(
+                f"retry_budget_refill must be in (0, 1], "
+                f"got {self.retry_budget_refill}"
+            )
+        if self.hedge_p95_factor < 0:
+            raise ValueError(
+                f"hedge_p95_factor must be >= 0 (0 disables hedging), "
+                f"got {self.hedge_p95_factor}"
+            )
+        if self.hedge_min_delay_s <= 0 or self.hedge_min_samples < 1:
+            raise ValueError(
+                "hedge_min_delay_s must be > 0 and hedge_min_samples >= 1, "
+                f"got {self.hedge_min_delay_s}/{self.hedge_min_samples}"
+            )
+        if not (0.0 < self.hedge_rate_cap <= 1.0):
+            raise ValueError(
+                f"hedge_rate_cap must be in (0, 1], "
+                f"got {self.hedge_rate_cap}"
             )
 
 
@@ -278,6 +327,11 @@ class FleetRequest:
         self.feat_store_key = None  # (tag, hash) to persist features under
         self.failed_on = set()   # replica names this request failed on
         self.last_error: Optional[BaseException] = None
+        self.hedges = 0          # hedged duplicate dispatches issued
+        # dispatches currently outstanding on replicas (fleet-lock
+        # guarded): with hedging, a failed twin must defer to the one
+        # still in flight instead of requeueing a request that may win
+        self.inflight_dispatches = 0
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._result: Optional[PredictionResult] = None
@@ -384,7 +438,8 @@ class ServingFleet:
                  engine_factory=None, model_apply_fn=None, injector=None,
                  tracer=None, registry: Optional[MetricRegistry] = None,
                  incident_hook=None,
-                 artifact_store: Optional[ArtifactStore] = None):
+                 artifact_store: Optional[ArtifactStore] = None,
+                 journal: Optional[IntakeJournal] = None):
         self.cfg = fleet_cfg
         self._params = params
         self._model_cfg = model_cfg
@@ -442,6 +497,29 @@ class ServingFleet:
         if self._store is not None:
             self._store.bind_registry(self.registry)
             self._store.set_current_tags(self._current_store_tags())
+
+        # ---- durable intake journal (ISSUE 18) ---- None keeps the
+        # in-memory-only request plane. With a journal, every accepted
+        # request is durably recorded at submit and settled (record
+        # unlinked) at its terminal path — `replay_journal()` after a
+        # restart pushes unsettled records back through submit, where
+        # front-door coalescing + the artifact store make the replay
+        # idempotent (at-least-once accepted->terminal, zero duplicate
+        # chip dispatch).
+        self._journal = journal
+        if journal is not None:
+            journal.bind_registry(self.registry)
+
+        # ---- fleet-wide retry budget (ISSUE 18) ---- one bucket for
+        # every internal retry kind; None = unmetered (pre-budget
+        # behavior). Lives in the fleet registry so /metrics carries the
+        # retry_budget_* families.
+        self._budget: Optional[RetryBudget] = None
+        if fleet_cfg.retry_budget_capacity > 0:
+            self._budget = RetryBudget(
+                fleet_cfg.retry_budget_capacity,
+                refill_ratio=fleet_cfg.retry_budget_refill,
+            ).bind_registry(self.registry)
 
         # ---- serving cost & profiling plane (telemetry/costs.py) ----
         # always on (dict bookkeeping, no model cost): the shared
@@ -534,6 +612,31 @@ class ServingFleet:
             for name in self._pools
         }
 
+        # ---- hedged dispatch (ISSUE 18) ---- per-pool replica SERVICE
+        # time (dispatch->completion, excludes queue wait: the hedge
+        # delay must measure how long a dispatch should take, not how
+        # long the queue was) + the outstanding-dispatch registry the
+        # hedge timer scans. `_hedge_lock` is a LEAF lock: dict ops only,
+        # never held across a call out, never nested with `_lock`.
+        self._pool_service = {
+            name: self.registry.histogram(
+                "fleet_pool_service_seconds",
+                help="replica service time (dispatch->completion) per "
+                     "capability pool; its p95 derives the hedge delay",
+                pool=name)
+            for name in self._pools
+        }
+        self._hedge_lock = threading.Lock()
+        self._outstanding = {}   # id(entry) -> primary-dispatch state
+        self._hedges_issued = 0  # lifetime, under _hedge_lock
+        self._hedge_denied = {}  # reason -> count, under _hedge_lock
+        self._hedge_counters = {}  # pool -> fleet_hedge_total, under _lock
+        self._dispatch_count = 0  # lifetime dispatches, under _lock
+        self._hedge_waste = self.registry.counter(
+            "hedge_wasted_chip_seconds_total",
+            help="chip-seconds spent by the LOSING side of hedged "
+                 "dispatch pairs (the price of the tail-latency cut)")
+
         # ---- replicas + health ----
         self._admission = AdmissionController(
             AdmissionConfig(capacity=fleet_cfg.queue_capacity))
@@ -565,6 +668,7 @@ class ServingFleet:
                 fault_hook=(injector.featurize_hook()
                             if injector is not None else None),
                 incident_hook=self._incident_hook,
+                retry_budget=self._budget,
             )
 
         self._degraded_rep: Optional[_Replica] = None
@@ -598,6 +702,12 @@ class ServingFleet:
             target=self._dispatch_loop, name="af2-fleet-dispatcher",
             daemon=True)
         self._dispatcher.start()
+        self._hedger: Optional[threading.Thread] = None
+        if fleet_cfg.hedge_p95_factor > 0:
+            self._hedger = threading.Thread(
+                target=self._hedge_loop, name="af2-fleet-hedger",
+                daemon=True)
+            self._hedger.start()
 
     # ------------------------------------------------------------ factories
 
@@ -803,6 +913,20 @@ class ServingFleet:
             self.flights.begin(trace_id, length=len(seq),
                                priority=str(priority))
 
+            # durable intake (ISSUE 18): record the request BEFORE any
+            # work happens — validation included, so a crash mid-
+            # featurize still replays (an invalid replay settles with
+            # the same typed error it would have settled with now). The
+            # journal stores the ABSOLUTE wall-clock deadline: a
+            # relative one would silently extend across a restart.
+            if self._journal is not None:
+                self._journal.accept(
+                    trace_id, seq, msa=msa, msa_mask=msa_mask,
+                    priority=resolve_priority(priority),
+                    deadline_unix=(time.time() + ttl
+                                   if ttl is not None else None),
+                    accepted_at_unix=time.time())
+
             # feature reuse from the artifact store (ISSUE 17): the
             # generalization of the `features` ride-along — a bundle any
             # replica (or a previous submission, retry, or process
@@ -837,10 +961,12 @@ class ServingFleet:
                 except SequenceTooLongError as e:
                     self._shed_too_long(e)
                     self.flights.finish(trace_id, "shed", code=e.code)
+                    self._journal_settle(trace_id)
                     raise
                 except ServingError as e:
                     self._count_error(e)
                     self.flights.finish(trace_id, "failed", code=e.code)
+                    self._journal_settle(trace_id)
                     raise
                 if feat_key is not None:
                     self._store.put_features(ftag, feat_key, features)
@@ -856,6 +982,7 @@ class ServingFleet:
                         f"({self._ladder.max_len})")
                     self._shed_too_long(e)
                     self.flights.finish(trace_id, "shed", code=e.code)
+                    self._journal_settle(trace_id)
                     raise e
                 entry = FleetRequest(features.seq, msa, msa_mask,
                                      resolve_priority(priority), deadline,
@@ -877,6 +1004,10 @@ class ServingFleet:
             try:
                 self._featurize.submit(
                     seq, msa, msa_mask, trace_id=trace_id,
+                    # fleet deadline rides into the CPU tier: a job whose
+                    # deadline passes while queued is dropped BEFORE
+                    # featurizing (featurize_expired_total)
+                    deadline=entry.deadline,
                     on_done=lambda bundle, exc, e=entry:
                     self._on_featurized(e, bundle, exc))
             except QueueFullError as e:
@@ -886,6 +1017,7 @@ class ServingFleet:
                 self._counts["shed"].inc()
                 self._count_error(e)
                 self.flights.finish(trace_id, "shed", code=e.code)
+                self._journal_settle(trace_id)
                 raise
             except EngineClosedError as e:
                 self._resolve_failed(entry, e)
@@ -911,6 +1043,15 @@ class ServingFleet:
                 # same sharp signal as the synchronous paths — the tier
                 # moves featurization across threads, never the taxonomy
                 self._resolve_shed(entry, "too_long", exc)
+            elif isinstance(exc, RequestTimeoutError):
+                # deadline passed while queued in the CPU tier — the
+                # tier's pre-featurize check (featurize_expired_total)
+                # dropped it before burning CPU
+                self._resolve_shed(entry, "deadline", exc)
+            elif isinstance(exc, RetryBudgetExhaustedError):
+                # a worker-death requeue was denied by the fleet-wide
+                # retry budget — brownout shed, not a request defect
+                self._resolve_shed(entry, "retry_budget", exc)
             else:
                 self._resolve_failed(entry, exc)
             return
@@ -980,6 +1121,7 @@ class ServingFleet:
                     from_cache=True, cache_tier="artifact_store",
                     cache_level=level, bucket=cached.bucket,
                     latency_s=round(latency, 6))
+                self._journal_settle(entry.trace_id)
             return True
         if not self._frontdoor.register((tag, key), entry):
             entry.coalesced = True
@@ -1033,6 +1175,7 @@ class ServingFleet:
                 # explaining) as forever in flight
                 self.flights.finish(entry.trace_id, "shed",
                                     reason="queue_full", code=e.code)
+                self._journal_settle(entry.trace_id)
                 # a shed LEADER's followers must shed with it (the
                 # raise skips _resolve_shed, so settle here)
                 self._settle_waiters(entry, exc=e)
@@ -1580,7 +1723,89 @@ class ServingFleet:
                 pool: sc.snapshot()
                 for pool, sc in sorted(self._pool_autoscalers.items())
             }
+        if self._journal is not None:
+            out["journal"] = self._journal.stats()
+        if self._budget is not None:
+            out["retry_budget"] = self._budget.snapshot()
+        if self._hedger is not None:
+            with self._hedge_lock:
+                out["hedging"] = {
+                    "issued": self._hedges_issued,
+                    "denied": dict(self._hedge_denied),
+                    "outstanding": len(self._outstanding),
+                    "wasted_chip_seconds": round(
+                        self._hedge_waste.value, 6),
+                }
         return out
+
+    def backpressure(self) -> dict:
+        """The shed-advice surface an HTTP front end quotes on 429s
+        (/statusz `backpressure` section): the global queue horizon,
+        per-pool horizons when capability pools are explicit, and the
+        retry-budget state when one is armed. Cheap enough to call per
+        scrape."""
+        out = {"queue_retry_after_s": round(
+            self._admission.retry_after_s(), 3)}
+        if not self._implicit_pools:
+            depth_by_pool = {}
+            for e in self._admission.entries():
+                p = getattr(e, "pool", None)
+                if p is not None:
+                    depth_by_pool[p] = depth_by_pool.get(p, 0) + 1
+            out["pools"] = {
+                name: round(self._pool_retry_after(
+                    name, depth=depth_by_pool.get(name, 0)), 3)
+                for name in self._pools
+            }
+        if self._budget is not None:
+            out["retry_budget"] = self._budget.snapshot()
+        return out
+
+    def replay_journal(self) -> dict:
+        """Re-drive every journaled-but-unsettled request through the
+        normal submit() path — call at startup, BEFORE admitting fresh
+        traffic. Idempotent by construction, not bookkeeping: a replayed
+        request re-enters front-door coalescing and the artifact store,
+        so work that completed before the crash replays as a store hit
+        and identical payloads coalesce — zero duplicate chip dispatch.
+        Records whose absolute deadline already passed settle directly
+        (journal_expired_total); a replay the submit path sheds/fails
+        synchronously is already sealed AND settled by that path.
+        Returns {replayed, expired, failed, requests} — `requests` holds
+        the live FleetRequest futures so a caller can await them."""
+        if self._journal is None:
+            return {"replayed": 0, "expired": 0, "failed": 0,
+                    "requests": []}
+        replayed = expired = failed = 0
+        requests = []
+        for rec in self._journal.pending():
+            if (rec.deadline_unix is not None
+                    and rec.deadline_unix <= time.time()):
+                self.registry.counter(
+                    "journal_expired_total",
+                    help="journal records dropped at replay because "
+                         "their deadline had already passed").inc()
+                self._journal.settle(rec.trace_id)
+                expired += 1
+                continue
+            remaining = (None if rec.deadline_unix is None
+                         else rec.deadline_unix - time.time())
+            try:
+                req = self.submit(
+                    rec.seq, msa=rec.msa, msa_mask=rec.msa_mask,
+                    timeout=remaining, priority=rec.priority,
+                    trace_id=rec.trace_id)
+            except ServingError:
+                failed += 1
+                continue
+            self.registry.counter(
+                "journal_replayed_total",
+                help="journal records re-driven through submit() after "
+                     "a restart").inc()
+            replayed += 1
+            requests.append(req)
+        return {"replayed": replayed, "expired": expired,
+                "failed": failed, "requests": requests}
 
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
         """Stop the front door, the router, the supervisor, and every
@@ -1606,6 +1831,8 @@ class ServingFleet:
             self._featurize.shutdown(drain=drain)
         self._stop.set()
         self._dispatcher.join(timeout)
+        if self._hedger is not None:
+            self._hedger.join(timeout)
         self._health.stop()
         with self._lock:
             reps = list(self._replicas.values())
@@ -1747,7 +1974,8 @@ class ServingFleet:
         self._admission.requeue(entry)
         time.sleep(self.cfg.dispatch_backoff_s)
 
-    def _try_dispatch(self, entry: FleetRequest, rep: _Replica) -> bool:
+    def _try_dispatch(self, entry: FleetRequest, rep: _Replica, *,
+                      hedge: bool = False) -> bool:
         engine = rep.engine
         if engine is None:
             return False
@@ -1755,6 +1983,10 @@ class ServingFleet:
         remaining = (None if entry.deadline is None
                      else entry.deadline - now)
         if remaining is not None and remaining <= 0:
+            if hedge:
+                # the PRIMARY dispatch owns the outcome — a hedge that
+                # finds the deadline gone simply declines to launch
+                return False
             self._resolve_shed(entry, "deadline", RequestTimeoutError(
                 "deadline passed at dispatch",
                 retry_after_s=self._admission.retry_after_s()))
@@ -1785,11 +2017,15 @@ class ServingFleet:
         except ServingError as e:
             # semantic rejection (bad MSA shape etc.): the request is the
             # problem — terminal, no failover
+            if hedge:
+                return False
             self._resolve_failed(entry, e)
             return True
         with self._lock:
             rep.in_flight += 1
             rep.dispatches += 1
+            entry.inflight_dispatches += 1
+            self._dispatch_count += 1
         # routed accounting: which capability pool actually took it, and
         # that pool's queue-wait distribution (the per-pool autoscaling
         # signal — a saturated pool's wait climbs even while another
@@ -1807,13 +2043,36 @@ class ServingFleet:
             # the engine cell's pool IS rep.pool (passed at build) —
             # drop it so the explicit kwarg below stays the one source
             cell.pop("pool", None)
-        self.flights.note(
-            entry.trace_id, "dispatch", replica=rep.name, pool=rep.pool,
-            queue_wait_s=round(now - entry.enqueued_at, 6),
-            requeues=entry.requeues, **cell)
-        hist = self._pool_wait.get(rep.pool)
-        if hist is not None:
-            hist.observe(now - entry.enqueued_at)
+        if hedge:
+            with self._lock:
+                counter = self._hedge_counters.get(rep.pool)
+                if counter is None:
+                    counter = self.registry.counter(
+                        "fleet_hedge_total",
+                        help="hedged (duplicate) dispatches per pool",
+                        pool=rep.pool)
+                    self._hedge_counters[rep.pool] = counter
+            counter.inc()
+            self.flights.note(
+                entry.trace_id, "hedge", replica=rep.name, pool=rep.pool,
+                age_s=round(now - entry.enqueued_at, 6), **cell)
+        else:
+            self.flights.note(
+                entry.trace_id, "dispatch", replica=rep.name,
+                pool=rep.pool,
+                queue_wait_s=round(now - entry.enqueued_at, 6),
+                requeues=entry.requeues, **cell)
+            hist = self._pool_wait.get(rep.pool)
+            if hist is not None:
+                hist.observe(now - entry.enqueued_at)
+            if self._hedger is not None:
+                # register the PRIMARY dispatch for the hedger's age scan;
+                # hedges themselves are never re-hedged
+                with self._hedge_lock:
+                    self._outstanding[id(entry)] = {
+                        "entry": entry, "rep": rep.name,
+                        "pool": rep.pool, "at": now, "hedged": False,
+                    }
         dispatched_at = now
         inner.add_done_callback(
             lambda r, e=entry, rp=rep, t=dispatched_at:
@@ -1828,6 +2087,10 @@ class ServingFleet:
         requeue onto another replica. Never blocks, never raises."""
         with self._lock:
             rep.in_flight -= 1
+            entry.inflight_dispatches -= 1
+            twin_in_flight = entry.inflight_dispatches > 0
+        with self._hedge_lock:
+            self._outstanding.pop(id(entry), None)
         result, exc = inner.peek()
         degraded = rep.name == DEGRADED
         if exc is None:
@@ -1835,6 +2098,11 @@ class ServingFleet:
                 self._health.record_success(rep.name)
             service_s = time.monotonic() - dispatched_at
             self._admission.note_served(service_s)
+            hist = self._pool_service.get(rep.pool)
+            if hist is not None:
+                hist.observe(service_s)
+            if self._budget is not None:
+                self._budget.on_success()
             pool = self._pools.get(rep.pool)
             if pool is not None:
                 # per-pool drain-rate EMA: what pool-quoted retry_after_s
@@ -1857,9 +2125,29 @@ class ServingFleet:
                     from_cache=result.from_cache, bucket=result.bucket,
                     latency_s=round(
                         time.monotonic() - entry.enqueued_at, 6))
+                self._journal_settle(entry.trace_id)
+            elif entry.hedges > 0:
+                # _finish lost the race on a HEDGED entry: this side is
+                # the hedge pair's loser — its chip-seconds bought nothing
+                # but the tail cut. sp_shards chips burned concurrently.
+                self._hedge_waste.inc(
+                    service_s * max(1, rep.cfg.sp_shards or 1))
+                self.flights.note(entry.trace_id, "hedge_lost",
+                                  replica=rep.name,
+                                  wasted_s=round(service_s, 6))
             # settle even when _finish lost a race (the result is still
             # the coalition's answer) — store put + follower resolution
             self._settle_waiters(entry, result=result, rep=rep)
+            return
+        if twin_in_flight and not entry.done():
+            # a hedge twin of this dispatch is still running — IT owns
+            # the outcome now; requeueing here would double-dispatch
+            if isinstance(exc, _REPLICA_FAULT_ERRORS) and not degraded:
+                self._health.record_failure(rep.name, exc.code)
+            self.flights.note(entry.trace_id, "hedge_twin_pending",
+                              failed_on=rep.name,
+                              code=getattr(exc, "code",
+                                           type(exc).__name__))
             return
         if isinstance(exc, RequestTimeoutError):
             # the request's OWN deadline expired inside the replica —
@@ -1872,6 +2160,17 @@ class ServingFleet:
             entry.failed_on.add(rep.name)
             entry.last_error = exc
             if not self._closed and entry.requeues < self.cfg.requeue_limit:
+                if (self._budget is not None
+                        and not self._budget.try_spend("failover")):
+                    # fleet-wide brownout: every replica failing means
+                    # every requeue is amplification — shed with honest
+                    # backoff advice instead of dogpiling
+                    self._resolve_shed(
+                        entry, "retry_budget", RetryBudgetExhaustedError(
+                            "failover retry denied: fleet-wide retry "
+                            "budget exhausted",
+                            retry_after_s=self._budget.retry_after_s()))
+                    return
                 entry.requeues += 1
                 self._requeue_total.inc()
                 self.flights.note(entry.trace_id, "requeue",
@@ -1887,6 +2186,111 @@ class ServingFleet:
                 self._resolve_failed(entry, err)
                 return
         self._resolve_failed(entry, exc)
+
+    # -------------------------------------------------- hedged dispatch
+
+    def _hedge_delay(self, pool_name: str) -> Optional[float]:
+        """How long a dispatch into `pool_name` may run before it earns
+        a hedge: the pool's own service-time p95 x hedge_p95_factor
+        (floored at hedge_min_delay_s). None — never hedge — until the
+        histogram holds `hedge_min_samples` observations: hedging off a
+        cold estimate would duplicate perfectly healthy traffic."""
+        hist = self._pool_service.get(pool_name)
+        if hist is None:
+            return None  # degraded-tier dispatches are never hedged
+        snap = hist.snapshot()
+        if snap.get("count", 0) < self.cfg.hedge_min_samples:
+            return None
+        p95 = snap.get("p95") or 0.0
+        if p95 <= 0.0:
+            return None
+        return max(self.cfg.hedge_min_delay_s,
+                   p95 * self.cfg.hedge_p95_factor)
+
+    def _hedge_loop(self):
+        """Dedicated scanner (armed only when hedge_p95_factor > 0):
+        wakes every tick and hedges any outstanding PRIMARY dispatch
+        older than its pool's hedge delay. First settle wins via
+        FleetRequest._finish's resolve-once; the loser's service time
+        lands in hedge_wasted_chip_seconds_total."""
+        while not self._stop.wait(self.cfg.tick_interval_s):
+            try:
+                self._hedge_scan()
+            except Exception:  # noqa: BLE001 — the scanner must outlive
+                # a bad snapshot; a dead hedger silently disables hedging
+                traceback.print_exc()
+
+    def _hedge_scan(self):
+        now = time.monotonic()
+        with self._hedge_lock:
+            stale = [st for st in list(self._outstanding.values())
+                     if not st["hedged"]]
+        for st in stale:
+            entry = st["entry"]
+            if entry.done():
+                continue
+            delay = self._hedge_delay(st["pool"])
+            if delay is None or now - st["at"] < delay:
+                continue
+            self._issue_hedge(entry, st)
+
+    def _hedge_deny(self, reason: str):
+        with self._hedge_lock:
+            self._hedge_denied[reason] = (
+                self._hedge_denied.get(reason, 0) + 1)
+        self.registry.counter(
+            "hedge_denied_total",
+            help="hedges declined by reason (rate_cap / budget / "
+                 "no_replica / dispatch_full)",
+            reason=reason).inc()
+
+    def _issue_hedge(self, entry: FleetRequest, st: dict):
+        """One budgeted duplicate dispatch for a straggling primary.
+        Order matters: the cheap global rate-cap check first, then
+        target selection, and the retry-budget token last — spent only
+        when a launch will actually be attempted."""
+        with self._lock:
+            dispatches = self._dispatch_count
+        with self._hedge_lock:
+            issued = self._hedges_issued
+        if issued + 1 > max(1, dispatches) * self.cfg.hedge_rate_cap:
+            self._hedge_deny("rate_cap")
+            return
+        length = (entry.features.length if entry.features is not None
+                  else len(entry.seq))
+        healthy = self._health.healthy_targets()
+        primary = st["rep"]
+        with self._lock:
+            # same candidate discipline as _route, minus the primary's
+            # replica and anything this entry already failed on — a
+            # hedge onto the straggler itself would measure nothing
+            targets = sorted(
+                (r for r in (self._replicas.get(n) for n in healthy)
+                 if r is not None and not r.retiring
+                 and r.name != primary
+                 and r.name not in entry.failed_on
+                 and self._pools[r.pool].max_len >= length),
+                key=lambda r: (self._pools[r.pool].rank, r.in_flight),
+            )
+        if not targets:
+            self._hedge_deny("no_replica")
+            return
+        if self._budget is not None and not self._budget.try_spend("hedge"):
+            self._hedge_deny("budget")
+            return
+        with self._hedge_lock:
+            cur = self._outstanding.get(id(entry))
+            if cur is not st or st["hedged"]:
+                return  # the primary settled (or another scan won) first
+            st["hedged"] = True
+            self._hedges_issued += 1
+        entry.hedges += 1
+        for rep in targets:
+            if self._try_dispatch(entry, rep, hedge=True):
+                return
+        # token spent but no engine admitted the duplicate — the attempt
+        # still counts against the rate cap (conservative by design)
+        self._hedge_deny("dispatch_full")
 
     # ------------------------------------------------- terminal accounting
 
@@ -1926,6 +2330,14 @@ class ServingFleet:
                 self._errors[code] = counter
         counter.inc()
 
+    def _journal_settle(self, trace_id: str):
+        """Unlink the trace's intake-journal record: called at every
+        terminal path (result, typed error, shed) so a restart replays
+        only truly unfinished work. No-op without a journal; settle()
+        itself is idempotent, so racing terminal paths are harmless."""
+        if self._journal is not None:
+            self._journal.settle(trace_id)
+
     def _resolve_shed(self, entry: FleetRequest, reason: str,
                       exc: ServingError) -> bool:
         if entry._finish(exc=exc):
@@ -1935,6 +2347,7 @@ class ServingFleet:
             self.flights.finish(entry.trace_id, "shed", reason=reason,
                                 code=getattr(exc, "code", "serving_error"),
                                 requeues=entry.requeues)
+            self._journal_settle(entry.trace_id)
             self._settle_waiters(entry, exc=exc)
             return True
         return False
@@ -1948,6 +2361,7 @@ class ServingFleet:
                                 code=getattr(exc, "code",
                                              type(exc).__name__),
                                 requeues=entry.requeues)
+            self._journal_settle(entry.trace_id)
             self._settle_waiters(entry, exc=exc)
             return True
         return False
@@ -2001,6 +2415,7 @@ class ServingFleet:
                         pool=rep.pool, degraded=degraded, coalesced=True,
                         leader=entry.trace_id, from_cache=True,
                         bucket=result.bucket, latency_s=round(latency, 6))
+                    self._journal_settle(follower.trace_id)
             elif isinstance(exc, QueueFullError):
                 self._resolve_shed(follower, "coalesced_leader_shed", exc)
             elif isinstance(exc, RequestTimeoutError):

@@ -23,7 +23,11 @@ endpoints):
                   ``artifact_store`` (hit/miss/corrupt/byte view) and
                   ``frontdoor`` (in-flight keys, waiting followers)
                   sections — the first place to look when the cache hit
-                  rate moves (docs/OPERATIONS.md runbook).
+                  rate moves (docs/OPERATIONS.md runbook). Fleet servers
+                  also carry a ``backpressure`` section (the queue /
+                  per-pool / retry-budget ``retry_after_s`` horizons an
+                  HTTP front end quotes next to its 429 +
+                  ``Retry-After`` sheds).
   * ``/explainz`` exemplar flight lookup (`?trace_id=<id>`): the full
                   per-request flight record from a `telemetry.costs.
                   FlightBook` — every lifecycle event across featurize
@@ -511,6 +515,7 @@ class OpsServer:
     def __init__(self, *, registry: MetricRegistry,
                  health_fn: Optional[Callable[[], dict]] = None,
                  stats_fn: Optional[Callable[[], dict]] = None,
+                 backpressure_fn: Optional[Callable[[], dict]] = None,
                  tracer: Tracer = NULL_TRACER,
                  slo=None, recorder: Optional[FlightRecorder] = None,
                  flights=None, profiler: Optional[ProfileCapturer] = None,
@@ -523,6 +528,10 @@ class OpsServer:
         self.registry = registry
         self._health_fn = health_fn
         self._stats_fn = stats_fn
+        # shed-advice provider (ServingFleet.backpressure): the queue /
+        # per-pool / retry-budget retry_after_s horizons a 429-emitting
+        # HTTP front end quotes in Retry-After headers
+        self._backpressure_fn = backpressure_fn
         self._tracer = tracer
         self.slo = slo
         self.recorder = recorder
@@ -573,6 +582,8 @@ class OpsServer:
         }
         if self._stats_fn is not None:
             out["stats"] = self._stats_fn()
+        if self._backpressure_fn is not None:
+            out["backpressure"] = self._backpressure_fn()
         if self.slo is not None:
             out["slo"] = self.slo.snapshot()
         if self.recorder is not None:
@@ -755,6 +766,7 @@ def ops_server_for_fleet(fleet, *, tracer: Tracer = NULL_TRACER,
     return OpsServer(
         registry=fleet.registry, health_fn=fleet.health,
         stats_fn=fleet.stats, tracer=tracer, slo=slo, recorder=recorder,
+        backpressure_fn=getattr(fleet, "backpressure", None),
         flights=getattr(fleet, "flights", None), profiler=profiler,
         host=host, port=port, tick_interval_s=tick_interval_s,
     )

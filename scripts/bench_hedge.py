@@ -1,0 +1,198 @@
+"""A/B bench: hedged dispatch against a straggling replica.
+
+Measures what ISSUE 18 gates on — settle p99 under a long-tail straggler
+— over the SAME trace, the SAME tiny-but-real fleet (real engines, real
+executables, CPU backend), and the SAME committed fault plan (one
+`straggle_dispatch` on r0 stalls a measured dispatch for --straggle-s
+seconds). Two arms:
+
+  off  — hedging disabled (hedge_p95_factor=0): the straggled request
+         waits out the full stall; it IS the settle p99.
+  on   — p95-derived hedging armed: once the per-pool service histogram
+         arms, the straggling dispatch gets ONE budgeted duplicate on
+         the healthy replica, first settle wins, and the loser's
+         chip-seconds land in hedge_wasted_chip_seconds_total.
+
+Each arm writes a raw-bench-line artifact (`load_metrics`-compatible) to
+BENCH_hedge_off.json / BENCH_hedge_on.json at the repo root, then the
+telemetry.check gate runs in-process:
+
+    *settle_p99*        = lower : -0.30   (hedging must CUT p99 >= 30%)
+    *chip_seconds_total* = lower : +cap   (extra chip-seconds bounded by
+                                           the hedge-rate cap)
+
+The equivalent CI command over the committed artifacts:
+
+    python -m alphafold2_tpu.telemetry.check \
+        --current BENCH_hedge_on.json --baseline BENCH_hedge_off.json \
+        --rule '*settle_p99*=lower:-0.30' \
+        --rule '*chip_seconds_total*=lower:0.25'
+
+Chip-free by design: the stall is injected wall-clock, and the PR 15
+cost ledger prices whatever backend ran the dispatch — the RATIOS the
+gates check are backend-independent.
+
+Usage: python scripts/bench_hedge.py [--straggle-s S] [--hedge-cap F]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+from alphafold2_tpu.constants import AA_ORDER  # noqa: E402
+from alphafold2_tpu.models import Alphafold2Config, alphafold2_init  # noqa: E402
+from alphafold2_tpu.reliability import Fault, FaultPlan  # noqa: E402
+from alphafold2_tpu.serving import (  # noqa: E402
+    FleetConfig,
+    ServingConfig,
+    ServingFleet,
+)
+from alphafold2_tpu.telemetry.check import check  # noqa: E402
+
+TINY = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8, max_seq_len=16)
+AA = AA_ORDER.replace("W", "")
+
+WARMUP = 4   # sequential requests that arm the per-pool p95 histogram
+TAIL = 5     # fast requests after the straggled wave
+
+
+def seq_of(length: int, offset: int = 0) -> str:
+    return "".join(AA[(offset + i) % len(AA)] for i in range(length))
+
+
+def run_arm(params, *, hedge: bool, straggle_s: float, cap: float) -> dict:
+    """One arm: 2 real replicas, precompiled buckets (compile noise must
+    not masquerade as the straggle), one injected straggler on r0's
+    first post-warmup dispatch."""
+    injector = FaultPlan(faults=(
+        Fault("straggle_dispatch", replica="r0", at=WARMUP,
+              delay_s=straggle_s),
+    )).injector()
+    fleet = ServingFleet(
+        params, TINY,
+        ServingConfig(buckets=(8, 16), max_batch=2, max_queue=16,
+                      max_wait_s=0.0, request_timeout_s=60.0,
+                      cache_capacity=0, precompile=True),
+        FleetConfig(replicas=2, probe_interval_s=0, reprobe_interval_s=30.0,
+                    tick_interval_s=0.02,
+                    retry_budget_capacity=10,
+                    hedge_p95_factor=(2.0 if hedge else 0.0),
+                    hedge_min_delay_s=0.05,
+                    hedge_min_samples=WARMUP,
+                    hedge_rate_cap=cap),
+        injector=injector)
+    try:
+        # warmup: sequential submits arm the service-seconds p95
+        for i in range(WARMUP):
+            fleet.predict(seq_of(6 + i % 4, offset=i))
+        # the measured wave: two concurrent submits — the one routed to
+        # r0 hits the straggler; with hedging on, its duplicate settles
+        # on the other replica long before the stall ends
+        wave = [fleet.submit(seq_of(7 + i, offset=10 + i)) for i in range(2)]
+        for req in wave:
+            req.result(timeout=60)
+        for i in range(TAIL):
+            fleet.predict(seq_of(5 + i % 4, offset=20 + i))
+        assert injector.exhausted(), "straggler was never delivered"
+
+        if hedge:
+            # the hedge loser (the straggled original) is still in flight
+            # when its wave settles — wait for its waste to be booked so
+            # the arm's chip-seconds are complete rather than flattered
+            deadline = time.monotonic() + straggle_s + 10.0
+            while (fleet.stats()["hedging"]["wasted_chip_seconds"] == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+
+        stats = fleet.stats()
+        n = stats["requests"]["completed"]
+        assert n == WARMUP + 2 + TAIL, stats["requests"]
+        assert stats["requests"]["failed"] == 0
+        chip_s = fleet.costs.fleet_chip_seconds_total()
+        row = {
+            "metric": "serve_settle_p99_seconds",
+            "value": stats["latency"]["p99"],
+            "unit": "seconds",
+            "backend": jax.default_backend(),
+            "requests": float(n),
+            "straggle_s": straggle_s,
+            "chip_seconds_total": chip_s,
+        }
+        if hedge:
+            h = stats["hedging"]
+            assert h["issued"] >= 1, (
+                f"hedging never fired: {h} "
+                f"(denials say why — rate_cap means the cap is too low "
+                f"for this trace length)")
+            dispatches = n + h["issued"]
+            row["hedge_issued"] = float(h["issued"])
+            row["hedge_rate"] = h["issued"] / dispatches
+            row["hedge_wasted_chip_seconds"] = h["wasted_chip_seconds"]
+            assert row["hedge_rate"] <= cap, row
+        return row
+    finally:
+        fleet.shutdown()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--straggle-s", type=float, default=0.75,
+                    help="injected stall on r0's measured dispatch "
+                         "(default 0.75)")
+    ap.add_argument("--hedge-cap", type=float, default=0.25,
+                    help="hedge rate cap — also the chip-seconds growth "
+                         "bound the gate enforces (default 0.25)")
+    args = ap.parse_args()
+    if args.straggle_s <= 0:
+        ap.error("--straggle-s must be > 0")
+    if not 0 < args.hedge_cap <= 1:
+        ap.error("--hedge-cap must be in (0, 1]")
+
+    params = alphafold2_init(jax.random.PRNGKey(0), TINY)
+    print(f"trace: {WARMUP} warmup + 2-wide straggled wave + {TAIL} tail "
+          f"on {jax.default_backend()}, straggle {args.straggle_s:g}s")
+    baseline = run_arm(params, hedge=False, straggle_s=args.straggle_s,
+                       cap=args.hedge_cap)
+    print(f"  off: settle p99 {baseline['value']:.3f}s, "
+          f"{baseline['chip_seconds_total']:.3f} chip-s total")
+    current = run_arm(params, hedge=True, straggle_s=args.straggle_s,
+                      cap=args.hedge_cap)
+    print(f"  on:  settle p99 {current['value']:.3f}s, "
+          f"{current['chip_seconds_total']:.3f} chip-s total, "
+          f"{current['hedge_issued']:.0f} hedge(s) "
+          f"(rate {current['hedge_rate']:.2f}, "
+          f"wasted {current['hedge_wasted_chip_seconds']:.3f} chip-s)")
+
+    for name, row in (("BENCH_hedge_off.json", baseline),
+                      ("BENCH_hedge_on.json", current)):
+        path = os.path.join(REPO, name)
+        with open(path, "w") as fh:
+            json.dump(row, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {path}")
+
+    gate = [("*settle_p99*", "lower", -0.30),
+            ("*chip_seconds_total*", "lower", args.hedge_cap)]
+    passed, rows = check(current, baseline, rules=gate)
+    for r in rows:
+        if r["direction"] is None:
+            continue
+        print(f"gate {r['metric']}={r['direction']}:{r['tolerance']:+.2f} "
+              f"-> change {r['change']:+.1%} "
+              f"[{'PASS' if r['status'] == 'ok' else 'FAIL'}]")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

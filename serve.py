@@ -287,6 +287,36 @@ def main():
                          "disk tier, 'auto' (sibling 'artifacts/' dir "
                          "next to --flight-dir, memory-only without "
                          "one), or 'off' (default). Fleet mode only")
+    ap.add_argument("--journal", default="off", metavar="DIR",
+                    help="crash-safe durable intake journal "
+                         "(docs/OPERATIONS.md): every accepted request "
+                         "is written to DIR before dispatch and unlinked "
+                         "at its terminal state; on startup unfinished "
+                         "records REPLAY through the front door "
+                         "(idempotent via coalescing + the artifact "
+                         "store). 'auto' = sibling 'journal/' dir next "
+                         "to --flight-dir (off without one); 'off' "
+                         "(default). Fleet mode only")
+    ap.add_argument("--retry-budget", type=int, default=0, metavar="N",
+                    help="fleet-wide retry budget: a token bucket of N "
+                         "tokens shared by featurize requeues, failover "
+                         "retries, and hedged dispatches, refilled as a "
+                         "fraction of successful completions — a "
+                         "brownout sheds with retry_budget_exhausted "
+                         "(HTTP 429 + Retry-After) instead of a retry "
+                         "storm (0 = unlimited retries, as before)")
+    ap.add_argument("--hedge-factor", type=float, default=0.0,
+                    metavar="X",
+                    help="hedged dispatch: when a dispatch exceeds X x "
+                         "its pool's service-time p95, issue one "
+                         "duplicate dispatch to another healthy replica "
+                         "— first settle wins, the loser's chip-seconds "
+                         "land in hedge_wasted_chip_seconds_total "
+                         "(0 = off; 1.5-3 are sane values)")
+    ap.add_argument("--hedge-rate-cap", type=float, default=0.1,
+                    metavar="FRAC",
+                    help="upper bound on hedges as a fraction of total "
+                         "dispatches (default 0.1)")
     ap.add_argument("--artifact-mem-entries", type=int, default=256,
                     metavar="N",
                     help="artifact-store hot-ring entry cap "
@@ -334,6 +364,12 @@ def main():
         ap.error("--featurize-workers must be >= 0")
     if args.artifact_mem_entries < 1:
         ap.error("--artifact-mem-entries must be >= 1")
+    if args.retry_budget < 0:
+        ap.error("--retry-budget must be >= 0 (0 disables it)")
+    if args.hedge_factor < 0:
+        ap.error("--hedge-factor must be >= 0 (0 disables hedging)")
+    if not (0.0 < args.hedge_rate_cap <= 1.0):
+        ap.error("--hedge-rate-cap must be in (0, 1]")
     if args.artifact_mem_mb < 1 or args.artifact_disk_mb < 1:
         ap.error("--artifact-mem-mb / --artifact-disk-mb must be >= 1")
 
@@ -359,6 +395,7 @@ def main():
         NoHealthyReplicaError,
         QueueFullError,
         RequestTimeoutError,
+        RetryBudgetExhaustedError,
         ServingConfig,
         ServingEngine,
         ServingError,
@@ -515,6 +552,11 @@ def main():
         print("WARNING: --artifact-store applies to fleet mode only "
               "(--replicas > 1, pools, featurize tier, or autoscale); "
               "single-engine mode keeps its per-engine result LRU")
+    if args.journal != "off" and not fleet_mode:
+        print("WARNING: --journal applies to fleet mode only (the fleet "
+              "front door is where requests are accepted and settled); "
+              "single-engine mode takes no journal")
+    journal_replays = []  # (name, seq, FleetRequest) recovered from a journal
     if fleet_mode:
         if logger is not None:
             # the per-batch JSONL stream is an engine-level concept (one
@@ -558,6 +600,25 @@ def main():
                           "'auto' disk tier)")
                   + f", hot ring {args.artifact_mem_entries} entries / "
                     f"{args.artifact_mem_mb} MB")
+        journal = None
+        if args.journal != "off":
+            from alphafold2_tpu.serving import IntakeJournal
+
+            if args.journal == "auto":
+                # same volume layout as --artifact-store auto: the
+                # journal lives beside the flight dir; without one there
+                # is no disk to anchor durability — say so, stay off
+                journal_root = (os.path.join(
+                    os.path.dirname(os.path.abspath(args.flight_dir)),
+                    "journal") if args.flight_dir else None)
+            else:
+                journal_root = args.journal
+            if journal_root is None:
+                print("WARNING: --journal auto needs --flight-dir to "
+                      "anchor a directory; journal stays OFF")
+            else:
+                journal = IntakeJournal(journal_root)
+                print(f"intake journal: {journal_root}")
         engine = ServingFleet(
             params, cfg, serving_cfg,
             FleetConfig(
@@ -574,11 +635,15 @@ def main():
                 featurize_workers=args.featurize_workers,
                 featurize_queue=args.featurize_queue,
                 pools=pools,
+                retry_budget_capacity=args.retry_budget,
+                hedge_p95_factor=args.hedge_factor,
+                hedge_rate_cap=args.hedge_rate_cap,
             ),
             injector=injector,
             tracer=tracer,
             incident_hook=recorder.incident if recorder else None,
             artifact_store=artifact_store,
+            journal=journal,
         )
         degraded_desc = ", ".join(
             ([f"mds_iters={degraded_iters}"] if degraded_iters else [])
@@ -589,7 +654,25 @@ def main():
               f"{args.fleet_queue}, featurize tier "
               + (f"{args.featurize_workers} worker(s)"
                  if args.featurize_workers else "OFF")
-              + ", degraded tier " + (degraded_desc or "OFF"))
+              + ", degraded tier " + (degraded_desc or "OFF")
+              + (f", retry budget {args.retry_budget}"
+                 if args.retry_budget else "")
+              + (f", hedging p95 x{args.hedge_factor:g} "
+                 f"(cap {args.hedge_rate_cap:g})"
+                 if args.hedge_factor else ""))
+        if journal is not None:
+            # replay BEFORE fresh traffic: crash-orphaned requests
+            # re-enter the front door (coalescing + artifact store make
+            # the replay idempotent — completed work replays as a hit)
+            replayed = engine.replay_journal()
+            if replayed["replayed"] or replayed["expired"]:
+                print(f"journal replay: {replayed['replayed']} "
+                      f"re-submitted, {replayed['expired']} expired, "
+                      f"{replayed['failed']} rejected")
+            journal_replays = [
+                (f"journal_{req.trace_id}", req.seq, req)
+                for req in replayed["requests"]
+            ]
     else:
         from alphafold2_tpu.telemetry import FlightBook
 
@@ -782,7 +865,9 @@ def main():
 
     # --- replay: submit everything, honoring backpressure explicitly ----
     t0 = time.time()
-    pending, failures, shed = [], 0, 0
+    # journal-recovered requests drain through the same result loop as
+    # fresh traffic (their names carry the journal_ prefix)
+    pending, failures, shed = list(journal_replays), 0, 0
     _MAX_SUBMIT_RETRIES = 200  # replay client's patience per record
     for pass_idx in range(max(1, args.passes)):
         for name, seq in records:
@@ -793,10 +878,10 @@ def main():
                 try:
                     pending.append((name, seq, engine.submit(seq)))
                     break
-                except QueueFullError as e:
+                except (QueueFullError, RetryBudgetExhaustedError) as e:
                     # honor the server's structured backoff advice (the
-                    # bounded queue is the throttle), but stay impatient
-                    # enough that a demo replay finishes
+                    # bounded queue / retry budget is the throttle), but
+                    # stay impatient enough that a demo replay finishes
                     retries += 1
                     if retries > _MAX_SUBMIT_RETRIES:
                         print(f"SHED {name}: [{e.code}] {e}")
@@ -827,9 +912,14 @@ def main():
             retry = (f" (retry_after={e.retry_after_s:.2f}s)"
                      if e.retry_after_s is not None else "")
             if isinstance(e, (QueueFullError, RequestTimeoutError,
-                              NoHealthyReplicaError)):
-                # structured load shed: a terminal outcome, not a bug
-                print(f"SHED {name}: [{e.code}] {e}{retry}")
+                              NoHealthyReplicaError,
+                              RetryBudgetExhaustedError)):
+                # structured load shed: a terminal outcome, not a bug.
+                # An HTTP front end maps this to e.http_status (429 for
+                # queue-full / retry-budget brownouts) with a Retry-After
+                # header from retry_after_s.
+                print(f"SHED {name}: [{e.code}] HTTP {e.http_status} "
+                      f"{e}{retry}")
                 shed += 1
             else:
                 print(f"FAILED {name}: [{e.code}] {e}{retry}")
@@ -932,6 +1022,26 @@ def main():
         if pools and stats.get("shed", {}).get("too_long"):
             print(f"too-long sheds: {stats['shed']['too_long']} "
                   f"(sequence past every pool ceiling)")
+        jstats = stats.get("journal")
+        if jstats:
+            print(f"journal: {jstats['accepted']} accepted, "
+                  f"{jstats['settled']} settled, {jstats['pending']} "
+                  f"pending, {jstats['corrupt']} corrupt, "
+                  f"{jstats['write_errors']} write error(s)")
+        bstats = stats.get("retry_budget")
+        if bstats:
+            print(f"retry budget: {bstats['tokens']:.1f}/"
+                  f"{bstats['capacity']:g} token(s) left, "
+                  f"{bstats['spent']} spent, "
+                  f"{bstats['denied']} denial(s)")
+        hstats = stats.get("hedging")
+        if hstats and (hstats["issued"] or hstats["denied"]):
+            denied = ", ".join(f"{k}={v}"
+                               for k, v in sorted(hstats["denied"].items()))
+            print(f"hedging: {hstats['issued']} issued "
+                  f"(denied: {denied or 'none'}), "
+                  f"{hstats['wasted_chip_seconds']:.2f} wasted "
+                  f"chip-second(s)")
         if stats["errors"]:
             print(f"errors by code: {stats['errors']}")
         if injector is not None:

@@ -1042,3 +1042,376 @@ def test_store_frontdoor_lock_order_acyclic_under_concurrency():
         assert snap["acquires"].get("FrontDoor._lock", 0) > 0
     finally:
         fleet.shutdown()
+
+
+# ===========================================================================
+# crash-safe request plane (ISSUE 18): durable intake journal + restart
+# replay, hedged dispatch with first-settle-wins, and the fleet-wide
+# retry budget. AF2_CHAOS_SEED varies the deterministic choices (which
+# record is torn, sequence offsets) so the CI fixed-seed matrix walks
+# distinct shapes of the same invariants.
+
+from alphafold2_tpu.serving import (  # noqa: E402
+    IntakeJournal,
+    RetryBudgetExhaustedError,
+)
+from alphafold2_tpu.serving import featurize as _feat_mod  # noqa: E402
+
+CHAOS_SEED = int(os.environ.get("AF2_CHAOS_SEED", "0"))
+
+
+@bounded(180)
+def test_journal_crash_recovery_replays_without_duplicate_dispatch(
+        tmp_path, monkeypatch):
+    """The acceptance scenario: a fleet dies with >=8 requests in flight
+    across BOTH tiers (featurize queue + dispatch), a new fleet on the
+    same --journal dir replays every record to terminal, and the shared
+    artifact store + front-door coalescing keep chip dispatch at exactly
+    one per unique payload — pre-crash-completed work replays as a store
+    hit, a torn record degrades to a counted quarantine skip."""
+    jdir = str(tmp_path / "journal")
+    store = ArtifactStore(ArtifactStoreConfig(root=None))  # B+C share
+
+    # --- phase 0: complete seq W against the shared store (fleet C),
+    # then journal an orphan record for it — simulating a crash between
+    # replica completion and the settle-unlink.
+    seq_w = seq_of(7, offset=CHAOS_SEED + 11)
+    fleet_c = fake_fleet(artifact_store=store, replicas=1)
+    fleet_a = fleet_b = None
+    engine_gate = threading.Event()   # fleet A dispatch tier plug
+    feat_gate = threading.Event()     # fleet A featurize tier plug
+    feat_blocked = threading.Event()
+    try:
+        assert fleet_c.submit(seq_w).result(timeout=30).coords is not None
+        tag = fleet_c._store_tag(next(iter(fleet_c._pools)))
+        key = request_key(seq_w, None, tag)
+        deadline = time.monotonic() + 10
+        while (fleet_c._store.lookup_result(tag, key) is None
+               and time.monotonic() < deadline):
+            time.sleep(0.01)   # settle-path put rides the callback thread
+        assert fleet_c._store.lookup_result(tag, key) is not None
+        IntakeJournal(jdir).accept(
+            "orphanw0001", seq_w, msa=None, msa_mask=None, priority=1,
+            deadline_unix=time.time() + 120.0,
+            accepted_at_unix=time.time())
+
+        # --- phase 1: fleet A with both tiers plugged. Ungated seqs
+        # clear featurize and wedge at the engine gate (dispatch tier);
+        # gated seqs wedge inside/behind the 1-worker featurize tier.
+        gated_seqs = {seq_of(9 + i, offset=CHAOS_SEED + 20 + i)
+                      for i in range(5)}
+        real_featurize = _feat_mod.featurize_request
+
+        def gated_featurize(seq, msa=None, msa_mask=None, **kw):
+            if seq in gated_seqs and not feat_gate.is_set():
+                feat_blocked.set()
+                feat_gate.wait(timeout=120)
+            return real_featurize(seq, msa=msa, msa_mask=msa_mask, **kw)
+
+        monkeypatch.setattr(_feat_mod, "featurize_request", gated_featurize)
+
+        class GateEngine(FakeEngine):
+            def _call_executable(self, bucket, tokens, mask,
+                                 msa=None, msa_mask=None):
+                engine_gate.wait(timeout=120)
+                return super()._call_executable(
+                    bucket, tokens, mask, msa=msa, msa_mask=msa_mask)
+
+        fleet_a = ServingFleet(
+            {}, TINY, fleet_scfg(), FleetConfig(
+                replicas=2, probe_interval_s=0, reprobe_interval_s=0.05,
+                fail_threshold=1, requeue_limit=2,
+                featurize_workers=1, featurize_queue=16),
+            engine_factory=lambda n, c, h: GateEngine({}, TINY, c,
+                                                      fault_hook=h),
+            artifact_store=ArtifactStore(ArtifactStoreConfig(root=None)),
+            journal=IntakeJournal(jdir))
+        seq_x = seq_of(6, offset=CHAOS_SEED + 1)
+        dispatch_reqs = [fleet_a.submit(s) for s in
+                         (seq_x, seq_x,                       # coalesce pair
+                          seq_of(5, offset=CHAOS_SEED + 2),
+                          seq_of(8, offset=CHAOS_SEED + 3))]
+        deadline = time.monotonic() + 20
+        while (fleet_a.stats()["featurize"]["requests"]["completed"] < 4
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        gated_reqs = [fleet_a.submit(s) for s in sorted(gated_seqs)]
+        assert feat_blocked.wait(20)   # tier worker is wedged on a record
+        st_a = fleet_a.stats()
+        assert st_a["requests"]["in_flight"] >= 8   # across both tiers
+        assert fleet_a._journal.pending_count() == 9
+        all_reqs = dispatch_reqs + gated_reqs
+
+        # --- the "crash": abandon fleet A cold (no shutdown, no settle),
+        # then tear one gated record mid-file the way a power cut would.
+        torn = gated_reqs[CHAOS_SEED % 5]
+        torn_path = os.path.join(jdir, torn.trace_id + ".jr")
+        size = os.path.getsize(torn_path)
+        with open(torn_path, "r+b") as f:
+            f.truncate(max(4, size // 2))
+
+        # --- phase 2: restart on the same journal dir. Engines count
+        # dispatched request-rows; a slow return keeps all nine replays
+        # overlapping so the coalesce pair deterministically meets at
+        # the front door rather than racing the settle-path store put.
+        feat_gate.set()
+        rows = []
+        rows_lock = threading.Lock()
+
+        class CountingEngine(FakeEngine):
+            def _run_live(self, bucket, live, allow_split):
+                # count REAL requests per device call (pad_batch
+                # duplicates the last row into unused slots, so the raw
+                # batch dim over-counts)
+                with rows_lock:
+                    rows.append(len(live))
+                return super()._run_live(bucket, live, allow_split)
+
+            def _call_executable(self, bucket, tokens, mask,
+                                 msa=None, msa_mask=None):
+                time.sleep(0.25)
+                return super()._call_executable(
+                    bucket, tokens, mask, msa=msa, msa_mask=msa_mask)
+
+        fleet_b = ServingFleet(
+            {}, TINY, fleet_scfg(), FleetConfig(
+                replicas=2, probe_interval_s=0, reprobe_interval_s=0.05,
+                fail_threshold=1, requeue_limit=2,
+                featurize_workers=1, featurize_queue=16),
+            engine_factory=lambda n, c, h: CountingEngine({}, TINY, c,
+                                                          fault_hook=h),
+            artifact_store=store,
+            journal=IntakeJournal(jdir))
+        out = fleet_b.replay_journal()
+        # 10 records on disk: 9 live (one torn -> quarantined) + orphan W
+        assert out["replayed"] == 9
+        assert out["expired"] == 0 and out["failed"] == 0
+        assert fleet_b._journal.stats()["corrupt"] == 1
+        for req in out["requests"]:
+            assert req.result(timeout=60).coords is not None
+
+        # at-least-once, exactly-one-dispatch: every journaled request is
+        # terminal, and the chip saw one row per unique surviving payload
+        # (X once despite two records, W zero times — store hit).
+        st_b = fleet_b.stats()
+        assert st_b["requests"]["completed"] == 9
+        assert st_b["requests"]["failed"] == 0
+        assert st_b["requests"]["in_flight"] == 0
+        assert sum(rows) == 7, rows
+        counters = st_b["telemetry"]["metrics"]["counters"]
+        assert counters["journal_corrupt_total"] == 1
+        assert counters["journal_replayed_total"] == 9
+        # settle proof at the disk level: no record outlives its request.
+        # Settle-unlink rides the dispatch callback thread AFTER the
+        # caller's future resolves (same stance as the store put), so
+        # drain is polled, not asserted instantaneously.
+        deadline = time.monotonic() + 10
+        while (time.monotonic() < deadline
+               and (fleet_b._journal.pending_count()
+                    or [f for f in os.listdir(jdir)
+                        if f.endswith(".jr")])):
+            time.sleep(0.02)
+        assert fleet_b._journal.pending_count() == 0
+        assert [f for f in os.listdir(jdir) if f.endswith(".jr")] == []
+    finally:
+        engine_gate.set()
+        feat_gate.set()
+        for f in (fleet_a, fleet_b, fleet_c):
+            if f is not None:
+                f.shutdown()
+
+
+@bounded(120)
+def test_retry_budget_bounds_failover_and_refills_on_recovery():
+    """Every replica failing at once: failover retries draw the shared
+    token bucket dry, the NEXT retry sheds typed (429-mapped, with
+    retry-after advice) instead of hammering, and recovery refills the
+    bucket as a fraction of fresh successes — no thundering herd."""
+    inj = plan(Fault("flap_replica", replica="r0", at=0, count=1),
+               Fault("flap_replica", replica="r1", at=0, count=1)).injector()
+    fleet = fake_fleet(inj, requeue_limit=10, retry_budget_capacity=1)
+    try:
+        victim = fleet.submit(seq_of(6, offset=CHAOS_SEED))
+        with pytest.raises(RetryBudgetExhaustedError) as ei:
+            victim.result(timeout=30)
+        assert ei.value.http_status == 429
+        assert ei.value.retry_after_s is not None
+        assert ei.value.retry_after_s > 0
+        st = fleet.stats()
+        assert st["shed"]["retry_budget"] == 1
+        snap = st["retry_budget"]
+        # retries <= budget: one failover spent the sole token, the
+        # second was DENIED — it never reached a replica
+        assert snap["spent"] == 1 and snap["denied"] == 1
+        assert snap["tokens"] == 0
+
+        # recovery: the flaps are exhausted, reprobe reinstates, and
+        # successes refill refill_ratio tokens each
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            states = {t["state"] for t in
+                      fleet._health.snapshot()["targets"].values()}
+            if states == {"healthy"}:
+                break
+            time.sleep(0.02)
+        for i in range(3):
+            ok = fleet.submit(seq_of(5 + i, offset=CHAOS_SEED + i + 1))
+            assert ok.result(timeout=30).coords is not None
+        snap = fleet.stats()["retry_budget"]
+        assert snap["successes"] >= 3
+        assert 0 < snap["tokens"] <= snap["capacity"]
+        assert fleet.stats()["requests"]["failed"] == 0
+    finally:
+        fleet.shutdown()
+
+
+@bounded(120)
+def test_hedged_dispatch_first_settle_wins_and_accounts_waste():
+    """A straggling replica holds one dispatch for 2s; once the per-pool
+    p95 arms, the hedger issues a budgeted duplicate to the healthy
+    replica, the FIRST settle wins (the caller never waits out the
+    straggler), and the loser's chip-seconds land in
+    hedge_wasted_chip_seconds_total."""
+    inj = plan(Fault("straggle_dispatch", replica="r0", at=0,
+                     delay_s=2.0)).injector()
+    fleet = fake_fleet(inj, hedge_p95_factor=2.0, hedge_min_delay_s=0.05,
+                       hedge_min_samples=3, hedge_rate_cap=1.0,
+                       tick_interval_s=0.02, retry_budget_capacity=8,
+                       requeue_limit=4)
+    mon = LockMonitor()
+    wrapped = mon.instrument(fleet)
+    assert "ServingFleet._hedge_lock" in wrapped
+    try:
+        t0 = time.monotonic()
+        reqs = [fleet.submit(seq_of(4 + i % 3, offset=CHAOS_SEED + i))
+                for i in range(6)]
+        for r in reqs:
+            assert r.result(timeout=30).coords is not None
+        wall = time.monotonic() - t0
+        assert wall < 1.5, f"hedge did not beat the 2s straggler: {wall:.2f}s"
+        st = fleet.stats()
+        assert st["requests"]["completed"] == 6
+        assert st["requests"]["failed"] == 0
+        assert st["requests"]["requeued"] == 0   # hedge, not failover
+        assert st["hedging"]["issued"] >= 1
+        assert st["retry_budget"]["spent"] >= 1  # hedges draw the budget
+        # loser accounting lands when the straggler finally wakes
+        deadline = time.monotonic() + 10
+        waste = 0.0
+        while waste <= 0 and time.monotonic() < deadline:
+            waste = fleet.stats()["hedging"]["wasted_chip_seconds"]
+            time.sleep(0.05)
+        assert waste > 0
+        counters = fleet.stats()["telemetry"]["metrics"]["counters"]
+        assert counters["hedge_wasted_chip_seconds_total"] == pytest.approx(
+            waste)
+        # instrumented-lock harness: the hedge registry lock stayed a
+        # leaf under real hedging traffic (runtime twin of CONC002)
+        mon.assert_acyclic()
+    finally:
+        fleet.shutdown()
+
+
+def test_fleet_breakers_trip_together_but_reprobe_desynced():
+    """Satellite: three replicas tripping their breakers on the same tick
+    must NOT re-probe on the same tick — the fleet seeds each breaker's
+    jitter with its replica index, so the open->half-open windows are
+    pairwise distinct (bounded by breaker_jitter)."""
+    fleet = fake_fleet(replicas=3, scfg=fleet_scfg(
+        breaker_threshold=1, breaker_reset_s=10.0))
+    try:
+        with fleet._lock:
+            reps = dict(fleet._replicas)
+        assert len(reps) == 3
+        windows = {}
+        for name, rep in reps.items():
+            br = rep.engine._breaker
+            assert br is not None
+            br.record_failure()            # threshold 1: opens this tick
+            windows[name] = br.snapshot()["current_reset_s"]
+        assert len(set(windows.values())) == 3, windows
+        lo, hi = 10.0, 10.0 * (1.0 + fleet.cfg.breaker_jitter)
+        for w in windows.values():
+            assert lo <= w <= hi
+    finally:
+        fleet.shutdown()
+
+
+@bounded(60)
+def test_fleet_deadline_rides_into_featurize_tier(monkeypatch):
+    """Satellite: a request whose fleet deadline passes while it queues in
+    the CPU featurize tier is dropped BEFORE featurizing — counted in
+    featurize_expired_total and shed with the deadline reason — instead
+    of burning a featurize slot on dead-on-arrival work."""
+    plug_seq = seq_of(8, offset=CHAOS_SEED + 7)
+    feat_gate = threading.Event()
+    feat_blocked = threading.Event()
+    real_featurize = _feat_mod.featurize_request
+
+    def gated(seq, msa=None, msa_mask=None, **kw):
+        if seq == plug_seq and not feat_gate.is_set():
+            feat_blocked.set()
+            feat_gate.wait(timeout=60)
+        return real_featurize(seq, msa=msa, msa_mask=msa_mask, **kw)
+
+    monkeypatch.setattr(_feat_mod, "featurize_request", gated)
+    fleet = fake_fleet(featurize_workers=1, featurize_queue=8)
+    try:
+        plug = fleet.submit(plug_seq, timeout=30)
+        assert feat_blocked.wait(10)
+        victim = fleet.submit(seq_of(6, offset=CHAOS_SEED + 8),
+                              timeout=0.05)
+        time.sleep(0.15)       # victim's deadline passes while queued
+        feat_gate.set()
+        assert plug.result(timeout=30).coords is not None
+        with pytest.raises(RequestTimeoutError):
+            victim.result(timeout=30)
+        deadline = time.monotonic() + 10
+        expired = 0
+        while expired < 1 and time.monotonic() < deadline:
+            expired = fleet.stats()["telemetry"]["metrics"]["counters"].get(
+                "featurize_expired_total", 0)
+            time.sleep(0.02)
+        assert expired == 1
+        assert fleet.stats()["shed"].get("deadline", 0) >= 1
+        assert fleet.stats()["requests"]["completed"] == 1
+    finally:
+        fleet.shutdown()
+
+
+@pytest.mark.slow
+@bounded(420)
+def test_serve_cli_crash_process_restart_replays_journal(tmp_path):
+    """End to end through the real CLI: kill -9 the serving process with
+    requests in flight, restart on the same --journal dir, and watch the
+    restarted fleet replay every orphaned record to terminal — the
+    journal drains to zero pending."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    jdir = tmp_path / "journal"
+    plan_path = tmp_path / "crash.json"
+    plan_path.write_text(json.dumps({"faults": [
+        {"kind": "crash_process", "at": 3}]}))
+    base = [sys.executable, os.path.join(repo, "serve.py"),
+            "--demo", "10", "--replicas", "2", "--buckets", "16,32",
+            "--dim", "16", "--depth", "1", "--heads", "2",
+            "--dim-head", "8", "--mds-iters", "2", "--max-batch", "2",
+            "--request-timeout", "120",
+            "--journal", str(jdir), "--seed", str(CHAOS_SEED)]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(base + ["--fault-plan", str(plan_path)],
+                         capture_output=True, text=True, timeout=200,
+                         env=env)
+    assert out.returncode == 137, (
+        out.stdout[-2000:] + out.stderr[-2000:])
+    orphans = [f for f in os.listdir(jdir) if f.endswith(".jr")]
+    assert orphans, "crash left no journaled in-flight work"
+    out2 = subprocess.run(base, capture_output=True, text=True,
+                          timeout=200, env=env)
+    assert out2.returncode == 0, (
+        out2.stdout[-2000:] + out2.stderr[-2000:])
+    assert "journal replay:" in out2.stdout
+    assert "0 pending" in out2.stdout
+    assert not [f for f in os.listdir(jdir) if f.endswith(".jr")]
