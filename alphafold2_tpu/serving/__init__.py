@@ -28,6 +28,13 @@ from alphafold2_tpu.serving.bucketing import (
 )
 from alphafold2_tpu.serving.autoscale import ReplicaAutoscaler, ScalePolicy
 from alphafold2_tpu.serving.cache import ResultCache, request_key
+from alphafold2_tpu.serving.cascade import (
+    CascadeLedger,
+    CascadePolicy,
+    CascadeVerdict,
+    ConfidenceScorer,
+    EntropyStressScorer,
+)
 from alphafold2_tpu.serving.engine import (
     PredictionResult,
     ServingConfig,
@@ -92,6 +99,11 @@ __all__ = [
     "pad_batch",
     "ResultCache",
     "request_key",
+    "CascadeLedger",
+    "CascadePolicy",
+    "CascadeVerdict",
+    "ConfidenceScorer",
+    "EntropyStressScorer",
     "FeatureBundle",
     "FeaturizeConfig",
     "FeaturizePool",

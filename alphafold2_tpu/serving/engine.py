@@ -123,6 +123,13 @@ class ServingConfig:
     sp_schedules: Tuple[Tuple[int, str], ...] = ()  # per-bucket overrides
     #                              ((bucket, schedule), ...) — win over
     #                              the heuristic, loud when infeasible
+    # trunk-depth early exit (serving cascade's third lever; the pipeline
+    # freezes a sample's distogram once consecutive checkpoint depths
+    # agree to within early_exit_kl of masked-mean delta-KL). The first
+    # depth is the delta-KL baseline, so arming requires >= 2 depths.
+    # Priced per exit depth as distinct cost-ledger cells.
+    early_exit_depths: Tuple[int, ...] = ()
+    early_exit_kl: float = 0.0
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -168,6 +175,35 @@ class ServingConfig:
                 "sp_schedules given but sp_shards=0 — per-bucket schedule "
                 "overrides only apply to the SP arm"
             )
+        object.__setattr__(
+            self, "early_exit_depths",
+            tuple(sorted({int(d) for d in self.early_exit_depths})))
+        if self.early_exit_depths:
+            if self.early_exit_depths[0] < 1:
+                raise ValueError(
+                    f"early_exit_depths must be >= 1, got "
+                    f"{self.early_exit_depths}"
+                )
+            if len(self.early_exit_depths) < 2:
+                raise ValueError(
+                    "early_exit_depths needs >= 2 checkpoints: the first "
+                    "is the delta-KL baseline and can never exit"
+                )
+            if self.early_exit_kl <= 0:
+                raise ValueError(
+                    f"early_exit_kl must be > 0 when early_exit_depths "
+                    f"is set, got {self.early_exit_kl}"
+                )
+            if self.sp_shards:
+                raise ValueError(
+                    "early exit segments the dense sequential trunk and "
+                    "cannot compose with the SP arm (sp_shards > 0)"
+                )
+        elif self.early_exit_kl:
+            raise ValueError(
+                "early_exit_kl set without early_exit_depths — the exit "
+                "gate has no checkpoints to fire at"
+            )
         if self.mds_init == "random" and self.cache_capacity:
             # random MDS inits draw from a per-dispatch key, so identical
             # requests served in different batches yield different
@@ -202,6 +238,14 @@ class PredictionResult:
     trace_id: str = ""        # request trace id: grep it in span exports /
     #                           flight-recorder bundles to reconstruct this
     #                           request's whole cross-replica life
+    mean_confidence: float = 0.0  # mean per-residue distogram confidence
+    #                           over the true length — the cascade
+    #                           scorer's primary signal (serving/cascade.py)
+    exit_depth: int = 0       # trunk depth the distogram froze at when
+    #                           early exit is armed (0 = early exit off)
+    tier: str = ""            # cascade provenance: "" (no cascade) /
+    #                           "draft" (accepted draft) / "escalated" /
+    #                           "full"
 
 
 class ServingRequest:
@@ -399,6 +443,33 @@ class ServingEngine:
                 hbm_bytes=cfg.sp_hbm_gb * (1 << 30),
                 overrides=dict(cfg.sp_schedules),
             )
+        # trunk-depth early exit (serving/pipeline.py _staged_trunk_logits;
+        # the cascade's third lever): validated against the MODEL here so
+        # a bad depth fails construction, not the first dispatch
+        if cfg.early_exit_depths:
+            if model_apply_fn is not None:
+                raise ValueError(
+                    "early_exit_depths and model_apply_fn are mutually "
+                    "exclusive: early exit drives the trunk itself"
+                )
+            if model_cfg.reversible:
+                raise ValueError(
+                    "early exit segments the sequential layer list; the "
+                    "reversible trunk is depth-stacked — set "
+                    "reversible=False"
+                )
+            if cfg.early_exit_depths[-1] >= model_cfg.depth:
+                raise ValueError(
+                    f"early_exit_depths {cfg.early_exit_depths} must all "
+                    f"be < model depth {model_cfg.depth} (the full-depth "
+                    f"checkpoint is implicit)"
+                )
+            if len(set(model_cfg.layer_sparse)) > 1:
+                raise ValueError(
+                    "early exit requires uniform sparse_self_attn flags "
+                    "across the trunk (layer slices re-index "
+                    "cfg.layer_sparse from 0)"
+                )
         # precision arm (serving/quant_residency.py): weight_dtype="int8"
         # places the per-channel-PTQ tree on device instead of the fp32
         # master — quantized once per residency tag process-wide, so a
@@ -430,11 +501,14 @@ class ServingEngine:
         # ... and the SP plan: two engines whose buckets take different
         # schedules (dense vs ring-accumulated sp_seq vs psum-ordered
         # sp_msa) agree only to rounding — never one cache keyspace
+        # ... and the early-exit knobs: an early-exited distogram is a
+        # different function of the sequence than the full-depth one
         self._config_tag = repr((
             model_cfg, cfg.mds_iters, cfg.mds_init, cfg.seed, cfg.msa_rows,
             cfg.params_tag, self._ladder.buckets, self._dispatch_tag,
             cfg.sp_shards,
             tuple((b, r.schedule) for b, r in sorted(self._sp_plan.items())),
+            cfg.early_exit_depths, cfg.early_exit_kl,
         ))
 
         self._executables = {}
@@ -515,6 +589,41 @@ class ServingEngine:
                 residency_bytes=residency.total_bytes,
                 chips=max(1, chips), max_batch=cfg.max_batch,
             )
+
+        # per-exit-depth cost cells: a request whose trunk froze at depth
+        # d did ~flops(d)/flops(depth) of the full forward. Each exit
+        # depth gets its OWN price-list cell (schedule "dense@exit{d}")
+        # so the router optimizes against what shallow answers actually
+        # cost; the dispatch path apportions the measured batch
+        # device-seconds across cells flops-proportionally (_run_live),
+        # preserving fleet_chip_seconds_total exactly.
+        self._exit_cells = {}
+        self._depth_flops = {}
+        if cfg.early_exit_depths:
+            # exits fire from the SECOND checkpoint on (the first is the
+            # delta-KL baseline), so only depths[1:] get cells
+            for bucket in self._ladder.buckets:
+                for d in cfg.early_exit_depths[1:]:
+                    sub_cfg = dataclasses.replace(model_cfg, depth=d)
+                    flops_d = model_fwd_flops(
+                        sub_cfg, n=bucket, r=rows, c=bucket)
+                    self._depth_flops[(bucket, d)] = flops_d
+                    sub_res = sp_arm.schedule_residency(
+                        sub_cfg, bucket=bucket, batch=cfg.max_batch,
+                        msa_rows=rows, schedule="dense", shards=1,
+                        weight_bytes=self._weight_residency["weight_bytes"],
+                    )
+                    self._exit_cells[(bucket, d)] = self.costs.register_cell(
+                        pool=pool_name, bucket=bucket,
+                        schedule=f"dense@exit{d}",
+                        backend_arm=backend_arm,
+                        weight_dtype=model_cfg.weight_dtype,
+                        forward_flops=flops_d,
+                        residency_bytes=sub_res.total_bytes,
+                        chips=1, max_batch=cfg.max_batch,
+                    )
+                self._depth_flops[(bucket, model_cfg.depth)] = (
+                    model_fwd_flops(model_cfg, n=bucket, r=rows, c=bucket))
 
         self._closed = False
         self._drain_on_stop = True
@@ -962,16 +1071,23 @@ class ServingEngine:
                 apply_fn = sp_arm.make_sp_apply_fn(
                     self._sp_mesh, plan.schedule)
 
+            ee_depths = self.cfg.early_exit_depths
+            ee_kl = self.cfg.early_exit_kl
+
             def run(params, tokens, mask, key, msa=None, msa_mask=None):
                 out = predict_structure(
                     params, mcfg, tokens, mask=mask, msa=msa,
                     msa_mask=msa_mask, rng=key, mds_iters=iters,
                     mds_init=init, model_apply_fn=apply_fn,
+                    early_exit_depths=ee_depths, early_exit_kl=ee_kl,
                 )
                 # the (B, Lb, Lb, buckets) logits stay on device: at
                 # bucket 512 they are ~150 MB per batch of host transfer
                 # nothing in the serving path reads
-                return {k: out[k] for k in ("coords", "confidence", "stress")}
+                keep = ("coords", "confidence", "stress")
+                if ee_depths:
+                    keep = keep + ("exit_depth",)
+                return {k: out[k] for k in keep}
 
             s_tok = jax.ShapeDtypeStruct((B, bucket), np.int32)
             s_mask = jax.ShapeDtypeStruct((B, bucket), np.bool_)
@@ -1053,9 +1169,17 @@ class ServingEngine:
                                       bucket=bucket, dispatch=idx,
                                       trace_ids=list(trace_ids),
                                       **self._span_tags):
-                return self._call_executable(
+                out = self._call_executable(
                     bucket, tokens, mask, msa, msa_mask
                 )
+                # realize the async device call INSIDE the span and the
+                # watchdog window: executables return unrealized buffers,
+                # so without this the execute span / cost-ledger timing
+                # would end at enqueue (billing dispatch overhead as the
+                # batch's device-seconds while the real compute lands in
+                # the untimed np.asarray conversion) and a wedged device
+                # computation would slip past the hung-batch watchdog
+                return jax.block_until_ready(out)
 
         timeout = self.cfg.watchdog_timeout_s
         if timeout is None:
@@ -1259,6 +1383,8 @@ class ServingEngine:
             coords = np.asarray(out["coords"])
             conf = np.asarray(out["confidence"])
             stress = np.asarray(out["stress"])
+            exit_depth = (np.asarray(out["exit_depth"])
+                          if "exit_depth" in out else None)
         except Exception as e:  # noqa: BLE001 — isolate, report, keep serving
             if dispatch_t0 is not None:
                 # device time a FAILED dispatch burned: the failover
@@ -1302,32 +1428,66 @@ class ServingEngine:
         # (accounted BEFORE the requests resolve, so a probe blocking on
         # its result observes this accounting inside its probe_span)
         self.goodput.add(self._goodput_name, "execute", exec_s)
-        self.costs.observe_batch(self._cost_cells[bucket],
-                                 device_seconds=exec_s, requests=len(live))
+        self._bill_batch(bucket, exec_s, live, exit_depth)
         done_at = time.monotonic()
         with self._tracer.span("serving.respond", cat="serving",
                                bucket=bucket, n=len(live),
                                trace_ids=[r.trace_id for r in live],
                                **self._span_tags):
             self._respond(bucket, live, coords, conf, stress, n_real,
-                          done_at)
+                          done_at, exit_depth=exit_depth)
 
-    def _respond(self, bucket, live, coords, conf, stress, n_real, done_at):
+    def _bill_batch(self, bucket, exec_s, live, exit_depth):
+        """Charge the batch's measured device-seconds to cost cells.
+
+        Without early exit the whole batch bills the bucket's one cell.
+        With it, requests grouped by exit depth split `exec_s`
+        flops-proportionally across the per-exit-depth cells — the shares
+        sum to exec_s exactly, so `fleet_chip_seconds_total` (the bench
+        gate's headline) stays a faithful device-time integral."""
+        if exit_depth is None or not self._exit_cells:
+            self.costs.observe_batch(self._cost_cells[bucket],
+                                     device_seconds=exec_s,
+                                     requests=len(live))
+            return
+        full_depth = self.model_cfg.depth
+        full_flops = self._depth_flops[(bucket, full_depth)]
+        groups = {}
+        for i in range(len(live)):
+            d = int(exit_depth[i])
+            groups[d] = groups.get(d, 0) + 1
+        total_w = sum(
+            self._depth_flops.get((bucket, d), full_flops) * n
+            for d, n in groups.items())
+        for d, n in sorted(groups.items()):
+            cell = self._exit_cells.get((bucket, d),
+                                        self._cost_cells[bucket])
+            w = self._depth_flops.get((bucket, d), full_flops) * n
+            share = exec_s * (w / total_w) if total_w else 0.0
+            self.costs.observe_batch(cell, device_seconds=share,
+                                     requests=n)
+
+    def _respond(self, bucket, live, coords, conf, stress, n_real, done_at,
+                 exit_depth=None):
         for i, req in enumerate(live):
             L = req.length
             # copies, not views: a view would both pin the whole
             # (max_batch, bucket, 3) batch array in the cache and let a
             # client's in-place edit corrupt later cache hits
+            conf_i = conf[i, :L].copy()
             result = PredictionResult(
                 seq=req.seq,
                 coords=coords[i, :L].copy(),
-                confidence=conf[i, :L].copy(),
+                confidence=conf_i,
                 stress=float(stress[i]),
                 bucket=bucket,
                 from_cache=False,
                 latency_s=done_at - req.submitted_at,
                 replica=self.replica_name,
                 trace_id=req.trace_id,
+                mean_confidence=float(conf_i.mean()) if L else 0.0,
+                exit_depth=int(exit_depth[i]) if exit_depth is not None
+                else 0,
             )
             # the cached entry and the resolved result may share arrays:
             # clients only ever see result() copies

@@ -70,6 +70,12 @@ from alphafold2_tpu.serving.admission import (
 from alphafold2_tpu.serving.artifact_store import ArtifactStore
 from alphafold2_tpu.serving.bucketing import BucketLadder
 from alphafold2_tpu.serving.cache import request_key
+from alphafold2_tpu.serving.cascade import (
+    CascadeLedger,
+    CascadePolicy,
+    CascadeVerdict,
+    EntropyStressScorer,
+)
 from alphafold2_tpu.serving.engine import (
     PredictionResult,
     ServingConfig,
@@ -145,6 +151,16 @@ class PoolSpec:
     #                              schedule), ...); () defers to the base
     #                              config's overrides (ladder-filtered)
     #                              and the residency heuristic
+    # per-pool fidelity knobs (the cascade's draft tier: int8 weights via
+    # weight_dtype above, FEWER MDS ITERATIONS, REDUCED MSA ROWS, and
+    # trunk-depth early exit — serving/cascade.py). Each knob also moves
+    # the pool's store tag, so cheaper results never alias dearer ones.
+    mds_iters: int = 0           # >0 overrides the base ServingConfig
+    msa_rows: Optional[int] = None  # None inherits; 0 drops the MSA
+    #                              stream entirely; >0 truncates riding
+    #                              FeatureBundles to the top rows
+    early_exit_depths: tuple = ()   # >= 2 checkpoint depths arm the
+    early_exit_kl: float = 0.0      # delta-KL trunk early exit
 
     def __post_init__(self):
         if not self.name or self.name == DEGRADED:
@@ -182,6 +198,22 @@ class PoolSpec:
             raise ValueError(
                 f"pool {self.name!r}: sp_schedules without sp_shards"
             )
+        if self.mds_iters < 0:
+            raise ValueError(
+                f"pool {self.name!r}: mds_iters must be >= 0 "
+                f"(0 inherits), got {self.mds_iters}"
+            )
+        if self.msa_rows is not None and self.msa_rows < 0:
+            raise ValueError(
+                f"pool {self.name!r}: msa_rows must be None (inherit) "
+                f"or >= 0, got {self.msa_rows}"
+            )
+        object.__setattr__(
+            self, "early_exit_depths",
+            tuple(int(d) for d in self.early_exit_depths))
+        # depth/kl consistency is ServingConfig.__post_init__'s job —
+        # _pool_serving_cfg replaces these into the pool's config, which
+        # re-validates
 
 
 @dataclasses.dataclass(frozen=True)
@@ -245,6 +277,14 @@ class FleetConfig:
     hedge_min_delay_s: float = 0.05
     hedge_rate_cap: float = 0.1
     hedge_min_samples: int = 8
+    # Adaptive-fidelity cascade (ISSUE 19; serving/cascade.py): a
+    # CascadePolicy routes eligible requests through a DRAFT pool first
+    # (named by policy.draft_pool — must be one of `pools`), scores the
+    # draft with a ConfidenceScorer, and escalates only low-confidence
+    # results to the remaining full-fidelity pools with the request's
+    # FeatureBundle riding. None keeps static pool routing
+    # (behavior-identical to the pre-cascade fleet).
+    cascade_policy: Optional["CascadePolicy"] = None
 
     def __post_init__(self):
         if self.replicas < 1:
@@ -295,6 +335,25 @@ class FleetConfig:
                 f"hedge_rate_cap must be in (0, 1], "
                 f"got {self.hedge_rate_cap}"
             )
+        if self.cascade_policy is not None:
+            names = [p.name for p in self.pools]
+            if not names:
+                raise ValueError(
+                    "cascade_policy requires explicit capability pools "
+                    "(FleetConfig.pools) — the draft tier is a pool"
+                )
+            if self.cascade_policy.draft_pool not in names:
+                raise ValueError(
+                    f"cascade draft_pool "
+                    f"{self.cascade_policy.draft_pool!r} is not a "
+                    f"configured pool (pools: {names})"
+                )
+            if len(names) < 2:
+                raise ValueError(
+                    "the cascade needs at least one full-fidelity pool "
+                    "besides the draft pool — escalations would have "
+                    "nowhere to go"
+                )
 
 
 class FleetRequest:
@@ -332,6 +391,13 @@ class FleetRequest:
         # guarded): with hedging, a failed twin must defer to the one
         # still in flight instead of requeueing a request that may win
         self.inflight_dispatches = 0
+        # cascade state (serving/cascade.py; "" when the cascade is off):
+        # tier is "draft" while the draft leg is pending, "full" after
+        # bypass/promotion/escalation; escalated marks a rejected draft;
+        # draft_accepted gates what may persist under the draft store tag
+        self.tier = ""
+        self.escalated = False
+        self.draft_accepted = False
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._result: Optional[PredictionResult] = None
@@ -371,6 +437,7 @@ class FleetRequest:
             degraded=self._meta.get("degraded", False),
             requeues=self.requeues,
             trace_id=self.trace_id,
+            tier=self._meta.get("tier", ""),
         )
 
 
@@ -439,7 +506,8 @@ class ServingFleet:
                  tracer=None, registry: Optional[MetricRegistry] = None,
                  incident_hook=None,
                  artifact_store: Optional[ArtifactStore] = None,
-                 journal: Optional[IntakeJournal] = None):
+                 journal: Optional[IntakeJournal] = None,
+                 cascade_scorer=None):
         self.cfg = fleet_cfg
         self._params = params
         self._model_cfg = model_cfg
@@ -484,6 +552,20 @@ class ServingFleet:
         self.registry = registry if registry is not None else MetricRegistry()
         self._incident_hook = incident_hook
         self._factory = engine_factory or self._default_factory
+
+        # ---- adaptive-fidelity cascade (ISSUE 19; serving/cascade.py) --
+        # None keeps static pool routing (behavior-identical). Armed, the
+        # draft pool takes every eligible request first; the scorer's
+        # verdict on each draft decides accept vs escalate in
+        # _on_replica_done, and _route/_admit keep the tiers disjoint.
+        self._cascade: Optional[CascadePolicy] = fleet_cfg.cascade_policy
+        self._cascade_scorer = None
+        self._cascade_ledger: Optional[CascadeLedger] = None
+        if self._cascade is not None:
+            self._cascade_scorer = (
+                cascade_scorer if cascade_scorer is not None
+                else EntropyStressScorer(self._cascade))
+            self._cascade_ledger = CascadeLedger(self.registry)
 
         # ---- fleet-wide artifact store + front-door coalescing (ISSUE
         # 17) ---- None keeps the pre-store fleet behavior-identical;
@@ -732,7 +814,14 @@ class ServingFleet:
                               if b in buckets)
         return dataclasses.replace(
             base, buckets=buckets, sp_shards=spec.sp_shards,
-            sp_schedules=sp_scheds)
+            sp_schedules=sp_scheds,
+            mds_iters=spec.mds_iters or base.mds_iters,
+            msa_rows=(base.msa_rows if spec.msa_rows is None
+                      else spec.msa_rows),
+            early_exit_depths=(spec.early_exit_depths
+                               or base.early_exit_depths),
+            early_exit_kl=(spec.early_exit_kl if spec.early_exit_depths
+                           else base.early_exit_kl))
 
     def _pool_model_cfg(self, pool: "_Pool"):
         """The pool's Alphafold2Config (weight-precision arm), derived
@@ -769,12 +858,25 @@ class ServingFleet:
         pool = self._pools[pool_name]
         cfg = self._pool_serving_cfg(pool)
         mcfg = self._pool_model_cfg(pool)
-        return "af2store:" + repr((
+        parts = (
             mcfg, cfg.mds_iters, cfg.mds_init, cfg.seed, cfg.msa_rows,
             cfg.params_tag, tuple(pool.ladder.buckets),
             dispatch_resolution_tag(), cfg.sp_shards, cfg.sp_hbm_gb,
             tuple(sorted(cfg.sp_schedules)),
-        ))
+            cfg.early_exit_depths, cfg.early_exit_kl,
+        )
+        if self.cfg.cascade_policy is not None:
+            # the cascade-tier component (ISSUE 19, the PR 13
+            # resolution_tag invariant family): even if an operator arms
+            # the cascade over numerically IDENTICAL pools, a draft-tier
+            # result must never alias or serve a full-fidelity hit —
+            # draft acceptance is a thresholded quality gate, not a
+            # config equivalence
+            role = ("cascade:draft"
+                    if pool_name == self.cfg.cascade_policy.draft_pool
+                    else "cascade:verify")
+            parts = parts + (role,)
+        return "af2store:" + repr(parts)
 
     def _feature_tag(self) -> str:
         """Feature bundles depend only on (union ladder, msa_rows) —
@@ -1063,14 +1165,41 @@ class ServingFleet:
                           bucket=bundle.bucket)
         self._admit(entry, raise_on_full=False)
 
-    def _preferred_pool_name(self, length: int) -> Optional[str]:
+    def _preferred_pool_name(self, length: int,
+                             exclude=()) -> Optional[str]:
         """First capability pool (preference order: ceiling ascending,
         declaration order) whose bucket ceiling covers `length` — the
-        router's primary target and the depth-accounting key."""
+        router's primary target and the depth-accounting key. `exclude`
+        skips pools by name (the cascade keeps full-tier work off the
+        draft pool)."""
         for pool in sorted(self._pools.values(), key=lambda p: p.rank):
+            if pool.name in exclude:
+                continue
             if pool.max_len >= length:
                 return pool.name
         return None
+
+    def _route_tier(self, entry: FleetRequest, length: int) -> Optional[str]:
+        """Pick the entry's preferred pool; with the cascade armed, also
+        stamp its tier. Draft-eligible work (length within the draft
+        pool's ladder and the policy's max_draft_length) goes to the
+        draft pool first; everything else — and escalations — goes to
+        the cheapest NON-draft pool."""
+        if self._cascade is None:
+            return self._preferred_pool_name(length)
+        draft = self._cascade.draft_pool
+        if entry.tier == "full" or entry.escalated:
+            return self._preferred_pool_name(length, exclude=(draft,))
+        eligible = (
+            self._pools[draft].max_len >= length
+            and (self._cascade.max_draft_length == 0
+                 or length <= self._cascade.max_draft_length))
+        if eligible:
+            entry.tier = "draft"
+            return draft
+        entry.tier = "full"
+        self._cascade_ledger.note_bypass("too_long")
+        return self._preferred_pool_name(length, exclude=(draft,))
 
     def _pool_retry_after(self, pool_name: Optional[str],
                           depth: Optional[int] = None) -> float:
@@ -1108,8 +1237,25 @@ class ServingFleet:
         tag = self._store_tag(entry.pool)
         key = request_key(f.seq, f.msa, tag, msa_mask=f.msa_mask)
         entry.store_key = (tag, key)
-        hit = self._store.lookup_result(tag, key)
-        if hit is not None:
+        lookups = [(tag, key)]
+        if self._cascade is not None and entry.tier == "draft":
+            # a FULL-fidelity result dominates a draft one: check the
+            # escalation target's tag first so a previously-escalated
+            # sequence is served at the better tier. The reverse never
+            # happens — full-tier entries only consult their own tag, so
+            # a draft result can never serve a full-fidelity lookup
+            # (tests/test_cascade.py pins the asymmetry)
+            full_pool = self._preferred_pool_name(
+                f.length, exclude=(self._cascade.draft_pool,))
+            if full_pool is not None:
+                ftag = self._store_tag(full_pool)
+                fkey = request_key(f.seq, f.msa, ftag,
+                                   msa_mask=f.msa_mask)
+                lookups.insert(0, (ftag, fkey))
+        for ltag, lkey in lookups:
+            hit = self._store.lookup_result(ltag, lkey)
+            if hit is None:
+                continue
             cached, level = hit
             latency = time.monotonic() - entry.enqueued_at
             if entry._finish(result=cached, replica="", degraded=False,
@@ -1138,7 +1284,7 @@ class ServingFleet:
         # pool-quoted retry_after_s key on it
         length = (entry.features.length if entry.features is not None
                   else len(entry.seq))
-        entry.pool = self._preferred_pool_name(length)
+        entry.pool = self._route_tier(entry, length)
         if self._front_door(entry):
             # served from the artifact store or attached to an identical
             # in-flight leader — the entry never reaches the admission
@@ -1406,6 +1552,8 @@ class ServingFleet:
             ).set(self.costs.fleet_chip_seconds_total() / completed)
         if self._featurize is not None:
             self._featurize.sample_gauges()
+        if self._cascade is not None:
+            self._cascade_ledger.publish()
 
     def _sample_headroom(self, now: float, healthy_by_pool: dict):
         """The capacity model closing ROADMAP item 2's loop: per pool,
@@ -1725,6 +1873,14 @@ class ServingFleet:
             }
         if self._journal is not None:
             out["journal"] = self._journal.stats()
+        if self._cascade is not None:
+            # /statusz "cascade" section: escalation rate + per-tier
+            # quality EMAs next to the policy that produced them, so an
+            # escalation-rate spike can be read against its thresholds
+            out["cascade"] = {
+                "policy": dataclasses.asdict(self._cascade),
+                **self._cascade_ledger.snapshot(),
+            }
         if self._budget is not None:
             out["retry_budget"] = self._budget.snapshot()
         if self._hedger is not None:
@@ -1933,6 +2089,31 @@ class ServingFleet:
         # the worst candidate, not an equal one — prefer untried healthy
         # replicas, fall to the degraded tier when none remain, and only
         # then retry where it failed (better a retry than a starve)
+        if self._cascade is not None:
+            draft_name = self._cascade.draft_pool
+            if entry.tier == "draft":
+                draft_only = [r for r in ranked if r.pool == draft_name]
+                if draft_only:
+                    ranked = draft_only
+                else:
+                    # the whole draft pool is down/retired: PROMOTE rather
+                    # than starve — the cascade is a cost optimization,
+                    # never an availability reduction. The entry re-tags
+                    # as full-tier so the store key, candidate set and
+                    # accounting all agree from here on.
+                    entry.tier = "full"
+                    entry.pool = self._preferred_pool_name(
+                        length, exclude=(draft_name,))
+                    self._cascade_ledger.note_bypass("draft_unavailable")
+                    self.flights.note(
+                        entry.trace_id, "cascade_promote",
+                        reason="draft_unavailable", pool=entry.pool)
+                    ranked = [r for r in ranked if r.pool != draft_name]
+            else:
+                # full-tier (incl. escalated) work must never land on the
+                # draft pool — a low-fidelity retry of a low-confidence
+                # draft would be noise, not verification
+                ranked = [r for r in ranked if r.pool != draft_name]
         fresh = [r for r in ranked if r.name not in entry.failed_on]
         stale = [r for r in ranked if r.name in entry.failed_on]
         targets = fresh
@@ -1991,6 +2172,26 @@ class ServingFleet:
                 "deadline passed at dispatch",
                 retry_after_s=self._admission.retry_after_s()))
             return True
+        features = entry.features
+        if (self._cascade is not None and features is not None
+                and features.msa is not None):
+            # one FeatureBundle rides every tier of the cascade
+            # (featurization is never repaid), but the draft pool's
+            # engines serve fewer MSA rows — hand each engine a VIEW
+            # truncated to its own row budget instead of tripping its
+            # featurized-for-a-different-deployment guard. Row truncation
+            # is the reduced-fidelity featurization by construction
+            # (featurize.py fills rows top-down), so the view is exactly
+            # what that pool would have featurized itself.
+            rows = getattr(getattr(engine, "cfg", None), "msa_rows", None)
+            if rows == 0:
+                features = dataclasses.replace(
+                    features, msa=None, msa_mask=None)
+            elif rows is not None and features.msa.shape[0] > rows:
+                features = dataclasses.replace(
+                    features, msa=features.msa[:rows],
+                    msa_mask=(features.msa_mask[:rows]
+                              if features.msa_mask is not None else None))
         try:
             # bind_trace: any span a helper records on the dispatcher
             # thread during THIS routing inherits the request's id
@@ -2006,7 +2207,8 @@ class ServingFleet:
                     trace_id=entry.trace_id,
                     # featurized once (tier or inline), dispatched many:
                     # a requeue onto another replica reuses the bundle
-                    features=entry.features,
+                    # (row-truncated to this engine's budget above)
+                    features=features,
                 )
         except QueueFullError:
             return False
@@ -2111,20 +2313,93 @@ class ServingFleet:
                     pool.service_ema_s = (
                         service_s if pool.service_ema_s is None
                         else 0.2 * service_s + 0.8 * pool.service_ema_s)
+            tier_meta = ""
+            if (self._cascade is not None and not degraded
+                    and rep.pool == self._cascade.draft_pool):
+                if entry.escalated:
+                    # a late draft arrival (hedge twin of the scored
+                    # dispatch) after the escalation decision: the full
+                    # tier owns the outcome now. The chip-second/health
+                    # accounting above already happened — just do not
+                    # finish, settle or persist the superseded draft.
+                    self.flights.note(entry.trace_id, "draft_superseded",
+                                      replica=rep.name)
+                    return
+                if entry.tier == "draft" and not entry.done():
+                    try:
+                        verdict = self._cascade_scorer.score(result)
+                    except Exception:  # noqa: BLE001 — a broken scorer
+                        # must degrade to "verify everything", never to
+                        # dropped requests or an unscored accept
+                        verdict = CascadeVerdict(
+                            accept=False, confidence=0.0, stress=0.0,
+                            reason="scorer_error")
+                    self._cascade_ledger.note_scored(verdict)
+                    if verdict.accept:
+                        entry.draft_accepted = True
+                    else:
+                        # ESCALATE: re-tag as full-tier and requeue; the
+                        # FeatureBundle rides (featurization is never
+                        # repaid), _route now excludes the draft pool,
+                        # and the draft result is discarded unstored.
+                        entry.escalated = True
+                        entry.tier = "full"
+                        length = (entry.features.length
+                                  if entry.features is not None
+                                  else len(entry.seq))
+                        entry.pool = self._preferred_pool_name(
+                            length, exclude=(self._cascade.draft_pool,))
+                        self.flights.note(
+                            entry.trace_id, "escalate",
+                            reason=verdict.reason,
+                            confidence=round(verdict.confidence, 4),
+                            stress=round(verdict.stress, 4),
+                            from_pool=rep.pool, to_pool=entry.pool)
+                        if entry.pool is not None:
+                            # the escalation is NEW demand on the verify
+                            # pool — count the arrival where the headroom
+                            # model will have to absorb it
+                            with self._arrivals_lock:
+                                self._arrivals[entry.pool] = (
+                                    self._arrivals.get(entry.pool, 0) + 1)
+                        self._admission.requeue(entry)
+                        return
+            if self._cascade is not None:
+                if entry.draft_accepted:
+                    tier_meta = "draft"
+                elif entry.escalated:
+                    tier_meta = "escalated"
+                else:
+                    tier_meta = "full"
             if entry._finish(result=result, replica=rep.name,
-                             degraded=degraded,
+                             degraded=degraded, tier=tier_meta,
                              latency_s=time.monotonic() - entry.enqueued_at):
                 self._counts["completed"].inc()
                 self._latency.observe(time.monotonic() - entry.enqueued_at)
                 if degraded:
                     self._degraded_total.inc()
+                finish_extra = {}
+                if self._cascade is not None:
+                    finish_extra["tier"] = tier_meta
+                    if entry.escalated:
+                        finish_extra["tier_path"] = "draft->escalated"
+                    elif entry.draft_accepted:
+                        finish_extra["tier_path"] = "draft-accepted"
+                    if result.exit_depth:
+                        finish_extra["exit_depth"] = result.exit_depth
+                    self._cascade_ledger.note_served(
+                        tier_meta,
+                        confidence=result.mean_confidence,
+                        stress=result.stress,
+                        exit_depth=result.exit_depth)
                 self.flights.finish(
                     entry.trace_id, "completed", replica=rep.name,
                     pool=rep.pool, degraded=degraded,
                     requeues=entry.requeues,
                     from_cache=result.from_cache, bucket=result.bucket,
                     latency_s=round(
-                        time.monotonic() - entry.enqueued_at, 6))
+                        time.monotonic() - entry.enqueued_at, 6),
+                    **finish_extra)
                 self._journal_settle(entry.trace_id)
             elif entry.hedges > 0:
                 # _finish lost the race on a HEDGED entry: this side is
@@ -2385,27 +2660,47 @@ class ServingFleet:
             # persist under the tag of the pool that actually SERVED the
             # request: a failover to another pool means another weight
             # precision / SP plan, i.e. another keyspace — storing it
-            # under the preferred pool's tag would alias wrong numerics
-            if rep.pool != entry.pool and rep.pool in self._pools:
-                tag = self._store_tag(rep.pool)
-                f = entry.features
-                key = request_key(f.seq, f.msa, tag, msa_mask=f.msa_mask)
-            # normalize provenance before persisting: a cached artifact
-            # carries no replica/latency history (each reader's result()
-            # copy re-stamps its own), and from_cache=True by decode
-            self._store.put_result(tag, key, dataclasses.replace(
-                result, from_cache=True, latency_s=0.0, replica="",
-                degraded=False, requeues=0, trace_id=""))
+            # under the preferred pool's tag would alias wrong numerics.
+            # Compare TAGS, not pool names: an ESCALATED entry has
+            # entry.pool == rep.pool (the verify pool) but a store_key
+            # minted at admit time under the DRAFT tag — keying on pool
+            # names would persist a full-fidelity result under the draft
+            # keyspace (the exact cross-tier aliasing the tags forbid).
+            persist = True
+            if rep.pool in self._pools:
+                serving_tag = self._store_tag(rep.pool)
+                if serving_tag != tag:
+                    tag = serving_tag
+                    f = entry.features
+                    key = request_key(f.seq, f.msa, tag,
+                                      msa_mask=f.msa_mask)
+            if (self._cascade is not None
+                    and rep.pool == self._cascade.draft_pool
+                    and not entry.draft_accepted):
+                # only ACCEPTED drafts may vouch for future lookups under
+                # the draft tag; an unscored/rejected draft result (e.g.
+                # a finish-race loser) must never enter the store
+                persist = False
+            if persist:
+                # normalize provenance before persisting: a cached
+                # artifact carries no replica/latency history (each
+                # reader's result() copy re-stamps its own), and
+                # from_cache=True by decode
+                self._store.put_result(tag, key, dataclasses.replace(
+                    result, from_cache=True, latency_s=0.0, replica="",
+                    degraded=False, requeues=0, trace_id=""))
         followers = self._frontdoor.settle(entry.store_key)
         # followers are served BY the coalition, not by a dispatch of
         # their own — their copy reads from_cache=True like a store hit
         shared = (None if result is None
                   else dataclasses.replace(result, from_cache=True))
+        leader_tier = entry._meta.get("tier", "") if entry.done() else ""
         for follower in followers:
             if shared is not None and rep is not None:
                 latency = time.monotonic() - follower.enqueued_at
                 if follower._finish(result=shared, replica=rep.name,
-                                    degraded=degraded, latency_s=latency):
+                                    degraded=degraded, tier=leader_tier,
+                                    latency_s=latency):
                     self._counts["completed"].inc()
                     self._latency.observe(latency)
                     if degraded:

@@ -37,6 +37,129 @@ from alphafold2_tpu.geometry import (
 from alphafold2_tpu.models import alphafold2_apply
 
 
+def _staged_trunk_logits(
+    params,
+    cfg,
+    tokens,
+    *,
+    mask,
+    msa,
+    msa_mask,
+    embedds,
+    templates,
+    templates_mask,
+    exit_depths,
+    exit_kl,
+):
+    """Trunk forward with confidence-gated depth early exit.
+
+    Runs front -> trunk segment -> head at each checkpoint depth and
+    freezes a sample's distogram once consecutive checkpoints agree
+    (per-sample masked-mean KL(prev ‖ cur) <= `exit_kl`). The FIRST
+    checkpoint is the delta-KL baseline — exits can fire from the second
+    checkpoint on, which is why the serving config demands >= 2 depths.
+
+    Per-sample outputs depend only on that sample's own tokens (the
+    freeze is a per-sample `where` select, never a data-dependent shape),
+    so the batch-composition-independence invariant the result cache
+    keys on still holds — only the batch's COST is composition-dependent,
+    exactly as micro-batching already makes it. Each checkpoint step is
+    wrapped in `lax.cond(all frozen)` so once the whole batch has exited,
+    the remaining trunk segments are skipped on device — that skipped
+    work is the chip-seconds the per-exit-depth cost cells
+    (serving/engine.py) price.
+
+    Returns (logits (b, L, L, buckets) float32, exit_depth (b,) int32).
+    """
+    from alphafold2_tpu.models.alphafold2 import (
+        alphafold2_front,
+        alphafold2_head,
+    )
+    from alphafold2_tpu.models.trunk import sequential_trunk_apply
+
+    if cfg.reversible:
+        raise ValueError(
+            "early exit segments the sequential layer list; the "
+            "reversible trunk is depth-stacked — set reversible=False"
+        )
+    checkpoints = tuple(sorted({int(d) for d in exit_depths}))
+    if len(checkpoints) < 2:
+        raise ValueError(
+            f"early exit needs >= 2 checkpoint depths (the first is the "
+            f"delta-KL baseline and can never exit), got {checkpoints}"
+        )
+    if checkpoints[0] < 1 or checkpoints[-1] >= cfg.depth:
+        raise ValueError(
+            f"early-exit depths must satisfy 1 <= d < depth={cfg.depth}, "
+            f"got {checkpoints}"
+        )
+    if len(set(cfg.layer_sparse)) > 1:
+        # sequential_trunk_apply indexes cfg.layer_sparse by LOCAL layer
+        # position; running a layer SLICE is only flag-correct when every
+        # layer shares the same flag
+        raise ValueError(
+            "early exit requires uniform sparse_self_attn flags across "
+            "the trunk (layer slices re-index cfg.layer_sparse from 0)"
+        )
+    if exit_kl <= 0:
+        raise ValueError(f"early_exit_kl must be > 0, got {exit_kl}")
+    checkpoints = checkpoints + (cfg.depth,)
+
+    x, m, x_mask, m_mask, _rng_trunk = alphafold2_front(
+        params, cfg, tokens, msa,
+        mask=mask, msa_mask=msa_mask, templates=templates,
+        templates_mask=templates_mask, embedds=embedds, rng=None,
+    )
+    layers = params["trunk"]
+    b, n = tokens.shape
+    if mask is not None:
+        pm = (mask[:, :, None] & mask[:, None, :]).astype(jnp.float32)
+    else:
+        pm = jnp.ones((b, n, n), jnp.float32)
+    denom = jnp.maximum(jnp.sum(pm, axis=(1, 2)), 1.0)
+
+    def head_logp(x_cur):
+        lg = alphafold2_head(params, cfg, x_cur).astype(jnp.float32)
+        return lg, jax.nn.log_softmax(lg, axis=-1)
+
+    # baseline segment: always runs, never exits
+    x, m = sequential_trunk_apply(
+        layers[: checkpoints[0]], cfg, x, m,
+        x_mask=x_mask, msa_mask=m_mask, rng=None,
+    )
+    out_logits, prev_logp = head_logp(x)
+    frozen = jnp.zeros((b,), bool)
+    exit_depth = jnp.full((b,), checkpoints[-1], jnp.int32)
+
+    start = checkpoints[0]
+    for ck in checkpoints[1:]:
+        seg = layers[start:ck]
+
+        def step(operand, seg=seg, ck=ck):
+            x_c, m_c, out_c, prev_c, frozen_c, depth_c = operand
+            x_n, m_n = sequential_trunk_apply(
+                seg, cfg, x_c, m_c,
+                x_mask=x_mask, msa_mask=m_mask, rng=None,
+            )
+            lg, logp = head_logp(x_n)
+            # per-sample masked-mean KL between consecutive checkpoint
+            # distograms; log-space and f32 throughout, pad pairs zeroed
+            kl = jnp.sum(jnp.exp(prev_c) * (prev_c - logp), axis=-1)
+            kl = jnp.sum(kl * pm, axis=(1, 2)) / denom
+            live = ~frozen_c
+            out_n = jnp.where(live[:, None, None, None], lg, out_c)
+            newly = live & (kl <= exit_kl)
+            depth_n = jnp.where(newly, ck, depth_c)
+            return (x_n, m_n, out_n, logp, frozen_c | newly, depth_n)
+
+        operand = (x, m, out_logits, prev_logp, frozen, exit_depth)
+        x, m, out_logits, prev_logp, frozen, exit_depth = jax.lax.cond(
+            jnp.all(frozen), lambda op: op, step, operand
+        )
+        start = ck
+    return out_logits, exit_depth
+
+
 def predict_structure(
     params,
     cfg,
@@ -52,6 +175,8 @@ def predict_structure(
     mds_iters: int = 200,
     mds_init: str = "classical",
     model_apply_fn=None,
+    early_exit_depths=(),
+    early_exit_kl: float = 0.0,
 ):
     """Tokens (+ optional MSA/embedds/templates) → CA trace + confidence.
 
@@ -73,6 +198,14 @@ def predict_structure(
       model_apply_fn: trunk-forward override with the `alphafold2_apply`
         keyword signature — e.g. a sequence-parallel wrapper
         (parallel/sp_trunk.py). Geometry always runs replicated.
+      early_exit_depths / early_exit_kl: static trunk-depth early-exit
+        knobs (the serving cascade's third lever, serving/cascade.py).
+        When `early_exit_depths` is non-empty the trunk runs in segments
+        and a sample freezes its distogram at the first checkpoint depth
+        whose masked-mean delta-KL from the previous checkpoint is
+        <= `early_exit_kl` (first checkpoint = baseline, never exits);
+        incompatible with `model_apply_fn` and `cfg.reversible`. Both
+        knobs must be covered by the serving config tag.
 
     Returns dict:
       coords: (b, L, 3) CA trace.
@@ -80,13 +213,32 @@ def predict_structure(
         (distogram-entropy pLDDT analog).
       stress: (b,) final normalized MDS stress.
       distogram_logits: (b, L, L, buckets) float32.
+      exit_depth: (b,) int32 trunk depth each sample's distogram froze
+        at — only when early exit is armed.
     """
-    apply_fn = model_apply_fn if model_apply_fn is not None else alphafold2_apply
-    logits = apply_fn(
-        params, cfg, tokens, msa,
-        mask=mask, msa_mask=msa_mask, embedds=embedds,
-        templates=templates, templates_mask=templates_mask,
-    )  # (b, L, L, buckets)
+    exit_depth = None
+    if early_exit_depths:
+        if model_apply_fn is not None:
+            raise ValueError(
+                "early exit drives the trunk itself (front/segments/"
+                "head); it cannot compose with model_apply_fn overrides"
+            )
+        logits, exit_depth = _staged_trunk_logits(
+            params, cfg, tokens,
+            mask=mask, msa=msa, msa_mask=msa_mask, embedds=embedds,
+            templates=templates, templates_mask=templates_mask,
+            exit_depths=early_exit_depths, exit_kl=float(early_exit_kl),
+        )  # (b, L, L, buckets) f32, (b,)
+    else:
+        apply_fn = (
+            model_apply_fn if model_apply_fn is not None
+            else alphafold2_apply
+        )
+        logits = apply_fn(
+            params, cfg, tokens, msa,
+            mask=mask, msa_mask=msa_mask, embedds=embedds,
+            templates=templates, templates_mask=templates_mask,
+        )  # (b, L, L, buckets)
 
     # geometry runs in float32 regardless of the trunk compute dtype: the
     # distogram -> MDS pipeline divides by pairwise distances and small
@@ -125,9 +277,12 @@ def predict_structure(
     )  # (b, 3, L), (iters, b)
 
     conf = distogram_confidence(probs, mask=mask)  # (b, L)
-    return {
+    out = {
         "coords": jnp.transpose(coords, (0, 2, 1)),  # (b, L, 3)
         "confidence": conf,
         "stress": stresses[-1],
         "distogram_logits": logits,
     }
+    if exit_depth is not None:
+        out["exit_depth"] = exit_depth
+    return out

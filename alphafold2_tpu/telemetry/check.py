@@ -91,6 +91,16 @@ _RULES: Tuple[Tuple[str, str, float], ...] = (
     # (a saturated SP pool hides inside a healthy global p95)
     ("*pool_queue_wait*", "lower", 0.25),
     ("*badput*", "lower", 0.25),
+    # the fidelity cascade (ISSUE 19): escalation rate is traffic
+    # COMPOSITION — a trace with more hard sequences legitimately
+    # escalates more; it must stay informational or a harder trace would
+    # read as a regression. Placed before *chip_seconds* so the rate row
+    # never falls through to a speed rule. Per-request chip cost is the
+    # gated cascade quantity, pinned explicitly (the generic
+    # *chip_seconds* rule below would also catch it, but the cascade
+    # bench gates at -30% and the doc trail should say so here).
+    ("*escalation_rate*", "ignore", 0.0),
+    ("*chip_seconds_per_request*", "lower", 0.25),
     # the serving cost plane (ISSUE 15): per-request chip cost gates
     # lower-better (it would also hit the generic *_seconds* rule, but
     # the explicit entry pins intent and a tighter doc trail); capacity

@@ -1,0 +1,320 @@
+"""A/B bench: the confidence-gated fidelity cascade under a mixed trace.
+
+Measures what ISSUE 19 gates on — `fleet_chip_seconds_per_request`
+amortized over a mixed-length MSA-bearing trace. Two arms over the SAME
+trace, the SAME weights, and the SAME tiny-but-real fleet (real engines,
+real executables, CPU backend):
+
+  off — one full-fidelity pool: every request pays the 8-row MSA stream,
+        the full trunk, and the reference 200-iteration MDS schedule.
+  on  — draft pool (sequence-only: the MSA stream dropped at dispatch,
+        trunk exits at the depth-2 delta-KL checkpoint, 8 MDS
+        iterations) in front of the full pool, gated by the stock
+        EntropyStressScorer. Confident drafts are served as-is; the rest
+        escalate to the full pool with their FeatureBundle riding — the
+        MSA the draft dispatch stripped is still in the bundle, so
+        escalation repays inference, never featurization.
+
+The draft gate threshold is CALIBRATED, not guessed: a draft-fidelity
+probe scores every unique sequence once and `min_confidence` is set at
+the midpoint that escalates the hardest --escalate-k of them — so the
+bench always exercises BOTH cascade verdicts (accept and escalate) and
+the recorded escalation rate is a trace property, not a tuning accident.
+
+Each arm writes a raw-bench-line artifact (`load_metrics`-compatible) to
+BENCH_cascade_off.json / BENCH_cascade_on.json at the repo root, then
+the telemetry.check improvement-floor gate runs in-process:
+
+    *chip_seconds_per_request* = lower : -0.30
+
+i.e. the cascade arm must CUT amortized chip-seconds per request by
+>= 30% or this script exits nonzero. The escalation rate rides in the
+same row under the default `*escalation_rate*=ignore` rule (traffic
+composition, never a speed gate). The equivalent CI command over the
+committed artifacts:
+
+    python -m alphafold2_tpu.telemetry.check \
+        --current BENCH_cascade_on.json \
+        --baseline BENCH_cascade_off.json \
+        --rule '*chip_seconds_per_request*=lower:-0.30'
+
+Draft-vs-full QUALITY rides in the `on` row via the PR 8 parity legs —
+distogram KL (full||draft) and top-L contact precision between the two
+fidelity arms over the unique sequences — so a draft tier that got
+cheap by drifting from the full-fidelity answer is visible in the same
+artifact the cost gate reads.
+
+Chip-free by design: device-seconds come from the PR 15 executable cost
+ledger (which realizes the async device call inside its timing window),
+pricing whatever backend ran the dispatch — the RATIO the gate checks
+is backend-independent (it counts work avoided: MSA rows never
+attended, trunk layers never run, MDS iterations never taken).
+
+Usage: python scripts/bench_cascade.py [--unique N] [--rounds N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from alphafold2_tpu.constants import aa_to_tokens  # noqa: E402
+from alphafold2_tpu.constants import AA_ORDER  # noqa: E402
+from alphafold2_tpu.geometry import center_distogram  # noqa: E402
+from alphafold2_tpu.models import (  # noqa: E402
+    Alphafold2Config,
+    alphafold2_init,
+)
+from alphafold2_tpu.serving import (  # noqa: E402
+    CascadePolicy,
+    FleetConfig,
+    PoolSpec,
+    ServingConfig,
+    ServingEngine,
+    ServingFleet,
+)
+from alphafold2_tpu.serving.pipeline import predict_structure  # noqa: E402
+from alphafold2_tpu.telemetry.check import check  # noqa: E402
+
+# big enough that the fidelity knobs dominate per-dispatch fixed
+# overhead on CPU (the draft tier's savings must be structural, not
+# timer noise): full fidelity pays 8 MSA rows + the depth-6 trunk +
+# the reference 200-iteration MDS schedule; the draft tier drops the
+# MSA stream, exits the trunk at the depth-2 delta-KL checkpoint, and
+# runs 8 MDS iterations
+CFG = Alphafold2Config(dim=96, depth=6, heads=4, dim_head=24,
+                       max_seq_len=32)
+BUCKETS = (16, 32)
+MSA_ROWS = 8
+FULL_MDS = 200
+DRAFT = dict(mds_iters=8, msa_rows=0, early_exit_depths=(1, 2),
+             early_exit_kl=1e9)
+AA = AA_ORDER.replace("W", "")
+GATE = [("*chip_seconds_per_request*", "lower", -0.30)]
+
+
+def seq_of(length: int, offset: int = 0) -> str:
+    return "".join(AA[(offset + i) % len(AA)] for i in range(length))
+
+
+def trace_seqs(n_unique: int) -> list:
+    # mixed lengths across both buckets — the length spread is what
+    # makes draft confidence differ per sequence
+    return [seq_of(10 + (4 * i) % 21, offset=i) for i in range(n_unique)]
+
+
+def synth_msa(seq: str) -> np.ndarray:
+    """Deterministic synthetic alignment: the query plus 7 mutated
+    homologs (20% of positions resampled per row)."""
+    rng = np.random.default_rng(len(seq))
+    base = np.asarray(aa_to_tokens(seq), np.int32)
+    rows = [base]
+    for _ in range(MSA_ROWS - 1):
+        row = base.copy()
+        idx = rng.integers(0, len(seq), size=max(1, len(seq) // 5))
+        row[idx] = rng.integers(0, 20, size=idx.size)
+        rows.append(row)
+    return np.stack(rows)
+
+
+def base_scfg() -> ServingConfig:
+    return ServingConfig(buckets=BUCKETS, max_batch=2, max_queue=16,
+                         max_wait_s=0.0, request_timeout_s=300.0,
+                         cache_capacity=0, mds_iters=FULL_MDS,
+                         msa_rows=MSA_ROWS)
+
+
+def calibrate_threshold(params, seqs, escalate_k: int) -> tuple:
+    """Score every unique sequence once at DRAFT fidelity and place
+    `min_confidence` at the midpoint above the hardest `escalate_k` of
+    them. Returns (threshold, per-seq draft confidences)."""
+    eng = ServingEngine(
+        params, CFG,
+        ServingConfig(buckets=BUCKETS, max_batch=1, max_queue=8,
+                      request_timeout_s=300.0, cache_capacity=0, **DRAFT))
+    try:
+        confs = [eng.predict(s).mean_confidence for s in seqs]
+    finally:
+        eng.shutdown()
+    ranked = sorted(confs)
+    lo, hi = ranked[escalate_k - 1], ranked[escalate_k]
+    if not hi > lo:
+        raise SystemExit(f"degenerate confidence spread {ranked}: cannot "
+                         f"place a threshold that escalates {escalate_k}")
+    return 0.5 * (lo + hi), confs
+
+
+def run_arm(params, seqs, rounds: int, policy) -> dict:
+    """One arm: fresh fleet (default engine factory, so the shared fleet
+    cost ledger prices every dispatch), the mixed trace run sequentially
+    so tier verdicts are per-request, not coalesced."""
+    if policy is None:
+        fcfg = FleetConfig(replicas=1, probe_interval_s=0,
+                           reprobe_interval_s=30.0)
+    else:
+        fcfg = FleetConfig(
+            pools=(PoolSpec("draft", replicas=1, **DRAFT),
+                   PoolSpec("full", replicas=1)),
+            cascade_policy=policy, probe_interval_s=0,
+            reprobe_interval_s=30.0)
+    fleet = ServingFleet(params, CFG, base_scfg(), fcfg)
+    try:
+        tiers = {}
+        n = 0
+        for _ in range(rounds):
+            for seq in seqs:
+                res = fleet.predict(seq, msa=synth_msa(seq))
+                tiers[res.tier or "full"] = tiers.get(res.tier or "full",
+                                                      0) + 1
+                n += 1
+        stats = fleet.stats()
+        completed = stats["requests"]["completed"]
+        assert completed == n, (completed, n)
+        chip_s = fleet.costs.fleet_chip_seconds_total()
+        row = {
+            "metric": "fleet_chip_seconds_per_request",
+            "value": chip_s / completed,
+            "unit": "chip-seconds/request",
+            "backend": jax.default_backend(),
+            "requests": float(completed),
+            "unique": float(len(seqs)),
+            "rounds": float(rounds),
+            "chip_seconds_total": chip_s,
+        }
+        if policy is not None:
+            casc = stats["cascade"]
+            row["escalation_rate"] = casc["escalation_rate"]
+            row["drafts_scored"] = float(casc["drafts_scored"])
+            row["tier_mix"] = {k: float(v) for k, v in sorted(tiers.items())}
+            # the bench premise: BOTH verdicts exercised on this trace
+            assert 0.0 < casc["escalation_rate"] < 1.0, casc
+        return row
+    finally:
+        fleet.shutdown()
+
+
+def quality_legs(params, seqs) -> dict:
+    """PR 8 parity legs, draft fidelity scored against full fidelity:
+    distogram KL (full||draft) and top-L contact precision over the
+    unique sequences. Pure pipeline calls — no fleet, no scorer. Every
+    sequence is padded to the top bucket so each fidelity arm traces
+    ONCE (mask excludes the padding from both legs)."""
+    top = BUCKETS[-1]
+
+    def arms(seq):
+        L = len(seq)
+        tok = np.zeros((1, top), np.int32)
+        tok[0, :L] = aa_to_tokens(seq)
+        mask = np.zeros((1, top), bool)
+        mask[0, :L] = True
+        msa = np.zeros((1, MSA_ROWS, top), np.int32)
+        msa[0, :, :L] = synth_msa(seq)
+        msa_mask = np.zeros((1, MSA_ROWS, top), bool)
+        msa_mask[0, :, :L] = True
+        full = predict_structure(params, CFG, jnp.asarray(tok),
+                                 mask=jnp.asarray(mask),
+                                 msa=jnp.asarray(msa),
+                                 msa_mask=jnp.asarray(msa_mask),
+                                 mds_iters=FULL_MDS)
+        draft = predict_structure(
+            params, CFG, jnp.asarray(tok), mask=jnp.asarray(mask),
+            mds_iters=DRAFT["mds_iters"],
+            early_exit_depths=DRAFT["early_exit_depths"],
+            early_exit_kl=DRAFT["early_exit_kl"])
+
+        def probs(out):
+            logits = np.asarray(out["distogram_logits"],
+                                np.float32)[:, :L, :L]
+            z = logits - logits.max(-1, keepdims=True)
+            e = np.exp(z)
+            return e / e.sum(-1, keepdims=True)
+
+        return probs(full), probs(draft)
+
+    def top_contacts(p):
+        d, _ = center_distogram(jnp.asarray(p))
+        d = np.asarray(d)[0]
+        L = d.shape[0]
+        ii, jj = np.triu_indices(L, k=3)
+        order = np.argsort(d[ii, jj])[:L]
+        return set(zip(ii[order].tolist(), jj[order].tolist()))
+
+    kls, precisions = [], []
+    for seq in seqs:
+        p_full, p_draft = arms(seq)
+        kl = (p_full * (np.log(p_full + 1e-9)
+                        - np.log(p_draft + 1e-9))).sum(-1)
+        kls.append(float(kl.mean()))
+        ref, got = top_contacts(p_full), top_contacts(p_draft)
+        precisions.append(len(ref & got) / max(len(got), 1))
+    return {
+        # floored like the PR 8 leg: keeps lower-better ratio math finite
+        "distogram_kl": max(float(np.mean(kls)), 1e-9),
+        "contact_precision": round(float(np.mean(precisions)), 4),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--unique", type=int, default=6,
+                    help="unique sequences in the mixed trace (default 6)")
+    ap.add_argument("--rounds", type=int, default=2,
+                    help="times the trace is replayed (default 2)")
+    ap.add_argument("--escalate-k", type=int, default=2,
+                    help="unique sequences the calibrated threshold "
+                         "escalates (default 2)")
+    args = ap.parse_args()
+    if not 0 < args.escalate_k < args.unique:
+        ap.error("--escalate-k must leave both verdicts represented")
+
+    params = alphafold2_init(jax.random.PRNGKey(0), CFG)
+    seqs = trace_seqs(args.unique)
+
+    threshold, confs = calibrate_threshold(params, seqs, args.escalate_k)
+    print(f"calibrated min_confidence={threshold:.6f} "
+          f"(draft confs {['%.6f' % c for c in confs]}) on "
+          f"{jax.default_backend()}")
+    policy = CascadePolicy(draft_pool="draft", min_confidence=threshold)
+
+    print(f"trace: {args.unique} unique x {args.rounds} rounds "
+          f"({args.unique * args.rounds} requests)")
+    baseline = run_arm(params, seqs, args.rounds, None)
+    print(f"  off: {baseline['value']:.6f} chip-s/request")
+    current = run_arm(params, seqs, args.rounds, policy)
+    current.update(quality_legs(params, seqs))
+    print(f"  on:  {current['value']:.6f} chip-s/request "
+          f"(escalation rate {current['escalation_rate']:.2f}, "
+          f"tiers {current['tier_mix']}, "
+          f"KL {current['distogram_kl']:.4f}, "
+          f"contact precision {current['contact_precision']:.2f})")
+
+    for name, row in (("BENCH_cascade_off.json", baseline),
+                      ("BENCH_cascade_on.json", current)):
+        path = os.path.join(REPO, name)
+        with open(path, "w") as fh:
+            json.dump(row, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {path}")
+
+    passed, rows = check(current, baseline, rules=GATE)
+    gated = next(r for r in rows
+                 if r["metric"] == "fleet_chip_seconds_per_request")
+    print(f"gate *chip_seconds_per_request*=lower:-0.30 -> "
+          f"change {gated['change']:+.1%} "
+          f"[{'PASS' if passed else 'FAIL'}]")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

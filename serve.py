@@ -197,6 +197,17 @@ def main():
                          "fleet tier; short requests route to the "
                          "cheapest capable pool, sequences past every "
                          "pool's ceiling shed with sequence_too_long")
+    ap.add_argument("--cascade", default="off", metavar="POLICY_JSON",
+                    help="adaptive-fidelity draft→verify cascade "
+                         "(serving/cascade.py; requires --pools): a "
+                         "serving.CascadePolicy JSON — "
+                         '{"draft_pool":"draft","min_confidence":0.7,'
+                         '"max_stress":0.3} — inline or a file path; '
+                         "unknown keys reject loudly. Eligible requests "
+                         "run on the draft pool first and only "
+                         "low-confidence drafts escalate to the "
+                         "full-fidelity pools. 'off' (default) keeps "
+                         "static pool routing")
     ap.add_argument("--featurize-workers", type=int, default=0,
                     help="CPU featurization worker threads in front of "
                          "the admission queue (0 = featurize inline); "
@@ -437,6 +448,25 @@ def main():
         ap.error("--sp-shards and --pools are mutually exclusive: with "
                  "pools configured, declare sp_shards per pool in the "
                  "pools JSON")
+    # adaptive-fidelity cascade (serving/cascade.py): parsed next to
+    # --pools because the policy's draft_pool must name one of them —
+    # FleetConfig validates the pairing loudly
+    cascade_policy = None
+    if args.cascade != "off":
+        from alphafold2_tpu.serving import CascadePolicy
+
+        if not pools:
+            ap.error("--cascade requires --pools: the draft tier is a "
+                     "capability pool (give it int8 weights / fewer "
+                     "mds_iters / reduced msa_rows in the pools JSON)")
+        try:
+            if os.path.exists(args.cascade):
+                cascade_policy = CascadePolicy.from_file(args.cascade)
+            else:
+                cascade_policy = CascadePolicy.from_dict(
+                    json.loads(args.cascade))
+        except ValueError as e:
+            ap.error(f"--cascade: {e}")
     union_buckets = tuple(sorted(
         set(buckets).union(*[p.buckets or buckets for p in pools])))
 
@@ -638,6 +668,7 @@ def main():
                 retry_budget_capacity=args.retry_budget,
                 hedge_p95_factor=args.hedge_factor,
                 hedge_rate_cap=args.hedge_rate_cap,
+                cascade_policy=cascade_policy,
             ),
             injector=injector,
             tracer=tracer,
@@ -659,7 +690,10 @@ def main():
                  if args.retry_budget else "")
               + (f", hedging p95 x{args.hedge_factor:g} "
                  f"(cap {args.hedge_rate_cap:g})"
-                 if args.hedge_factor else ""))
+                 if args.hedge_factor else "")
+              + (f", cascade draft_pool={cascade_policy.draft_pool!r} "
+                 f"min_confidence={cascade_policy.min_confidence:g}"
+                 if cascade_policy is not None else ""))
         if journal is not None:
             # replay BEFORE fresh traffic: crash-orphaned requests
             # re-enter the front door (coalescing + artifact store make
@@ -932,6 +966,10 @@ def main():
             tag += f" (requeued x{res.requeues})"
         if res.degraded:
             tag += " (DEGRADED)"
+        if res.tier:
+            tag += f" tier={res.tier}"
+            if res.exit_depth:
+                tag += f"@exit{res.exit_depth}"
         tid = f" tid={res.trace_id}" if res.trace_id else ""
         print(f"{name}: L={len(seq)} bucket={res.bucket} "
               f"stress={res.stress:.3f} "
