@@ -76,6 +76,26 @@ class BucketLadder:
         )
 
 
+def batch_shape_ladder(max_batch: int) -> Tuple[int, ...]:
+    """Power-of-two batch shapes {1, 2, 4, ...} up to `max_batch`.
+
+    The batch-dim twin of the length ladder above: with it, a partial
+    batch runs an executable compiled at the smallest rung >= its live
+    count instead of paying phantom-row chip time at the full
+    `max_batch` shape. `max_batch` itself is always the top rung even
+    when it is not a power of two, so a full batch never splits.
+    """
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    shapes = []
+    b = 1
+    while b < max_batch:
+        shapes.append(b)
+        b *= 2
+    shapes.append(int(max_batch))
+    return tuple(shapes)
+
+
 def pad_tokens(tokens: np.ndarray, bucket: int):
     """(L,) int tokens -> ((bucket,) padded tokens, (bucket,) bool mask).
     Padding depends only on the target length, not on ladder state."""

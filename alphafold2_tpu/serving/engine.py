@@ -25,10 +25,18 @@ padded shapes + executable reuse):
 
 Thread model: clients call `submit()`/`result()` from any thread; all
 model dispatch happens on the single worker thread, so device traffic is
-serialized by construction. Failure isolation: a model-call exception
-fails only the requests of that batch — and a multi-request batch is
-retried one request at a time first, so a single poison request cannot
-take its batchmates down with it.
+serialized by construction. With **pipelined dispatch** armed
+(`pipeline_depth > 0`, docs/SERVING.md "The dispatch pipeline") the
+worker still issues every device call in order, but realization,
+billing, and response move to a dedicated settle thread behind a bounded
+in-flight window — batch N's device compute overlaps batch N±1's host
+assembly and numpy conversion. The **batch-shape ladder**
+(`batch_ladder`) compiles each bucket at power-of-two batch shapes so a
+partial batch runs the smallest executable that fits instead of paying
+phantom-row chip time at `max_batch`. Failure isolation: a model-call
+exception fails only the requests of that batch — and a multi-request
+batch is retried one request at a time first, so a single poison request
+cannot take its batchmates down with it.
 
 Self-protection (reliability layer, both off by default): a
 consecutive-failure **circuit breaker** (`breaker_threshold` — open →
@@ -53,6 +61,7 @@ import numpy as np
 from alphafold2_tpu.serving.bucketing import (
     DEFAULT_BUCKETS,
     BucketLadder,
+    batch_shape_ladder,
     pad_batch,
 )
 from alphafold2_tpu.ops.dispatch import (
@@ -130,10 +139,26 @@ class ServingConfig:
     # Priced per exit depth as distinct cost-ledger cells.
     early_exit_depths: Tuple[int, ...] = ()
     early_exit_kl: float = 0.0
+    # batch-shape ladder (bucketing.batch_shape_ladder): compile each
+    # bucket at power-of-two batch shapes {1, 2, ..., max_batch} and
+    # assemble batches at the smallest shape >= live count, so a partial
+    # batch stops paying phantom-row chip time. Off = the classic
+    # single-shape engine (every executable at max_batch).
+    batch_ladder: bool = False
+    # pipelined dispatch: >0 splits the scheduler into an assembly/
+    # dispatch thread and a settle thread with at most this many batches
+    # enqueued-but-unsettled, so batch N's device compute overlaps batch
+    # N±1's host assembly / numpy conversion / settle. 0 = synchronous
+    # legacy path (dispatch realizes inline on the worker thread).
+    pipeline_depth: int = 0
 
     def __post_init__(self):
         if self.max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.pipeline_depth < 0:
+            raise ValueError(
+                f"pipeline_depth must be >= 0, got {self.pipeline_depth}"
+            )
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
         if self.max_wait_s < 0:
@@ -345,6 +370,28 @@ class ServingRequest:
 
 _IDLE_POLL_S = 0.05  # worker wake cadence when nothing is staged
 
+_SETTLE_STOP = object()  # settle-queue sentinel: enqueued LAST by the
+#                          worker's final flush / abort, so every
+#                          in-flight batch settles before the settle
+#                          thread exits
+
+
+@dataclasses.dataclass
+class _InFlight:
+    """One enqueued-but-unsettled pipelined batch (worker -> settle
+    thread handoff). `out` holds unrealized device buffers; `enqueue_t`
+    and `compile_s0` let the settle side bill enqueue->realized minus
+    any concurrent compile."""
+
+    bucket: int
+    shape: int
+    live: list
+    out: dict
+    idx: int
+    enqueue_t: float
+    compile_s0: float
+    n_real: int
+
 
 class ServingEngine:
     """Length-bucketed, micro-batching inference engine over
@@ -503,13 +550,26 @@ class ServingEngine:
         # sp_msa) agree only to rounding — never one cache keyspace
         # ... and the early-exit knobs: an early-exited distogram is a
         # different function of the sequence than the full-depth one
-        self._config_tag = repr((
+        # batch-shape ladder: the smallest executable shape >= live count
+        # serves each batch (perf only — per-sample outputs are batch-
+        # composition independent, serving/pipeline.py). Still covered by
+        # the config tag below when armed, so result-cache / artifact /
+        # AOT keyspaces never alias across ladder configs; unarmed
+        # engines keep the byte-identical legacy tag.
+        self._batch_shapes = (
+            batch_shape_ladder(cfg.max_batch) if cfg.batch_ladder
+            else (cfg.max_batch,)
+        )
+        tag_fields = (
             model_cfg, cfg.mds_iters, cfg.mds_init, cfg.seed, cfg.msa_rows,
             cfg.params_tag, self._ladder.buckets, self._dispatch_tag,
             cfg.sp_shards,
             tuple((b, r.schedule) for b, r in sorted(self._sp_plan.items())),
             cfg.early_exit_depths, cfg.early_exit_kl,
-        ))
+        )
+        if cfg.batch_ladder:
+            tag_fields = tag_fields + (("batch_ladder", self._batch_shapes),)
+        self._config_tag = repr(tag_fields)
 
         self._executables = {}
         self._compile_lock = threading.Lock()
@@ -519,7 +579,8 @@ class ServingEngine:
         self._counter_lock = threading.Lock()
         self._batch_counter = 0
         self._fault_hook = fault_hook
-        self._dispatch_counter = 0  # worker-thread only (the chaos clock)
+        self._dispatch_counter = 0  # the chaos clock; under _counter_lock
+        #                             (worker + settle-thread retries)
         self.replica_name = replica_name
         self._span_tags = {"replica": replica_name} if replica_name else {}
         self._incident_hook = incident_hook
@@ -571,24 +632,34 @@ class ServingEngine:
 
         backend_arm = dispatch_resolved_arm("flash_attention")
         rows = cfg.msa_rows
+        # one cell per (bucket, batch shape): the ladder leg compiles a
+        # distinct executable per shape, and each shape's measured EMA
+        # must never blend with another's (a 1-row batch and a 4-row
+        # batch of the same bucket cost ~4x apart). Shape is encoded as
+        # an `@b{B}` schedule suffix (same composition the cascade's
+        # `dense@exit{d}` cells use) so the CellKey arity and label set
+        # stay stable; unarmed engines keep the suffix-free legacy cells.
         for bucket in self._ladder.buckets:
             plan = self._sp_plan.get(bucket)
             schedule = plan.schedule if plan is not None else "dense"
             chips = cfg.sp_shards if schedule != "dense" else 1
-            residency = sp_arm.schedule_residency(
-                model_cfg, bucket=bucket, batch=cfg.max_batch,
-                msa_rows=rows, schedule=schedule, shards=max(1, chips),
-                weight_bytes=self._weight_residency["weight_bytes"],
-            )
-            self._cost_cells[bucket] = self.costs.register_cell(
-                pool=pool_name, bucket=bucket, schedule=schedule,
-                backend_arm=backend_arm,
-                weight_dtype=model_cfg.weight_dtype,
-                forward_flops=model_fwd_flops(
-                    model_cfg, n=bucket, r=rows, c=bucket),
-                residency_bytes=residency.total_bytes,
-                chips=max(1, chips), max_batch=cfg.max_batch,
-            )
+            for shape in self._batch_shapes:
+                residency = sp_arm.schedule_residency(
+                    model_cfg, bucket=bucket, batch=shape,
+                    msa_rows=rows, schedule=schedule, shards=max(1, chips),
+                    weight_bytes=self._weight_residency["weight_bytes"],
+                )
+                sched_tag = (f"{schedule}@b{shape}" if cfg.batch_ladder
+                             else schedule)
+                self._cost_cells[(bucket, shape)] = self.costs.register_cell(
+                    pool=pool_name, bucket=bucket, schedule=sched_tag,
+                    backend_arm=backend_arm,
+                    weight_dtype=model_cfg.weight_dtype,
+                    forward_flops=model_fwd_flops(
+                        model_cfg, n=bucket, r=rows, c=bucket),
+                    residency_bytes=residency.total_bytes,
+                    chips=max(1, chips), max_batch=shape,
+                )
 
         # per-exit-depth cost cells: a request whose trunk froze at depth
         # d did ~flops(d)/flops(depth) of the full forward. Each exit
@@ -608,32 +679,67 @@ class ServingEngine:
                     flops_d = model_fwd_flops(
                         sub_cfg, n=bucket, r=rows, c=bucket)
                     self._depth_flops[(bucket, d)] = flops_d
-                    sub_res = sp_arm.schedule_residency(
-                        sub_cfg, bucket=bucket, batch=cfg.max_batch,
-                        msa_rows=rows, schedule="dense", shards=1,
-                        weight_bytes=self._weight_residency["weight_bytes"],
-                    )
-                    self._exit_cells[(bucket, d)] = self.costs.register_cell(
-                        pool=pool_name, bucket=bucket,
-                        schedule=f"dense@exit{d}",
-                        backend_arm=backend_arm,
-                        weight_dtype=model_cfg.weight_dtype,
-                        forward_flops=flops_d,
-                        residency_bytes=sub_res.total_bytes,
-                        chips=1, max_batch=cfg.max_batch,
-                    )
+                    # exit cells compose with the batch-shape ladder the
+                    # same way the base cells do: one cell per (bucket,
+                    # exit depth, shape), schedule `dense@exit{d}@b{B}`
+                    for shape in self._batch_shapes:
+                        sub_res = sp_arm.schedule_residency(
+                            sub_cfg, bucket=bucket, batch=shape,
+                            msa_rows=rows, schedule="dense", shards=1,
+                            weight_bytes=self._weight_residency[
+                                "weight_bytes"],
+                        )
+                        exit_tag = (f"dense@exit{d}@b{shape}"
+                                    if cfg.batch_ladder else f"dense@exit{d}")
+                        self._exit_cells[(bucket, d, shape)] = (
+                            self.costs.register_cell(
+                                pool=pool_name, bucket=bucket,
+                                schedule=exit_tag,
+                                backend_arm=backend_arm,
+                                weight_dtype=model_cfg.weight_dtype,
+                                forward_flops=flops_d,
+                                residency_bytes=sub_res.total_bytes,
+                                chips=1, max_batch=shape,
+                            ))
                 self._depth_flops[(bucket, model_cfg.depth)] = (
                     model_fwd_flops(model_cfg, n=bucket, r=rows, c=bucket))
 
         self._closed = False
         self._drain_on_stop = True
         self._stop = threading.Event()
+        # ladder-aware drain-rate EMA (retry_after_estimate): seconds of
+        # non-overlapped batch wall per settled request. Written from
+        # whichever thread settles batches (worker in sync mode, settle
+        # thread in pipelined mode) and read from client threads.
+        self._rate_lock = threading.Lock()
+        self._sec_per_req_ema = 0.0
+        # ---- pipelined dispatch (cfg.pipeline_depth > 0) ----
+        # the worker thread assembles and ENQUEUES batches; the settle
+        # thread realizes device buffers, bills the cost plane, and
+        # resolves requests. The semaphore bounds enqueued-but-unsettled
+        # batches to the configured window; _last_realized_t is the
+        # engine-wide realization watermark _billed_window clamps
+        # against so concurrent in-flight spans never double-bill one
+        # wall second of device time.
+        self._settle_dead = False
+        self._pipeline_lock = threading.Lock()
+        self._last_realized_t = 0.0
+        self._settle_queue: "queue.Queue" = queue.Queue()
+        self._inflight_sem = threading.Semaphore(max(1, cfg.pipeline_depth))
+        self._settle_thread = None
         # precompile BEFORE the worker thread exists: a failing compile
         # must abort construction cleanly, not strand a started worker
         # (and the device params it references) behind a raised __init__
         if cfg.precompile:
             for bucket in self._ladder.buckets:
-                self._executable_for(bucket)
+                for shape in self._batch_shapes:
+                    self._executable_for(bucket, shape)
+        if cfg.pipeline_depth:
+            self._settle_thread = threading.Thread(
+                target=self._settle_loop,
+                name=f"af2-settle-{replica_name or 'engine'}", daemon=True
+            )
+            self._settle_thread.start()
         self._worker = threading.Thread(
             target=self._worker_loop,
             name=f"af2-serve-{replica_name or 'engine'}", daemon=True
@@ -889,11 +995,17 @@ class ServingEngine:
             "max_len": self._ladder.max_len,
         }
 
-    def cell_for(self, bucket: int) -> dict:
+    def cell_for(self, bucket: int, batch_shape: Optional[int] = None) -> dict:
         """The cost-ledger cell one bucket's executable bills to —
         flight records and operators use it to answer "this request ran
-        WHICH executable, on which arm, at what precision"."""
-        key = self._cost_cells.get(bucket)
+        WHICH executable, on which arm, at what precision". With the
+        batch-shape ladder armed each (bucket, shape) has its own cell;
+        `batch_shape=None` returns the top-rung cell (the shape a full
+        batch runs at — the identity known at submit time, before batch
+        assembly has picked a rung)."""
+        if batch_shape is None:
+            batch_shape = self._batch_shapes[-1]
+        key = self._cost_cells.get((bucket, batch_shape))
         if key is None:
             return {}
         pool, b, schedule, arm, dtype = key
@@ -902,13 +1014,42 @@ class ServingEngine:
 
     def retry_after_estimate(self) -> float:
         """Backoff advice for shed clients: batch-assembly wait plus the
-        backlog's drain time at the observed p50 — clamped so a cold
-        engine still answers something actionable."""
-        lat = self.metrics.latency.snapshot()
-        per_batch = lat.get("p50") or 0.1
-        backlog_batches = 1 + self._queue.qsize() // self.cfg.max_batch
-        est = self.cfg.max_wait_s + per_batch * backlog_batches
+        backlog drained at the measured per-request rate.
+
+        The rate is an EMA of non-overlapped batch wall seconds per
+        settled request, so it is ladder-aware by construction: partial
+        batches served at small ladder rungs feed their real (cheaper)
+        drain rate instead of the old assumption that every backlog
+        batch is a full `max_batch` dispatch at batch p50. A cold engine
+        (nothing settled yet) falls back to that p50 heuristic; both
+        paths clamp to something actionable."""
+        backlog = self._queue.qsize() + 1
+        with self._rate_lock:
+            sec_per_req = self._sec_per_req_ema
+        if sec_per_req > 0.0:
+            est = self.cfg.max_wait_s + sec_per_req * backlog
+        else:
+            lat = self.metrics.latency.snapshot()
+            per_batch = lat.get("p50") or 0.1
+            backlog_batches = 1 + self._queue.qsize() // self.cfg.max_batch
+            est = self.cfg.max_wait_s + per_batch * backlog_batches
         return float(min(60.0, max(0.05, est)))
+
+    def _note_drain(self, window_s: float, n: int):
+        """Feed the drain-rate EMA one settled batch: `window_s` is the
+        batch's NON-overlapped wall share (sync: dispatch wall), so in
+        pipelined mode concurrently in-flight batches don't each claim
+        the same second and overstate how slowly the engine drains."""
+        if n <= 0:
+            return
+        sec_per_req = window_s / n
+        with self._rate_lock:
+            if self._sec_per_req_ema == 0.0:
+                self._sec_per_req_ema = sec_per_req
+            else:
+                self._sec_per_req_ema = (
+                    0.2 * sec_per_req + 0.8 * self._sec_per_req_ema
+                )
 
     def health(self) -> dict:
         """Cheap liveness payload for `/healthz` (telemetry/ops_plane.py):
@@ -916,6 +1057,8 @@ class ServingEngine:
         "degraded" (up but fast-rejecting: breaker not closed), or
         "down" (closed or worker dead — the HTTP layer maps it to 503)."""
         alive = self._worker.is_alive()
+        if self._settle_thread is not None:
+            alive = alive and self._settle_thread.is_alive()
         status = "ok" if (not self._closed and alive) else "down"
         out = {
             "status": status,
@@ -924,6 +1067,8 @@ class ServingEngine:
             "queue_depth": self._queue.qsize(),
             "queue_capacity": self.cfg.max_queue,
         }
+        if self._settle_thread is not None:
+            out["settle_alive"] = self._settle_thread.is_alive()
         if self._breaker is not None:
             snap = self._breaker.snapshot()
             out["breaker"] = snap["state"]
@@ -950,6 +1095,12 @@ class ServingEngine:
         snap["cache"] = self._cache.snapshot()
         snap["buckets"] = list(self._ladder.buckets)
         snap["max_batch"] = self.cfg.max_batch
+        snap["batch_shapes"] = list(self._batch_shapes)
+        if self.cfg.pipeline_depth:
+            snap["pipeline"] = {
+                "depth": self.cfg.pipeline_depth,
+                **self.metrics.pipeline_snapshot(),
+            }
         snap["closed"] = self._closed
         snap["weights"] = dict(self._weight_residency)
         # which backend arm each hot op resolved to at build (part of the
@@ -992,7 +1143,10 @@ class ServingEngine:
 
         drain=True: pending requests (queued + staged) are served first —
         batch-assembly deadlines are waived, expiry still honored.
-        drain=False: pending requests fail with EngineClosedError.
+        drain=False: pending requests fail with EngineClosedError; with
+        pipelined dispatch, batches ALREADY enqueued on device are still
+        settled either way (their device time is spent — abandoning them
+        would only turn finished work into failures).
         Idempotent; safe to call from any thread except the worker.
         """
         # under the inflight lock: _abort_worker flips the same flag
@@ -1009,6 +1163,11 @@ class ServingEngine:
         # here would fail requests drain=True promised to serve
         if self._worker.is_alive():
             return
+        # the worker's final flush put the settle sentinel LAST, so by
+        # the time the settle thread sees it every in-flight batch has
+        # settled (drain=True's promise covers the pipeline window too)
+        if self._settle_thread is not None:
+            self._settle_thread.join(timeout)
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -1051,15 +1210,23 @@ class ServingEngine:
 
     # ------------------------------------------------- compile cache
 
-    def _executable_for(self, bucket: int):
-        """AOT-compiled executable for (bucket, engine config); compiled
-        at most once per bucket, under a lock (precompile + worker can
-        race)."""
+    def _executable_for(self, bucket: int, batch_shape: Optional[int] = None):
+        """AOT-compiled executable for (bucket, batch shape, engine
+        config); compiled at most once per (bucket, shape), under a lock
+        (precompile + worker can race). `batch_shape=None` compiles the
+        top ladder rung (== max_batch; the only rung without the
+        batch-shape ladder). Shapes never alias: the executable table is
+        keyed on the pair, so a 2-row batch can never run — or clobber —
+        the 4-row binary. The per-bucket compile gauges accumulate every
+        shape's seconds under the bucket (`compile_count` stays the
+        <= len(buckets) distinct-bucket invariant)."""
+        if batch_shape is None:
+            batch_shape = self._batch_shapes[-1]
         with self._compile_lock:
-            exe = self._executables.get(bucket)
+            exe = self._executables.get((bucket, batch_shape))
             if exe is not None:
                 return exe
-            B, rows = self.cfg.max_batch, self.cfg.msa_rows
+            B, rows = batch_shape, self.cfg.msa_rows
             mcfg, iters, init = self.model_cfg, self.cfg.mds_iters, self.cfg.mds_init
             apply_fn = self._model_apply_fn
             plan = self._sp_plan.get(bucket)
@@ -1121,14 +1288,16 @@ class ServingEngine:
                     )
             self.goodput.add(self._goodput_name, "compile",
                              time.monotonic() - t_compile)
-            self._executables[bucket] = exe
+            self._executables[(bucket, batch_shape)] = exe
             return exe
 
     def _call_executable(self, bucket: int, tokens, mask, msa=None,
                          msa_mask=None):
         """One device call. Overridable seam: tests substitute failure
-        injection or fake outputs here without touching the scheduler."""
-        exe = self._executable_for(bucket)
+        injection or fake outputs here without touching the scheduler.
+        The batch shape rides in `tokens.shape[0]` — batch assembly
+        already padded the rows to the chosen ladder rung."""
+        exe = self._executable_for(bucket, tokens.shape[0])
         with self._counter_lock:
             self._batch_counter += 1
             batch_idx = self._batch_counter
@@ -1136,6 +1305,23 @@ class ServingEngine:
         if self.cfg.msa_rows:
             return exe(self._params, tokens, mask, key, msa, msa_mask)
         return exe(self._params, tokens, mask, key)
+
+    def _next_dispatch_idx(self) -> int:
+        """Monotone dispatch index (the chaos clock) — under the counter
+        lock: the worker's pipelined enqueues and a settle-thread
+        poison-split retry can dispatch concurrently."""
+        with self._counter_lock:
+            idx = self._dispatch_counter
+            self._dispatch_counter += 1
+            return idx
+
+    def _realize(self, out):
+        """Block until a dispatch's output buffers are realized on host-
+        visible memory. Overridable seam: tests simulating a wedged
+        DEVICE computation (as opposed to a wedged dispatch call) block
+        or raise here — it is the exact point the hung-batch watchdog
+        guards in both dispatch modes."""
+        return jax.block_until_ready(out)
 
     def _dispatch(self, bucket: int, tokens, mask, msa=None, msa_mask=None,
                   trace_ids=()):
@@ -1150,8 +1336,7 @@ class ServingEngine:
         call runs inline (zero thread overhead, the production default
         when the runtime already bounds execution time).
         """
-        idx = self._dispatch_counter
-        self._dispatch_counter += 1
+        idx = self._next_dispatch_idx()
 
         def call():
             if self._fault_hook is not None:
@@ -1179,7 +1364,7 @@ class ServingEngine:
                 # batch's device-seconds while the real compute lands in
                 # the untimed np.asarray conversion) and a wedged device
                 # computation would slip past the hung-batch watchdog
-                return jax.block_until_ready(out)
+                return self._realize(out)
 
         timeout = self.cfg.watchdog_timeout_s
         if timeout is None:
@@ -1263,6 +1448,10 @@ class ServingEngine:
                     self.metrics.inc("failed")
                     self.metrics.inc_error(err)
         staged.clear()
+        if self._settle_thread is not None:
+            # in-flight batches (enqueued before the crash) still settle
+            # FIFO ahead of the sentinel; nothing new can follow it
+            self._settle_queue.put(_SETTLE_STOP)
 
     def _stage(self, staged, req: ServingRequest):
         staged.setdefault(req.bucket, []).append(req)
@@ -1311,6 +1500,11 @@ class ServingEngine:
                         self.metrics.inc("failed")
                         self.metrics.inc_error("engine_closed")
             staged.clear()
+        if self._settle_thread is not None:
+            # sentinel LAST: batches the drain just enqueued (and any
+            # still in flight from before the stop) settle first, so
+            # shutdown(drain=True) means "every in-flight batch settled"
+            self._settle_queue.put(_SETTLE_STOP)
 
     def _run_batch(self, bucket: int, reqs, allow_split: bool = True):
         now = time.monotonic()
@@ -1355,6 +1549,90 @@ class ServingEngine:
             self._run_live(bucket, live, allow_split)
 
     def _run_live(self, bucket: int, live, allow_split: bool):
+        shape = self._batch_shape_for(len(live))
+        if self.cfg.pipeline_depth and allow_split and not self._settle_dead:
+            self._run_pipelined(bucket, shape, live)
+        else:
+            # sync path: pipeline off, or a poison-isolation single
+            # retry (those run synchronously on whichever thread split
+            # the batch — the worker in sync mode, the settle thread in
+            # pipelined mode), or the settle thread died mid-flight
+            self._run_sync(bucket, shape, live, allow_split)
+
+    def _batch_shape_for(self, n: int) -> int:
+        """Smallest ladder rung that fits n live rows (== max_batch when
+        the batch-shape ladder is off)."""
+        for s in self._batch_shapes:
+            if n <= s:
+                return s
+        return self._batch_shapes[-1]
+
+    def _billed_window(self, t0: float, t1: float, compile_s0: float):
+        """(window_s, billed_s) for one dispatch realized over [t0, t1].
+
+        window_s is the span clamped against the engine-wide realization
+        watermark: with pipelined dispatch, concurrent in-flight spans
+        each cover the same wall seconds, and billing every span in full
+        would double-count device time (the PR 19 rule — bill what the
+        device actually spent — must survive the split). Settles are
+        FIFO, so the clamp partitions wall time exactly: the sum of
+        windows never exceeds wall, which is what keeps the goodput
+        ledger's sums-to-wall invariant intact. billed_s additionally
+        subtracts the compile tracker's delta over the span (a
+        first-call compile is accounted under "compile", never
+        "execute"); a compile straddling the span boundary is subtracted
+        in full — conservative under-billing, never double-billing.
+        Sync mode (depth 0) keeps the legacy arithmetic: window == wall.
+        """
+        compile_delta = self.metrics.compile_seconds_total() - compile_s0
+        if not self.cfg.pipeline_depth:
+            window = max(0.0, t1 - t0)
+        else:
+            with self._pipeline_lock:
+                start = max(t0, self._last_realized_t)
+                if t1 > self._last_realized_t:
+                    self._last_realized_t = t1
+            window = max(0.0, t1 - start)
+        return window, max(0.0, window - compile_delta)
+
+    def _fail_live(self, bucket: int, live, e: Exception, allow_split: bool,
+                   burned_s: float = 0.0):
+        """Shared failure tail for a dispatched batch (sync dispatch,
+        pipelined enqueue, or pipelined settle): bill the burned device
+        time as requeue badput, poison-split multi-request batches, and
+        otherwise resolve everything with the terminal error."""
+        if burned_s > 0.0:
+            # device time a FAILED dispatch burned: the failover bill
+            # ("requeue" badput — its requests requeue onto another
+            # replica or fail), never productive execute
+            self.goodput.add(self._goodput_name, "requeue", burned_s)
+        hung = isinstance(e, HungBatchError)
+        if not hung and allow_split and len(live) > 1:
+            # a poison request must not take its batchmates down: retry
+            # one at a time so only the offender fails. A HUNG batch is
+            # different — the device (not a request) is the suspect, and
+            # each per-request retry would burn another full watchdog
+            # window against a wedged call
+            for req in live:
+                self._run_batch(bucket, [req], allow_split=False)
+            return
+        # terminal dispatch outcome: the breaker counts it
+        if self._breaker is not None:
+            self._breaker.record_failure()
+        if hung:
+            err = e
+        else:
+            err = PredictionError(
+                f"prediction failed for bucket {bucket}: "
+                f"{type(e).__name__}: {e}"
+            )
+            err.__cause__ = e
+        for req in live:
+            if self._resolve(req, exc=err):
+                self.metrics.inc("failed")
+                self.metrics.inc_error(err)
+
+    def _run_sync(self, bucket: int, shape: int, live, allow_split: bool):
         dispatch_t0 = None  # set iff the device call actually started
         compile_s0 = 0.0
         try:
@@ -1363,11 +1641,11 @@ class ServingEngine:
             # call — isolated to its batch, never escalated to the
             # worker's last-resort abort
             tokens, mask, n_real = pad_batch(
-                [r.tokens for r in live], bucket, self.cfg.max_batch
+                [r.tokens for r in live], bucket, shape
             )
             msa = msa_mask = None
             if self.cfg.msa_rows:
-                msa, msa_mask = self._pad_msa_batch(live, bucket)
+                msa, msa_mask = self._pad_msa_batch(live, bucket, shape)
             # cost-plane timing: dispatch wall minus the compile
             # tracker's delta = pure execute seconds — a bucket's first
             # batch (30s+ of XLA on real models) must not poison the
@@ -1377,49 +1655,19 @@ class ServingEngine:
             dispatch_t0 = time.monotonic()
             out = self._dispatch(bucket, tokens, mask, msa, msa_mask,
                                  trace_ids=[r.trace_id for r in live])
-            exec_s = max(0.0, (time.monotonic() - dispatch_t0)
-                         - (self.metrics.compile_seconds_total()
-                            - compile_s0))
+            window, exec_s = self._billed_window(
+                dispatch_t0, time.monotonic(), compile_s0)
             coords = np.asarray(out["coords"])
             conf = np.asarray(out["confidence"])
             stress = np.asarray(out["stress"])
             exit_depth = (np.asarray(out["exit_depth"])
                           if "exit_depth" in out else None)
         except Exception as e:  # noqa: BLE001 — isolate, report, keep serving
+            burned = 0.0
             if dispatch_t0 is not None:
-                # device time a FAILED dispatch burned: the failover
-                # bill ("requeue" badput — its requests requeue onto
-                # another replica or fail), never productive execute
-                self.goodput.add(
-                    self._goodput_name, "requeue",
-                    max(0.0, (time.monotonic() - dispatch_t0)
-                        - (self.metrics.compile_seconds_total()
-                           - compile_s0)))
-            hung = isinstance(e, HungBatchError)
-            if not hung and allow_split and len(live) > 1:
-                # a poison request must not take its batchmates down:
-                # retry one at a time so only the offender fails. A HUNG
-                # batch is different — the device (not a request) is the
-                # suspect, and each per-request retry would burn another
-                # full watchdog window against a wedged call
-                for req in live:
-                    self._run_batch(bucket, [req], allow_split=False)
-                return
-            # terminal dispatch outcome: the breaker counts it
-            if self._breaker is not None:
-                self._breaker.record_failure()
-            if hung:
-                err = e
-            else:
-                err = PredictionError(
-                    f"prediction failed for bucket {bucket}: "
-                    f"{type(e).__name__}: {e}"
-                )
-                err.__cause__ = e
-            for req in live:
-                if self._resolve(req, exc=err):
-                    self.metrics.inc("failed")
-                    self.metrics.inc_error(err)
+                _, burned = self._billed_window(
+                    dispatch_t0, time.monotonic(), compile_s0)
+            self._fail_live(bucket, live, e, allow_split, burned_s=burned)
             return
 
         if self._breaker is not None:
@@ -1428,25 +1676,211 @@ class ServingEngine:
         # (accounted BEFORE the requests resolve, so a probe blocking on
         # its result observes this accounting inside its probe_span)
         self.goodput.add(self._goodput_name, "execute", exec_s)
-        self._bill_batch(bucket, exec_s, live, exit_depth)
+        self._bill_batch(bucket, shape, exec_s, live, exit_depth)
+        self._note_drain(window, len(live))
         done_at = time.monotonic()
         with self._tracer.span("serving.respond", cat="serving",
                                bucket=bucket, n=len(live),
                                trace_ids=[r.trace_id for r in live],
                                **self._span_tags):
-            self._respond(bucket, live, coords, conf, stress, n_real,
+            self._respond(bucket, shape, live, coords, conf, stress, n_real,
                           done_at, exit_depth=exit_depth)
 
-    def _bill_batch(self, bucket, exec_s, live, exit_depth):
+    # ------------------------------------------------- pipelined dispatch
+
+    def _run_pipelined(self, bucket: int, shape: int, live):
+        """Assemble + enqueue on the worker thread; realization, billing
+        and response move to the settle thread (`_settle_loop`). At most
+        `pipeline_depth` batches sit enqueued-but-unsettled, so batch
+        N's device compute overlaps batch N±1's host work without
+        letting the device queue grow unboundedly."""
+        idx = self._next_dispatch_idx()
+        acquired = False
+        try:
+            tokens, mask, n_real = pad_batch(
+                [r.tokens for r in live], bucket, shape
+            )
+            msa = msa_mask = None
+            if self.cfg.msa_rows:
+                msa, msa_mask = self._pad_msa_batch(live, bucket, shape)
+            # the chaos fault hook fires at the same point in the
+            # request's life as the sync path: after assembly, before
+            # the device call, inside the failure-isolation guard
+            if self._fault_hook is not None:
+                self._fault_hook(idx, bucket)
+            # bound the in-flight window BEFORE touching the device. The
+            # timeout loop keeps the worker responsive to a dead settle
+            # thread, whose releases would otherwise never come.
+            while not self._inflight_sem.acquire(timeout=0.1):
+                if self._settle_dead:
+                    raise PredictionError(
+                        "settle thread died with the pipeline window "
+                        "full; engine is closed")
+            acquired = True
+            # compile snapshot BEFORE the call — a first-use compile of
+            # this (bucket, shape) happens inside _call_executable and
+            # must be subtracted from the settle-side billing window
+            compile_s0 = self.metrics.compile_seconds_total()
+            enqueue_t = time.monotonic()
+            out = self._call_executable(bucket, tokens, mask, msa, msa_mask)
+        except Exception as e:  # noqa: BLE001 — same isolation as sync
+            if acquired:
+                self._inflight_sem.release()
+            self._fail_live(bucket, live, e, allow_split=True)
+            return
+        self.metrics.pipeline_inflight_delta(+1)
+        self._settle_queue.put(_InFlight(
+            bucket=bucket, shape=shape, live=live, out=out, idx=idx,
+            enqueue_t=enqueue_t, compile_s0=compile_s0, n_real=n_real,
+        ))
+
+    def _settle_loop(self):
+        """Settle-thread main: realize each in-flight batch FIFO, bill
+        the cost plane, resolve its requests. The worker enqueues the
+        stop sentinel LAST (final flush / abort), so every in-flight
+        batch settles before this thread exits."""
+        try:
+            while True:
+                rec = self._settle_queue.get()
+                if rec is _SETTLE_STOP:
+                    return
+                self._settle(rec)
+        except BaseException as e:  # noqa: BLE001 — last-resort guard
+            # mirror of _abort_worker: bookkeeping bugs on the settle
+            # side must not strand in-flight requests behind a silently
+            # dead thread
+            self._abort_settle(e)
+
+    def _settle(self, rec: "_InFlight"):
+        try:
+            out = self._wait_realized(rec)
+            realized_t = time.monotonic()
+            coords = np.asarray(out["coords"])
+            conf = np.asarray(out["confidence"])
+            stress = np.asarray(out["stress"])
+            exit_depth = (np.asarray(out["exit_depth"])
+                          if "exit_depth" in out else None)
+        except Exception as e:  # noqa: BLE001 — isolate, keep settling
+            realized_t = time.monotonic()
+            _, burned = self._billed_window(
+                rec.enqueue_t, realized_t, rec.compile_s0)
+            # release the window slot BEFORE the poison-split retries:
+            # those run synchronously here and the worker must be able
+            # to keep enqueuing behind them
+            self._inflight_sem.release()
+            self.metrics.pipeline_inflight_delta(-1)
+            self._fail_live(rec.bucket, rec.live, e, allow_split=True,
+                            burned_s=burned)
+            return
+        self._inflight_sem.release()
+        self.metrics.pipeline_inflight_delta(-1)
+        span_s = realized_t - rec.enqueue_t
+        window, exec_s = self._billed_window(
+            rec.enqueue_t, realized_t, rec.compile_s0)
+        # the execute span still brackets enqueue->realized per batch
+        # (the PR 19 contract); the overlap gauge is cumulative
+        # span/window — >1.0 exactly when in-flight batches overlapped
+        self._tracer.add("serving.execute", span_s, cat="serving",
+                         bucket=rec.bucket, dispatch=rec.idx,
+                         trace_ids=[r.trace_id for r in rec.live],
+                         **self._span_tags)
+        self.metrics.observe_pipeline_settle(span_s, window)
+        if self._breaker is not None:
+            self._breaker.record_success()
+        # accounted BEFORE the requests resolve (probe_span contract)
+        self.goodput.add(self._goodput_name, "execute", exec_s)
+        self._bill_batch(rec.bucket, rec.shape, exec_s, rec.live, exit_depth)
+        self._note_drain(window, len(rec.live))
+        done_at = time.monotonic()
+        with self._tracer.span("serving.respond", cat="serving",
+                               bucket=rec.bucket, n=len(rec.live),
+                               trace_ids=[r.trace_id for r in rec.live],
+                               **self._span_tags):
+            self._respond(rec.bucket, rec.shape, rec.live, coords, conf,
+                          stress, rec.n_real, done_at, exit_depth=exit_depth)
+
+    def _wait_realized(self, rec: "_InFlight"):
+        """Realize one in-flight batch under the hung-batch watchdog.
+
+        Every in-flight dispatch gets a FULL watchdog window measured
+        from when the settle thread reaches it (settles are FIFO): a
+        wedged batch fires its own watchdog and is abandoned, and its
+        pipelined neighbor then starts a fresh window — one wedged
+        in-flight batch never takes its neighbor down with it. Without a
+        watchdog the realization runs inline on the settle thread."""
+        timeout = self.cfg.watchdog_timeout_s
+        if timeout is None:
+            return self._realize(rec.out)
+        box = {}
+        done = threading.Event()
+
+        def runner():
+            try:
+                box["out"] = self._realize(rec.out)
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                box["exc"] = e
+            finally:
+                done.set()
+
+        threading.Thread(
+            target=runner, daemon=True,
+            name=f"af2-settle-wait-{self.replica_name or 'engine'}-{rec.idx}"
+        ).start()
+        if not done.wait(timeout):
+            self._incident("watchdog_fire", bucket=rec.bucket,
+                           dispatch=rec.idx, timeout_s=timeout,
+                           trace_ids=[r.trace_id for r in rec.live])
+            raise HungBatchError(
+                f"dispatch {rec.idx} (bucket {rec.bucket}) exceeded the "
+                f"{timeout}s hung-batch watchdog; in-flight realization "
+                f"abandoned"
+            )
+        if "exc" in box:
+            raise box["exc"]
+        return box["out"]
+
+    def _abort_settle(self, cause: BaseException):
+        import traceback
+
+        # _settle_dead FIRST: the worker's bounded semaphore acquire
+        # polls it, and must stop waiting on releases that will never
+        # come before it can observe the closed flag
+        self._settle_dead = True
+        with self._inflight_lock:
+            self._closed = True
+        traceback.print_exc()
+        err = PredictionError(
+            f"serving settle thread crashed: {type(cause).__name__}: "
+            f"{cause}; engine is closed"
+        )
+        err.__cause__ = cause
+        while True:
+            try:
+                rec = self._settle_queue.get_nowait()
+            except queue.Empty:
+                break
+            if rec is _SETTLE_STOP:
+                continue
+            self._inflight_sem.release()
+            self.metrics.pipeline_inflight_delta(-1)
+            for req in rec.live:
+                if self._resolve(req, exc=err):
+                    self.metrics.inc("failed")
+                    self.metrics.inc_error(err)
+
+    def _bill_batch(self, bucket, shape, exec_s, live, exit_depth):
         """Charge the batch's measured device-seconds to cost cells.
 
-        Without early exit the whole batch bills the bucket's one cell.
-        With it, requests grouped by exit depth split `exec_s`
+        Cells are keyed per (bucket, batch shape): the ladder leg's
+        whole point is that a 1-row dispatch is a different (cheaper)
+        executable than the 4-row one, so their EMAs must never blend.
+        Without early exit the whole batch bills that one cell. With it,
+        requests grouped by exit depth split `exec_s`
         flops-proportionally across the per-exit-depth cells — the shares
         sum to exec_s exactly, so `fleet_chip_seconds_total` (the bench
         gate's headline) stays a faithful device-time integral."""
         if exit_depth is None or not self._exit_cells:
-            self.costs.observe_batch(self._cost_cells[bucket],
+            self.costs.observe_batch(self._cost_cells[(bucket, shape)],
                                      device_seconds=exec_s,
                                      requests=len(live))
             return
@@ -1460,15 +1894,15 @@ class ServingEngine:
             self._depth_flops.get((bucket, d), full_flops) * n
             for d, n in groups.items())
         for d, n in sorted(groups.items()):
-            cell = self._exit_cells.get((bucket, d),
-                                        self._cost_cells[bucket])
+            cell = self._exit_cells.get((bucket, d, shape),
+                                        self._cost_cells[(bucket, shape)])
             w = self._depth_flops.get((bucket, d), full_flops) * n
             share = exec_s * (w / total_w) if total_w else 0.0
             self.costs.observe_batch(cell, device_seconds=share,
                                      requests=n)
 
-    def _respond(self, bucket, live, coords, conf, stress, n_real, done_at,
-                 exit_depth=None):
+    def _respond(self, bucket, shape, live, coords, conf, stress, n_real,
+                 done_at, exit_depth=None):
         for i, req in enumerate(live):
             L = req.length
             # copies, not views: a view would both pin the whole
@@ -1496,16 +1930,17 @@ class ServingEngine:
                 self.metrics.inc("completed")
                 self.metrics.latency.observe(result.latency_s)
         self.metrics.observe_batch(
-            n_real, self.cfg.max_batch,
+            n_real, shape,
             latency_s=done_at - live[0].submitted_at,
         )
 
-    def _pad_msa_batch(self, live, bucket: int):
-        """(B, rows, bucket) MSA stream. A request without an MSA gets its
-        query as row 0 (an alignment always contains the query); unused
-        rows duplicate row 0 under a False mask — finite values that
-        masked attention zero-weights, never NaN-generating garbage."""
-        B, rows = self.cfg.max_batch, self.cfg.msa_rows
+    def _pad_msa_batch(self, live, bucket: int, batch_shape: int):
+        """(B, rows, bucket) MSA stream at the chosen batch shape. A
+        request without an MSA gets its query as row 0 (an alignment
+        always contains the query); unused rows duplicate row 0 under a
+        False mask — finite values that masked attention zero-weights,
+        never NaN-generating garbage."""
+        B, rows = batch_shape, self.cfg.msa_rows
         from alphafold2_tpu.constants import PAD_TOKEN_ID
 
         msa = np.full((B, rows, bucket), PAD_TOKEN_ID, np.int32)

@@ -13,6 +13,11 @@ named — instead of the ad-hoc dicts this module used to keep:
              (via `telemetry.CompileTracker`)
   latency:   histogram `serving_request_latency_seconds`
              (sliding-window p50/p95/p99)
+  padding:   gauge `serve_batch_pad_ratio` — cumulative padded rows /
+             live rows across dispatches (batch-shape ladder waste)
+  pipeline:  gauges `serve_pipeline_inflight` /
+             `serve_pipeline_overlap_ratio` — the engine's pipelined
+             dispatch (settle thread) feeds both
 
 `snapshot()` keeps its pre-registry JSON shape — it is the engine's
 health-check payload (`ServingEngine.stats()`) and the chaos suite
@@ -89,6 +94,31 @@ class ServingMetrics:
             help="real requests across dispatched batches")
         self._recent_lock = threading.Lock()
         self._recent_batch_sizes = collections.deque(maxlen=256)
+        # batch-shape ladder accounting (serving/bucketing.py
+        # batch_shape_ladder): cumulative padded vs live rows across
+        # dispatched batches — the waste the ladder deletes. Occupancy
+        # is measured against the CHOSEN batch shape, not max_batch.
+        self._shape_rows = 0   # sum of chosen batch shapes (row slots)
+        self._live_rows = 0    # sum of real requests (live rows)
+        self._pad_ratio_gauge = self.registry.gauge(
+            "serve_batch_pad_ratio",
+            help="cumulative padded rows / live rows across dispatched "
+                 "batches (batch-shape ladder waste metric)")
+        # pipelined-dispatch accounting (engine settle thread): span =
+        # enqueue->realized per batch; window = the same span clamped
+        # against previously realized batches (the non-double-billed
+        # device seconds). span/window > 1 iff in-flight batches overlap.
+        self._pipe_lock = threading.Lock()
+        self._pipe_span_s = 0.0
+        self._pipe_window_s = 0.0
+        self._pipe_inflight = 0
+        self._pipe_inflight_gauge = self.registry.gauge(
+            "serve_pipeline_inflight",
+            help="batches enqueued on device but not yet settled")
+        self._pipe_overlap_gauge = self.registry.gauge(
+            "serve_pipeline_overlap_ratio",
+            help="sum(enqueue->realized spans) / union of those spans; "
+                 "1.0 = synchronous dispatch, >1.0 = pipelined overlap")
         self._compiles_lock = threading.Lock()
         self._compile_seconds = {}  # bucket -> seconds gauge (snapshot view)
         # prefix "serving_compile": the tracker's `<prefix>_seconds_total`
@@ -121,20 +151,57 @@ class ServingMetrics:
                 self._errors[code] = counter
         counter.inc(n)
 
-    def observe_batch(self, n_real: int, max_batch: int, latency_s: float):
-        """One dispatched batch: n_real real requests of max_batch slots;
+    def observe_batch(self, n_real: int, batch_shape: int, latency_s: float):
+        """One dispatched batch: n_real real requests of `batch_shape`
+        row slots (the CHOSEN executable shape — max_batch without the
+        batch-shape ladder, the smallest ladder rung >= n_real with it);
         latency_s is the oldest member's submit->complete latency."""
         self._batches.inc()
         self._batch_requests.inc(n_real)
         with self._recent_lock:
             self._recent_batch_sizes.append(n_real)
+            self._shape_rows += batch_shape
+            self._live_rows += n_real
+            live = self._live_rows
+            pad = self._shape_rows - self._live_rows
+        self._pad_ratio_gauge.set(pad / live if live else 0.0)
         step = int(self._batches.value)
         if self._logger is not None:
             self._logger.log(step, {
                 "batch_requests": n_real,
-                "batch_occupancy": n_real / max_batch,
+                "batch_shape": batch_shape,
+                "batch_occupancy": n_real / batch_shape,
                 "batch_latency_s": latency_s,
             })
+
+    def observe_pipeline_settle(self, span_s: float, window_s: float):
+        """One settled pipelined batch: span = enqueue->realized wall,
+        window = the span's non-overlapping share (engine's realized-
+        watermark clamp). The published overlap ratio is cumulative
+        span/window — exactly 1.0 when dispatch is synchronous."""
+        with self._pipe_lock:
+            self._pipe_span_s += span_s
+            self._pipe_window_s += window_s
+            span, window = self._pipe_span_s, self._pipe_window_s
+        self._pipe_overlap_gauge.set(span / window if window > 0 else 0.0)
+
+    def pipeline_inflight_delta(self, delta: int):
+        """Track batches enqueued-but-unsettled (the in-flight window)."""
+        with self._pipe_lock:
+            self._pipe_inflight += delta
+            n = self._pipe_inflight
+        self._pipe_inflight_gauge.set(n)
+
+    def pipeline_snapshot(self) -> dict:
+        with self._pipe_lock:
+            span, window = self._pipe_span_s, self._pipe_window_s
+            inflight = self._pipe_inflight
+        return {
+            "inflight": inflight,
+            "span_seconds": span,
+            "window_seconds": window,
+            "overlap_ratio": span / window if window > 0 else 0.0,
+        }
 
     @contextlib.contextmanager
     def compile_span(self, bucket: int):
@@ -198,6 +265,8 @@ class ServingMetrics:
         batch_requests = int(self._batch_requests.value)
         with self._recent_lock:
             recent = list(self._recent_batch_sizes)
+            shape_rows = self._shape_rows
+            live_rows = self._live_rows
         with self._compiles_lock:
             compiles = {b: g.value for b, g in self._compile_seconds.items()}
         with self._errors_lock:
@@ -219,8 +288,17 @@ class ServingMetrics:
                 "mean_requests_per_batch": (
                     batch_requests / batches if batches else 0.0
                 ),
+                # occupancy vs the CHOSEN batch shape per dispatch (the
+                # batch-shape ladder's view); falls back to max_batch
+                # slots for direct-call paths that never observed a batch
                 "mean_occupancy": (
-                    batch_requests / (batches * max_batch) if batches else 0.0
+                    batch_requests / shape_rows if shape_rows
+                    else (batch_requests / (batches * max_batch)
+                          if batches else 0.0)
+                ),
+                "pad_ratio": (
+                    (shape_rows - live_rows) / live_rows if live_rows
+                    else 0.0
                 ),
                 "recent_sizes": recent,
             },

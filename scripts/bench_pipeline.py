@@ -1,0 +1,217 @@
+"""A/B bench: batch-shape ladder + pipelined dispatch on a bursty trace.
+
+Measures what ISSUE 20 gates on — chip-seconds per request and dispatch
+overlap — over the SAME bursty partial-batch trace and the SAME
+tiny-but-real engine (real executables, CPU backend). Two arms:
+
+  off — the classic engine: every bucket compiled at max_batch only,
+        synchronous dispatch (assemble -> dispatch -> block_until_ready
+        -> settle on one worker thread). Partial batches pay phantom-row
+        chip time; the device idles through every host-side phase.
+  on  — batch_ladder=True + pipeline_depth=2: partial batches run the
+        smallest power-of-two rung that fits, and realization moves to
+        the settle thread so batch N's device compute overlaps batch
+        N±1's host work.
+
+The trace is bursty by construction: short waves of 1-2 requests land
+back to back (the pipeline's overlap window), separated by idle gaps
+long enough that batches stay PARTIAL (the ladder's waste window) —
+the traffic shape ParaFold/HelixFold-style serving actually sees.
+
+Each arm writes a raw-bench-line artifact (`load_metrics`-compatible)
+to BENCH_pipeline_off.json / BENCH_pipeline_on.json at the repo root,
+then the telemetry.check gate runs in-process:
+
+    *chip_seconds_per_request* = lower  : -0.25  (ladder must CUT >=25%)
+    *overlap_ratio*            = higher : -0.10  (pipeline must overlap:
+                                                  off arm is 1.0 by
+                                                  construction, on arm
+                                                  must measure > 1.0)
+
+The equivalent CI command over the committed artifacts:
+
+    python -m alphafold2_tpu.telemetry.check \
+        --current BENCH_pipeline_on.json \
+        --baseline BENCH_pipeline_off.json \
+        --rule '*chip_seconds_per_request*=lower:-0.25' \
+        --rule '*overlap_ratio*=higher:-0.10' \
+        --rule 'goodput_wall_seconds=ignore:0'
+
+(the wall ignore: the on arm AOT-warms every ladder rung where the off
+arm compiles one shape, so cross-arm wall is apples-to-oranges — the
+default `*_seconds*` lower-better rule would gate it backwards)
+
+Chip-free by design: the PR 15 cost ledger prices whatever backend ran
+the dispatch, and both legs are RATIOS over the same backend. Both
+arms also self-check the PR 19/20 accounting invariants: the goodput
+ledger's accounted seconds sum to <= wall (the watermark clamp means
+pipelining never double-bills a second) and the cost-ledger total
+reconciles with the goodput execute account exactly.
+
+Usage: python scripts/bench_pipeline.py [--bursts N] [--mds-iters K]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+from alphafold2_tpu.constants import AA_ORDER  # noqa: E402
+from alphafold2_tpu.models import Alphafold2Config, alphafold2_init  # noqa: E402
+from alphafold2_tpu.serving import ServingConfig, ServingEngine  # noqa: E402
+from alphafold2_tpu.telemetry.check import check  # noqa: E402
+
+TINY = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8, max_seq_len=16)
+AA = AA_ORDER.replace("W", "")
+
+# the bursty partial-batch trace: each burst is three quick waves of
+# 1/2/1 requests (they arrive inside the pipeline's overlap window),
+# bursts are separated by a gap long enough that batches stay partial
+BURST_WIDTHS = (1, 2, 1)
+WAVE_PACE_S = 0.005
+BURST_GAP_S = 0.12
+
+
+def seq_of(length: int, offset: int = 0) -> str:
+    return "".join(AA[(offset + i) % len(AA)] for i in range(length))
+
+
+def run_arm(params, *, on: bool, bursts: int, mds_iters: int) -> dict:
+    """One arm over the shared trace. precompile=True keeps compile wall
+    out of the measured window on both arms (compile is excluded from
+    execute billing either way; precompiling just removes the first-call
+    latency skew between arms)."""
+    cfg = ServingConfig(
+        buckets=(16,), max_batch=4, max_queue=64, max_wait_s=0.01,
+        request_timeout_s=300.0, cache_capacity=0, mds_iters=mds_iters,
+        precompile=True,
+        batch_ladder=on, pipeline_depth=(2 if on else 0),
+    )
+    eng = ServingEngine(params, TINY, cfg)
+    try:
+        reqs = []
+        k = 0
+        for _b in range(bursts):
+            for width in BURST_WIDTHS:
+                # distinct sequences: no cache hits, no coalescing —
+                # every request is a real dispatch row
+                reqs.append([eng.submit(seq_of(9 + (k + j) % 8,
+                                               offset=5 * k + j))
+                             for j in range(width)])
+                k += 1
+                time.sleep(WAVE_PACE_S)
+            time.sleep(BURST_GAP_S)
+        for wave in reqs:
+            for r in wave:
+                r.result(timeout=300)
+
+        stats = eng.stats()
+        n = stats["requests"]["completed"]
+        assert n == bursts * sum(BURST_WIDTHS), stats["requests"]
+        assert stats["requests"]["failed"] == 0
+
+        # -- accounting invariants (both arms, before any gate) --------
+        # (1) sums-to-wall: the watermark clamp means pipelined billing
+        # never charges the same wall second twice
+        accounted = sum(eng.goodput.totals("engine").values())
+        wall = eng.goodput.wall("engine")
+        assert accounted <= wall * 1.01 + 1e-6, (accounted, wall)
+        # (2) ledger == goodput execute: every billed device-second
+        # lands in exactly one cost cell AND the execute account
+        chip_s = eng.costs.fleet_chip_seconds_total()
+        execute_s = stats["serve_goodput"]["replicas"]["engine"][
+            "buckets"]["execute"]
+        assert abs(chip_s - execute_s) <= max(1e-6, 0.001 * execute_s), (
+            chip_s, execute_s)
+
+        if on:
+            overlap = stats["pipeline"]["overlap_ratio"]
+            assert stats["pipeline"]["inflight"] == 0, stats["pipeline"]
+            assert overlap > 1.0, (
+                f"pipelined arm measured no overlap: {stats['pipeline']}")
+        else:
+            # synchronous dispatch: span == window per batch by
+            # construction — the ratio is identically 1.0
+            overlap = 1.0
+        row = {
+            "metric": "serve_chip_seconds_per_request",
+            "value": chip_s / n,
+            "unit": "seconds/request",
+            "backend": jax.default_backend(),
+            "arm": "ladder+pipeline" if on else "sync-maxbatch",
+            "requests": float(n),
+            "batches": float(stats["batches"]["count"]),
+            "pad_ratio": stats["batches"]["pad_ratio"],
+            "mean_occupancy": stats["batches"]["mean_occupancy"],
+            "overlap_ratio": overlap,
+            "chip_seconds_total": chip_s,
+            "goodput_execute_seconds": execute_s,
+            "goodput_accounted_seconds": accounted,
+            "goodput_wall_seconds": wall,
+        }
+        return row
+    finally:
+        eng.shutdown(timeout=60)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--bursts", type=int, default=8,
+                    help="bursts per arm; each is 1+2+1 requests "
+                         "(default 8 -> 32 requests)")
+    ap.add_argument("--mds-iters", type=int, default=768,
+                    help="MDS iterations — sizes per-dispatch device "
+                         "time so overlap is measurable above host "
+                         "noise (default 768: ~tens of ms per dispatch "
+                         "on a laptop-class CPU)")
+    args = ap.parse_args()
+    if args.bursts < 2:
+        ap.error("--bursts must be >= 2")
+
+    params = alphafold2_init(jax.random.PRNGKey(0), TINY)
+    n = args.bursts * sum(BURST_WIDTHS)
+    print(f"trace: {args.bursts} bursts x {BURST_WIDTHS} waves = {n} "
+          f"requests on {jax.default_backend()}, mds_iters={args.mds_iters}")
+    baseline = run_arm(params, on=False, bursts=args.bursts,
+                       mds_iters=args.mds_iters)
+    print(f"  off: {baseline['value'] * 1e3:.2f} chip-ms/req over "
+          f"{baseline['batches']:.0f} batches, pad ratio "
+          f"{baseline['pad_ratio']:.2f}, overlap {baseline['overlap_ratio']:.2f}")
+    current = run_arm(params, on=True, bursts=args.bursts,
+                      mds_iters=args.mds_iters)
+    print(f"  on:  {current['value'] * 1e3:.2f} chip-ms/req over "
+          f"{current['batches']:.0f} batches, pad ratio "
+          f"{current['pad_ratio']:.2f}, overlap {current['overlap_ratio']:.2f}")
+
+    for name, row in (("BENCH_pipeline_off.json", baseline),
+                      ("BENCH_pipeline_on.json", current)):
+        path = os.path.join(REPO, name)
+        with open(path, "w") as fh:
+            json.dump(row, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {path}")
+
+    gate = [("*chip_seconds_per_request*", "lower", -0.25),
+            ("*overlap_ratio*", "higher", -0.10)]
+    passed, rows = check(current, baseline, rules=gate)
+    for r in rows:
+        if r["direction"] is None:
+            continue
+        print(f"gate {r['metric']}={r['direction']}:{r['tolerance']:+.2f} "
+              f"-> change {r['change']:+.1%} "
+              f"[{'PASS' if r['status'] == 'ok' else 'FAIL'}]")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -125,6 +125,16 @@ def main():
     ap.add_argument("--buckets", default="64,128,256",
                     help="comma-separated length-bucket ladder")
     ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--batch-ladder", action="store_true",
+                    help="compile each bucket at power-of-two batch "
+                         "shapes {1, 2, ..., max-batch} and serve partial "
+                         "batches at the smallest fitting shape instead "
+                         "of paying phantom-row chip time at max-batch")
+    ap.add_argument("--pipeline-depth", type=int, default=0,
+                    help="pipelined dispatch: keep up to this many "
+                         "batches enqueued-but-unsettled so device "
+                         "compute overlaps host assembly/settle "
+                         "(0 = synchronous dispatch)")
     ap.add_argument("--max-wait-ms", type=float, default=50.0,
                     help="batch-assembly deadline for partial batches")
     ap.add_argument("--queue-size", type=int, default=64)
@@ -566,6 +576,8 @@ def main():
         params_tag=params_tag,
         sp_shards=args.sp_shards,
         sp_hbm_gb=args.sp_hbm_gb,
+        batch_ladder=args.batch_ladder,
+        pipeline_depth=args.pipeline_depth,
         breaker_threshold=args.breaker_threshold,
         breaker_reset_s=args.breaker_reset,
         watchdog_timeout_s=(

@@ -1415,3 +1415,79 @@ def test_serve_cli_crash_process_restart_replays_journal(tmp_path):
     assert "journal replay:" in out2.stdout
     assert "0 pending" in out2.stdout
     assert not [f for f in os.listdir(jdir) if f.endswith(".jr")]
+
+
+# ------------------------------------------- pipelined dispatch failover
+
+
+@bounded(120)
+def test_fleet_kill_replica_mid_pipeline_requeues_once(tmp_path):
+    """PR 20 failover semantics with the dispatch pipeline armed
+    (depth 2, batch-shape ladder on): r0 dies AFTER its first batch is
+    enqueued on device, so the kill lands mid-pipeline — the in-flight
+    batch still settles (spent device time becomes a result, never a
+    failure), every batch that failed at dispatch requeues EXACTLY once
+    onto the healthy replica, the intake journal drains to zero, and the
+    front-door/artifact-store dedupe keeps chip dispatch at one per
+    request across the failover."""
+    inj = plan(Fault("kill_replica", replica="r0", at=1)).injector()
+    jdir = str(tmp_path / "journal")
+    rows = []
+    rows_lock = threading.Lock()
+
+    class PipelinedCountingEngine(FakeEngine):
+        def _call_executable(self, bucket, tokens, mask,
+                             msa=None, msa_mask=None):
+            with rows_lock:
+                rows.append(tokens.shape[0])
+            return super()._call_executable(
+                bucket, tokens, mask, msa=msa, msa_mask=msa_mask)
+
+        def _realize(self, out):
+            # device-side latency: keeps r0's first batch OUTSTANDING in
+            # the pipeline window while the kill fires on its second
+            time.sleep(0.1)
+            return out
+
+    fleet = ServingFleet(
+        {}, TINY,
+        fleet_scfg(max_batch=1, batch_ladder=True, pipeline_depth=2),
+        FleetConfig(replicas=2, probe_interval_s=0, reprobe_interval_s=30.0,
+                    fail_threshold=1, requeue_limit=2),
+        engine_factory=lambda n, c, h: PipelinedCountingEngine(
+            {}, TINY, c, fault_hook=h),
+        injector=inj,
+        artifact_store=ArtifactStore(ArtifactStoreConfig(root=None)),
+        journal=IntakeJournal(jdir))
+    try:
+        reqs = [fleet.submit(seq_of(4 + i % 3, offset=i)) for i in range(6)]
+        results = [r.result(timeout=30) for r in reqs]
+        assert all(r.coords is not None for r in results)
+        st = fleet.stats()
+        assert st["requests"]["completed"] == 6
+        assert st["requests"]["failed"] == 0
+        assert st["requests"]["in_flight"] == 0
+        # exactly-once failover: no request survives more than one
+        # requeue, and at least one batch actually rode the failover
+        assert all(r.requeues <= 1 for r in results), \
+            [(r.trace_id, r.requeues) for r in results]
+        assert st["requests"]["requeued"] >= 1
+        assert st["requests"]["requeued"] == \
+            sum(r.requeues for r in results)
+        # r0's pre-kill in-flight batch settled as a RESULT on r0: the
+        # pipeline window was not abandoned with the replica
+        assert any(r.replica == "r0" and r.requeues == 0 for r in results)
+        # dedupe across the failover: each request reached a device
+        # exactly once fleet-wide — failed dispatch attempts raise at the
+        # fault hook (before the executable) and never double-dispatch
+        assert sorted(rows) == [1] * 6, rows
+        assert st["health"]["targets"]["r0"]["state"] == "down"
+        # journal settle-unlink drains on the callback thread
+        deadline = time.monotonic() + 10
+        while (fleet._journal.pending_count() > 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert fleet._journal.pending_count() == 0
+        assert inj.exhausted()
+    finally:
+        fleet.shutdown(timeout=30)

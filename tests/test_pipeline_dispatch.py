@@ -1,0 +1,419 @@
+"""Batch-shape ladder + pipelined dispatch tests (tier-1, CPU).
+
+Two coupled serving legs (ISSUE 20): the power-of-two batch-shape ladder
+(partial batches run an executable compiled at the smallest rung >= the
+live count instead of paying phantom-row chip time at max_batch) and the
+pipelined dispatch split (assembly/dispatch worker + settle thread with a
+bounded in-flight window). The invariants pinned here:
+
+  * no aliasing: engines differing only in ladder config get distinct
+    config tags; (bucket, shape) cost cells and AOT executables never
+    collide; cascade `dense@exit{d}` cells compose with shapes
+  * billing: with batches overlapped in flight, the execute span still
+    brackets enqueue->realized per batch, the cost ledger and the
+    goodput execute account reconcile, and accounted seconds sum to
+    <= wall (no double-billed device time)
+  * failure semantics: the watchdog fires on a wedged in-flight batch
+    without killing its pipelined neighbor; shutdown(drain=True)
+    settles every in-flight batch; a settle-side poison batch splits to
+    singles and only the offender fails
+
+Scheduler tests run a `FakeModelEngine` overriding the documented
+`_call_executable` / `_realize` seams (zero XLA compiles); the
+executable-table test uses the real tiny model.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from alphafold2_tpu.constants import AA_ORDER
+from alphafold2_tpu.models import Alphafold2Config, alphafold2_init
+from alphafold2_tpu.serving import (
+    HungBatchError,
+    PredictionError,
+    ServingConfig,
+    ServingEngine,
+)
+from alphafold2_tpu.serving.bucketing import batch_shape_ladder
+
+TINY = Alphafold2Config(dim=16, depth=1, heads=2, dim_head=8, max_seq_len=16)
+# depth-3 trunk for the early-exit x ladder composition test (exit
+# checkpoints must sit strictly below the full depth)
+TINY3 = Alphafold2Config(dim=16, depth=3, heads=2, dim_head=8, max_seq_len=16)
+AA = AA_ORDER.replace("W", "")
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return alphafold2_init(jax.random.PRNGKey(0), TINY)
+
+
+def seq_of(length, offset=0):
+    return "".join(AA[(offset + i) % len(AA)] for i in range(length))
+
+
+def serving_cfg(**overrides):
+    base = dict(buckets=(8, 16), max_batch=4, max_queue=16, max_wait_s=0.05,
+                request_timeout_s=30.0, cache_capacity=0, mds_iters=4)
+    base.update(overrides)
+    return ServingConfig(**base)
+
+
+class FakeModelEngine(ServingEngine):
+    """Device call + realization stubbed at the documented seams.
+
+    `call_hook(bucket, tokens, mask)` runs inside `_call_executable`
+    (dispatch time, worker thread); `realize_hook(out)` runs inside
+    `_realize` (realization time — the settle thread in pipelined mode),
+    so tests can wedge or fail the DEVICE side specifically.
+    """
+
+    def __init__(self, *args, call_hook=None, realize_hook=None, **kwargs):
+        self.calls = 0
+        self.batch_rows = []  # (B, Lb) per dispatch: the chosen rung
+        self._hook = call_hook
+        self._realize_hook = realize_hook
+        super().__init__(*args, **kwargs)
+
+    def _call_executable(self, bucket, tokens, mask, msa=None, msa_mask=None):
+        self.calls += 1
+        self.batch_rows.append(tokens.shape)
+        if self._hook is not None:
+            self._hook(bucket, tokens, mask)
+        B, Lb = tokens.shape
+        return {
+            "coords": np.zeros((B, Lb, 3), np.float32),
+            "confidence": np.full((B, Lb), 0.5, np.float32),
+            "stress": np.zeros((B,), np.float32),
+        }
+
+    def _realize(self, out):
+        if self._realize_hook is not None:
+            self._realize_hook(out)
+        return out
+
+
+def fake_engine(**overrides):
+    call_hook = overrides.pop("call_hook", None)
+    realize_hook = overrides.pop("realize_hook", None)
+    model_cfg = overrides.pop("model_cfg", TINY)
+    return FakeModelEngine({}, model_cfg, serving_cfg(**overrides),
+                           call_hook=call_hook, realize_hook=realize_hook)
+
+
+# ------------------------------------------------------ the shape ladder
+
+
+def test_batch_shape_ladder_rungs():
+    assert batch_shape_ladder(1) == (1,)
+    assert batch_shape_ladder(2) == (1, 2)
+    # max_batch is always the top rung, power of two or not
+    assert batch_shape_ladder(3) == (1, 2, 3)
+    assert batch_shape_ladder(4) == (1, 2, 4)
+    assert batch_shape_ladder(8) == (1, 2, 4, 8)
+    assert batch_shape_ladder(12) == (1, 2, 4, 8, 12)
+    with pytest.raises(ValueError):
+        batch_shape_ladder(0)
+
+
+def test_assembly_selects_smallest_rung():
+    """A single request dispatches at shape 1, a burst of 3 at shape 4
+    (max_batch=4: rungs 1,2,4) — never the phantom-row max_batch shape."""
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def hook(bucket, tokens, mask):
+        entered.set()
+        gate.wait(timeout=30)
+
+    eng = fake_engine(batch_ladder=True, call_hook=hook)
+    try:
+        assert eng._batch_shapes == (1, 2, 4)
+        assert eng._batch_shape_for(1) == 1
+        assert eng._batch_shape_for(2) == 2
+        assert eng._batch_shape_for(3) == 4
+        assert eng._batch_shape_for(4) == 4
+        first = eng.submit(seq_of(5))
+        assert entered.wait(10)  # dispatched alone, wedged in the hook
+        burst = [eng.submit(seq_of(4 + i, offset=i)) for i in range(3)]
+        gate.set()
+        assert first.result(timeout=10).coords.shape == (5, 3)
+        for r in burst:
+            assert r.result(timeout=10).coords is not None
+        assert eng.batch_rows == [(1, 8), (4, 8)]
+        st = eng.stats()
+        assert st["batch_shapes"] == [1, 2, 4]
+        # occupancy is vs the CHOSEN shape: (1 + 3) live / (1 + 4) slots
+        assert st["batches"]["mean_occupancy"] == pytest.approx(4 / 5)
+        assert st["batches"]["pad_ratio"] == pytest.approx(1 / 4)
+    finally:
+        gate.set()
+        eng.shutdown(timeout=10)
+
+
+# ------------------------------------------------------------ no aliasing
+
+
+def test_config_tag_distinct_when_ladder_armed():
+    """Result-cache/AOT keyspaces re-key on the ladder: tags differ
+    exactly when the shape set differs (ladder off stays byte-identical
+    to the pre-ladder engine)."""
+    off_a = fake_engine()
+    off_b = fake_engine()
+    on_4 = fake_engine(batch_ladder=True)
+    on_3 = fake_engine(batch_ladder=True, max_batch=3)
+    try:
+        assert off_a.config_tag == off_b.config_tag
+        assert on_4.config_tag != off_a.config_tag
+        assert on_3.config_tag != on_4.config_tag
+        assert "batch_ladder" in on_4.config_tag
+        assert "batch_ladder" not in off_a.config_tag
+    finally:
+        for e in (off_a, off_b, on_4, on_3):
+            e.shutdown(timeout=10)
+
+
+def test_cost_cells_keyed_per_bucket_shape():
+    """Each (bucket, shape) bills its own cell, tagged `dense@b{B}`;
+    cell_for defaults to the top rung (the submit-time identity) and
+    answers {} off-ladder — shapes never blend EMAs."""
+    eng = fake_engine(batch_ladder=True)
+    legacy = fake_engine()
+    try:
+        assert eng.cell_for(8, 1)["schedule"] == "dense@b1"
+        assert eng.cell_for(8, 2)["schedule"] == "dense@b2"
+        assert eng.cell_for(8)["schedule"] == "dense@b4"  # top rung
+        assert eng.cell_for(8, 3) == {}   # 3 is not a rung of max_batch=4
+        assert eng.cell_for(999) == {}
+        scheds = {c["schedule"] for c in eng.stats()["costs"]["cells"]}
+        assert scheds == {"dense@b1", "dense@b2", "dense@b4"}
+        # unarmed engine: the classic single cell, untagged
+        assert legacy.cell_for(8)["schedule"] == "dense"
+        assert legacy.cell_for(8, 1) == {}
+        assert {c["schedule"] for c in legacy.stats()["costs"]["cells"]} \
+            == {"dense"}
+        # a 1-row dispatch bills the b1 cell ONLY
+        eng.predict(seq_of(5))
+        cells = {c["schedule"]: c for c in eng.stats()["costs"]["cells"]
+                 if c["bucket"] == 8}
+        assert cells["dense@b1"]["requests"] == 1
+        assert cells["dense@b2"]["requests"] == 0
+        assert cells["dense@b4"]["requests"] == 0
+    finally:
+        eng.shutdown(timeout=10)
+        legacy.shutdown(timeout=10)
+
+
+def test_exit_cells_compose_with_shapes():
+    """Cascade early-exit cells cross the ladder: one `dense@exit{d}@b{B}`
+    cell per (bucket, depth, shape), alongside the per-shape trunk cells."""
+    eng = fake_engine(model_cfg=TINY3, buckets=(8,), max_batch=2,
+                      batch_ladder=True, early_exit_depths=(1, 2),
+                      early_exit_kl=0.1)
+    try:
+        # the first checkpoint is the delta-KL baseline (never exits), so
+        # only depth 2 gets cells — one per ladder rung
+        scheds = {c["schedule"] for c in eng.stats()["costs"]["cells"]}
+        assert scheds == {
+            "dense@b1", "dense@b2",
+            "dense@exit2@b1", "dense@exit2@b2",
+        }
+    finally:
+        eng.shutdown(timeout=10)
+
+
+def test_real_executables_keyed_per_shape(tiny_params):
+    """The AOT table is keyed on (bucket, shape): precompile warms every
+    rung, a served request runs (not recompiles) its rung's binary, and
+    `compile_count` keeps the <= len(buckets) distinct-bucket invariant."""
+    eng = ServingEngine(tiny_params, TINY, ServingConfig(
+        buckets=(8,), max_batch=2, max_wait_s=0.0, mds_iters=2,
+        cache_capacity=0, batch_ladder=True, precompile=True))
+    try:
+        assert set(eng._executables) == {(8, 1), (8, 2)}
+        assert eng.compile_count == 1  # shapes accumulate under the bucket
+        exes = dict(eng._executables)
+        res = eng.predict(seq_of(5))
+        assert res.coords.shape == (5, 3)
+        assert eng._executables == exes  # served from the warm table
+        cells = {c["schedule"]: c for c in eng.stats()["costs"]["cells"]}
+        assert cells["dense@b1"]["requests"] == 1
+        assert cells["dense@b2"]["requests"] == 0
+    finally:
+        eng.shutdown(timeout=10)
+
+
+# ------------------------------------------------------ pipelined dispatch
+
+
+def test_pipelined_overlap_and_billing_reconcile():
+    """The headline invariant pair: with depth 2 and device-side realize
+    latency, spans overlap (overlap_ratio > 1.0) while the watermark
+    clamp keeps accounted device seconds non-overlapping — goodput sums
+    to <= wall and the cost ledger equals the execute account exactly."""
+    eng = fake_engine(max_batch=1, pipeline_depth=2,
+                      realize_hook=lambda out: time.sleep(0.05))
+    try:
+        reqs = [eng.submit(seq_of(4 + i % 3, offset=i)) for i in range(6)]
+        for r in reqs:
+            assert r.result(timeout=30).coords is not None
+        st = eng.stats()
+        assert st["requests"]["completed"] == 6
+        pipe = st["pipeline"]
+        assert pipe["depth"] == 2
+        assert pipe["inflight"] == 0
+        # batch N's enqueue->realized span covers batch N-1's realize
+        # tail: cumulative span / non-overlapped window must exceed 1
+        assert pipe["overlap_ratio"] > 1.05, pipe
+        assert pipe["window_seconds"] == pytest.approx(
+            st["serve_goodput"]["replicas"]["engine"]["buckets"]["execute"],
+            rel=1e-6)
+        # no double-billed device seconds across in-flight batches
+        total = sum(eng.goodput.totals("engine").values())
+        assert total <= eng.goodput.wall("engine") * 1.01 + 1e-6
+        # ledger == goodput execute (fake: no compile to subtract)
+        assert eng.costs.fleet_chip_seconds_total() == pytest.approx(
+            st["serve_goodput"]["replicas"]["engine"]["buckets"]["execute"],
+            rel=1e-6)
+        gauges = st["telemetry"]["metrics"]["gauges"]
+        assert gauges["serve_pipeline_overlap_ratio"] > 1.05
+        assert gauges["serve_pipeline_inflight"] == 0
+    finally:
+        eng.shutdown(timeout=10)
+
+
+def test_watchdog_isolates_wedged_inflight_neighbor():
+    """A wedged in-flight realization trips ITS watchdog and is
+    abandoned; the pipelined neighbor behind it gets a fresh window and
+    completes — one hung batch never takes the pipeline down."""
+    wedge = threading.Event()
+    state = {"n": 0}
+    lock = threading.Lock()
+
+    def realize_hook(out):
+        with lock:
+            state["n"] += 1
+            first = state["n"] == 1
+        if first:
+            wedge.wait(timeout=30)  # far past the watchdog
+
+    eng = fake_engine(max_batch=1, pipeline_depth=2,
+                      watchdog_timeout_s=0.25, realize_hook=realize_hook)
+    try:
+        victim = eng.submit(seq_of(4))
+        neighbor = eng.submit(seq_of(5))
+        with pytest.raises(HungBatchError, match="watchdog"):
+            victim.result(timeout=10)
+        assert neighbor.result(timeout=10).coords.shape == (5, 3)
+        st = eng.stats()
+        assert st["errors"]["hung_batch"] == 1
+        assert st["requests"]["completed"] == 1
+        assert st["requests"]["failed"] == 1
+        assert st["pipeline"]["inflight"] == 0
+        # the settle thread survived: fresh traffic serves
+        assert eng.submit(seq_of(6)).result(timeout=10).coords is not None
+    finally:
+        wedge.set()  # unwedge the orphaned runner before teardown
+        eng.shutdown(timeout=10)
+
+
+def test_shutdown_drain_settles_all_inflight():
+    """drain=True's promise covers the pipeline window: batches enqueued
+    on device when shutdown lands still settle (the stop sentinel is
+    enqueued LAST), so their spent device time becomes results."""
+    dispatched = threading.Event()
+
+    def realize_hook(out):
+        dispatched.set()
+        time.sleep(0.15)
+
+    eng = fake_engine(max_batch=1, pipeline_depth=2,
+                      realize_hook=realize_hook)
+    reqs = [eng.submit(seq_of(4)), eng.submit(seq_of(5))]
+    assert dispatched.wait(10)  # both enqueued or enqueueing
+    eng.shutdown(drain=True, timeout=30)
+    for r, length in zip(reqs, (4, 5)):
+        assert r.result(timeout=1).coords.shape == (length, 3)
+    st = eng.stats()
+    assert st["requests"]["completed"] == 2
+    assert st["pipeline"]["inflight"] == 0
+    assert not eng._settle_thread.is_alive()
+
+
+def test_settle_side_poison_splits_to_singles():
+    """A batch that fails at REALIZATION (settle thread) splits exactly
+    like a dispatch-time failure: batchmates retry as singles and only
+    the poison request fails."""
+    poison_seq = "W" * 5
+    w_token = AA_ORDER.index("W")
+
+    def realize_hook(out):
+        if out.get("poison"):
+            raise RuntimeError("injected device fault")
+
+    eng = fake_engine(max_batch=3, batch_ladder=True, pipeline_depth=2,
+                      max_wait_s=0.5, realize_hook=realize_hook)
+
+    real_call = FakeModelEngine._call_executable
+
+    def marking_call(self, bucket, tokens, mask, msa=None, msa_mask=None):
+        out = real_call(self, bucket, tokens, mask, msa=msa, msa_mask=msa_mask)
+        out["poison"] = bool(np.any(tokens == w_token))
+        return out
+
+    eng._call_executable = marking_call.__get__(eng)
+    try:
+        # three submits inside one assembly window -> one shape-3 batch
+        good_a = eng.submit(seq_of(4))
+        bad = eng.submit(poison_seq)
+        good_b = eng.submit(seq_of(6))
+        assert good_a.result(timeout=10).coords.shape == (4, 3)
+        assert good_b.result(timeout=10).coords.shape == (6, 3)
+        with pytest.raises(PredictionError):
+            bad.result(timeout=10)
+        st = eng.stats()
+        assert st["requests"]["completed"] == 2
+        assert st["requests"]["failed"] == 1
+        assert st["pipeline"]["inflight"] == 0
+        # batch of 3 at rung 3? no — rungs of max_batch=3 are (1,2,3);
+        # first dispatch took all three at shape 3, retries ran singles
+        assert eng.batch_rows[0] == (3, 8)
+        assert eng.batch_rows[1:] == [(1, 8), (1, 8), (1, 8)]
+    finally:
+        eng.shutdown(timeout=10)
+
+
+def test_retry_after_uses_drain_rate_ema():
+    """Shed clients are quoted from the measured drain rate, not the
+    full-batch p50 assumption: the estimate tracks the EMA once batches
+    have settled, and falls back to a clamped heuristic when cold."""
+    eng = fake_engine()
+    try:
+        cold = eng.retry_after_estimate()
+        assert 0.05 <= cold <= 60.0
+        with eng._rate_lock:
+            eng._sec_per_req_ema = 2.0
+        est = eng.retry_after_estimate()  # empty queue -> backlog of 1
+        assert est == pytest.approx(eng.cfg.max_wait_s + 2.0, abs=0.01)
+        with eng._rate_lock:
+            eng._sec_per_req_ema = 120.0
+        assert eng.retry_after_estimate() == 60.0  # actionable clamp
+    finally:
+        eng.shutdown(timeout=10)
+
+
+def test_drain_ema_feeds_from_settled_batches():
+    """The EMA arms from real settles in both dispatch modes."""
+    for depth in (0, 2):
+        eng = fake_engine(max_batch=1, pipeline_depth=depth)
+        try:
+            for i in range(3):
+                eng.predict(seq_of(4, offset=i))
+            with eng._rate_lock:
+                assert eng._sec_per_req_ema > 0.0
+        finally:
+            eng.shutdown(timeout=10)
